@@ -1,0 +1,22 @@
+"""bsseqconsensusreads_tpu — a TPU-native duplex-consensus framework for BS-seq / EM-seq.
+
+A from-scratch re-design of the capabilities of Wubeizhongxinghua/BSSeqConsensusReads
+(reference mounted read-only at /root/reference) for TPU hardware:
+
+* ``io``        — first-party BGZF/BAM/FASTA/FASTQ codecs (pure Python + native C++),
+                  replacing the reference's pysam/samtools dependency
+                  (reference: tools/1.convert_AG_to_CT.py:25, main.snake.py:93).
+* ``ops``       — pure-JAX array transforms and consensus math: tensorization,
+                  AG->CT B-strand conversion (reference: tools/1.convert_AG_to_CT.py),
+                  gap extension (reference: tools/2.extend_gap.py), Pallas kernels.
+* ``models``    — the consensus "model family": molecular (single-strand) and duplex
+                  callers with the fgbio error model surface used by the reference
+                  (reference: main.snake.py:54,163).
+* ``parallel``  — jax.sharding Mesh / shard_map sharding of the MI-family axis and
+                  segmented reductions for deep families.
+* ``pipeline``  — host-side record ops (SamToFastq / ZipperBams / sorts / filters
+                  equivalents) and a file-DAG workflow engine with mtime-based rerun
+                  (the reference uses Snakemake; reference: main.snake.py:40-189).
+"""
+
+__version__ = "0.1.0"

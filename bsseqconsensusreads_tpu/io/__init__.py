@@ -1,0 +1,23 @@
+"""First-party sequencing-data codecs: BGZF, BAM, FASTA, FASTQ.
+
+The reference delegates all record I/O to pysam/htslib and samtools
+(reference: tools/1.convert_AG_to_CT.py:25-26, main.snake.py:93). This package
+implements the formats directly in a pure-Python codec. (A native C++ codec
+for the hot decode path is planned under native/ and will be preferred when
+built; until then this is the only codec.)
+"""
+
+from bsseqconsensusreads_tpu.io.bam import (  # noqa: F401
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    CIGAR_OPS,
+    CDEL,
+    CHARD_CLIP,
+    CINS,
+    CMATCH,
+    CSOFT_CLIP,
+)
+from bsseqconsensusreads_tpu.io.bgzf import BgzfReader, BgzfWriter  # noqa: F401
+from bsseqconsensusreads_tpu.io.fasta import FastaFile  # noqa: F401

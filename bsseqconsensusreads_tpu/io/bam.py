@@ -1,0 +1,379 @@
+"""BAM record model and codec (pure Python).
+
+First-party replacement for the pysam.AlignmentFile surface the reference uses
+(reference: tools/1.convert_AG_to_CT.py:67-68, tools/2.extend_gap.py:149-152):
+streaming reader, template-based writer, record field/tag access and mutation.
+
+BAM layout (SAM spec §4): BGZF-compressed stream of
+  magic "BAM\\1" | l_text | text | n_ref | (l_name name l_ref)*
+then per alignment:
+  block_size refID pos l_read_name mapq bin n_cigar_op flag l_seq
+  next_refID next_pos tlen read_name\\0 cigar[u32*] seq[nibbles] qual[u8*] tags
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Iterable, Iterator
+
+from bsseqconsensusreads_tpu.io.bgzf import BgzfReader, BgzfWriter
+
+BAM_MAGIC = b"BAM\x01"
+
+# CIGAR op codes and letters (SAM spec order).
+CIGAR_OPS = "MIDNSHP=X"
+CMATCH, CINS, CDEL, CREF_SKIP, CSOFT_CLIP, CHARD_CLIP, CPAD, CEQUAL, CDIFF = range(9)
+_CONSUMES_REF = (True, False, True, True, False, False, False, True, True)
+_CONSUMES_QUERY = (True, True, False, False, True, False, False, True, True)
+
+# 4-bit base codes.
+SEQ_NT16 = "=ACMGRSVTWYHKDBN"
+_NT16_OF = {c: i for i, c in enumerate(SEQ_NT16)}
+for _c in "acmgrsvtwyhkdbn":
+    _NT16_OF[_c] = _NT16_OF[_c.upper()]
+# Byte -> two-base string table so seq decode is one dict-free pass per byte.
+_NT16_PAIRS = [SEQ_NT16[b >> 4] + SEQ_NT16[b & 0xF] for b in range(256)]
+
+# SAM flag bits.
+FPAIRED, FPROPER_PAIR, FUNMAP, FMUNMAP = 0x1, 0x2, 0x4, 0x8
+FREVERSE, FMREVERSE, FREAD1, FREAD2 = 0x10, 0x20, 0x40, 0x80
+FSECONDARY, FQCFAIL, FDUP, FSUPPLEMENTARY = 0x100, 0x200, 0x400, 0x800
+
+
+class BamError(IOError):
+    pass
+
+
+@dataclass
+class BamHeader:
+    """SAM header text plus the binary reference dictionary."""
+
+    text: str = ""
+    references: list[tuple[str, int]] = field(default_factory=list)
+
+    def ref_id(self, name: str) -> int:
+        for i, (n, _) in enumerate(self.references):
+            if n == name:
+                return i
+        return -1
+
+    def ref_name(self, rid: int) -> str:
+        if 0 <= rid < len(self.references):
+            return self.references[rid][0]
+        return "*"
+
+    def copy(self) -> "BamHeader":
+        return BamHeader(self.text, list(self.references))
+
+
+@dataclass
+class BamRecord:
+    """One alignment record. pos is 0-based; qual holds raw Phred ints.
+
+    tags maps 2-char keys to (type_char, value); type chars follow the SAM tag
+    grammar (A c C s S i I f Z H B). For 'B', value is (subtype_char, list).
+    """
+
+    qname: str = "*"
+    flag: int = 0
+    ref_id: int = -1
+    pos: int = -1
+    mapq: int = 0
+    cigar: list[tuple[int, int]] = field(default_factory=list)
+    next_ref_id: int = -1
+    next_pos: int = -1
+    tlen: int = 0
+    seq: str = ""
+    qual: bytes | None = None
+    tags: dict[str, tuple[str, Any]] = field(default_factory=dict)
+
+    # -- flag predicates -------------------------------------------------
+    @property
+    def is_paired(self) -> bool:
+        return bool(self.flag & FPAIRED)
+
+    @property
+    def is_unmapped(self) -> bool:
+        return bool(self.flag & FUNMAP)
+
+    @property
+    def is_reverse(self) -> bool:
+        return bool(self.flag & FREVERSE)
+
+    @property
+    def is_read1(self) -> bool:
+        return bool(self.flag & FREAD1)
+
+    @property
+    def is_read2(self) -> bool:
+        return bool(self.flag & FREAD2)
+
+    @property
+    def is_secondary(self) -> bool:
+        return bool(self.flag & FSECONDARY)
+
+    @property
+    def is_supplementary(self) -> bool:
+        return bool(self.flag & FSUPPLEMENTARY)
+
+    # -- geometry --------------------------------------------------------
+    @property
+    def reference_length(self) -> int:
+        return sum(ln for op, ln in self.cigar if _CONSUMES_REF[op])
+
+    @property
+    def reference_end(self) -> int:
+        """0-based exclusive end (pos + ref-consumed length)."""
+        return self.pos + self.reference_length
+
+    @property
+    def query_length(self) -> int:
+        return sum(ln for op, ln in self.cigar if _CONSUMES_QUERY[op])
+
+    # -- tags ------------------------------------------------------------
+    def get_tag(self, key: str) -> Any:
+        return self.tags[key][1]
+
+    def has_tag(self, key: str) -> bool:
+        return key in self.tags
+
+    def set_tag(self, key: str, value: Any, type_char: str | None = None) -> None:
+        if type_char is None:
+            if isinstance(value, int):
+                type_char = "i"
+            elif isinstance(value, float):
+                type_char = "f"
+            elif isinstance(value, str):
+                type_char = "Z"
+            else:
+                raise TypeError(f"cannot infer tag type for {value!r}")
+        self.tags[key] = (type_char, value)
+
+    def cigar_string(self) -> str:
+        if not self.cigar:
+            return "*"
+        return "".join(f"{ln}{CIGAR_OPS[op]}" for op, ln in self.cigar)
+
+    def copy(self) -> "BamRecord":
+        return BamRecord(
+            self.qname, self.flag, self.ref_id, self.pos, self.mapq,
+            list(self.cigar), self.next_ref_id, self.next_pos, self.tlen,
+            self.seq, self.qual, dict(self.tags),
+        )
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """BAI binning (SAM spec §5.3)."""
+    end -= 1
+    if end < 0:
+        end = 0
+    if beg < 0:
+        beg = 0
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+_TAG_FMT = {"c": "<b", "C": "<B", "s": "<h", "S": "<H", "i": "<i", "I": "<I", "f": "<f"}
+
+
+def _decode_tags(data: bytes, off: int) -> dict[str, tuple[str, Any]]:
+    tags: dict[str, tuple[str, Any]] = {}
+    n = len(data)
+    while off < n:
+        key = data[off : off + 2].decode("ascii")
+        tc = chr(data[off + 2])
+        off += 3
+        if tc == "A":
+            tags[key] = ("A", chr(data[off]))
+            off += 1
+        elif tc in _TAG_FMT:
+            fmt = _TAG_FMT[tc]
+            tags[key] = (tc, struct.unpack_from(fmt, data, off)[0])
+            off += struct.calcsize(fmt)
+        elif tc in ("Z", "H"):
+            end = data.index(0, off)
+            tags[key] = (tc, data[off:end].decode("ascii"))
+            off = end + 1
+        elif tc == "B":
+            sub = chr(data[off])
+            count = struct.unpack_from("<I", data, off + 1)[0]
+            off += 5
+            fmt = _TAG_FMT[sub]
+            size = struct.calcsize(fmt)
+            vals = list(struct.unpack_from(f"<{count}{fmt[1]}", data, off))
+            tags[key] = ("B", (sub, vals))
+            off += count * size
+        else:
+            raise BamError(f"unknown tag type {tc!r} for {key}")
+    return tags
+
+
+def _encode_tags(tags: dict[str, tuple[str, Any]]) -> bytes:
+    out = bytearray()
+    for key, (tc, val) in tags.items():
+        out += key.encode("ascii")
+        if tc == "A":
+            out += b"A" + ord(val).to_bytes(1, "little")
+        elif tc in _TAG_FMT:
+            out += tc.encode("ascii") + struct.pack(_TAG_FMT[tc], val)
+        elif tc in ("Z", "H"):
+            out += tc.encode("ascii") + val.encode("ascii") + b"\x00"
+        elif tc == "B":
+            sub, vals = val
+            out += b"B" + sub.encode("ascii") + struct.pack("<I", len(vals))
+            out += struct.pack(f"<{len(vals)}{_TAG_FMT[sub][1]}", *vals)
+        else:
+            raise BamError(f"unknown tag type {tc!r} for {key}")
+    return bytes(out)
+
+
+_REC_FIXED = struct.Struct("<iiBBHHHIiii")  # refID..tlen after block_size (32 bytes)
+
+
+def decode_record(data: bytes) -> BamRecord:
+    """Decode one alignment from its variable-size data (sans block_size)."""
+    (ref_id, pos, l_qname, mapq, _bin, n_cigar, flag, l_seq, next_ref, next_pos, tlen) = _REC_FIXED.unpack_from(data, 0)
+    off = 32
+    qname = data[off : off + l_qname - 1].decode("ascii")
+    off += l_qname
+    cigar = []
+    for _ in range(n_cigar):
+        v = struct.unpack_from("<I", data, off)[0]
+        cigar.append((v & 0xF, v >> 4))
+        off += 4
+    nbytes = (l_seq + 1) // 2
+    pairs = _NT16_PAIRS
+    seq = "".join([pairs[b] for b in data[off : off + nbytes]])[:l_seq]
+    off += nbytes
+    qual_raw = data[off : off + l_seq]
+    qual = None if (l_seq == 0 or (qual_raw and qual_raw[0] == 0xFF)) else qual_raw
+    off += l_seq
+    tags = _decode_tags(data, off)
+    return BamRecord(qname, flag, ref_id, pos, mapq, cigar, next_ref, next_pos, tlen, seq, qual, tags)
+
+
+def encode_record(rec: BamRecord) -> bytes:
+    """Encode one alignment including its leading block_size field."""
+    qname_b = rec.qname.encode("ascii") + b"\x00"
+    l_seq = len(rec.seq)
+    body = bytearray()
+    body += _REC_FIXED.pack(
+        rec.ref_id,
+        rec.pos,
+        len(qname_b),
+        rec.mapq,
+        reg2bin(rec.pos if rec.pos >= 0 else 0, rec.reference_end if rec.cigar else (rec.pos + 1 if rec.pos >= 0 else 1)),
+        len(rec.cigar),
+        rec.flag,
+        l_seq,
+        rec.next_ref_id,
+        rec.next_pos,
+        rec.tlen,
+    )
+    body += qname_b
+    for op, ln in rec.cigar:
+        body += struct.pack("<I", (ln << 4) | op)
+    nibbles = bytearray((l_seq + 1) // 2)
+    for i, c in enumerate(rec.seq):
+        code = _NT16_OF.get(c, 15)
+        if i % 2 == 0:
+            nibbles[i >> 1] |= code << 4
+        else:
+            nibbles[i >> 1] |= code
+    body += nibbles
+    if rec.qual is None:
+        body += b"\xff" * l_seq
+    else:
+        if len(rec.qual) != l_seq:
+            raise BamError(
+                f"qual length {len(rec.qual)} != seq length {l_seq} for {rec.qname}"
+            )
+        body += rec.qual
+    body += _encode_tags(rec.tags)
+    return struct.pack("<i", len(body)) + bytes(body)
+
+
+class BamReader:
+    """Streaming BAM reader (iterate to get BamRecords)."""
+
+    def __init__(self, path: str):
+        self._bgzf = BgzfReader.open(path)
+        magic = self._bgzf.read(4)
+        if magic != BAM_MAGIC:
+            raise BamError(f"{path}: not a BAM file")
+        (l_text,) = struct.unpack("<i", self._bgzf.read(4))
+        text = self._bgzf.read(l_text).decode("utf-8", "replace").rstrip("\x00")
+        (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
+        refs = []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", self._bgzf.read(4))
+            name = self._bgzf.read(l_name)[:-1].decode("ascii")
+            (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
+            refs.append((name, l_ref))
+        self.header = BamHeader(text, refs)
+
+    def __iter__(self) -> Iterator[BamRecord]:
+        while True:
+            raw = self._bgzf.read(4)
+            if len(raw) < 4:
+                return
+            (block_size,) = struct.unpack("<i", raw)
+            data = self._bgzf.read(block_size)
+            if len(data) < block_size:
+                raise BamError("truncated BAM record")
+            yield decode_record(data)
+
+    def get_reference_name(self, rid: int) -> str:
+        return self.header.ref_name(rid)
+
+    def close(self) -> None:
+        self._bgzf.close()
+
+    def __enter__(self) -> "BamReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class BamWriter:
+    """Streaming BAM writer; pass the header (e.g. reader.header) up front."""
+
+    def __init__(self, path: str, header: BamHeader, level: int = 6):
+        self.header = header
+        self._bgzf = BgzfWriter.open(path, level=level)
+        text = header.text.encode("utf-8")
+        out = bytearray(BAM_MAGIC)
+        out += struct.pack("<i", len(text))
+        out += text
+        out += struct.pack("<i", len(header.references))
+        for name, length in header.references:
+            nb = name.encode("ascii") + b"\x00"
+            out += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+        self._bgzf.write(bytes(out))
+
+    def write(self, rec: BamRecord) -> None:
+        self._bgzf.write(encode_record(rec))
+
+    def write_all(self, recs: Iterable[BamRecord]) -> None:
+        for rec in recs:
+            self.write(rec)
+
+    def close(self) -> None:
+        self._bgzf.close()
+
+    def __enter__(self) -> "BamWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,47 @@
+"""FASTQ writing — replacement for Picard SamToFastq.
+
+The reference shells out to `java -jar picard SamToFastq I=… F=… F2=…`
+(reference: main.snake.py:67,79,176) to split an unaligned consensus BAM into
+a gzipped R1/R2 FASTQ pair. This module does the same from BamRecords:
+read1 -> F, read2 -> F2, reverse-strand records are reverse-complemented back
+to sequencing orientation (Picard's default behavior).
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Iterable
+
+from bsseqconsensusreads_tpu.io.bam import BamRecord, FREAD2, FREVERSE
+
+_COMPLEMENT = str.maketrans("ACGTNacgtn", "TGCANtgcan")
+
+
+def reverse_complement(seq: str) -> str:
+    return seq.translate(_COMPLEMENT)[::-1]
+
+
+def qual_to_ascii(qual: bytes | None, length: int) -> str:
+    if qual is None:
+        return "!" * length
+    return "".join(chr(min(q, 93) + 33) for q in qual)
+
+
+def sam_to_fastq(records: Iterable[BamRecord], fq1_path: str, fq2_path: str) -> tuple[int, int]:
+    """Split records into paired gzipped FASTQs; returns (n_r1, n_r2)."""
+    n1 = n2 = 0
+    with gzip.open(fq1_path, "wt") as f1, gzip.open(fq2_path, "wt") as f2:
+        for rec in records:
+            if rec.flag & 0x900:  # secondary/supplementary never exported
+                continue
+            seq, qual = rec.seq, qual_to_ascii(rec.qual, len(rec.seq))
+            if rec.flag & FREVERSE:
+                seq = reverse_complement(seq)
+                qual = qual[::-1]
+            if rec.flag & FREAD2:
+                f2.write(f"@{rec.qname}/2\n{seq}\n+\n{qual}\n")
+                n2 += 1
+            else:
+                f1.write(f"@{rec.qname}/1\n{seq}\n+\n{qual}\n")
+                n1 += 1
+    return n1, n2

@@ -1,0 +1,1 @@
+"""Shared utilities: flag vocabulary, observability, testing helpers."""

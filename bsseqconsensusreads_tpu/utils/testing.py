@@ -1,0 +1,159 @@
+"""Synthetic data generators for tests and benchmarks.
+
+The reference ships no tests and no fixtures (SURVEY.md §4); its author
+smoke-tested on an `input/test.bam`. These generators produce the same shape
+of data: a reference genome, raw UMI-grouped read families (the output contract
+of `fgbio GroupReadsByUmi -s Paired`, reference: README.md:51-55 — RX = UMI,
+MI = group id with /A | /B strand suffixes), and aligned consensus-read duplex
+groups with flags {99, 163, 83, 147}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecord,
+    CMATCH,
+    CSOFT_CLIP,
+)
+from bsseqconsensusreads_tpu.io.fastq import reverse_complement
+
+BASES = "ACGT"
+
+
+def random_genome(rng: np.random.Generator, length: int = 5000, name: str = "chr1") -> tuple[str, str]:
+    seq = "".join(BASES[i] for i in rng.integers(0, 4, size=length))
+    return name, seq
+
+
+def write_fasta(path: str, name: str, seq: str, width: int = 60) -> None:
+    with open(path, "w") as fh:
+        fh.write(f">{name}\n")
+        for i in range(0, len(seq), width):
+            fh.write(seq[i : i + width] + "\n")
+
+
+def simulate_read(
+    rng: np.random.Generator,
+    genome: str,
+    start: int,
+    length: int,
+    error_rate: float = 0.01,
+) -> tuple[str, bytes]:
+    """Draw a read from genome[start:start+length] with random substitutions."""
+    frag = list(genome[start : start + length])
+    quals = rng.integers(20, 41, size=len(frag)).astype(np.uint8)
+    for i in range(len(frag)):
+        if rng.random() < error_rate:
+            frag[i] = BASES[rng.integers(0, 4)]
+    return "".join(frag), bytes(quals)
+
+
+def bisulfite_convert(seq: str, genome: str, start: int, strand: str, meth_cpg: bool = True) -> str:
+    """Apply bisulfite chemistry to a fragment in top-strand coordinates.
+
+    Top ('A') strand: unmethylated C -> T; CpG Cs stay C when methylated.
+    Bottom ('B') strand: the complementary strand converts, which reads out on
+    the top-strand coordinates as G -> A (except methylated CpG Gs).
+    """
+    out = list(seq)
+    n = len(genome)
+    for i, b in enumerate(out):
+        gpos = start + i
+        if strand == "A" and b == "C":
+            in_cpg = gpos + 1 < n and genome[gpos + 1] == "G"
+            if not (meth_cpg and in_cpg):
+                out[i] = "T"
+        elif strand == "B" and b == "G":
+            in_cpg = gpos - 1 >= 0 and genome[gpos - 1] == "C"
+            if not (meth_cpg and in_cpg):
+                out[i] = "A"
+    return "".join(out)
+
+
+def make_grouped_bam_records(
+    rng: np.random.Generator,
+    genome_name: str,
+    genome: str,
+    n_families: int = 8,
+    reads_per_strand: tuple[int, int] = (2, 4),
+    read_len: int = 50,
+    error_rate: float = 0.01,
+) -> tuple[BamHeader, list[BamRecord]]:
+    """Simulate the GroupReadsByUmi -s Paired output BAM: raw paired reads,
+    RX tag = umi pair, MI tag = '<group>/A' or '<group>/B'."""
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [(genome_name, len(genome))])
+    records: list[BamRecord] = []
+    for fam in range(n_families):
+        frag_start = int(rng.integers(10, len(genome) - 3 * read_len))
+        frag_len = int(rng.integers(read_len + 10, 2 * read_len))
+        umi = "".join(BASES[i] for i in rng.integers(0, 4, size=8))
+        umi2 = "".join(BASES[i] for i in rng.integers(0, 4, size=8))
+        r2_start = frag_start + frag_len - read_len
+        for strand in "AB":
+            depth = int(rng.integers(reads_per_strand[0], reads_per_strand[1] + 1))
+            for d in range(depth):
+                left_seq, left_qual = simulate_read(rng, genome, frag_start, read_len, error_rate)
+                right_seq, right_qual = simulate_read(rng, genome, r2_start, read_len, error_rate)
+                left_seq = bisulfite_convert(left_seq, genome, frag_start, strand)
+                right_seq = bisulfite_convert(right_seq, genome, r2_start, strand)
+                qname = f"fam{fam}:{strand}:{d}"
+                # A strand: left read is R1 forward (99), right is R2 reverse (147).
+                # B strand: left read is R2 forward (163), right is R1 reverse (83).
+                left_flag, right_flag = (99, 147) if strand == "A" else (163, 83)
+                rx = f"{umi}-{umi2}"
+                mi = f"{fam}/{strand}"
+                left = BamRecord(
+                    qname=qname, flag=left_flag, ref_id=0, pos=frag_start,
+                    mapq=60, cigar=[(CMATCH, read_len)], next_ref_id=0,
+                    next_pos=r2_start, tlen=frag_len, seq=left_seq, qual=left_qual,
+                )
+                right = BamRecord(
+                    qname=qname, flag=right_flag, ref_id=0, pos=r2_start, mapq=60,
+                    cigar=[(CMATCH, read_len)], next_ref_id=0,
+                    next_pos=frag_start, tlen=-frag_len, seq=right_seq, qual=right_qual,
+                )
+                for rec in (left, right):
+                    rec.set_tag("RX", rx, "Z")
+                    rec.set_tag("MI", mi, "Z")
+                    records.append(rec)
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return header, records
+
+
+def make_aligned_duplex_group(
+    rng: np.random.Generator,
+    genome_name: str,
+    genome: str,
+    mi: int,
+    start: int,
+    length: int,
+    softclip: int = 0,
+) -> list[BamRecord]:
+    """One aligned duplex group of 4 single-strand consensus reads with flags
+    99/163/83/147 spanning [start, start+length) — the input shape of the
+    convert/extend/duplex stages (reference: main.snake.py:121-164)."""
+    recs = []
+    frag = genome[start : start + length]
+    a_seq = bisulfite_convert(frag, genome, start, "A")
+    b_seq = bisulfite_convert(frag, genome, start, "B")
+    qual = bytes(rng.integers(30, 41, size=length).astype(np.uint8))
+    for flag, strand, seq in ((99, "A", a_seq), (163, "B", b_seq), (83, "B", b_seq), (147, "A", a_seq)):
+        cigar = [(CMATCH, length)]
+        out_seq, out_qual, pos = seq, qual, start
+        if softclip and flag in (99, 163):
+            clip = "".join(BASES[i] for i in rng.integers(0, 4, size=softclip))
+            out_seq = clip + seq
+            out_qual = bytes([2] * softclip) + qual
+            cigar = [(CSOFT_CLIP, softclip), (CMATCH, length)]
+        rec = BamRecord(
+            qname=f"mi{mi}:{flag}", flag=flag, ref_id=0, pos=pos, mapq=60,
+            cigar=cigar, next_ref_id=0, next_pos=start, tlen=length,
+            seq=out_seq, qual=out_qual,
+        )
+        rec.set_tag("MI", f"{mi}/{'A' if strand == 'A' else 'B'}", "Z")
+        rec.set_tag("RX", "ACGTACGT-TGCATGCA", "Z")
+        recs.append(rec)
+    return recs

@@ -1,0 +1,203 @@
+"""Round-trip tests for the first-party BGZF/BAM/FASTA/FASTQ codecs."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    CMATCH,
+    CSOFT_CLIP,
+    decode_record,
+    encode_record,
+)
+from bsseqconsensusreads_tpu.io.bgzf import BGZF_EOF, BgzfReader, BgzfWriter, is_bgzf
+from bsseqconsensusreads_tpu.io.fasta import FastaFile
+from bsseqconsensusreads_tpu.io.fastq import reverse_complement, sam_to_fastq
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+
+class TestBgzf:
+    def test_roundtrip_small(self, tmp_path):
+        path = str(tmp_path / "x.bgzf")
+        payload = b"hello bgzf world" * 100
+        with BgzfWriter.open(path) as w:
+            w.write(payload)
+        with BgzfReader.open(path) as r:
+            assert r.read_all() == payload
+        assert is_bgzf(path)
+
+    def test_roundtrip_multiblock(self, tmp_path):
+        path = str(tmp_path / "big.bgzf")
+        rng = np.random.default_rng(0)
+        payload = rng.integers(0, 256, size=300_000, dtype=np.uint8).tobytes()
+        with BgzfWriter.open(path) as w:
+            for i in range(0, len(payload), 9973):
+                w.write(payload[i : i + 9973])
+        with BgzfReader.open(path) as r:
+            got = r.read(len(payload))
+            assert got == payload
+            assert r.read(10) == b""
+
+    def test_eof_marker(self, tmp_path):
+        path = str(tmp_path / "x.bgzf")
+        with BgzfWriter.open(path) as w:
+            w.write(b"abc")
+        data = open(path, "rb").read()
+        assert data.endswith(BGZF_EOF)
+
+    def test_missing_eof_marker_detected(self, tmp_path):
+        # A writer killed after flush but before close leaves no EOF block;
+        # the reader must not silently treat the file as complete.
+        path = str(tmp_path / "x.bgzf")
+        with BgzfWriter.open(path) as w:
+            w.write(b"payload" * 10)
+        data = open(path, "rb").read()
+        open(path, "wb").write(data[: -len(BGZF_EOF)])
+        r = BgzfReader.open(path)
+        with pytest.raises(IOError, match="EOF marker"):
+            r.read_all()
+
+    def test_gzip_interop(self, tmp_path):
+        # BGZF is valid gzip: stdlib gzip must decompress the concatenation.
+        path = str(tmp_path / "x.bgzf")
+        with BgzfWriter.open(path) as w:
+            w.write(b"interop-check" * 50)
+        assert gzip.open(path, "rb").read() == b"interop-check" * 50
+
+
+def _sample_record() -> BamRecord:
+    rec = BamRecord(
+        qname="q1", flag=99, ref_id=0, pos=100, mapq=60,
+        cigar=[(CSOFT_CLIP, 3), (CMATCH, 10)], next_ref_id=0, next_pos=200,
+        tlen=150, seq="ACGTNACGTACGT", qual=bytes(range(13)),
+    )
+    rec.set_tag("MI", "42/A", "Z")
+    rec.set_tag("RX", "ACGT-TTTT", "Z")
+    rec.set_tag("LA", 1, "i")
+    rec.set_tag("cd", ("S", [3, 3, 2, 3]), "B")
+    rec.set_tag("XF", 0.5, "f")
+    rec.set_tag("XA", "Q", "A")
+    return rec
+
+
+class TestBamRecordCodec:
+    def test_record_roundtrip(self):
+        rec = _sample_record()
+        blob = encode_record(rec)
+        (size,) = struct.unpack_from("<i", blob)
+        assert size == len(blob) - 4
+        back = decode_record(blob[4:])
+        assert back.qname == rec.qname
+        assert back.flag == rec.flag
+        assert back.pos == rec.pos
+        assert back.cigar == rec.cigar
+        assert back.seq == rec.seq
+        assert back.qual == rec.qual
+        assert back.get_tag("MI") == "42/A"
+        assert back.get_tag("LA") == 1
+        assert back.get_tag("cd") == ("S", [3, 3, 2, 3])
+        assert abs(back.get_tag("XF") - 0.5) < 1e-6
+        assert back.get_tag("XA") == "Q"
+
+    def test_missing_qual(self):
+        rec = BamRecord(qname="q", flag=4, seq="ACGT", qual=None, cigar=[])
+        back = decode_record(encode_record(rec)[4:])
+        assert back.qual is None
+        assert back.seq == "ACGT"
+
+    def test_reference_end(self):
+        rec = _sample_record()
+        assert rec.reference_end == 110  # softclip consumes no reference
+        assert rec.query_length == 13
+
+    def test_cigar_string(self):
+        assert _sample_record().cigar_string() == "3S10M"
+
+
+class TestBamFile:
+    def test_file_roundtrip(self, tmp_path, rng):
+        name, genome = random_genome(rng, 2000)
+        header, records = make_grouped_bam_records(rng, name, genome, n_families=4)
+        path = str(tmp_path / "test.bam")
+        with BamWriter(path, header) as w:
+            w.write_all(records)
+        with BamReader(path) as r:
+            assert r.header.references == [(name, len(genome))]
+            got = list(r)
+        assert len(got) == len(records)
+        for a, b in zip(records, got):
+            assert (a.qname, a.flag, a.pos, a.seq, a.qual, a.cigar) == (
+                b.qname, b.flag, b.pos, b.seq, b.qual, b.cigar,
+            )
+            assert a.get_tag("MI") == b.get_tag("MI")
+            assert a.get_tag("RX") == b.get_tag("RX")
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "bad.bam")
+        with BgzfWriter.open(path) as w:
+            w.write(b"NOPE")
+        with pytest.raises(IOError):
+            BamReader(path)
+
+
+class TestFasta:
+    def test_fetch(self, tmp_path, rng):
+        name, genome = random_genome(rng, 1000)
+        path = str(tmp_path / "g.fa")
+        write_fasta(path, name, genome, width=37)
+        fa = FastaFile(path)
+        assert fa.get_reference_length(name) == 1000
+        assert fa.fetch(name, 0, 10) == genome[:10]
+        assert fa.fetch(name, 35, 75) == genome[35:75]
+        assert fa.fetch(name, 990, 1200) == genome[990:]
+        assert fa.fetch(name, 0) == genome
+        # .fai persisted and reloadable
+        fa2 = FastaFile(path)
+        assert fa2.fetch(name, 123, 456) == genome[123:456]
+
+    def test_non_uniform_lines_rejected(self, tmp_path):
+        # Interior short line breaks offset arithmetic; must refuse like
+        # samtools faidx rather than serve wrong bases.
+        path = str(tmp_path / "bad.fa")
+        with open(path, "w") as fh:
+            fh.write(">a\nACGTAC\nGT\nACGTACGT\n")
+        with pytest.raises(IOError, match="non-uniform"):
+            FastaFile(path)
+
+    def test_multi_sequence(self, tmp_path):
+        path = str(tmp_path / "m.fa")
+        with open(path, "w") as fh:
+            fh.write(">a desc\nACGTAC\nGT\n>b\nTTTT\n")
+        fa = FastaFile(path)
+        assert fa.references == ["a", "b"]
+        assert fa.fetch("a", 0, 8) == "ACGTACGT"
+        assert fa.fetch("b", 1, 3) == "TT"
+
+
+class TestFastq:
+    def test_reverse_complement(self):
+        assert reverse_complement("ACGTN") == "NACGT"
+
+    def test_sam_to_fastq(self, tmp_path):
+        r1 = BamRecord(qname="q", flag=99 & ~0x10, seq="ACGT", qual=bytes([30] * 4), cigar=[])
+        r1.flag = 0x40 | 0x1  # read1, no reverse
+        r2 = BamRecord(qname="q", flag=0x80 | 0x10 | 0x1, seq="AACC", qual=bytes([10, 20, 30, 40]), cigar=[])
+        fq1, fq2 = str(tmp_path / "1.fq.gz"), str(tmp_path / "2.fq.gz")
+        n1, n2 = sam_to_fastq([r1, r2], fq1, fq2)
+        assert (n1, n2) == (1, 1)
+        lines1 = gzip.open(fq1, "rt").read().splitlines()
+        lines2 = gzip.open(fq2, "rt").read().splitlines()
+        assert lines1 == ["@q/1", "ACGT", "+", "????"]
+        # reverse-strand R2 is flipped back to sequencing orientation
+        assert lines2[1] == "GGTT"
+        assert lines2[3] == "".join(chr(q + 33) for q in (40, 30, 20, 10))
