@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import struct
 from dataclasses import dataclass, field
-from typing import Any, BinaryIO, Iterable, Iterator
+from typing import Any, Iterable, Iterator
+
+import numpy as np
 
 from bsseqconsensusreads_tpu.io.bgzf import BgzfReader, BgzfWriter
 
@@ -34,6 +36,10 @@ for _c in "acmgrsvtwyhkdbn":
     _NT16_OF[_c] = _NT16_OF[_c.upper()]
 # Byte -> two-base string table so seq decode is one dict-free pass per byte.
 _NT16_PAIRS = [SEQ_NT16[b >> 4] + SEQ_NT16[b & 0xF] for b in range(256)]
+# char byte -> 4-bit code table for the encode path (unknown chars -> N=15).
+_NT16_CODE = np.full(256, 15, dtype=np.uint8)
+for _ch, _code in _NT16_OF.items():
+    _NT16_CODE[ord(_ch)] = _code
 
 # SAM flag bits.
 FPAIRED, FPROPER_PAIR, FUNMAP, FMUNMAP = 0x1, 0x2, 0x4, 0x8
@@ -281,16 +287,14 @@ def encode_record(rec: BamRecord) -> bytes:
         rec.tlen,
     )
     body += qname_b
-    for op, ln in rec.cigar:
-        body += struct.pack("<I", (ln << 4) | op)
-    nibbles = bytearray((l_seq + 1) // 2)
-    for i, c in enumerate(rec.seq):
-        code = _NT16_OF.get(c, 15)
-        if i % 2 == 0:
-            nibbles[i >> 1] |= code << 4
-        else:
-            nibbles[i >> 1] |= code
-    body += nibbles
+    if rec.cigar:
+        body += struct.pack(
+            f"<{len(rec.cigar)}I", *((ln << 4) | op for op, ln in rec.cigar)
+        )
+    codes = _NT16_CODE[np.frombuffer(rec.seq.encode("ascii"), dtype=np.uint8)]
+    if l_seq % 2:
+        codes = np.append(codes, 0)
+    body += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
     if rec.qual is None:
         body += b"\xff" * l_seq
     else:

@@ -54,6 +54,7 @@ class FastaFile:
         self._fh.seek(0)
         name = None
         length = offset = linebases = linewidth = 0
+        blank_seen = False
         pos = 0
         for raw in self._fh:
             line_len = len(raw)
@@ -63,8 +64,17 @@ class FastaFile:
                     index[name] = (length, offset, linebases, linewidth)
                 name = line[1:].split()[0].decode("ascii") if len(line) > 1 else ""
                 length = linebases = linewidth = 0
+                blank_seen = False
                 offset = pos + line_len
-            elif line and name is not None:
+            elif not line:
+                blank_seen = True
+            elif name is not None:
+                if blank_seen:
+                    # A blank line inside a sequence body breaks the
+                    # offset arithmetic; refuse like samtools faidx.
+                    raise FastaError(
+                        f"{self._path}: blank line inside sequence {name!r}"
+                    )
                 if linebases == 0:
                     linebases = len(line)
                     linewidth = line_len

@@ -1,0 +1,9 @@
+"""The consensus model family: molecular (single-strand) and duplex callers.
+
+These are the TPU-native re-implementations of the two JVM consensus engines
+the reference shells out to (fgbio CallMolecularConsensusReads at
+main.snake.py:54 and CallDuplexConsensusReads at main.snake.py:163), exposed
+as jit/vmap-able functions over family tensors.
+"""
+
+from bsseqconsensusreads_tpu.models.params import ConsensusParams  # noqa: F401

@@ -1,0 +1,14 @@
+"""Pure-JAX array transforms and consensus math.
+
+These replace the per-read Python loops and JVM consensus engines of the
+reference with jit/vmap tensor programs: phred-space error arithmetic,
+family tensorization, the AG->CT B-strand conversion
+(reference: tools/1.convert_AG_to_CT.py), gap extension
+(reference: tools/2.extend_gap.py), and the consensus vote kernels.
+"""
+
+from bsseqconsensusreads_tpu.ops.phred import (  # noqa: F401
+    phred_to_prob,
+    prob_to_phred,
+    prob_error_two_trials,
+)

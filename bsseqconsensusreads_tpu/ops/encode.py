@@ -1,0 +1,189 @@
+"""Tensorization: UMI-family records -> padded family tensors.
+
+The reference's consensus engines walk per-read Python/JVM loops; the TPU
+design instead packs each MI family into fixed-shape arrays laid out in
+*genome window space* (offset = pos - window_start), so every downstream
+transform (overlap co-call, consensus vote, AG->CT conversion, gap extension,
+duplex merge) is a dense per-column tensor op.
+
+Bucketed padding bounds pad waste across the 1-2-read cfDNA tail and deep
+(>500 read) families (SURVEY.md §5.7): template counts round up to powers of
+two and window lengths to multiples of 128 (the TPU lane width).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamRecord,
+    CHARD_CLIP,
+    CINS,
+    CDEL,
+    CSOFT_CLIP,
+    FREAD2,
+)
+
+from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE
+
+# TPU-friendly padding granularity.
+LANE = 128
+MAX_TEMPLATES_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def seq_to_codes(seq: str) -> np.ndarray:
+    return BASE_CODE[np.frombuffer(seq.encode("ascii"), dtype=np.uint8)]
+
+
+def codes_to_seq(codes: np.ndarray) -> str:
+    return BASE_CHAR[np.clip(codes, 0, NBASE)].tobytes().decode("ascii")
+
+
+def trim_softclips(rec: BamRecord) -> tuple[np.ndarray, np.ndarray, int] | None:
+    """Return (codes, quals, pos) with soft clips removed, or None when the
+    read must be dropped (indel or hardclip CIGAR ops — the reference drops
+    these too: tools/1.convert_AG_to_CT.py:79-80, tools/2.extend_gap.py:160).
+    """
+    if any(op in (CINS, CDEL, CHARD_CLIP) for op, _ in rec.cigar):
+        return None
+    codes = seq_to_codes(rec.seq)
+    quals = (
+        np.frombuffer(rec.qual, dtype=np.uint8)
+        if rec.qual is not None
+        else np.zeros(len(rec.seq), dtype=np.uint8)
+    )
+    start, end = 0, len(codes)
+    if rec.cigar and rec.cigar[0][0] == CSOFT_CLIP:
+        start = rec.cigar[0][1]
+    if rec.cigar and rec.cigar[-1][0] == CSOFT_CLIP:
+        end -= rec.cigar[-1][1]
+    return codes[start:end], quals[start:end], rec.pos
+
+
+@dataclasses.dataclass
+class FamilyMeta:
+    """Host-side metadata for one encoded family (one MI group, one strand)."""
+
+    mi: str
+    ref_id: int
+    window_start: int
+    n_templates: int
+    rx: str = ""
+    qname: str = ""
+
+
+@dataclasses.dataclass
+class MolecularBatch:
+    """[F, T, 2, W] family tensors for the molecular consensus kernel.
+
+    bases==4 marks "no observation" (pad, N, or no coverage); role axis is
+    (R1, R2). All arrays are numpy; the kernel takes them as device arrays.
+    """
+
+    bases: np.ndarray  # int8 [F, T, 2, W]
+    quals: np.ndarray  # uint8 [F, T, 2, W]
+    meta: list[FamilyMeta]
+
+    @property
+    def shape(self) -> tuple[int, int, int]:
+        f, t, _, w = self.bases.shape
+        return f, t, w
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def bucket_templates(t: int) -> int:
+    for b in MAX_TEMPLATES_BUCKETS:
+        if t <= b:
+            return b
+    return _round_up(t, 1024)
+
+
+def bucket_window(w: int) -> int:
+    return max(LANE, _round_up(w, LANE))
+
+
+def encode_molecular_families(
+    families: Sequence[tuple[str, Sequence[BamRecord]]],
+    max_window: int = 4096,
+) -> tuple[MolecularBatch, list[str]]:
+    """Encode MI families (already grouped, e.g. by io streaming) into one
+    padded batch. Families whose window exceeds max_window are skipped and
+    reported (never silently dropped — SURVEY.md §7.3 'no silent caps').
+
+    Returns (batch, skipped_mi_list).
+    """
+    placed = []
+    skipped: list[str] = []
+    max_t = 1
+    max_w = LANE
+    for mi, records in families:
+        templates: dict[str, dict[int, tuple]] = defaultdict(dict)
+        ref_id = -1
+        rx_counts: dict[str, int] = defaultdict(int)
+        lo, hi = None, None
+        for rec in records:
+            trimmed = trim_softclips(rec)
+            if trimmed is None:
+                continue
+            codes, quals, pos = trimmed
+            if len(codes) == 0:
+                continue
+            ref_id = rec.ref_id
+            role = 1 if rec.flag & FREAD2 else 0
+            templates[rec.qname][role] = (codes, quals, pos)
+            if rec.has_tag("RX"):
+                rx_counts[rec.get_tag("RX")] += 1
+            lo = pos if lo is None else min(lo, pos)
+            e = pos + len(codes)
+            hi = e if hi is None else max(hi, e)
+        if lo is None:
+            skipped.append(mi)
+            continue
+        window = hi - lo
+        if window > max_window:
+            skipped.append(mi)
+            continue
+        rx = max(rx_counts, key=rx_counts.get) if rx_counts else ""
+        placed.append((mi, ref_id, lo, window, rx, templates))
+        max_t = max(max_t, len(templates))
+        max_w = max(max_w, window)
+
+    f = len(placed)
+    t_pad = bucket_templates(max_t)
+    w_pad = bucket_window(max_w)
+    bases = np.full((f, t_pad, 2, w_pad), NBASE, dtype=np.int8)
+    quals = np.zeros((f, t_pad, 2, w_pad), dtype=np.uint8)
+    meta: list[FamilyMeta] = []
+    for fi, (mi, ref_id, lo, window, rx, templates) in enumerate(placed):
+        for ti, (qname, roles) in enumerate(templates.items()):
+            for role, (codes, q, pos) in roles.items():
+                off = pos - lo
+                bases[fi, ti, role, off : off + len(codes)] = codes
+                quals[fi, ti, role, off : off + len(codes)] = q
+        meta.append(FamilyMeta(mi, ref_id, lo, len(templates), rx))
+    return MolecularBatch(bases, quals, meta), skipped
+
+
+def iter_mi_groups(records: Iterable[BamRecord], strip_suffix: bool = False):
+    """Group a record stream by MI tag, preserving first-seen order.
+
+    strip_suffix drops the /A |/B strand suffix (like tools/2.extend_gap.py:166)
+    so both strands of a duplex land in one group. Records without an MI tag
+    raise, matching the reference (tools/2.extend_gap.py:180).
+    """
+    groups: dict[str, list[BamRecord]] = {}
+    for rec in records:
+        if not rec.has_tag("MI"):
+            raise ValueError(f"{rec.qname} does not have MI tag.")
+        mi = str(rec.get_tag("MI"))
+        if strip_suffix:
+            mi = mi.split("/")[0]
+        groups.setdefault(mi, []).append(rec)
+    return list(groups.items())

@@ -1,0 +1,66 @@
+"""Phred-scale probability arithmetic for the consensus error model.
+
+The reference's consensus engines (fgbio CallMolecularConsensusReads /
+CallDuplexConsensusReads, invoked at main.snake.py:54,163) parameterize their
+error model with Phred-scaled rates: --error-rate-pre-umi=45 (errors in the
+source molecule before UMI attachment) and --error-rate-post-umi=30 (errors
+introduced between UMI attachment and sequencing, e.g. PCR). This module is
+the same arithmetic as jit-friendly jnp ops.
+
+All functions accept and return jnp arrays (float32) and are safe inside jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Phred bounds used for emitted qualities: htslib caps printable quals at 93
+# ('~'); 2 ('#') is the conventional no-call / minimum quality.
+MAX_PHRED = 93.0
+MIN_PHRED = 2.0
+NO_CALL_QUAL = 2
+
+# Base alphabet re-exported from the single definition in alphabet.py.
+from bsseqconsensusreads_tpu.alphabet import A, C, G, N, NUM_BASES, T  # noqa: F401,E402
+
+
+def phred_to_prob(q):
+    """Error probability for a Phred score: 10^(-q/10)."""
+    return jnp.power(10.0, -jnp.asarray(q, jnp.float32) / 10.0)
+
+
+def prob_to_phred(p, min_q: float = MIN_PHRED, max_q: float = MAX_PHRED):
+    """Phred score for an error probability, clamped to [min_q, max_q]."""
+    p = jnp.clip(jnp.asarray(p, jnp.float32), 1e-12, 1.0)
+    return jnp.clip(-10.0 * jnp.log10(p), min_q, max_q)
+
+
+def prob_error_two_trials(p1, p2):
+    """Probability the final base is wrong after two independent error
+    processes with per-trial error probabilities p1 then p2.
+
+    Exactly one trial errs -> wrong; both err -> wrong unless the second error
+    lands back on the original base (1/3 chance under a uniform substitution
+    model): p1(1-p2) + (1-p1)p2 + (2/3)p1p2.
+    """
+    p1 = jnp.asarray(p1, jnp.float32)
+    p2 = jnp.asarray(p2, jnp.float32)
+    return p1 * (1.0 - p2) + (1.0 - p1) * p2 + (2.0 / 3.0) * p1 * p2
+
+
+def adjust_quals_post_umi(quals, error_rate_post_umi):
+    """Fold the post-UMI error prior into raw base qualities.
+
+    Raw quality only models the sequencer; amplification errors after UMI
+    attachment are an independent error process, so the effective per-base
+    error is prob_error_two_trials(p_base, p_post).
+    """
+    p = phred_to_prob(quals)
+    p_post = phred_to_prob(error_rate_post_umi)
+    return prob_error_two_trials(p, p_post)
+
+
+def log_likelihoods(p_err):
+    """(log P[obs | true==obs], log P[obs | true!=obs]) per observation."""
+    p_err = jnp.clip(p_err, 1e-12, 1.0 - 1e-7)
+    return jnp.log1p(-p_err), jnp.log(p_err / 3.0)

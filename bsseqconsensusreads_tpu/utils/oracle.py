@@ -1,0 +1,119 @@
+"""Scalar-Python oracles for differential testing of the JAX kernels.
+
+Deliberately written as naive per-base loops with stdlib floats — an
+independent transcription of the documented model semantics (SURVEY.md §4:
+"unit tests of the pure-JAX transforms against scalar-Python oracles").
+These are also the measured "CPU reference path" stand-in for benchmarks,
+playing the role of the reference's pysam/JVM per-read loops.
+"""
+
+from __future__ import annotations
+
+import math
+
+NBASE = 4
+
+
+def _perr(q: float) -> float:
+    return 10.0 ** (-q / 10.0)
+
+
+def _two_trials(p1: float, p2: float) -> float:
+    return p1 * (1 - p2) + (1 - p1) * p2 + (2.0 / 3.0) * p1 * p2
+
+
+def _to_phred(p: float) -> float:
+    p = min(max(p, 1e-12), 1.0)
+    return min(max(-10.0 * math.log10(p), 2.0), 93.0)
+
+
+def oracle_overlap_cocall(bases, quals):
+    """bases/quals: nested lists [T][2][W]. Returns updated copies."""
+    T = len(bases)
+    W = len(bases[0][0])
+    out_b = [[list(bases[t][r]) for r in range(2)] for t in range(T)]
+    out_q = [[list(quals[t][r]) for r in range(2)] for t in range(T)]
+    for t in range(T):
+        for w in range(W):
+            b1, b2 = bases[t][0][w], bases[t][1][w]
+            q1, q2 = float(quals[t][0][w]), float(quals[t][1][w])
+            if b1 == NBASE or b2 == NBASE:
+                continue
+            if b1 == b2:
+                for r in range(2):
+                    out_q[t][r][w] = q1 + q2
+            else:
+                if q1 == q2:
+                    for r in range(2):
+                        out_b[t][r][w] = NBASE
+                        out_q[t][r][w] = 0.0
+                else:
+                    win = b1 if q1 > q2 else b2
+                    for r in range(2):
+                        out_b[t][r][w] = win
+                        out_q[t][r][w] = abs(q1 - q2)
+    return out_b, out_q
+
+
+def oracle_column_vote(
+    column_bases,
+    column_quals,
+    error_rate_pre_umi=45.0,
+    error_rate_post_umi=30.0,
+    min_input_base_quality=0,
+    min_consensus_base_quality=0,
+):
+    """One window column: lists of base codes / phred quals (one per read).
+
+    Returns (base, qual, depth, errors) with base==4 when uncalled.
+    """
+    p_post = _perr(error_rate_post_umi)
+    ll = [0.0, 0.0, 0.0, 0.0]
+    obs = []
+    for b, q in zip(column_bases, column_quals):
+        if b == NBASE or q < min_input_base_quality:
+            continue
+        p = _two_trials(_perr(float(q)), p_post)
+        p = min(max(p, 1e-12), 1.0 - 1e-7)
+        obs.append(b)
+        for cand in range(4):
+            ll[cand] += math.log1p(-p) if cand == b else math.log(p / 3.0)
+    depth = len(obs)
+    if depth == 0:
+        return NBASE, 2, 0, 0
+    cons = max(range(4), key=lambda c: ll[c])
+    m = max(ll)
+    denom = sum(math.exp(v - m) for v in ll)
+    p_cons = 1.0 - math.exp(ll[cons] - m) / denom
+    p_final = _two_trials(p_cons, _perr(error_rate_pre_umi))
+    qual = _to_phred(p_final)
+    if qual < min_consensus_base_quality:
+        return NBASE, 2, depth, 0
+    errors = sum(1 for b in obs if b != cons)
+    return cons, int(round(qual)), depth, errors
+
+
+def oracle_molecular_family(bases, quals, params) -> dict:
+    """Whole family [T][2][W] -> {'base','qual','depth','errors'}: [2][W]."""
+    if params.consensus_call_overlapping_bases:
+        bases, quals = oracle_overlap_cocall(bases, quals)
+    T = len(bases)
+    W = len(bases[0][0])
+    out = {k: [[0] * W, [0] * W] for k in ("base", "qual", "depth", "errors")}
+    for role in range(2):
+        for w in range(W):
+            col_b = [bases[t][role][w] for t in range(T)]
+            col_q = [quals[t][role][w] for t in range(T)]
+            b, q, d, e = oracle_column_vote(
+                col_b,
+                col_q,
+                params.error_rate_pre_umi,
+                params.error_rate_post_umi,
+                params.min_input_base_quality,
+                params.min_consensus_base_quality,
+            )
+            out["base"][role][w] = b
+            out["qual"][role][w] = q
+            out["depth"][role][w] = d
+            out["errors"][role][w] = e
+    return out

@@ -174,6 +174,21 @@ class TestFasta:
         with pytest.raises(IOError, match="non-uniform"):
             FastaFile(path)
 
+    def test_blank_line_inside_sequence_rejected(self, tmp_path):
+        path = str(tmp_path / "blank.fa")
+        with open(path, "w") as fh:
+            fh.write(">a\nACGTAC\n\nGTACGT\n")
+        with pytest.raises(IOError, match="blank line"):
+            FastaFile(path)
+
+    def test_trailing_blank_line_ok(self, tmp_path):
+        path = str(tmp_path / "ok.fa")
+        with open(path, "w") as fh:
+            fh.write(">a\nACGTAC\n\n>b\nTTTT\n\n")
+        fa = FastaFile(path)
+        assert fa.fetch("a", 0, 6) == "ACGTAC"
+        assert fa.fetch("b", 0, 4) == "TTTT"
+
     def test_multi_sequence(self, tmp_path):
         path = str(tmp_path / "m.fa")
         with open(path, "w") as fh:

@@ -1,0 +1,186 @@
+"""Molecular consensus kernel vs scalar oracle + semantics tests."""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.models.molecular import (
+    molecular_consensus,
+    overlap_cocall,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.encode import (
+    NBASE,
+    encode_molecular_families,
+    iter_mi_groups,
+)
+from bsseqconsensusreads_tpu.utils.oracle import oracle_molecular_family
+from bsseqconsensusreads_tpu.utils.testing import make_grouped_bam_records, random_genome
+
+
+def random_family(rng, T, W, n_frac=0.1):
+    bases = rng.integers(0, 4, size=(T, 2, W)).astype(np.int8)
+    quals = rng.integers(2, 41, size=(T, 2, W)).astype(np.uint8)
+    mask = rng.random((T, 2, W)) < n_frac
+    bases[mask] = NBASE
+    quals[bases == NBASE] = 0
+    return bases, quals
+
+
+PARAM_SETS = [
+    ConsensusParams(),
+    ConsensusParams(consensus_call_overlapping_bases=False),
+    ConsensusParams(error_rate_pre_umi=20.0, error_rate_post_umi=10.0),
+    ConsensusParams(min_input_base_quality=15),
+    ConsensusParams(min_consensus_base_quality=30),
+]
+
+
+class TestKernelVsOracle:
+    @pytest.mark.parametrize("pi", range(len(PARAM_SETS)))
+    def test_matches_oracle(self, pi):
+        rng = np.random.default_rng(1000 + pi)
+        params = PARAM_SETS[pi]
+        T, W = 5, 24
+        bases, quals = random_family(rng, T, W)
+        got = molecular_consensus(bases[None], quals[None], params)
+        want = oracle_molecular_family(bases.tolist(), quals.tolist(), params)
+        np.testing.assert_array_equal(np.asarray(got["base"][0]), np.array(want["base"]))
+        np.testing.assert_array_equal(np.asarray(got["depth"][0]), np.array(want["depth"]))
+        np.testing.assert_array_equal(np.asarray(got["errors"][0]), np.array(want["errors"]))
+        # quals can differ by 1 at rounding boundaries (float32 vs float64)
+        dq = np.abs(
+            np.asarray(got["qual"][0], np.int32) - np.array(want["qual"], np.int32)
+        )
+        assert dq.max() <= 1
+
+    def test_batch_of_families(self):
+        rng = np.random.default_rng(2000)
+        params = ConsensusParams()
+        F, T, W = 6, 4, 16
+        all_b, all_q = [], []
+        for _ in range(F):
+            b, q = random_family(rng, T, W)
+            all_b.append(b)
+            all_q.append(q)
+        bases = np.stack(all_b)
+        quals = np.stack(all_q)
+        got = molecular_consensus(bases, quals, params)
+        for f in range(F):
+            want = oracle_molecular_family(bases[f].tolist(), quals[f].tolist(), params)
+            np.testing.assert_array_equal(np.asarray(got["base"][f]), np.array(want["base"]))
+
+
+class TestSemantics:
+    def test_unanimous_high_qual(self):
+        # 4 agreeing T observations -> consensus T with high quality.
+        T, W = 4, 8
+        bases = np.full((T, 2, W), 3, dtype=np.int8)
+        quals = np.full((T, 2, W), 35, dtype=np.uint8)
+        out = molecular_consensus(bases[None], quals[None], ConsensusParams())
+        assert (np.asarray(out["base"][0]) == 3).all()
+        assert (np.asarray(out["depth"][0]) == 4).all()
+        assert (np.asarray(out["errors"][0]) == 0).all()
+        # pre-UMI error rate 45 caps the final quality at ~45
+        assert np.asarray(out["qual"][0]).max() <= 46
+
+    def test_majority_wins(self):
+        bases = np.full((3, 2, 4), 0, dtype=np.int8)
+        bases[2] = 2  # one dissenting G vs two As
+        quals = np.full((3, 2, 4), 30, dtype=np.uint8)
+        out = molecular_consensus(
+            bases[None], quals[None], ConsensusParams(consensus_call_overlapping_bases=False)
+        )
+        assert (np.asarray(out["base"][0]) == 0).all()
+        assert (np.asarray(out["errors"][0]) == 1).all()
+
+    def test_no_coverage_is_no_call(self):
+        bases = np.full((2, 2, 6), NBASE, dtype=np.int8)
+        quals = np.zeros((2, 2, 6), dtype=np.uint8)
+        out = molecular_consensus(bases[None], quals[None], ConsensusParams())
+        assert (np.asarray(out["base"][0]) == NBASE).all()
+        assert (np.asarray(out["qual"][0]) == 2).all()
+        assert (np.asarray(out["depth"][0]) == 0).all()
+
+    def test_single_read_passthrough(self):
+        # Depth-1 family: consensus equals the read, qual bounded by the read.
+        W = 10
+        bases = np.full((1, 2, W), NBASE, dtype=np.int8)
+        quals = np.zeros((1, 2, W), dtype=np.uint8)
+        read = np.array([0, 1, 2, 3, 0, 1, 2, 3, 0, 1], dtype=np.int8)
+        bases[0, 0] = read
+        quals[0, 0] = 30
+        out = molecular_consensus(bases[None], quals[None], ConsensusParams())
+        np.testing.assert_array_equal(np.asarray(out["base"][0, 0]), read)
+        assert (np.asarray(out["base"][0, 1]) == NBASE).all()
+        assert np.asarray(out["qual"][0, 0]).max() <= 31
+
+    def test_overlap_cocall_agreement_boosts(self):
+        # R1 and R2 agree on the overlap: co-call doubles the evidence weight.
+        bases = np.zeros((1, 2, 4), dtype=np.int8)
+        quals = np.full((1, 2, 4), 20, dtype=np.uint8)
+        b2, q2 = overlap_cocall(bases.astype(np.int8), quals.astype(np.float32))
+        assert (np.asarray(q2) == 40.0).all()
+        assert (np.asarray(b2) == 0).all()
+
+    def test_overlap_cocall_disagreement(self):
+        bases = np.zeros((1, 2, 1), dtype=np.int8)
+        bases[0, 1, 0] = 2
+        quals = np.zeros((1, 2, 1), dtype=np.float32)
+        quals[0, 0, 0] = 30.0
+        quals[0, 1, 0] = 20.0
+        b2, q2 = overlap_cocall(bases, quals)
+        assert np.asarray(b2[0, 0, 0]) == 0 and np.asarray(b2[0, 1, 0]) == 0
+        assert np.asarray(q2[0, 0, 0]) == 10.0
+        # exact tie -> masked
+        quals[0, 1, 0] = 30.0
+        b3, _ = overlap_cocall(bases, quals)
+        assert (np.asarray(b3)[0, :, 0] == NBASE).all()
+
+
+class TestEncoder:
+    def test_encode_synthetic_families(self, rng):
+        name, genome = random_genome(rng, 2000)
+        _, records = make_grouped_bam_records(rng, name, genome, n_families=5)
+        groups = iter_mi_groups(records)
+        batch, skipped = encode_molecular_families(groups)
+        assert not skipped
+        assert len(batch.meta) == 10  # 5 families x 2 strands
+        f, t, w = batch.shape
+        assert w % 128 == 0
+        # every family window must contain at least one observation
+        assert ((batch.bases != NBASE).any(axis=(1, 2, 3))).all()
+        # encoded bases at covered positions are 0..3
+        covered = batch.bases != NBASE
+        assert batch.bases[covered].min() >= 0 and batch.bases[covered].max() <= 3
+
+    def test_missing_mi_raises(self, rng):
+        from bsseqconsensusreads_tpu.io.bam import BamRecord
+
+        rec = BamRecord(qname="q", flag=99, seq="ACGT", qual=bytes([30] * 4))
+        with pytest.raises(ValueError, match="MI tag"):
+            iter_mi_groups([rec])
+
+    def test_encoder_consensus_end_to_end(self, rng):
+        # Error-free family: consensus must reproduce the bisulfite-converted
+        # genome windows exactly.
+        name, genome = random_genome(rng, 1000)
+        _, records = make_grouped_bam_records(
+            rng, name, genome, n_families=3, error_rate=0.0
+        )
+        groups = iter_mi_groups(records)
+        batch, _ = encode_molecular_families(groups)
+        out = molecular_consensus(batch.bases, batch.quals, ConsensusParams())
+        base = np.asarray(out["base"])
+        depth = np.asarray(out["depth"])
+        for fi, meta in enumerate(batch.meta):
+            for role in range(2):
+                cov = depth[fi, role] > 0
+                assert cov.any()
+                # reconstruct expected from any input read of that role
+                fam_bases = batch.bases[fi, :, role, :]
+                for t in range(fam_bases.shape[0]):
+                    read_cov = fam_bases[t] != NBASE
+                    if read_cov.any():
+                        np.testing.assert_array_equal(
+                            base[fi, role][read_cov], fam_bases[t][read_cov]
+                        )
