@@ -1,0 +1,97 @@
+"""Duplex consensus kernel: merge A- and B-strand single-strand consensi.
+
+TPU-native equivalent of `fgbio CallDuplexConsensusReads` as invoked by the
+reference (main.snake.py:163): per MI group, combine the converted,
+coordinate-harmonized strand reads into one duplex read pair, with
+--min-reads=0 semantics — emit everything, including groups where only one
+strand survived (README.md:9 "not filtered").
+
+After convert_ag_to_ct + extend_gap, a duplex family is a [4, W] window
+tensor with rows (99, 163, 83, 147). The duplex R1 merges rows (99, 163)
+(the two forward-mapped strand reads covering the top-strand window); the
+duplex R2 merges rows (83, 147). Each merge is the same quality-weighted
+log-likelihood vote as the molecular stage, with depth <= 2 — reproducing
+the reference pipeline's configuration, which feeds molecular-consensus reads
+back through the same fgbio error model (error-rate-pre-umi=45,
+error-rate-post-umi=30) a second time.
+
+Strand bookkeeping for tags: rows 99/147 are A-strand, rows 163/83 are
+B-strand; per-column per-strand depths are emitted so the writer can produce
+aD/bD-style annotations alongside cD/cM/cE.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.molecular import column_vote
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
+from bsseqconsensusreads_tpu.ops.extend import (
+    ROW_83,
+    ROW_99,
+    ROW_147,
+    ROW_163,
+    extend_gap,
+)
+
+# (rows merged, A-strand row, B-strand row) for duplex R1 and R2.
+R1_ROWS = (ROW_99, ROW_163)
+R2_ROWS = (ROW_83, ROW_147)
+A_ROWS = (ROW_99, ROW_147)
+
+
+def _merge(bases, quals, rows, params):
+    b = jnp.stack([bases[..., r, :] for r in rows], axis=-2)
+    q = jnp.stack([quals[..., r, :] for r in rows], axis=-2)
+    out = column_vote(b, q, params)
+    a_row, b_row = (rows[0], rows[1]) if rows[0] in A_ROWS else (rows[1], rows[0])
+    out["a_depth"] = (bases[..., a_row, :] != NBASE).astype(jnp.int32)
+    out["b_depth"] = (bases[..., b_row, :] != NBASE).astype(jnp.int32)
+    return out
+
+
+def _family_duplex(bases, quals, params):
+    r1 = _merge(bases, quals, R1_ROWS, params)
+    r2 = _merge(bases, quals, R2_ROWS, params)
+    return jax.tree.map(lambda a, b: jnp.stack([a, b], axis=0), r1, r2)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def duplex_consensus(bases, quals, params: ConsensusParams = ConsensusParams(min_reads=0)):
+    """Batched duplex merge.
+
+    bases: int8 [F, 4, W] (rows 99/163/83/147, NBASE where uncovered),
+    quals: float32/uint8 [F, 4, W].
+    Returns dict of [F, 2, W] arrays: base, qual, depth, errors,
+    a_depth, b_depth. Roles: 0 = duplex R1, 1 = duplex R2.
+    """
+    quals = quals.astype(jnp.float32)
+    return jax.vmap(lambda b, q: _family_duplex(b, q, params))(bases, quals)
+
+
+@partial(jax.jit, static_argnames=("params",))
+def duplex_call_pipeline(
+    bases, quals, cover, ref, convert_mask, extend_eligible=None,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+):
+    """The fused TPU duplex stage: AG->CT conversion -> gap extension ->
+    duplex merge, one compiled program per batch shape.
+
+    Replaces the reference's four-process chain convert_Bstrain -> extend ->
+    groupsort_convert -> callduplex (main.snake.py:121-164): the
+    TemplateCoordinate sort is obviated because families are already grouped
+    on the family axis. Inputs are DuplexBatch arrays; returns the
+    duplex_consensus output dict plus 'la'/'rd' [F, 4] for parity inspection.
+    """
+    b, q, c, la, rd = convert_ag_to_ct(bases, quals, cover, ref, convert_mask)
+    b, q, c = extend_gap(b, q, c, la, rd, extend_eligible)
+    b = jnp.where(c, b, NBASE)
+    out = duplex_consensus(b, q, params)
+    out["la"] = la
+    out["rd"] = rd
+    return out

@@ -1,0 +1,117 @@
+"""B-strand AG->CT conversion as a pure-JAX window-space transform.
+
+TPU-native equivalent of the reference's per-read Python loop
+(tools/1.convert_AG_to_CT.py:69-186): rewrite aligned B-strand reads
+(flags 83/163/1) from A/G space into C/T space using the reference genome, so
+the two duplex strands become directly comparable. Pass-through flags
+(0/99/147) are untouched; other flags never reach this op (the stage encoder
+drops them, matching the reference's silent drop).
+
+Semantics reproduced exactly (reference line cites):
+ * prepend one base whose value is the reference base there, quality 40
+   ('I'), shifting pos one left (tools/1.convert_AG_to_CT.py:87-121,174-177);
+   LA tag = 1 when prepended;
+ * per-base rewrite (:122-150):
+     read A over ref G -> G (bisulfite-converted signal; restore G)
+     read C at a ref CpG with next read base A -> T (and the next base
+       becomes G via the A-over-G rule)
+     read C at a ref CpG otherwise -> stays C
+     read C not in CpG context -> T (in-silico full conversion)
+     everything else unchanged;
+ * if the reference base just past the read end is G and the converted read
+   now ends in C, trim that trailing C (methylation state unknowable);
+   RD tag = 1 (:155-171).
+
+The reference's sequential loop is position-parallel: its only cross-position
+mutation (setting base i+1 to G inside the CpG pair rule) coincides exactly
+with the standalone A-over-ref-G rule at that position, and the skipped
+iteration would have been a no-op (G stays G). Hence this op is a single
+vectorized select over (read, ref, ref-shifted, read-shifted).
+
+Documented deviation: a read mapped at reference position 0 cannot be
+prepended (no column to the left). The reference still prepends there,
+shifting the whole read one base out of register (a faithful-but-wrong
+translation we refuse to reproduce); we skip the prepend and set LA=0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from bsseqconsensusreads_tpu.alphabet import A, C, G, NBASE
+
+PREPEND_QUAL = 40.0  # 'I' (tools/1.convert_AG_to_CT.py:177)
+
+
+def _span(cover):
+    """First and last covered column index per read ([..., W] bool)."""
+    w = cover.shape[-1]
+    first = jnp.argmax(cover, axis=-1)
+    last = w - 1 - jnp.argmax(cover[..., ::-1], axis=-1)
+    return first, last
+
+
+@partial(jax.jit, static_argnames=())
+def convert_ag_to_ct(bases, quals, cover, ref, convert_mask):
+    """Vectorized conversion over a family window.
+
+    bases:  int8  [..., R, W]  base codes in genome-forward orientation
+    quals:  f32/u8 [..., R, W]
+    cover:  bool  [..., R, W]  contiguous covered span per read
+    ref:    int8  [..., W+1]   reference codes for the window + 1 extra column
+    convert_mask: bool [..., R]  True for B-strand reads (flags 83/163/1)
+
+    Returns (bases, quals, cover, la, rd) with la/rd int8 [..., R].
+    """
+    quals = quals.astype(jnp.float32)
+    w = bases.shape[-1]
+    idx = jnp.arange(w)
+    has = cover.any(axis=-1)
+    first, last = _span(cover)
+    act = convert_mask & has
+
+    # -- prepend: one column left of the read, value = reference base there.
+    can_pre = act & (first > 0)
+    pre_col = jnp.maximum(first - 1, 0)
+    pre_hot = (idx == pre_col[..., None]) & can_pre[..., None]
+    ref_w = ref[..., :w]
+    bases = jnp.where(pre_hot, ref_w[..., None, :], bases)
+    quals = jnp.where(pre_hot, PREPEND_QUAL, quals)
+    cover = cover | pre_hot
+    first = jnp.where(can_pre, pre_col, first)
+
+    # -- per-column rewrite.
+    ref_next = ref[..., 1 : w + 1]
+    pad_base = jnp.full_like(bases[..., :1], NBASE)
+    read_next = jnp.concatenate([bases[..., 1:], pad_base], axis=-1)
+    next_cov = jnp.concatenate(
+        [cover[..., 1:], jnp.zeros_like(cover[..., :1])], axis=-1
+    )
+    is_cpg = (ref_w == C) & (ref_next == G)
+    a_rule = (bases == A) & (ref_w[..., None, :] == G)
+    cpg_here = is_cpg[..., None, :]
+    c_pair = (bases == C) & cpg_here & next_cov & (read_next == A)
+    c_plain = (bases == C) & ~cpg_here
+    out = jnp.where(a_rule, G, bases)
+    out = jnp.where(c_pair | c_plain, jnp.where(bases == C, 3, out), out)
+    # (3 == T; using literal keeps the select int8-typed)
+    gate = (act[..., None] & cover)
+    bases = jnp.where(gate, out, bases)
+
+    # -- trailing trim: ref base past the end is G and read now ends in C.
+    last_base = jnp.take_along_axis(bases, last[..., None], axis=-1)[..., 0]
+    ref_after = jnp.take_along_axis(
+        jnp.broadcast_to(ref_next[..., None, :], bases.shape), last[..., None], axis=-1
+    )[..., 0]
+    trim = act & (ref_after == G) & (last_base == C)
+    last_hot = (idx == last[..., None]) & trim[..., None]
+    cover = cover & ~last_hot
+    bases = jnp.where(last_hot, NBASE, bases)
+    quals = jnp.where(last_hot, 0.0, quals)
+
+    la = can_pre.astype(jnp.int8)
+    rd = trim.astype(jnp.int8)
+    return bases, quals, cover, la, rd

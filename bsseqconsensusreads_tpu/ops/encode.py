@@ -29,6 +29,7 @@ from bsseqconsensusreads_tpu.io.bam import (
 )
 
 from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE
+from bsseqconsensusreads_tpu.utils.flags import CONVERT_FLAGS, GROUP_ORDER
 
 # TPU-friendly padding granularity.
 LANE = 128
@@ -169,6 +170,134 @@ def encode_molecular_families(
                 quals[fi, ti, role, off : off + len(codes)] = q
         meta.append(FamilyMeta(mi, ref_id, lo, len(templates), rx))
     return MolecularBatch(bases, quals, meta), skipped
+
+
+#: Flags the duplex stage accepts, and their row in the family tensor —
+#: derived from the single flag vocabulary in utils.flags (GROUP_ORDER is the
+#: reference's output order, tools/2.extend_gap.py:136). The conversion tool
+#: passes 0/99/147 through, converts 1/83/163, and silently drops everything
+#: else (tools/1.convert_AG_to_CT.py:70-73).
+DUPLEX_ROW_OF_FLAG = {f: i for i, f in enumerate(GROUP_ORDER)}
+CONVERT_ROWS = tuple(
+    i for i, f in enumerate(GROUP_ORDER) if f in CONVERT_FLAGS
+)  # rows for flags 163 and 83: B-strand reads needing AG->CT
+
+
+@dataclasses.dataclass
+class DuplexBatch:
+    """[F, 4, W] family tensors for the convert -> extend -> duplex stages.
+
+    Row order (99, 163, 83, 147); ref carries W+1 reference codes per family
+    (one extra column for the CpG / trailing-trim lookahead). convert_mask
+    marks B-strand rows that are present.
+    """
+
+    bases: np.ndarray  # int8 [F, 4, W]
+    quals: np.ndarray  # float32 [F, 4, W]
+    cover: np.ndarray  # bool [F, 4, W]
+    ref: np.ndarray  # int8 [F, W+1]
+    convert_mask: np.ndarray  # bool [F, 4]
+    extend_eligible: np.ndarray  # bool [F] — group had exactly 4 reads
+    meta: list[FamilyMeta]
+
+
+def encode_duplex_families(
+    families: Sequence[tuple[str, Sequence[BamRecord]]],
+    ref_fetch,
+    ref_names: Sequence[str],
+    max_window: int = 4096,
+) -> tuple[DuplexBatch, list[BamRecord], list[str]]:
+    """Encode duplex MI groups (strand suffix already stripped) for the fused
+    convert+extend+duplex TPU stage.
+
+    ref_fetch(name, start, end) -> str is a FastaFile.fetch-compatible
+    callable; a failed fetch falls back to all-N, matching the reference
+    (tools/1.convert_AG_to_CT.py:106-109).
+
+    Returns (batch, leftovers, skipped): leftovers are records this stage
+    cannot tensorize (flags outside {99,163,83,147}, duplicate flags, indel
+    reads, or reads empty after softclip trimming) for the caller to handle
+    host-side; skipped lists MI groups dropped entirely (window too large /
+    no usable reads).
+
+    Reference-parity gate: the reference only harmonizes groups of exactly 4
+    reads, passing every other group through unextended
+    (tools/2.extend_gap.py:114-115). Group size counts reads surviving the
+    hardclip drop, like the reference's grouping pass; the resulting
+    per-family extend_eligible flag gates extend_gap downstream.
+    """
+    placed = []
+    leftovers: list[BamRecord] = []
+    skipped: list[str] = []
+    max_w = LANE
+    for mi, records in families:
+        rows: dict[int, tuple] = {}
+        rx = ""
+        ref_id = -1
+        lo, hi = None, None
+        group_size = 0
+        for rec in records:
+            if any(op == CHARD_CLIP for op, _ in rec.cigar):
+                continue  # reference drops hardclipped reads (2.extend_gap.py:160)
+            group_size += 1
+            row = DUPLEX_ROW_OF_FLAG.get(rec.flag)
+            trimmed = trim_softclips(rec)
+            if row is None or row in rows or trimmed is None or len(trimmed[0]) == 0:
+                leftovers.append(rec)
+                continue
+            codes, quals, pos = trimmed
+            rows[row] = (codes, quals, pos)
+            ref_id = rec.ref_id
+            if not rx and rec.has_tag("RX"):
+                rx = rec.get_tag("RX")
+            lo = pos if lo is None else min(lo, pos)
+            e = pos + len(codes)
+            hi = e if hi is None else max(hi, e)
+        if lo is None:
+            skipped.append(mi)
+            continue
+        start = max(lo - 1, 0)  # one margin column for the conversion prepend
+        window = hi - start
+        if window > max_window:
+            skipped.append(mi)
+            continue
+        placed.append((mi, ref_id, start, window, rows, rx, group_size == 4))
+        max_w = max(max_w, window)
+
+    f = len(placed)
+    w_pad = bucket_window(max_w)
+    bases = np.full((f, 4, w_pad), NBASE, dtype=np.int8)
+    quals = np.zeros((f, 4, w_pad), dtype=np.float32)
+    cover = np.zeros((f, 4, w_pad), dtype=bool)
+    ref = np.full((f, w_pad + 1), NBASE, dtype=np.int8)
+    convert_mask = np.zeros((f, 4), dtype=bool)
+    eligible = np.zeros(f, dtype=bool)
+    meta: list[FamilyMeta] = []
+    for fi, (mi, ref_id, start, window, rows, rx, is_4) in enumerate(placed):
+        eligible[fi] = is_4
+        for row, (codes, q, pos) in rows.items():
+            off = pos - start
+            bases[fi, row, off : off + len(codes)] = codes
+            quals[fi, row, off : off + len(codes)] = q
+            cover[fi, row, off : off + len(codes)] = True
+            if row in CONVERT_ROWS:
+                convert_mask[fi, row] = True
+        name = ref_names[ref_id] if 0 <= ref_id < len(ref_names) else None
+        if name is not None:
+            try:
+                # Only window+1 columns are ever read by the kernels (the
+                # rest stay N-padded); don't fetch the whole bucket width.
+                ref_str = ref_fetch(name, start, start + window + 1)
+            except Exception:
+                ref_str = ""
+            codes = seq_to_codes(ref_str)
+            ref[fi, : len(codes)] = codes
+        meta.append(FamilyMeta(mi, ref_id, start, len(rows), rx))
+    return (
+        DuplexBatch(bases, quals, cover, ref, convert_mask, eligible, meta),
+        leftovers,
+        skipped,
+    )
 
 
 def iter_mi_groups(records: Iterable[BamRecord], strip_suffix: bool = False):

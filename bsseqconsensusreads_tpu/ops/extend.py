@@ -1,0 +1,84 @@
+"""Duplex coordinate harmonization ("gap extension") as a window-space op.
+
+TPU-native equivalent of the reference's tools/2.extend_gap.py: after B-strand
+conversion, the converted reads (flags 163/83) start one base earlier (LA=1)
+and may end one base earlier (RD=1) than their unconverted duplex partners
+(99/147). This op copies the boundary bases across so both reads of each pair
+span identical reference columns — the precondition for the duplex merge
+(in the reference, for fgbio's TemplateCoordinate sort + duplex call,
+main.snake.py:144-164).
+
+Reference semantics reproduced (tools/2.extend_gap.py:58-110):
+ * pair (99, 163): left read = 163 (the converted one), right = 99;
+   pair (83, 147): left read = 83, right = 147 (:61-64);
+ * LA(left)==1 -> right read gets left's first base+qual prepended, its start
+   decremented, CIGAR 1M prepended (:70-80);
+ * RD(left)==1 -> left read gets right's LAST base+qual appended, CIGAR 1M
+   appended (:92-101 — the comment there says "from left read" but the code
+   takes right_read.query_sequence[-1]; code is authoritative, SURVEY §3.3);
+ * groups that don't have exactly 4 reads pass through unchanged (:114-115) —
+   enforced by the stage encoder host-side, not here.
+
+In window space both rules are one-hot column copies: LA copies column
+first(left) from left into right; RD copies column last(right) from right
+into left. The reference's whole-BAM-in-RAM dict (tools/2.extend_gap.py:
+155-178, the 100 GB hotspot) disappears: families stream through in batches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Row layout of a duplex family tensor: (99, 163, 83, 147) — the output order
+# the reference uses (tools/2.extend_gap.py:136).
+ROW_99, ROW_163, ROW_83, ROW_147 = 0, 1, 2, 3
+# (left=converted row, right=partner row) per pair:
+PAIRS = ((ROW_163, ROW_99), (ROW_83, ROW_147))
+
+
+def _copy_column(bases, quals, cover, src_row, dst_row, col, gate):
+    """Copy (base, qual, cover) at `col` from src_row into dst_row when gate."""
+    w = bases.shape[-1]
+    hot = (jnp.arange(w) == col[..., None]) & gate[..., None]  # [..., W]
+    src_b = jnp.take_along_axis(bases[..., src_row, :], col[..., None], axis=-1)
+    src_q = jnp.take_along_axis(quals[..., src_row, :], col[..., None], axis=-1)
+    dst_b = jnp.where(hot, src_b, bases[..., dst_row, :])
+    dst_q = jnp.where(hot, src_q, quals[..., dst_row, :])
+    dst_c = cover[..., dst_row, :] | hot
+    bases = bases.at[..., dst_row, :].set(dst_b)
+    quals = quals.at[..., dst_row, :].set(dst_q)
+    cover = cover.at[..., dst_row, :].set(dst_c)
+    return bases, quals, cover
+
+
+@jax.jit
+def extend_gap(bases, quals, cover, la, rd, eligible=None):
+    """bases/quals/cover: [..., 4, W] rows ordered (99, 163, 83, 147);
+    la/rd: int8 [..., 4] from convert_ag_to_ct (nonzero only on rows 163/83);
+    eligible: optional bool [...] — the reference only harmonizes groups of
+    exactly 4 reads (tools/2.extend_gap.py:114-115); pass
+    DuplexBatch.extend_eligible to reproduce that gate (None = all eligible).
+
+    Returns updated (bases, quals, cover). Missing reads (no coverage) are
+    left untouched.
+    """
+    quals = quals.astype(jnp.float32)
+    w = bases.shape[-1]
+    for left, right in PAIRS:
+        has_l = cover[..., left, :].any(axis=-1)
+        has_r = cover[..., right, :].any(axis=-1)
+        both = has_l & has_r
+        if eligible is not None:
+            both = both & eligible
+        first_l = jnp.argmax(cover[..., left, :], axis=-1)
+        last_r = w - 1 - jnp.argmax(cover[..., right, ::-1], axis=-1)
+        la_gate = both & (la[..., left] == 1)
+        rd_gate = both & (rd[..., left] == 1)
+        bases, quals, cover = _copy_column(
+            bases, quals, cover, left, right, first_l, la_gate
+        )
+        bases, quals, cover = _copy_column(
+            bases, quals, cover, right, left, last_r, rd_gate
+        )
+    return bases, quals, cover

@@ -93,6 +93,79 @@ def oracle_column_vote(
     return cons, int(round(qual)), depth, errors
 
 
+def oracle_convert_read(seq: str, quals, pos: int, genome: str):
+    """Scalar oracle for the B-strand AG->CT conversion (SURVEY.md §3.2).
+
+    seq is the softclip-trimmed read (genome-forward orientation), quals a
+    list of Phred ints, pos its 0-based mapped position. Returns
+    (seq, quals, pos, la, rd). Mirrors the reference loop exactly — mutable
+    list, skip after a CpG pair rewrite — except at pos==0, where the
+    framework deliberately skips the prepend (see ops/convert.py docstring)
+    instead of shifting the read out of register.
+    """
+    prepend = pos > 0
+    if prepend:
+        new_pos = pos - 1
+        s = list("N" + seq)
+        q = [40] + list(quals)
+    else:
+        new_pos = pos
+        s = list(seq)
+        q = list(quals)
+    L = len(s)
+    # the reference upper-cases its fetch (tools/1.convert_AG_to_CT.py:107)
+    ref = genome[new_pos : new_pos + L + 1].upper()
+    ref += "N" * (L + 1 - len(ref))
+    if prepend:
+        s[0] = ref[0]
+    i = 0
+    while i < L:
+        b, r = s[i], ref[i]
+        if b == "A":
+            if r == "G":
+                s[i] = "G"
+        elif b == "C":
+            if r == "C" and ref[i + 1] == "G":
+                if i + 1 < L and s[i + 1] == "A":
+                    s[i] = "T"
+                    s[i + 1] = "G"
+                    i += 1
+            else:
+                s[i] = "T"
+        i += 1
+    rd = 0
+    if ref[L] == "G" and s and s[-1] == "C":
+        s.pop()
+        q.pop()
+        rd = 1
+    return "".join(s), q, new_pos, int(prepend), rd
+
+
+def oracle_extend_group(reads: dict) -> dict:
+    """Scalar oracle for gap extension (SURVEY.md §3.3).
+
+    reads: {flag: {'seq': str, 'qual': list[int], 'pos': int,
+                   'la': int, 'rd': int}} for flags among (99, 163, 83, 147).
+    Returns the updated dict (copies). Pairs (99,163) and (83,147); the read
+    with flag in {83,163} is the 'left' (converted) one. LA(left)==1 prepends
+    left's first base to the right read (start-1); RD(left)==1 appends the
+    right read's last base to the left read.
+    """
+    out = {f: dict(r) for f, r in reads.items()}
+    for left_flag, right_flag in ((163, 99), (83, 147)):
+        if left_flag not in out or right_flag not in out:
+            continue
+        left, right = out[left_flag], out[right_flag]
+        if left["la"] == 1:
+            right["seq"] = left["seq"][0] + right["seq"]
+            right["qual"] = [left["qual"][0]] + list(right["qual"])
+            right["pos"] -= 1
+        if left["rd"] == 1:
+            left["seq"] = left["seq"] + right["seq"][-1]
+            left["qual"] = list(left["qual"]) + [right["qual"][-1]]
+    return out
+
+
 def oracle_molecular_family(bases, quals, params) -> dict:
     """Whole family [T][2][W] -> {'base','qual','depth','errors'}: [2][W]."""
     if params.consensus_call_overlapping_bases:

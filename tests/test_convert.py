@@ -1,0 +1,128 @@
+"""AG->CT conversion transform vs scalar oracle."""
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
+from bsseqconsensusreads_tpu.ops.encode import codes_to_seq, seq_to_codes
+from bsseqconsensusreads_tpu.utils.oracle import oracle_convert_read
+from bsseqconsensusreads_tpu.utils.testing import BASES, bisulfite_convert, random_genome
+
+
+def run_window_convert(seq, quals, pos, genome, window_start, W, convert=True):
+    """Place one read in a window and run the JAX op; decode results."""
+    bases = np.full((1, W), NBASE, dtype=np.int8)
+    q = np.zeros((1, W), dtype=np.float32)
+    cover = np.zeros((1, W), dtype=bool)
+    off = pos - window_start
+    codes = seq_to_codes(seq)
+    bases[0, off : off + len(codes)] = codes
+    q[0, off : off + len(codes)] = quals
+    cover[0, off : off + len(codes)] = True
+    ref_str = genome[window_start : window_start + W + 1]
+    ref_str += "N" * (W + 1 - len(ref_str))
+    ref = seq_to_codes(ref_str)
+    out_b, out_q, out_c, la, rd = convert_ag_to_ct(
+        bases, q, cover, ref, np.array([convert])
+    )
+    out_b, out_q, out_c = np.asarray(out_b), np.asarray(out_q), np.asarray(out_c)
+    if not out_c[0].any():
+        return "", [], None, int(la[0]), int(rd[0])
+    idx = np.nonzero(out_c[0])[0]
+    assert (np.diff(idx) == 1).all(), "coverage must stay contiguous"
+    new_pos = window_start + idx[0]
+    return (
+        codes_to_seq(out_b[0, idx]),
+        [int(v) for v in out_q[0, idx]],
+        new_pos,
+        int(la[0]),
+        int(rd[0]),
+    )
+
+
+class TestConvertVsOracle:
+    def test_random_reads(self):
+        rng = np.random.default_rng(7)
+        name, genome = random_genome(rng, 3000)
+        for trial in range(40):
+            pos = int(rng.integers(1, 2800))
+            length = int(rng.integers(10, 120))
+            # B-strand-like read: bisulfite-converted bottom strand + noise
+            raw = genome[pos : pos + length]
+            read = bisulfite_convert(raw, genome, pos, "B")
+            read = "".join(
+                c if rng.random() > 0.05 else BASES[rng.integers(0, 4)] for c in read
+            )
+            quals = [int(x) for x in rng.integers(2, 41, size=length)]
+            want = oracle_convert_read(read, quals, pos, genome)
+            got = run_window_convert(read, quals, pos, genome, pos - 4, 160)
+            assert got[0] == want[0], f"trial {trial}: seq mismatch"
+            assert got[1] == want[1], f"trial {trial}: qual mismatch"
+            assert got[2] == want[2], f"trial {trial}: pos mismatch"
+            assert got[3:] == want[3:], f"trial {trial}: la/rd mismatch"
+
+    def test_read_at_position_zero_not_prepended(self):
+        rng = np.random.default_rng(8)
+        _, genome = random_genome(rng, 200)
+        read = genome[0:30].replace("G", "A")  # force conversions
+        quals = [30] * 30
+        got = run_window_convert(read, quals, 0, genome, 0, 128)
+        want = oracle_convert_read(read, quals, 0, genome)
+        assert got[0] == want[0]
+        assert got[3] == 0  # LA=0: no room to prepend
+
+    def test_passthrough_read_untouched(self):
+        rng = np.random.default_rng(9)
+        _, genome = random_genome(rng, 500)
+        read = genome[100:150]
+        quals = [33] * 50
+        got = run_window_convert(read, quals, 100, genome, 96, 128, convert=False)
+        assert got[0] == read
+        assert got[1] == quals
+        assert got[2] == 100
+        assert got[3:] == (0, 0)
+
+
+class TestConvertSemantics:
+    def test_a_over_g_restored(self):
+        # genome ...G..., read A at that position -> G
+        genome = "TTTTGTTTT"
+        got = run_window_convert("TATT", [30] * 4, 3, genome, 2, 128)
+        # prepended base = genome[2]='T'; read T A T T -> T G T T
+        assert got[0] == "TTGTT"
+        assert got[2] == 2
+
+    def test_c_not_cpg_converted_to_t(self):
+        genome = "AAACAAAA"  # C at 3, next base A -> not CpG
+        got = run_window_convert("CAA", [30] * 3, 3, genome, 1, 128)
+        assert got[0][1] == "T"  # the C -> T
+
+    def test_methylated_cpg_pair_rewrite(self):
+        # ref CG at positions 3-4; read C,A -> T,G (signal transfer)
+        genome = "TTTCGTTTT"
+        got = run_window_convert("CAT", [30] * 3, 3, genome, 2, 128)
+        assert got[0] == "TTGT"  # prepend T, then C->T, A->G, T stays
+
+    def test_cpg_without_next_a_keeps_c(self):
+        genome = "TTTCGTTTT"
+        got = run_window_convert("CTT", [30] * 3, 3, genome, 2, 128)
+        assert got[0][1] == "C"  # C kept: next read base is T, not A
+
+    def test_trailing_c_before_g_trimmed(self):
+        # read ends in C at a ref C, next ref base G -> trim + RD=1
+        genome = "TTTTTCGTT"
+        read = "TTC"  # maps at 3: positions 3,4,5; genome[6]='G'
+        got = run_window_convert(read, [30] * 3, 3, genome, 2, 128)
+        assert got[4] == 1  # RD set
+        assert not got[0].endswith("C")
+        assert len(got[1]) == len(got[0])
+
+    def test_prepended_base_is_itself_converted(self):
+        # prepend column lands on a ref C in CpG with next read base A:
+        # the synthetic base must go through the same rules (ref-sub then T)
+        genome = "TTCGTTTTT"
+        # read maps at 3 (the G), first base A
+        got = run_window_convert("ATT", [30] * 3, 3, genome, 1, 128)
+        # prepend = genome[2] = 'C'; CpG (C at 2, G at 3), next read base A
+        # -> prepended C becomes T, the A becomes G
+        assert got[0] == "TGTT"

@@ -1,0 +1,239 @@
+"""Gap extension + duplex merge + fused duplex pipeline tests."""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.io.fasta import FastaFile
+from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline, duplex_consensus
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
+from bsseqconsensusreads_tpu.ops.encode import (
+    codes_to_seq,
+    encode_duplex_families,
+    iter_mi_groups,
+)
+from bsseqconsensusreads_tpu.ops.extend import extend_gap
+from bsseqconsensusreads_tpu.utils.oracle import (
+    oracle_column_vote,
+    oracle_convert_read,
+    oracle_extend_group,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    bisulfite_convert,
+    make_aligned_duplex_group,
+    random_genome,
+    write_fasta,
+)
+
+DUPLEX_PARAMS = ConsensusParams(min_reads=0)
+FLAG_ROW = {99: 0, 163: 1, 83: 2, 147: 3}
+
+
+def encode_groups(rng, genome, name, n=6, softclip=0):
+    recs = []
+    for mi in range(n):
+        start = 20 + mi * 120
+        recs += make_aligned_duplex_group(
+            rng, name, genome, mi, start, 80, softclip=softclip
+        )
+    groups = iter_mi_groups(recs, strip_suffix=True)
+    fa_like = lambda nm, s, e: genome[s:e]
+    return encode_duplex_families(groups, fa_like, [name])
+
+
+def rows_to_records(batch, fi):
+    """Extract per-row (seq, qual, pos) from a batch for oracle comparison."""
+    out = {}
+    for flag, row in FLAG_ROW.items():
+        cov = batch.cover[fi, row]
+        if not cov.any():
+            continue
+        idx = np.nonzero(cov)[0]
+        out[flag] = {
+            "seq": codes_to_seq(batch.bases[fi, row, idx]),
+            "qual": [int(q) for q in batch.quals[fi, row, idx]],
+            "pos": batch.meta[fi].window_start + int(idx[0]),
+        }
+    return out
+
+
+class TestExtendVsOracle:
+    def test_full_group_matches_oracle(self):
+        rng = np.random.default_rng(11)
+        name, genome = random_genome(rng, 1200)
+        batch, leftovers, skipped = encode_groups(rng, genome, name)
+        assert not leftovers and not skipped
+        b, q, c, la, rd = convert_ag_to_ct(
+            batch.bases, batch.quals, batch.cover, batch.ref, batch.convert_mask
+        )
+        b, q, c = np.asarray(b), np.asarray(q), np.asarray(c)
+        la, rd = np.asarray(la), np.asarray(rd)
+        eb, eq, ec = extend_gap(b, q, c, la, rd)
+        eb, eq, ec = np.asarray(eb), np.asarray(eq), np.asarray(ec)
+        for fi in range(len(batch.meta)):
+            # build oracle inputs from the converted (pre-extend) tensors
+            conv = {"bases": b, "quals": q, "cover": c}
+            reads = {}
+            for flag, row in FLAG_ROW.items():
+                cov = c[fi, row]
+                if not cov.any():
+                    continue
+                idx = np.nonzero(cov)[0]
+                reads[flag] = {
+                    "seq": codes_to_seq(b[fi, row, idx]),
+                    "qual": [int(v) for v in q[fi, row, idx]],
+                    "pos": batch.meta[fi].window_start + int(idx[0]),
+                    "la": int(la[fi, row]),
+                    "rd": int(rd[fi, row]),
+                }
+            want = oracle_extend_group(reads)
+            for flag, row in FLAG_ROW.items():
+                if flag not in want:
+                    continue
+                cov = ec[fi, row]
+                idx = np.nonzero(cov)[0]
+                got_seq = codes_to_seq(eb[fi, row, idx])
+                got_pos = batch.meta[fi].window_start + int(idx[0])
+                assert got_seq == want[flag]["seq"], f"family {fi} flag {flag}"
+                assert got_pos == want[flag]["pos"]
+                assert [int(v) for v in eq[fi, row, idx]] == want[flag]["qual"]
+
+    def test_postcondition_identical_spans(self):
+        # After extension, both reads of each pair span the same columns
+        # (the property TemplateCoordinate sorting relies on, SURVEY §3.3).
+        rng = np.random.default_rng(12)
+        name, genome = random_genome(rng, 1200)
+        batch, _, _ = encode_groups(rng, genome, name)
+        b, q, c, la, rd = convert_ag_to_ct(
+            batch.bases, batch.quals, batch.cover, batch.ref, batch.convert_mask
+        )
+        _, _, ec = extend_gap(b, q, c, la, rd)
+        ec = np.asarray(ec)
+        for fi in range(len(batch.meta)):
+            for l_row, r_row in ((1, 0), (2, 3)):
+                li = np.nonzero(ec[fi, l_row])[0]
+                ri = np.nonzero(ec[fi, r_row])[0]
+                if len(li) == 0 or len(ri) == 0:
+                    continue
+                assert li[0] == ri[0], f"family {fi} pair start mismatch"
+                assert li[-1] == ri[-1], f"family {fi} pair end mismatch"
+
+    def test_non_four_read_group_not_extended(self):
+        # Reference gate: only exactly-4-read groups are harmonized
+        # (tools/2.extend_gap.py:114-115). A 2-read group must pass through.
+        rng = np.random.default_rng(21)
+        name, genome = random_genome(rng, 600)
+        recs = [
+            r
+            for r in make_aligned_duplex_group(rng, name, genome, 0, 100, 60)
+            if r.flag in (99, 163)
+        ]
+        groups = iter_mi_groups(recs, strip_suffix=True)
+        batch, _, _ = encode_duplex_families(groups, lambda n, s, e: genome[s:e], [name])
+        assert not batch.extend_eligible[0]
+        b, q, c, la, rd = convert_ag_to_ct(
+            batch.bases, batch.quals, batch.cover, batch.ref, batch.convert_mask
+        )
+        eb, eq, ec = extend_gap(b, q, c, la, rd, batch.extend_eligible)
+        np.testing.assert_array_equal(np.asarray(ec), np.asarray(c))
+
+    def test_missing_partner_is_noop(self):
+        # Family with only the converted read: extension must not invent data.
+        W = 128
+        bases = np.full((1, 4, W), NBASE, np.int8)
+        quals = np.zeros((1, 4, W), np.float32)
+        cover = np.zeros((1, 4, W), bool)
+        bases[0, 1, 10:20] = 1
+        cover[0, 1, 10:20] = True
+        la = np.zeros((1, 4), np.int8)
+        rd = np.zeros((1, 4), np.int8)
+        la[0, 1] = 1
+        eb, eq, ec = extend_gap(bases, quals, cover, la, rd)
+        np.testing.assert_array_equal(np.asarray(ec), cover)
+
+
+class TestDuplexMerge:
+    def test_agreement_and_disagreement_match_oracle(self):
+        rng = np.random.default_rng(13)
+        W = 128
+        bases = rng.integers(0, 4, size=(3, 4, W)).astype(np.int8)
+        quals = rng.integers(10, 41, size=(3, 4, W)).astype(np.float32)
+        out = duplex_consensus(bases, quals, DUPLEX_PARAMS)
+        for fi in range(3):
+            for role, rows in ((0, (0, 1)), (1, (2, 3))):
+                for w in range(0, W, 17):
+                    col_b = [int(bases[fi, r, w]) for r in rows]
+                    col_q = [float(quals[fi, r, w]) for r in rows]
+                    wb, wq, wd, we = oracle_column_vote(col_b, col_q)
+                    assert int(np.asarray(out["base"])[fi, role, w]) == wb
+                    assert int(np.asarray(out["depth"])[fi, role, w]) == wd
+
+    def test_single_strand_family_emits(self):
+        # min-reads=0 semantics: one strand only still produces output.
+        W = 128
+        bases = np.full((1, 4, W), NBASE, np.int8)
+        quals = np.zeros((1, 4, W), np.float32)
+        bases[0, 0, :30] = 2
+        quals[0, 0, :30] = 30.0
+        out = duplex_consensus(bases, quals, DUPLEX_PARAMS)
+        assert (np.asarray(out["base"])[0, 0, :30] == 2).all()
+        assert (np.asarray(out["a_depth"])[0, 0, :30] == 1).all()
+        assert (np.asarray(out["b_depth"])[0, 0, :30] == 0).all()
+
+
+class TestFusedPipeline:
+    def test_error_free_duplex_recovers_ct_genome(self):
+        # Error-free methylated duplex groups: the fused convert+extend+merge
+        # must reproduce the A-strand bisulfite pattern exactly, full depth 2.
+        rng = np.random.default_rng(14)
+        name, genome = random_genome(rng, 1500)
+        batch, leftovers, skipped = encode_groups(rng, genome, name, n=8)
+        assert not leftovers and not skipped
+        out = duplex_call_pipeline(
+            batch.bases, batch.quals, batch.cover, batch.ref, batch.convert_mask,
+            batch.extend_eligible, params=DUPLEX_PARAMS,
+        )
+        base = np.asarray(out["base"])
+        depth = np.asarray(out["depth"])
+        for fi, meta in enumerate(batch.meta):
+            start = meta.window_start
+            expect = bisulfite_convert(
+                genome[start : start + base.shape[-1]], genome, start, "A"
+            )
+            for role in range(2):
+                cov = np.nonzero(depth[fi, role] > 0)[0]
+                assert len(cov) > 0
+                got = codes_to_seq(base[fi, role, cov])
+                want = "".join(expect[i] for i in cov)
+                assert got == want, f"family {fi} role {role}"
+                # interior columns see both strands
+                assert (depth[fi, role, cov[1:-1]] == 2).all()
+
+    def test_softclipped_inputs_handled(self):
+        rng = np.random.default_rng(15)
+        name, genome = random_genome(rng, 1500)
+        batch, leftovers, skipped = encode_groups(rng, genome, name, n=4, softclip=5)
+        assert not skipped
+        out = duplex_call_pipeline(
+            batch.bases, batch.quals, batch.cover, batch.ref, batch.convert_mask,
+            batch.extend_eligible, params=DUPLEX_PARAMS,
+        )
+        assert np.isfinite(np.asarray(out["qual"], np.float32)).all()
+
+    def test_fasta_backed_ref_fetch(self, tmp_path):
+        rng = np.random.default_rng(16)
+        name, genome = random_genome(rng, 900)
+        path = str(tmp_path / "g.fa")
+        write_fasta(path, name, genome)
+        fa = FastaFile(path)
+        recs = make_aligned_duplex_group(rng, name, genome, 0, 50, 60)
+        groups = iter_mi_groups(recs, strip_suffix=True)
+        batch, _, _ = encode_duplex_families(groups, fa.fetch, [name])
+        # fetched reference must cover the family window + 1 lookahead column;
+        # columns beyond that stay N (never read by the kernels)
+        start = batch.meta[0].window_start
+        cov = np.nonzero(batch.cover[0].any(axis=0))[0]
+        window_end = int(cov[-1]) + 1
+        want = genome[start : start + window_end + 1]
+        assert codes_to_seq(batch.ref[0][: len(want)]) == want
