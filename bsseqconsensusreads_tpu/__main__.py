@@ -1,0 +1,3 @@
+from bsseqconsensusreads_tpu.cli import main
+
+raise SystemExit(main())

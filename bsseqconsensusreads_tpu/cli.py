@@ -1,0 +1,176 @@
+"""Command-line interface: `python -m bsseqconsensusreads_tpu <cmd>`.
+
+Subcommands mirror the reference's entry points (SURVEY.md §1 L4):
+
+* run       — the whole pipeline for one sample (the reference's
+              `snakemake -s main.snake.py --config bam=…`, README.md:62)
+* molecular — just the molecular consensus stage (fgbio
+              CallMolecularConsensusReads equivalent, main.snake.py:54)
+* duplex    — just the fused duplex stage (the reference's convert ->
+              extend -> sort -> callduplex chain, main.snake.py:121-164)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+
+def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
+    p.add_argument("--error-rate-pre-umi", type=float, default=45.0)
+    p.add_argument("--error-rate-post-umi", type=float, default=30.0)
+    p.add_argument("--min-input-base-quality", type=int, default=0)
+    p.add_argument("--min-consensus-base-quality", type=int, default=0)
+    p.add_argument("--min-reads", type=int, default=min_reads_default)
+    p.add_argument(
+        "--no-consensus-call-overlapping-bases",
+        action="store_true",
+        help="disable R1/R2 overlap co-calling",
+    )
+    p.add_argument("--batch-families", type=int, default=512)
+    p.add_argument("--max-window", type=int, default=4096)
+    p.add_argument(
+        "--grouping",
+        choices=("gather", "adjacent", "coordinate"),
+        default="coordinate",
+        help="MI-group streaming strategy (coordinate = bounded memory on sorted input)",
+    )
+
+
+def _params(args, **kw) -> ConsensusParams:
+    return ConsensusParams(
+        error_rate_pre_umi=args.error_rate_pre_umi,
+        error_rate_post_umi=args.error_rate_post_umi,
+        min_input_base_quality=args.min_input_base_quality,
+        min_consensus_base_quality=args.min_consensus_base_quality,
+        consensus_call_overlapping_bases=not args.no_consensus_call_overlapping_bases,
+        min_reads=args.min_reads,
+        **kw,
+    )
+
+
+def cmd_run(args) -> int:
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    cfg = (
+        FrameworkConfig.from_yaml(args.config)
+        if args.config
+        else FrameworkConfig()
+    )
+    if args.aligner:
+        cfg.aligner = args.aligner
+    if args.reference:
+        import os
+
+        cfg.genome_dir = os.path.dirname(args.reference) or "."
+        cfg.genome_fasta_file_name = os.path.basename(args.reference)
+    target, results, stats = run_pipeline(
+        cfg, args.bam, outdir=args.outdir, force=args.force
+    )
+    for r in results:
+        status = "ran" if r.ran else "skip"
+        print(f"[{status}] {r.name} ({r.seconds:.2f}s) {r.reason}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "target": target,
+                "stats": {k: s.as_dict() for k, s in stats.items()},
+            }
+        )
+    )
+    return 0
+
+
+def cmd_molecular(args) -> int:
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_molecular
+    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_sort
+
+    stats = StageStats()
+    with BamReader(args.input) as reader:
+        recs = call_molecular(
+            reader,
+            params=_params(args),
+            mode=args.mode,
+            batch_families=args.batch_families,
+            max_window=args.max_window,
+            grouping=args.grouping,
+            stats=stats,
+        )
+        out = list(recs)
+        if args.mode == "self":
+            out = coordinate_sort(out)
+        with BamWriter(args.output, reader.header) as writer:
+            writer.write_all(out)
+    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    return 0
+
+
+def cmd_duplex(args) -> int:
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.io.fasta import FastaFile
+    from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_duplex
+    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_sort
+
+    stats = StageStats()
+    fasta = FastaFile(args.reference)
+    with BamReader(args.input) as reader:
+        names = [n for n, _ in reader.header.references]
+        recs = call_duplex(
+            reader,
+            fasta.fetch,
+            names,
+            params=_params(args),
+            mode=args.mode,
+            batch_families=args.batch_families,
+            max_window=args.max_window,
+            grouping=args.grouping,
+            stats=stats,
+        )
+        out = list(recs)
+        if args.mode == "self":
+            out = coordinate_sort(out)
+        with BamWriter(args.output, reader.header) as writer:
+            writer.write_all(out)
+    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="bsseqconsensusreads_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run the full pipeline for one sample")
+    p.add_argument("--config", default="", help="YAML config (reference-compatible)")
+    p.add_argument("--bam", required=True, help="GroupReadsByUmi output BAM")
+    p.add_argument("--outdir", default="output")
+    p.add_argument("--aligner", choices=("self", "bwameth", "none"), default="")
+    p.add_argument("--reference", default="", help="genome FASTA (overrides config)")
+    p.add_argument("--force", action="store_true")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("molecular", help="molecular consensus stage only")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    _add_params(p, min_reads_default=1)
+    p.set_defaults(fn=cmd_molecular)
+
+    p = sub.add_parser("duplex", help="fused duplex stage only")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--reference", required=True, help="genome FASTA")
+    p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    _add_params(p, min_reads_default=0)
+    p.set_defaults(fn=cmd_duplex)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,65 @@
+"""Framework configuration.
+
+Same YAML surface as the reference's config.yaml (genome_dir,
+genome_fasta_file_name, tmp, external tool paths — reference config.yaml:1-11)
+plus the keys the reference hardcodes in rule bodies, promoted to config as
+SURVEY.md §5.6 prescribes: the consensus error model, backend selection
+(`backend: tpu|cpu`), and the alignment mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import yaml
+
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+
+@dataclasses.dataclass
+class FrameworkConfig:
+    # reference-compatible keys (config.yaml:1-11)
+    genome_dir: str = "."
+    genome_fasta_file_name: str = "genome.fa"
+    tmp: str = "/tmp"
+    bwameth: str = ""  # external aligner path; empty = not available
+    samtools: str = ""  # kept for interop; unused by the native pipeline
+
+    # framework keys (promoted from hardcoded rule bodies, SURVEY.md §5.6)
+    backend: str = "tpu"  # tpu | cpu (cpu = same JAX kernels on host)
+    aligner: str = "self"  # self | bwameth | none
+    batch_families: int = 512
+    max_window: int = 4096
+    #: MI-group streaming strategy: 'coordinate' bounds host memory on
+    #: coordinate-sorted input; 'adjacent' for MI-grouped input; 'gather'
+    #: holds everything (any order). See pipeline.calling.stream_mi_groups.
+    grouping: str = "coordinate"
+    molecular: ConsensusParams = dataclasses.field(
+        default_factory=lambda: ConsensusParams(min_reads=1)
+    )
+    duplex: ConsensusParams = dataclasses.field(
+        default_factory=lambda: ConsensusParams(min_reads=0)
+    )
+
+    @property
+    def genome_fasta(self) -> str:
+        return os.path.join(self.genome_dir, self.genome_fasta_file_name)
+
+    @classmethod
+    def from_yaml(cls, path: str, **overrides) -> "FrameworkConfig":
+        with open(path) as fh:
+            raw = yaml.safe_load(fh) or {}
+        raw.update(overrides)
+        kw = {}
+        for f in dataclasses.fields(cls):
+            if f.name in ("molecular", "duplex"):
+                continue
+            if f.name in raw:
+                kw[f.name] = raw[f.name]
+        cfg = cls(**kw)
+        for side in ("molecular", "duplex"):
+            if side in raw:
+                base = getattr(cfg, side)
+                setattr(cfg, side, base.replace(**raw[side]))
+        return cfg

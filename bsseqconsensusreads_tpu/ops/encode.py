@@ -26,6 +26,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     CDEL,
     CSOFT_CLIP,
     FREAD2,
+    FREVERSE,
 )
 
 from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE
@@ -74,7 +75,9 @@ class FamilyMeta:
     window_start: int
     n_templates: int
     rx: str = ""
-    qname: str = ""
+    #: majority mapped-orientation per role (R1, R2): True = reverse strand.
+    #: Needed to emit unaligned consensus in sequencing orientation.
+    role_reverse: tuple = (False, True)
 
 
 @dataclasses.dataclass
@@ -138,7 +141,7 @@ def encode_molecular_families(
                 continue
             ref_id = rec.ref_id
             role = 1 if rec.flag & FREAD2 else 0
-            templates[rec.qname][role] = (codes, quals, pos)
+            templates[rec.qname][role] = (codes, quals, pos, bool(rec.flag & FREVERSE))
             if rec.has_tag("RX"):
                 rx_counts[rec.get_tag("RX")] += 1
             lo = pos if lo is None else min(lo, pos)
@@ -152,7 +155,14 @@ def encode_molecular_families(
             skipped.append(mi)
             continue
         rx = max(rx_counts, key=rx_counts.get) if rx_counts else ""
-        placed.append((mi, ref_id, lo, window, rx, templates))
+        # majority orientation over the records actually kept (one vote per
+        # (template, role) slot; duplicates overwrite, so vote the survivor)
+        rev_votes = [[0, 0], [0, 0]]
+        for roles in templates.values():
+            for role, (_, _, _, rev) in roles.items():
+                rev_votes[role][1 if rev else 0] += 1
+        role_rev = (rev_votes[0][1] > rev_votes[0][0], rev_votes[1][1] > rev_votes[1][0])
+        placed.append((mi, ref_id, lo, window, rx, templates, role_rev))
         max_t = max(max_t, len(templates))
         max_w = max(max_w, window)
 
@@ -162,13 +172,13 @@ def encode_molecular_families(
     bases = np.full((f, t_pad, 2, w_pad), NBASE, dtype=np.int8)
     quals = np.zeros((f, t_pad, 2, w_pad), dtype=np.uint8)
     meta: list[FamilyMeta] = []
-    for fi, (mi, ref_id, lo, window, rx, templates) in enumerate(placed):
+    for fi, (mi, ref_id, lo, window, rx, templates, role_rev) in enumerate(placed):
         for ti, (qname, roles) in enumerate(templates.items()):
-            for role, (codes, q, pos) in roles.items():
+            for role, (codes, q, pos, _rev) in roles.items():
                 off = pos - lo
                 bases[fi, ti, role, off : off + len(codes)] = codes
                 quals[fi, ti, role, off : off + len(codes)] = q
-        meta.append(FamilyMeta(mi, ref_id, lo, len(templates), rx))
+        meta.append(FamilyMeta(mi, ref_id, lo, len(templates), rx, role_reverse=role_rev))
     return MolecularBatch(bases, quals, meta), skipped
 
 

@@ -1,0 +1,8 @@
+"""Host-side pipeline layer: record ops, streaming callers, workflow engine.
+
+Replaces the reference's external-process toolchain (Picard SamToFastq, fgbio
+ZipperBams / SortBam, samtools view/sort — main.snake.py:58-119,144-153) with
+in-process record operations, and its Snakemake orchestration with a small
+file-DAG workflow engine with the same checkpoint/rerun semantics
+(SURVEY.md §5.4).
+"""

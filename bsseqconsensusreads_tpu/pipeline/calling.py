@@ -1,0 +1,465 @@
+"""Streaming consensus callers: BAM records in, consensus records out.
+
+Host glue between the io layer and the JAX kernels. Replaces the two JVM
+consensus engines of the reference:
+
+* call_molecular — `fgbio CallMolecularConsensusReads` (main.snake.py:46-55)
+* call_duplex    — the whole convert -> extend -> sort -> duplex chain
+                   (main.snake.py:121-164) as one fused TPU stage
+
+Both stream MI families in bounded batches instead of materializing the BAM
+(the reference needs >=100 GB RAM for these steps, README.md:83).
+
+Alignment modes for the emitted consensus:
+* 'unaligned' — parity with fgbio: unmapped records in sequencing
+  orientation, to be realigned externally (bwameth).
+* 'self' — TPU-first shortcut: window-space consensus keeps genomic
+  coordinates, so records are emitted already aligned (flags reconstructed
+  from strand orientation), skipping the SamToFastq/bwameth/ZipperBams
+  round-trip entirely. The reference cannot do this because fgbio consensus
+  discards coordinates.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.io.bam import (
+    BamRecord,
+    FMREVERSE,
+    FMUNMAP,
+    FPAIRED,
+    FPROPER_PAIR,
+    FREAD1,
+    FREAD2,
+    FREVERSE,
+    FUNMAP,
+    CMATCH,
+)
+from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.encode import (
+    codes_to_seq,
+    encode_duplex_families,
+    encode_molecular_families,
+)
+
+_COMPLEMENT = dict(zip("ACGTN", "TGCAN"))
+
+
+def _revcomp(seq: str) -> str:
+    return "".join(_COMPLEMENT[c] for c in reversed(seq))
+
+
+@dataclass
+class StageStats:
+    """Observability for one streaming stage (SURVEY.md §5.5)."""
+
+    records_in: int = 0
+    families: int = 0
+    consensus_out: int = 0
+    skipped_families: int = 0
+    leftover_records: int = 0
+    refragmented_families: int = 0
+    batches: int = 0
+    pad_cells: int = 0
+    used_cells: int = 0
+    wall_seconds: float = 0.0
+
+    @property
+    def pad_waste(self) -> float:
+        total = self.pad_cells + self.used_cells
+        return self.pad_cells / total if total else 0.0
+
+    @property
+    def families_per_second(self) -> float:
+        return self.families / self.wall_seconds if self.wall_seconds else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "records_in": self.records_in,
+            "families": self.families,
+            "consensus_out": self.consensus_out,
+            "skipped_families": self.skipped_families,
+            "leftover_records": self.leftover_records,
+            "refragmented_families": self.refragmented_families,
+            "batches": self.batches,
+            "pad_waste": round(self.pad_waste, 4),
+            "families_per_second": round(self.families_per_second, 1),
+            "wall_seconds": round(self.wall_seconds, 3),
+        }
+
+
+def stream_mi_groups(
+    records: Iterable[BamRecord],
+    strip_suffix: bool = False,
+    grouping: str = "gather",
+    flush_margin: int = 10_000,
+    stats: StageStats | None = None,
+) -> Iterator[tuple[str, list[BamRecord]]]:
+    """Yield (mi, records) groups from a record stream.
+
+    grouping:
+    * 'gather'     — hold all groups until the stream ends; correct for any
+                     input order, memory O(file). (The reference's approach,
+                     tools/2.extend_gap.py:155-178.)
+    * 'adjacent'   — yield a group when the MI changes; O(1 family) memory;
+                     requires MI-grouped input (GroupReadsByUmi /
+                     TemplateCoordinate order).
+    * 'coordinate' — bounded memory for coordinate-sorted input: a group is
+                     flushed once the stream has moved flush_margin bases past
+                     its last read (UMI families are position-local). A family
+                     that reappears after being flushed is processed as a
+                     second family and counted in stats.refragmented_families
+                     rather than silently merged or dropped.
+
+    Records without an MI tag raise, matching the reference
+    (tools/2.extend_gap.py:180).
+    """
+
+    def mi_of(rec: BamRecord) -> str:
+        if not rec.has_tag("MI"):
+            raise ValueError(f"{rec.qname} does not have MI tag.")
+        mi = str(rec.get_tag("MI"))
+        return mi.split("/")[0] if strip_suffix else mi
+
+    if grouping == "gather":
+        groups: dict[str, list[BamRecord]] = {}
+        n = 0
+        for rec in records:
+            n += 1
+            groups.setdefault(mi_of(rec), []).append(rec)
+        if stats is not None:
+            stats.records_in += n
+        yield from groups.items()
+        return
+
+    if grouping == "adjacent":
+        current_mi: str | None = None
+        bucket: list[BamRecord] = []
+        seen: set[str] = set()
+        for rec in records:
+            if stats is not None:
+                stats.records_in += 1
+            mi = mi_of(rec)
+            if mi != current_mi:
+                if bucket:
+                    yield current_mi, bucket
+                if mi in seen and stats is not None:
+                    stats.refragmented_families += 1
+                seen.add(mi)
+                current_mi, bucket = mi, []
+            bucket.append(rec)
+        if bucket:
+            yield current_mi, bucket
+        return
+
+    if grouping != "coordinate":
+        raise ValueError(f"unknown grouping {grouping!r}")
+
+    open_groups: dict[str, list[BamRecord]] = {}
+    group_end: dict[str, tuple[int, int]] = {}  # mi -> (ref_id, max end)
+    flushed: set[str] = set()
+    for rec in records:
+        if stats is not None:
+            stats.records_in += 1
+        mi = mi_of(rec)
+        if rec.pos >= 0 and open_groups:
+            done = [
+                g
+                for g, (rid, end) in group_end.items()
+                if rid != rec.ref_id or end + flush_margin < rec.pos
+            ]
+            for g in done:
+                yield g, open_groups.pop(g)
+                del group_end[g]
+                flushed.add(g)
+        if mi in flushed and mi not in open_groups and stats is not None:
+            stats.refragmented_families += 1
+        open_groups.setdefault(mi, []).append(rec)
+        if rec.pos >= 0:
+            rid, end = group_end.get(mi, (rec.ref_id, -1))
+            group_end[mi] = (rec.ref_id, max(end, rec.reference_end))
+    yield from open_groups.items()
+
+
+def _group_batches(
+    groups: Iterator[tuple[str, list[BamRecord]]], size: int
+) -> Iterator[list[tuple[str, list[BamRecord]]]]:
+    buf: list[tuple[str, list[BamRecord]]] = []
+    for g in groups:
+        buf.append(g)
+        if len(buf) >= size:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def _consensus_tags(depth_arr, err_arr, mi, rx):
+    """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce."""
+    depth_list = [int(d) for d in depth_arr]
+    err_list = [int(e) for e in err_arr]
+    total = sum(depth_list)
+    errs = sum(err_list)
+    tags = {
+        "MI": ("Z", mi),
+        "cD": ("i", max(depth_list) if depth_list else 0),
+        "cM": ("i", min(depth_list) if depth_list else 0),
+        "cE": ("f", errs / total if total else 0.0),
+        "cd": ("B", ("S", depth_list)),
+        "ce": ("B", ("S", err_list)),
+    }
+    if rx:
+        tags["RX"] = ("Z", rx)
+    return tags
+
+
+def _emit_read(
+    *,
+    qname: str,
+    role: int,
+    seq_fwd: str,
+    quals_fwd: bytes,
+    tags: dict,
+    mode: str,
+    reverse: bool,
+    ref_id: int,
+    pos: int,
+    mate_pos: int,
+    mate_reverse: bool,
+    tlen: int,
+) -> BamRecord:
+    """Build one consensus record in either alignment mode."""
+    role_flag = FREAD2 if role else FREAD1
+    if mode == "self":
+        mate_exists = mate_pos >= 0
+        flag = FPAIRED | role_flag
+        if mate_exists:
+            flag |= FPROPER_PAIR
+            if mate_reverse:
+                flag |= FMREVERSE
+        else:
+            flag |= FMUNMAP
+        if reverse:
+            flag |= FREVERSE
+        return BamRecord(
+            qname=qname,
+            flag=flag,
+            ref_id=ref_id,
+            pos=pos,
+            mapq=60,
+            cigar=[(CMATCH, len(seq_fwd))],
+            next_ref_id=ref_id if mate_exists else -1,
+            next_pos=mate_pos if mate_exists else -1,
+            tlen=tlen,
+            seq=seq_fwd,
+            qual=quals_fwd,
+            tags=tags,
+        )
+    seq = _revcomp(seq_fwd) if reverse else seq_fwd
+    qual = quals_fwd[::-1] if reverse else quals_fwd
+    return BamRecord(
+        qname=qname,
+        flag=FPAIRED | FUNMAP | FMUNMAP | role_flag,
+        ref_id=-1,
+        pos=-1,
+        mapq=0,
+        cigar=[],
+        next_ref_id=-1,
+        next_pos=-1,
+        tlen=0,
+        seq=seq,
+        qual=qual,
+        tags=tags,
+    )
+
+
+def call_molecular(
+    records: Iterable[BamRecord],
+    params: ConsensusParams = ConsensusParams(min_reads=1),
+    mode: str = "unaligned",
+    batch_families: int = 512,
+    max_window: int = 4096,
+    grouping: str = "gather",
+    stats: StageStats | None = None,
+) -> Iterator[BamRecord]:
+    """Molecular (single-strand) consensus over MI families.
+
+    min_reads filters whole families by raw read count (fgbio --min-reads=1
+    drops nothing; larger values drop shallow families). grouping controls
+    host memory: 'coordinate'/'adjacent' stream with bounded memory on sorted
+    input (see stream_mi_groups), 'gather' holds the whole input.
+    """
+    stats = stats if stats is not None else StageStats()
+    t0 = time.monotonic()
+    groups = stream_mi_groups(records, grouping=grouping, stats=stats)
+    for chunk in _group_batches(groups, batch_families):
+        batch, skipped = encode_molecular_families(chunk, max_window=max_window)
+        stats.skipped_families += len(skipped)
+        if not batch.meta:
+            continue
+        stats.batches += 1
+        used = int((batch.bases != NBASE).sum())
+        stats.pad_cells += batch.bases.size - used
+        stats.used_cells += used
+        out = molecular_consensus(batch.bases, batch.quals, params)
+        base = np.asarray(out["base"])
+        qual = np.asarray(out["qual"])
+        depth = np.asarray(out["depth"])
+        errors = np.asarray(out["errors"])
+        for fi, meta in enumerate(batch.meta):
+            stats.families += 1
+            n_reads = int((batch.bases[fi] != NBASE).any(axis=-1).sum())
+            if n_reads < params.min_reads:
+                stats.skipped_families += 1
+                continue
+            spans = []
+            for role in range(2):
+                cov = np.nonzero(depth[fi, role] > 0)[0]
+                spans.append(cov)
+            starts = [
+                meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+            ]
+            for role in range(2):
+                cov = spans[role]
+                if len(cov) == 0:
+                    continue
+                seq_fwd = codes_to_seq(base[fi, role, cov])
+                quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+                tags = _consensus_tags(
+                    depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+                )
+                other = 1 - role
+                tlen = 0
+                if starts[0] >= 0 and starts[1] >= 0:
+                    lo = min(starts)
+                    hi = max(
+                        meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                    )
+                    tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
+                yield _emit_read(
+                    qname=meta.mi,
+                    role=role,
+                    seq_fwd=seq_fwd,
+                    quals_fwd=quals_fwd,
+                    tags=tags,
+                    mode=mode,
+                    reverse=meta.role_reverse[role],
+                    ref_id=meta.ref_id,
+                    pos=starts[role],
+                    mate_pos=starts[other],
+                    mate_reverse=meta.role_reverse[other],
+                    tlen=tlen,
+                )
+                stats.consensus_out += 1
+    stats.wall_seconds += time.monotonic() - t0
+
+
+def call_duplex(
+    records: Iterable[BamRecord],
+    ref_fetch,
+    ref_names: Sequence[str],
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    mode: str = "unaligned",
+    batch_families: int = 512,
+    max_window: int = 4096,
+    grouping: str = "gather",
+    stats: StageStats | None = None,
+) -> Iterator[BamRecord]:
+    """The fused duplex stage: convert + extend + duplex merge per MI group.
+
+    Input: the aligned, tag-zipped, mapped-only molecular consensus BAM
+    (reference checkpoint `…_aunamerged_aligned.bam`) — or, in self-aligned
+    flows, call_molecular(mode='self') output directly. min_reads=0 emits
+    every group (README.md:9 "not filtered").
+
+    Records that cannot be tensorized (flags outside {99,163,83,147},
+    duplicate flags, indel reads) are counted as leftovers and dropped — a
+    documented deviation: the reference would pass some of these through to
+    fgbio (SURVEY.md §7.3).
+    """
+    stats = stats if stats is not None else StageStats()
+    t0 = time.monotonic()
+    groups = stream_mi_groups(records, strip_suffix=True, grouping=grouping, stats=stats)
+    for chunk in _group_batches(groups, batch_families):
+        batch, leftovers, skipped = encode_duplex_families(
+            chunk, ref_fetch, ref_names, max_window=max_window
+        )
+        stats.skipped_families += len(skipped)
+        stats.leftover_records += len(leftovers)
+        if not batch.meta:
+            continue
+        stats.batches += 1
+        used = int(batch.cover.sum())
+        stats.pad_cells += batch.cover.size - used
+        stats.used_cells += used
+        out = duplex_call_pipeline(
+            batch.bases,
+            batch.quals,
+            batch.cover,
+            batch.ref,
+            batch.convert_mask,
+            batch.extend_eligible,
+            params=params,
+        )
+        base = np.asarray(out["base"])
+        qual = np.asarray(out["qual"])
+        depth = np.asarray(out["depth"])
+        errors = np.asarray(out["errors"])
+        a_depth = np.asarray(out["a_depth"])
+        b_depth = np.asarray(out["b_depth"])
+        for fi, meta in enumerate(batch.meta):
+            stats.families += 1
+            if meta.n_templates < params.min_reads:
+                # family-level --min-reads filter (0 in the reference's
+                # configuration = emit everything, README.md:9)
+                stats.skipped_families += 1
+                continue
+            spans = [np.nonzero(depth[fi, role] > 0)[0] for role in range(2)]
+            starts = [
+                meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+            ]
+            for role in range(2):
+                cov = spans[role]
+                if len(cov) == 0:
+                    continue
+                seq_fwd = codes_to_seq(base[fi, role, cov])
+                quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+                tags = _consensus_tags(
+                    depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+                )
+                tags["aD"] = ("i", int(a_depth[fi, role, cov].max()))
+                tags["bD"] = ("i", int(b_depth[fi, role, cov].max()))
+                other = 1 - role
+                tlen = 0
+                if starts[0] >= 0 and starts[1] >= 0:
+                    lo = min(starts)
+                    hi = max(
+                        meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                    )
+                    tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
+                # duplex R1 merges the forward-mapped pair (99,163): emit
+                # forward; duplex R2 merges the reverse pair (83,147).
+                yield _emit_read(
+                    qname=meta.mi,
+                    role=role,
+                    seq_fwd=seq_fwd,
+                    quals_fwd=quals_fwd,
+                    tags=tags,
+                    mode=mode,
+                    reverse=bool(role),
+                    ref_id=meta.ref_id,
+                    pos=starts[role],
+                    mate_pos=starts[other],
+                    mate_reverse=not bool(role),
+                    tlen=tlen,
+                )
+                stats.consensus_out += 1
+    stats.wall_seconds += time.monotonic() - t0

@@ -1,0 +1,93 @@
+"""Record-level BAM operations replacing the reference's external tools.
+
+Each function is the in-process equivalent of one shell step of the reference
+pipeline; citations point at the rule that invokes the original.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamRecord,
+    FREAD2,
+    FUNMAP,
+)
+
+#: Consensus/UMI tags ZipperBams grafts from the unaligned onto the aligned
+#: record (fgbio semantics: attributes of the source molecule, not the
+#: alignment).
+GRAFT_TAGS = ("MI", "RX", "cD", "cM", "cE", "cd", "ce", "aD", "bD", "aM", "bM")
+
+
+def filter_mapped(records: Iterable[BamRecord]) -> Iterator[BamRecord]:
+    """`samtools view -F 4` — drop unmapped records (main.snake.py:118)."""
+    for rec in records:
+        if not rec.flag & FUNMAP:
+            yield rec
+
+
+def name_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """`samtools sort -n` — queryname order (main.snake.py:106). R1 before R2
+    within a name, matching htslib's flag-based tiebreak closely enough for
+    the zipper pass that consumes it."""
+    return sorted(records, key=lambda r: (r.qname, bool(r.flag & FREAD2), r.flag))
+
+
+def coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """`--sort Coordinate` of ZipperBams (main.snake.py:106): by (ref, pos);
+    unmapped records go last."""
+    return sorted(
+        records,
+        key=lambda r: (
+            r.ref_id if r.ref_id >= 0 else 1 << 30,
+            r.pos if r.pos >= 0 else 1 << 30,
+            r.qname,
+            r.flag,
+        ),
+    )
+
+
+def template_coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """`fgbio SortBam -s TemplateCoordinate` (main.snake.py:152): order by the
+    template's earliest coordinate so both strands of a duplex group become
+    adjacent — the sole purpose it serves in the reference pipeline. Key:
+    (ref, min(pos, matepos), MI-without-suffix, qname, flag).
+    """
+
+    def key(r: BamRecord):
+        mi = str(r.get_tag("MI")).split("/")[0] if r.has_tag("MI") else ""
+        lo = min(
+            r.pos if r.pos >= 0 else 1 << 30,
+            r.next_pos if r.next_pos >= 0 else 1 << 30,
+        )
+        return (r.ref_id if r.ref_id >= 0 else 1 << 30, lo, mi, r.qname, r.flag)
+
+    return sorted(records, key=key)
+
+
+def zipper_bams(
+    aligned: Iterable[BamRecord],
+    unaligned: Iterable[BamRecord],
+    tags: tuple[str, ...] = GRAFT_TAGS,
+) -> list[BamRecord]:
+    """`fgbio ZipperBams --unmapped … --sort Coordinate` (main.snake.py:106):
+    graft molecule-level tags from the unaligned consensus BAM onto the
+    aligned records (bwameth strips them), then coordinate-sort.
+
+    Records are matched by (qname, read-of-pair). Secondary/supplementary
+    alignments receive the same tags as their primary. Aligned records with
+    no unaligned partner pass through untouched.
+    """
+    lookup: dict[tuple[str, bool], BamRecord] = {}
+    for rec in unaligned:
+        lookup[(rec.qname, bool(rec.flag & FREAD2))] = rec
+    out = []
+    for rec in aligned:
+        src = lookup.get((rec.qname, bool(rec.flag & FREAD2)))
+        if src is not None:
+            for tag in tags:
+                if src.has_tag(tag) and not rec.has_tag(tag):
+                    rec.tags[tag] = src.tags[tag]
+        out.append(rec)
+    return coordinate_sort(out)

@@ -1,0 +1,209 @@
+"""The duplex-consensus pipeline as a workflow over file checkpoints.
+
+Builds the reference's rule chain (main.snake.py:40-189) with the consensus
+stages running on TPU. Intermediate file names match the reference's
+suffix-chain convention (SURVEY.md §3.1) so users of the reference find the
+same checkpoints.
+
+Three alignment modes (config.aligner):
+
+* 'self'    — full TPU path. Window-space consensus keeps coordinates, so
+              the SamToFastq -> bwameth -> ZipperBams -> view -F 4 round-trip
+              (reference rules at main.snake.py:58-119) collapses away;
+              2 rules instead of 11. Final realignment optional.
+* 'bwameth' — parity path: every reference rule has an equivalent here,
+              shelling out to bwameth exactly as the reference does
+              (alignment is external in both designs, SURVEY.md §2.2).
+* 'none'    — stop after molecular consensus FASTQs (user aligns elsewhere).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamWriter
+from bsseqconsensusreads_tpu.io.fasta import FastaFile
+from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
+from bsseqconsensusreads_tpu.io.sam import read_sam
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex,
+    call_molecular,
+)
+from bsseqconsensusreads_tpu.pipeline.record_ops import (
+    coordinate_sort,
+    filter_mapped,
+    zipper_bams,
+)
+from bsseqconsensusreads_tpu.pipeline.workflow import Workflow, WorkflowError
+
+
+def sample_name(bam_path: str) -> str:
+    """The reference's sample derivation (main.snake.py:38)."""
+    return os.path.basename(bam_path).replace(".bam", "")
+
+
+class PipelineBuilder:
+    """Assembles the Workflow for one sample and collects stage stats."""
+
+    def __init__(self, cfg: FrameworkConfig, bam_path: str, outdir: str = "output"):
+        self.cfg = cfg
+        self.bam_path = bam_path
+        self.sample = sample_name(bam_path)
+        self.outdir = outdir
+        self.stats: dict[str, StageStats] = {}
+
+    def out(self, suffix: str) -> str:
+        return os.path.join(self.outdir, f"{self.sample}{suffix}")
+
+    # ---- stage bodies -------------------------------------------------
+
+    def _unaligned_header(self, template: BamHeader) -> BamHeader:
+        h = template.copy()
+        if "@HD" not in h.text:
+            h.text = "@HD\tVN:1.6\tSO:unsorted\n" + h.text
+        return h
+
+    def run_molecular(self, rule, mode: str) -> None:
+        stats = self.stats.setdefault("molecular", StageStats())
+        with BamReader(rule.inputs[0]) as reader:
+            recs = call_molecular(
+                reader,
+                params=self.cfg.molecular,
+                mode=mode,
+                batch_families=self.cfg.batch_families,
+                max_window=self.cfg.max_window,
+                grouping=self.cfg.grouping,
+                stats=stats,
+            )
+            out = list(recs)
+            if mode == "self":
+                out = coordinate_sort(out)
+            with BamWriter(rule.outputs[0], reader.header) as writer:
+                writer.write_all(out)
+
+    def run_duplex(self, rule, mode: str) -> None:
+        stats = self.stats.setdefault("duplex", StageStats())
+        fasta = FastaFile(self.cfg.genome_fasta)
+        with BamReader(rule.inputs[0]) as reader:
+            names = [n for n, _ in reader.header.references]
+            recs = call_duplex(
+                reader,
+                fasta.fetch,
+                names,
+                params=self.cfg.duplex,
+                mode=mode,
+                batch_families=self.cfg.batch_families,
+                max_window=self.cfg.max_window,
+                grouping=self.cfg.grouping,
+                stats=stats,
+            )
+            out = list(recs)
+            if mode == "self":
+                out = coordinate_sort(out)
+            with BamWriter(rule.outputs[0], reader.header) as writer:
+                writer.write_all(out)
+
+    def run_sam_to_fastq(self, rule) -> None:
+        with BamReader(rule.inputs[0]) as reader:
+            sam_to_fastq(reader, rule.outputs[0], rule.outputs[1])
+
+    def run_bwameth(self, rule) -> None:
+        if not self.cfg.bwameth:
+            raise WorkflowError(
+                "aligner 'bwameth' requested but config.bwameth is not set; "
+                "use aligner 'self' for the pure-TPU path"
+            )
+        cmd = (
+            f"{self.cfg.bwameth} --reference {shlex.quote(self.cfg.genome_fasta)} "
+            f"-t 8 {shlex.quote(rule.inputs[0])} {shlex.quote(rule.inputs[1])}"
+        )
+        proc = subprocess.Popen(
+            cmd, shell=True, stdout=subprocess.PIPE, text=True
+        )
+        header, records = read_sam(proc.stdout)
+        with BamWriter(rule.outputs[0], header) as writer:
+            writer.write_all(records)
+        if proc.wait() != 0:
+            raise WorkflowError(f"bwameth failed: {cmd}")
+
+    def run_zipper(self, rule) -> None:
+        with BamReader(rule.inputs[0]) as aligned, BamReader(rule.inputs[1]) as unaligned:
+            merged = zipper_bams(list(aligned), list(unaligned))
+            with BamWriter(rule.outputs[0], aligned.header) as writer:
+                writer.write_all(merged)
+
+    def run_filter_mapped(self, rule) -> None:
+        with BamReader(rule.inputs[0]) as reader:
+            with BamWriter(rule.outputs[0], reader.header) as writer:
+                writer.write_all(filter_mapped(reader))
+
+    # ---- pipeline assembly --------------------------------------------
+
+    def build(self) -> tuple[Workflow, str]:
+        cfg = self.cfg
+        wf = Workflow()
+        if cfg.aligner == "self":
+            aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
+            wf.rule(
+                "call_consensus_molecular_tpu",
+                [self.bam_path],
+                [aligned],
+                lambda r: self.run_molecular(r, mode="self"),
+            )
+            target = self.out("_consensus_duplex_unfiltered.bam")
+            wf.rule(
+                "call_duplex_tpu",
+                [aligned],
+                [target],
+                lambda r: self.run_duplex(r, mode="self"),
+            )
+            return wf, target
+
+        molecular = self.out("_unalignedConsensus_molecular.bam")
+        wf.rule(
+            "call_consensus_reads_molecular",
+            [self.bam_path],
+            [molecular],
+            lambda r: self.run_molecular(r, mode="unaligned"),
+        )
+        fq1 = self.out("_unalignedConsensus_unfiltered_1.fq.gz")
+        fq2 = self.out("_unalignedConsensus_unfiltered_2.fq.gz")
+        wf.rule("consensus_to_fq_unfiltered", [molecular], [fq1, fq2], self.run_sam_to_fastq)
+        if cfg.aligner == "none":
+            return wf, fq1
+
+        aligned0 = self.out("_consensus_unfiltered.bam")
+        wf.rule("align_consensus_unfiltered", [fq1, fq2], [aligned0], self.run_bwameth)
+        merged = self.out("_consensus_unfiltered_aunamerged.bam")
+        wf.rule("mergeAunA_consensus", [aligned0, molecular], [merged], self.run_zipper)
+        aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
+        wf.rule("mergeAunA_consensus_grepaligned", [merged], [aligned], self.run_filter_mapped)
+        duplex = self.out(
+            "_consensus_unfiltered_aunamerged_converted_extended_duplexconsensus.bam"
+        )
+        wf.rule(
+            "callduplex_tpu",
+            [aligned],
+            [duplex],
+            lambda r: self.run_duplex(r, mode="unaligned"),
+        )
+        dfq1 = self.out("_unalignedConsensus_duplex_1.fq.gz")
+        dfq2 = self.out("_unalignedConsensus_duplex_2.fq.gz")
+        wf.rule("consensusduplex_to_fq", [duplex], [dfq1, dfq2], self.run_sam_to_fastq)
+        target = self.out("_consensus_duplex_unfiltered_bwameth.bam")
+        wf.rule("align_consensus_unfiltered_duplex", [dfq1, dfq2], [target], self.run_bwameth)
+        return wf, target
+
+
+def run_pipeline(
+    cfg: FrameworkConfig, bam_path: str, outdir: str = "output", force: bool = False
+):
+    """Build and run the pipeline; returns (target, rule results, stats)."""
+    builder = PipelineBuilder(cfg, bam_path, outdir)
+    wf, target = builder.build()
+    results = wf.run([target], force=force)
+    return target, results, builder.stats

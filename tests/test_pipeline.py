@@ -1,0 +1,370 @@
+"""End-to-end pipeline tests: record ops, workflow engine, streaming callers,
+the self-aligned full pipeline, and the CLI."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamRecord, BamWriter, CMATCH
+from bsseqconsensusreads_tpu.io.sam import format_sam_record, parse_sam_line, read_sam
+from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_duplex, call_molecular
+from bsseqconsensusreads_tpu.pipeline.record_ops import (
+    coordinate_sort,
+    filter_mapped,
+    name_sort,
+    template_coordinate_sort,
+    zipper_bams,
+)
+from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline, sample_name
+from bsseqconsensusreads_tpu.pipeline.workflow import Workflow, WorkflowError
+from bsseqconsensusreads_tpu.utils.testing import (
+    bisulfite_convert,
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+
+def rec(qname, flag, pos=0, ref_id=0, **kw):
+    r = BamRecord(qname=qname, flag=flag, ref_id=ref_id, pos=pos,
+                  seq=kw.pop("seq", "ACGT"), qual=kw.pop("qual", bytes([30] * 4)),
+                  cigar=kw.pop("cigar", [(CMATCH, 4)]), **kw)
+    return r
+
+
+class TestRecordOps:
+    def test_filter_mapped(self):
+        recs = [rec("a", 0), rec("b", 4), rec("c", 99)]
+        assert [r.qname for r in filter_mapped(recs)] == ["a", "c"]
+
+    def test_sorts(self):
+        recs = [rec("b", 99, pos=50), rec("a", 147, pos=10), rec("a", 99, pos=5)]
+        assert [r.qname for r in name_sort(recs)] == ["a", "a", "b"]
+        assert [r.pos for r in coordinate_sort(recs)] == [5, 10, 50]
+
+    def test_template_coordinate_groups_duplex_mates(self):
+        # A/B strand reads of one MI must become adjacent despite positions.
+        a1 = rec("x", 99, pos=100)
+        a1.set_tag("MI", "7/A", "Z")
+        other = rec("y", 99, pos=105)
+        other.set_tag("MI", "9/A", "Z")
+        b1 = rec("z", 163, pos=100)
+        b1.set_tag("MI", "7/B", "Z")
+        srt = template_coordinate_sort([other, b1, a1])
+        mis = [str(r.get_tag("MI")).split("/")[0] for r in srt]
+        assert mis == ["7", "7", "9"]
+
+    def test_zipper_grafts_tags(self):
+        aligned = rec("q1", 99, pos=10)
+        unaligned = rec("q1", 77)
+        unaligned.set_tag("MI", "5/A", "Z")
+        unaligned.set_tag("RX", "AAAA-TTTT", "Z")
+        unaligned.set_tag("cD", 7, "i")
+        out = zipper_bams([aligned], [unaligned])
+        assert out[0].get_tag("MI") == "5/A"
+        assert out[0].get_tag("cD") == 7
+        # aligned record without partner passes through
+        lone = rec("solo", 99, pos=5)
+        assert zipper_bams([lone], [unaligned])[0].qname == "solo"
+
+
+class TestSamInterop:
+    def test_sam_round_trip(self):
+        header = BamHeader("@HD\tVN:1.6\n", [("chr1", 1000)])
+        r = rec("q", 99, pos=42, seq="ACGTA", qual=bytes([30, 31, 32, 33, 34]),
+                cigar=[(CMATCH, 5)], next_ref_id=0, next_pos=100, tlen=62)
+        r.set_tag("MI", "3/A", "Z")
+        r.set_tag("cD", 4, "i")
+        r.set_tag("cd", ("S", [1, 2, 3]), "B")
+        line = format_sam_record(r, header)
+        back = parse_sam_line(line, header)
+        assert back.qname == "q" and back.pos == 42 and back.seq == "ACGTA"
+        assert back.qual == r.qual
+        assert back.get_tag("MI") == "3/A"
+        assert back.get_tag("cd") == ("S", [1, 2, 3])
+
+    def test_read_sam_stream(self):
+        import io as _io
+
+        text = (
+            "@HD\tVN:1.6\n@SQ\tSN:c\tLN:100\n"
+            "q\t99\tc\t11\t60\t4M\t=\t20\t13\tACGT\tIIII\tMI:Z:1/A\n"
+        )
+        header, records = read_sam(_io.StringIO(text))
+        recs = list(records)
+        assert header.references == [("c", 100)]
+        assert recs[0].pos == 10
+        assert recs[0].get_tag("MI") == "1/A"
+
+
+class TestWorkflowEngine:
+    def test_dag_run_skip_and_rerun(self, tmp_path):
+        log = []
+        src = tmp_path / "in.txt"
+        mid = tmp_path / "mid.txt"
+        out = tmp_path / "out.txt"
+        src.write_text("1")
+
+        def mk(name, inp, outp):
+            def run(rule):
+                log.append(name)
+                outp.write_text(inp.read_text() + name)
+
+            return run
+
+        wf = Workflow()
+        wf.rule("a", [str(src)], [str(mid)], mk("a", src, mid))
+        wf.rule("b", [str(mid)], [str(out)], mk("b", mid, out))
+        res = wf.run([str(out)])
+        assert [r.name for r in res if r.ran] == ["a", "b"]
+        # second run: everything up to date
+        res = wf.run([str(out)])
+        assert all(not r.ran for r in res)
+        # touch the source: both rules re-run
+        os.utime(src, (os.path.getmtime(src) + 10,) * 2)
+        res = wf.run([str(out)])
+        assert [r.name for r in res if r.ran] == ["a", "b"]
+
+    def test_temp_cleanup(self, tmp_path):
+        src = tmp_path / "in.txt"
+        mid = tmp_path / "mid.txt"
+        out = tmp_path / "out.txt"
+        src.write_text("1")
+        wf = Workflow()
+        wf.rule("a", [str(src)], [str(mid)], lambda r: mid.write_text("m"),
+                temp_outputs=[str(mid)])
+        wf.rule("b", [str(mid)], [str(out)], lambda r: out.write_text("o"))
+        wf.run([str(out)])
+        assert out.exists() and not mid.exists()
+
+    def test_missing_input_raises(self, tmp_path):
+        wf = Workflow()
+        wf.rule("a", [str(tmp_path / "ghost")], [str(tmp_path / "x")], lambda r: None)
+        with pytest.raises(WorkflowError, match="no rule produces"):
+            wf.run([str(tmp_path / "x")])
+
+    def test_duplicate_output_rejected(self, tmp_path):
+        wf = Workflow()
+        wf.rule("a", [], [str(tmp_path / "x")], lambda r: None)
+        with pytest.raises(WorkflowError, match="produced by both"):
+            wf.rule("b", [], [str(tmp_path / "x")], lambda r: None)
+
+
+@pytest.fixture(scope="module")
+def pipeline_env(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pipe")
+    rng = np.random.default_rng(31)
+    name, genome = random_genome(rng, 6000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=12, error_rate=0.01
+    )
+    bam = str(tmp / "input" / "sampleX.bam")
+    os.makedirs(os.path.dirname(bam), exist_ok=True)
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    return {"tmp": tmp, "genome": genome, "name": name, "fasta": fasta, "bam": bam}
+
+
+class TestSelfAlignedPipeline:
+    def test_full_run(self, pipeline_env):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output")
+        target, results, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert os.path.exists(target)
+        assert [r.name for r in results if r.ran] == [
+            "call_consensus_molecular_tpu",
+            "call_duplex_tpu",
+        ]
+        with BamReader(target) as r:
+            duplex = list(r)
+        # 12 families -> R1+R2 each
+        assert len(duplex) == 24
+        genome = env["genome"]
+        checked = 0
+        for d in duplex:
+            assert d.has_tag("MI") and d.has_tag("cD") and d.has_tag("cd")
+            expect = bisulfite_convert(
+                genome[d.pos : d.pos + len(d.seq)], genome, d.pos, "A"
+            )
+            mismatches = sum(a != b for a, b in zip(d.seq, expect))
+            assert mismatches <= 2  # 1% raw error, depth>=4: near-perfect
+            checked += 1
+        assert checked == 24
+        # second invocation: everything cached
+        _, results2, _ = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert all(not r.ran for r in results2)
+
+    def test_stats_populated(self, pipeline_env):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output2")
+        _, _, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert stats["molecular"].families == 24  # 12 MIs x 2 strands
+        assert stats["duplex"].families == 12
+        assert stats["molecular"].consensus_out == 48
+        assert 0 <= stats["molecular"].pad_waste < 1
+
+
+class TestParityModeStages:
+    def test_unaligned_molecular_then_fastq(self, pipeline_env):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="none",
+        )
+        outdir = str(env["tmp"] / "output3")
+        target, results, _ = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert target.endswith("_unalignedConsensus_unfiltered_1.fq.gz")
+        sample = sample_name(env["bam"])
+        mol = os.path.join(outdir, f"{sample}_unalignedConsensus_molecular.bam")
+        with BamReader(mol) as r:
+            recs = list(r)
+        assert all(r.flag in (77, 141) for r in recs)
+        assert all(r.ref_id == -1 and r.pos == -1 for r in recs)
+        lines = gzip.open(target, "rt").read().splitlines()
+        assert len(lines) == 4 * sum(1 for r in recs if r.flag == 77)
+
+    def test_bwameth_missing_raises(self, pipeline_env):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="bwameth",
+        )
+        outdir = str(env["tmp"] / "output4")
+        with pytest.raises(WorkflowError, match="bwameth"):
+            run_pipeline(cfg, env["bam"], outdir=outdir)
+
+
+class TestStreaming:
+    def _tagged(self, qname, mi, pos):
+        r = rec(qname, 99, pos=pos)
+        r.set_tag("MI", mi, "Z")
+        return r
+
+    def test_adjacent_grouping(self):
+        from bsseqconsensusreads_tpu.pipeline.calling import stream_mi_groups
+
+        recs = [self._tagged("a", "1", 0), self._tagged("b", "1", 5),
+                self._tagged("c", "2", 10)]
+        got = list(stream_mi_groups(recs, grouping="adjacent"))
+        assert [(mi, len(g)) for mi, g in got] == [("1", 2), ("2", 1)]
+
+    def test_coordinate_grouping_flushes_and_counts_refragmented(self):
+        from bsseqconsensusreads_tpu.pipeline.calling import stream_mi_groups
+
+        stats = StageStats()
+        recs = [
+            self._tagged("a", "1", 100),
+            self._tagged("b", "2", 150),
+            self._tagged("c", "2", 200),
+            # far downstream: families 1 and 2 must flush before this
+            self._tagged("d", "3", 50_000),
+            # family 1 reappears after flush -> refragmented
+            self._tagged("e", "1", 50_100),
+        ]
+        got = list(stream_mi_groups(recs, grouping="coordinate", stats=stats))
+        mis = [mi for mi, _ in got]
+        assert mis == ["1", "2", "3", "1"]
+        assert stats.refragmented_families == 1
+        assert stats.records_in == 5
+
+    def test_coordinate_streaming_matches_gather_end_to_end(self, pipeline_env):
+        env = pipeline_env
+        from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+        with BamReader(env["bam"]) as r:
+            recs = list(r)
+        a = sorted(
+            (x.qname, x.flag, x.seq)
+            for x in call_molecular(recs, grouping="gather")
+        )
+        b = sorted(
+            (x.qname, x.flag, x.seq)
+            for x in call_molecular(recs, grouping="coordinate")
+        )
+        assert a == b
+
+
+class TestMinReadsFilters:
+    def test_duplex_min_reads_filters_families(self, pipeline_env):
+        env = pipeline_env
+        from bsseqconsensusreads_tpu.io.fasta import FastaFile
+        from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output_minreads")
+        run_pipeline(cfg, env["bam"], outdir=outdir)
+        sample = sample_name(env["bam"])
+        aligned = os.path.join(
+            outdir, f"{sample}_consensus_unfiltered_aunamerged_aligned.bam"
+        )
+        fa = FastaFile(env["fasta"])
+        with BamReader(aligned) as r:
+            names = [n for n, _ in r.header.references]
+            recs = list(r)
+        # every group has 4 consensus reads; min_reads=5 must drop them all
+        stats = StageStats()
+        out = list(
+            call_duplex(
+                recs, fa.fetch, names,
+                params=ConsensusParams(min_reads=5), stats=stats,
+            )
+        )
+        assert out == []
+        assert stats.skipped_families == stats.families
+
+
+class TestCli:
+    def test_cli_duplex_stage(self, pipeline_env, capsys):
+        env = pipeline_env
+        from bsseqconsensusreads_tpu.cli import main
+
+        # build the aligned molecular consensus first via the self pipeline
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output5")
+        run_pipeline(cfg, env["bam"], outdir=outdir)
+        sample = sample_name(env["bam"])
+        aligned = os.path.join(
+            outdir, f"{sample}_consensus_unfiltered_aunamerged_aligned.bam"
+        )
+        out = str(env["tmp"] / "cli_duplex.bam")
+        rc = main(
+            [
+                "duplex",
+                "-i", aligned,
+                "-o", out,
+                "--reference", env["fasta"],
+                "--mode", "self",
+            ]
+        )
+        assert rc == 0
+        with BamReader(out) as r:
+            assert len(list(r)) == 24
+        err = capsys.readouterr().err
+        stats = json.loads(err.strip().splitlines()[-1])
+        assert stats["families"] == 12

@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""Drop-in TPU duplex-consensus stage.
+
+Replaces the reference's four-rule chain convert_Bstrain -> extend ->
+groupsort_convert -> callduplex (reference: main.snake.py:121-164) with a
+single TPU stage, gated behind `config['backend'] == 'tpu'` in a
+Snakemake rule of the same shape (BASELINE.json north_star):
+
+    rule callduplex:
+        input:  "output/{s}_consensus_unfiltered_aunamerged_aligned.bam"
+        output: "output/{s}_…_duplexconsensus.bam"
+        shell:
+            "{python3} tools/call_duplex_consensus_tpu.py "
+            "-i {input} -o {output} --reference {genome}"
+
+Emits the same unfiltered duplex consensus BAM with RX/MI tags
+(reference: README.md:9).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bsseqconsensusreads_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["duplex"] + sys.argv[1:]))

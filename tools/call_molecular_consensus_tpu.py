@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Drop-in TPU molecular-consensus stage.
+
+Replaces `fgbio CallMolecularConsensusReads` in the reference's first rule
+(reference: main.snake.py:46-55) with the TPU kernel; same I/O shape:
+
+    rule call_consensus_reads_molecular:
+        input:  "input/{s}.bam"            # GroupReadsByUmi -s Paired output
+        output: "output/{s}_unalignedConsensus_molecular.bam"
+        shell:
+            "{python3} tools/call_molecular_consensus_tpu.py -i {input} -o {output}"
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bsseqconsensusreads_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["molecular"] + sys.argv[1:]))
