@@ -1,0 +1,135 @@
+"""Benchmark: duplex consensus reads/sec on one chip vs the scalar CPU path.
+
+Prints ONE JSON line:
+  {"metric": "duplex consensus reads/sec/chip", "value": N,
+   "unit": "reads/sec", "vs_baseline": R}
+
+The baseline is the measured per-read rate of the scalar-Python oracle
+pipeline (oracle_convert_read + oracle_extend_group + oracle_column_vote) on
+the same data — the stand-in for the reference's pysam/JVM per-read loops
+(the reference publishes no numbers, BASELINE.md; a baseline must be
+measured). The TPU path times the fused duplex kernel end-to-end per batch:
+host->device transfer + convert + extend + duplex vote + device->host.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import jax
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.duplex import (
+    duplex_call_pipeline_packed,
+    unpack_duplex_outputs,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+from bsseqconsensusreads_tpu.utils import oracle
+
+PARAMS = ConsensusParams(min_reads=0)
+F = 16384  # families per batch (large batches amortize dispatch latency)
+W = 192  # window: 150bp reads + margins (1.5 x 128-lane tiles)
+READ_LEN = 150
+READS_PER_FAMILY = 4
+
+
+def make_batch(f: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    bases = np.full((f, 4, W), NBASE, dtype=np.int8)
+    quals = np.zeros((f, 4, W), dtype=np.uint8)  # kernels upcast on device
+    cover = np.zeros((f, 4, W), dtype=bool)
+    ref = rng.integers(0, 4, size=(f, W + 1)).astype(np.int8)
+    start = 4
+    for row in range(4):
+        # pairs (99,163) share a span; (83,147) end-shifted like real duplexes
+        off = start if row in (0, 1) else start + (W - 2 * start - READ_LEN)
+        read = rng.integers(0, 4, size=(f, READ_LEN))
+        bases[:, row, off : off + READ_LEN] = read
+        quals[:, row, off : off + READ_LEN] = rng.integers(10, 41, size=(f, READ_LEN))
+        cover[:, row, off : off + READ_LEN] = True
+    convert_mask = np.zeros((f, 4), dtype=bool)
+    convert_mask[:, 1] = convert_mask[:, 2] = True
+    eligible = np.ones(f, dtype=bool)
+    return bases, quals, cover, ref, convert_mask, eligible
+
+
+def bench_tpu(iters: int = 10) -> float:
+    """Returns raw consensus input reads/sec through the fused duplex stage."""
+    args = make_batch(F)
+    # warmup/compile
+    packed, la, rd = duplex_call_pipeline_packed(*args, params=PARAMS)
+    jax.device_get(packed)
+    t0 = time.monotonic()
+    prev = None
+    for i in range(iters):
+        dev_args = [jax.device_put(a) for a in args]
+        packed, la, rd = duplex_call_pipeline_packed(*dev_args, params=PARAMS)
+        packed.copy_to_host_async()
+        if prev is not None:
+            unpack_duplex_outputs(jax.device_get(prev), f=F, w=W)
+        prev = packed
+    unpack_duplex_outputs(jax.device_get(prev), f=F, w=W)
+    dt = time.monotonic() - t0
+    return F * READS_PER_FAMILY * iters / dt
+
+
+def bench_oracle(n_families: int = 150) -> float:
+    """Scalar-Python per-read rate over the same work (convert the B-strand
+    rows, extend, per-column duplex vote). Measured in CPU process time so
+    container scheduling noise doesn't skew the ratio."""
+    bases, quals, cover, ref, convert_mask, eligible = make_batch(n_families, seed=1)
+    genomes = [codes_to_seq(ref[i]) for i in range(n_families)]
+    t0 = time.process_time()
+    for fi in range(n_families):
+        reads = {}
+        for flag, row in ((99, 0), (163, 1), (83, 2), (147, 3)):
+            idx = np.nonzero(cover[fi, row])[0]
+            seq = codes_to_seq(bases[fi, row, idx])
+            q = [int(x) for x in quals[fi, row, idx]]
+            pos = int(idx[0])
+            if row in (1, 2):
+                seq, q, pos, la, rd = oracle.oracle_convert_read(
+                    seq, q, pos, genomes[fi]
+                )
+            else:
+                la = rd = 0
+            reads[flag] = {"seq": seq, "qual": q, "pos": pos, "la": la, "rd": rd}
+        reads = oracle.oracle_extend_group(reads)
+        for pair in ((99, 163), (83, 147)):
+            r0, r1 = reads[pair[0]], reads[pair[1]]
+            lo = min(r0["pos"], r1["pos"])
+            hi = max(r0["pos"] + len(r0["seq"]), r1["pos"] + len(r1["seq"]))
+            for w in range(lo, hi):
+                col_b, col_q = [], []
+                for r in (r0, r1):
+                    j = w - r["pos"]
+                    if 0 <= j < len(r["seq"]):
+                        col_b.append("ACGTN".index(r["seq"][j]))
+                        col_q.append(float(r["qual"][j]))
+                oracle.oracle_column_vote(col_b, col_q)
+    dt = time.process_time() - t0
+    return n_families * READS_PER_FAMILY / dt
+
+
+def main() -> None:
+    tpu_rate = max(bench_tpu(iters=5) for _ in range(2))
+    # best-of-3 so a background-load hiccup doesn't skew the ratio
+    cpu_rate = max(bench_oracle() for _ in range(3))
+    print(
+        json.dumps(
+            {
+                "metric": "duplex consensus reads/sec/chip",
+                "value": round(tpu_rate, 1),
+                "unit": "reads/sec",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from bsseqconsensusreads_tpu.alphabet import NBASE
-from bsseqconsensusreads_tpu.models.molecular import column_vote
+from bsseqconsensusreads_tpu.models.molecular import column_vote, narrow_outputs
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
 from bsseqconsensusreads_tpu.ops.extend import (
@@ -50,8 +50,13 @@ def _merge(bases, quals, rows, params):
     q = jnp.stack([quals[..., r, :] for r in rows], axis=-2)
     out = column_vote(b, q, params)
     a_row, b_row = (rows[0], rows[1]) if rows[0] in A_ROWS else (rows[1], rows[0])
-    out["a_depth"] = (bases[..., a_row, :] != NBASE).astype(jnp.int32)
-    out["b_depth"] = (bases[..., b_row, :] != NBASE).astype(jnp.int32)
+    # per-strand depths use the same observation filter as the vote, so
+    # a_depth + b_depth == depth always (the packed wire format relies on it)
+    for key, row in (("a_depth", a_row), ("b_depth", b_row)):
+        out[key] = (
+            (bases[..., row, :] != NBASE)
+            & (quals[..., row, :] >= params.min_input_base_quality)
+        ).astype(jnp.int32)
     return out
 
 
@@ -71,7 +76,8 @@ def duplex_consensus(bases, quals, params: ConsensusParams = ConsensusParams(min
     a_depth, b_depth. Roles: 0 = duplex R1, 1 = duplex R2.
     """
     quals = quals.astype(jnp.float32)
-    return jax.vmap(lambda b, q: _family_duplex(b, q, params))(bases, quals)
+    out = jax.vmap(lambda b, q: _family_duplex(b, q, params))(bases, quals)
+    return narrow_outputs(out)
 
 
 @partial(jax.jit, static_argnames=("params",))
@@ -95,3 +101,69 @@ def duplex_call_pipeline(
     out["la"] = la
     out["rd"] = rd
     return out
+
+
+def pack_duplex_outputs(out: dict):
+    """Pack the per-column duplex outputs into one uint8 [..., 2, W, 2] array.
+
+    The device->host hop on tunneled TPU hosts is latency- and
+    bandwidth-bound (~66 ms/fetch + ~34 MB/s measured); six separate array
+    fetches per batch dominate the stage. Duplex columns fit 2 bytes:
+
+      byte0 = base(3b) | depth(2b)<<3 | errors(2b)<<5 | a_depth(1b)<<7
+      byte1 = qual   (duplex depth/errors are bounded by 2 strands;
+                      b_depth = depth - a_depth)
+
+    la/rd ride separately (tiny [..., 4] int8). Unpack host-side with
+    unpack_duplex_outputs.
+    """
+    b0 = (
+        out["base"].astype(jnp.uint8)
+        | (out["depth"].astype(jnp.uint8) << 3)
+        | (out["errors"].astype(jnp.uint8) << 5)
+        | (out["a_depth"].astype(jnp.uint8) << 7)
+    )
+    packed = jnp.stack([b0, out["qual"].astype(jnp.uint8)], axis=-1)
+    # Flatten to 1D u32 for the wire: the tunnel moves 1D word-sized arrays
+    # ~2x faster than small-minor-dim u8 arrays (measured 34 vs 18 MB/s).
+    flat = packed.reshape(-1, 4)
+    return jax.lax.bitcast_convert_type(flat, jnp.uint32).reshape(-1)
+
+
+def unpack_duplex_outputs(packed, f: int | None = None, w: int | None = None) -> dict:
+    """numpy inverse of pack_duplex_outputs (host side).
+
+    Accepts either the 4D uint8 layout or the 1D uint32 wire format (then
+    f/w are required to restore [f, 2, w, 2])."""
+    import numpy as np
+
+    packed = np.asarray(packed)
+    if packed.ndim == 1:
+        packed = packed.view(np.uint8).reshape(f, 2, w, 2)
+    b0 = packed[..., 0]
+    depth = (b0 >> 3) & 0x3
+    a_depth = (b0 >> 7) & 0x1
+    return {
+        "base": (b0 & 0x7).astype(np.int8),
+        "qual": packed[..., 1],
+        "depth": depth.astype(np.int16),
+        "errors": ((b0 >> 5) & 0x3).astype(np.int16),
+        "a_depth": a_depth.astype(np.int8),
+        "b_depth": (depth - a_depth).astype(np.int8),
+    }
+
+
+@partial(jax.jit, static_argnames=("params",))
+def duplex_call_pipeline_packed(
+    bases, quals, cover, ref, convert_mask, extend_eligible,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+):
+    """duplex_call_pipeline with per-column outputs packed for one fetch.
+
+    Returns (packed uint32 [F*2*W*2/4] wire array, la int8 [F, 4],
+    rd int8 [F, 4]); unpack with unpack_duplex_outputs(packed, f, w).
+    """
+    out = duplex_call_pipeline(
+        bases, quals, cover, ref, convert_mask, extend_eligible, params=params
+    )
+    return pack_duplex_outputs(out), out["la"], out["rd"]

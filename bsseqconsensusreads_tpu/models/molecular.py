@@ -70,13 +70,13 @@ def overlap_cocall(bases, quals):
     )
 
 
-def column_vote(bases, quals, params: ConsensusParams):
-    """Quality-weighted log-likelihood vote.
+def vote_partials(bases, quals, params: ConsensusParams):
+    """Per-column partial sums of the vote, reduced over the reads axis.
 
     bases: int8 [R, W] (4 = no observation), quals: float32 [R, W] Phred.
-    Returns dict with per-column consensus arrays (length W):
-      base (int8, 4 where uncalled), qual (uint8), depth (int32),
-      errors (int32).
+    Returns (ll [W, 4], depth [W]) — pure sums over reads, so shards of the
+    reads axis can compute these locally and psum them (the deep-family
+    segmented reduction in parallel.deep_family rides exactly this split).
     """
     observed = (bases != NBASE) & (quals >= params.min_input_base_quality)
     p_err = phred.adjust_quals_post_umi(quals, params.error_rate_post_umi)
@@ -89,10 +89,17 @@ def column_vote(bases, quals, params: ConsensusParams):
         axis=0,
     )  # [W, 4]
     depth = jnp.sum(observed, axis=0).astype(jnp.int32)  # [W]
+    return ll, depth
+
+
+def vote_finalize(ll, depth, params: ConsensusParams):
+    """Turn reduced vote sums into (base, qual): argmax + posterior + pre-UMI
+    adjustment. Deterministic given (ll, depth) — replicas holding identical
+    psum results finalize identically."""
     called = depth > 0
     cons = jnp.argmax(ll, axis=-1)  # [W]
     post = jax.nn.softmax(ll, axis=-1)
-    p_cons = 1.0 - jnp.take_along_axis(post, cons[:, None], axis=-1)[:, 0]
+    p_cons = 1.0 - jnp.take_along_axis(post, cons[..., None], axis=-1)[..., 0]
     p_final = phred.prob_error_two_trials(
         p_cons, phred.phred_to_prob(params.error_rate_pre_umi)
     )
@@ -101,10 +108,38 @@ def column_vote(bases, quals, params: ConsensusParams):
     cons = jnp.where(called & ~low, cons, NBASE).astype(jnp.int8)
     qual = jnp.where(called & ~low, qual, float(NO_CALL_QUAL))
     qual = jnp.round(qual).astype(jnp.uint8)
-    errors = jnp.sum(
-        jnp.where(observed & (cons[None, :] != NBASE) & (bases != cons[None, :]), 1, 0),
-        axis=0,
-    ).astype(jnp.int32)
+    return cons, qual
+
+
+def count_errors(bases, quals, cons, params: ConsensusParams):
+    """Per-column count of observations disagreeing with the consensus —
+    also a pure sum over reads (psum-able). int32 while reducing; callers
+    narrow for transport."""
+    observed = (bases != NBASE) & (quals >= params.min_input_base_quality)
+    disagree = observed & (cons[..., None, :] != NBASE) & (bases != cons[..., None, :])
+    return jnp.sum(jnp.where(disagree, 1, 0), axis=-2).astype(jnp.int32)
+
+
+def narrow_outputs(out: dict) -> dict:
+    """Narrow count dtypes for the device->host hop (the tunnel hop is the
+    bottleneck on this hardware — SURVEY.md §6 HBM/host budget): depths and
+    errors fit int16 (family depth is bounded by the template bucket, max
+    1024), per-strand coverage fits int8."""
+    narrow = {"depth": jnp.int16, "errors": jnp.int16, "a_depth": jnp.int8, "b_depth": jnp.int8}
+    return {k: (v.astype(narrow[k]) if k in narrow else v) for k, v in out.items()}
+
+
+def column_vote(bases, quals, params: ConsensusParams):
+    """Quality-weighted log-likelihood vote.
+
+    bases: int8 [R, W] (4 = no observation), quals: float32 [R, W] Phred.
+    Returns dict with per-column consensus arrays (length W):
+      base (int8, 4 where uncalled), qual (uint8), depth (int32),
+      errors (int32).
+    """
+    ll, depth = vote_partials(bases, quals, params)
+    cons, qual = vote_finalize(ll, depth, params)
+    errors = count_errors(bases, quals, cons, params)
     return {"base": cons, "qual": qual, "depth": depth, "errors": errors}
 
 
@@ -123,8 +158,10 @@ def molecular_consensus(bases, quals, params: ConsensusParams = ConsensusParams(
     """Batched molecular consensus.
 
     bases: int8 [F, T, 2, W], quals: uint8/float32 [F, T, 2, W].
-    Returns dict of [F, 2, W] arrays: base, qual, depth, errors.
-    min_reads is a family-level filter (fgbio drops whole families below it);
-    apply it host-side on meta.n_templates — this kernel always emits.
+    Returns dict of [F, 2, W] arrays: base, qual, depth (int16),
+    errors (int16). min_reads is a family-level filter (fgbio drops whole
+    families below it); apply it host-side on meta.n_templates — this kernel
+    always emits.
     """
-    return jax.vmap(lambda b, q: _family_consensus(b, q, params))(bases, quals)
+    out = jax.vmap(lambda b, q: _family_consensus(b, q, params))(bases, quals)
+    return narrow_outputs(out)

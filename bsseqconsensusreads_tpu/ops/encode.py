@@ -113,13 +113,21 @@ def bucket_window(w: int) -> int:
     return max(LANE, _round_up(w, LANE))
 
 
+#: Families deeper than this are skipped AND reported (never silent):
+#: keeps counts inside the int16 transport dtypes (narrow_outputs) with a
+#: wide margin; real UMI families this deep are degenerate-UMI artifacts.
+MAX_TEMPLATES = 4096
+
+
 def encode_molecular_families(
     families: Sequence[tuple[str, Sequence[BamRecord]]],
     max_window: int = 4096,
+    max_templates: int = MAX_TEMPLATES,
 ) -> tuple[MolecularBatch, list[str]]:
     """Encode MI families (already grouped, e.g. by io streaming) into one
-    padded batch. Families whose window exceeds max_window are skipped and
-    reported (never silently dropped — SURVEY.md §7.3 'no silent caps').
+    padded batch. Families whose window exceeds max_window or whose template
+    count exceeds max_templates are skipped and reported (never silently
+    dropped — SURVEY.md §7.3 'no silent caps').
 
     Returns (batch, skipped_mi_list).
     """
@@ -151,7 +159,7 @@ def encode_molecular_families(
             skipped.append(mi)
             continue
         window = hi - lo
-        if window > max_window:
+        if window > max_window or len(templates) > max_templates:
             skipped.append(mi)
             continue
         rx = max(rx_counts, key=rx_counts.get) if rx_counts else ""

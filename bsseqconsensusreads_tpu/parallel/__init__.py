@@ -1,0 +1,24 @@
+"""Multi-chip execution: device meshes, family-axis sharding, deep-family
+segmented reductions.
+
+The reference has no distributed layer at all (SURVEY.md §2.3, §5.8 — its
+only parallelism is Snakemake core scheduling and per-process threads). The
+TPU design shards the embarrassingly-parallel MI-family axis over the mesh's
+'data' axis with shard_map (zero collectives), and splits very deep families
+(>500 reads, BASELINE.json config 3) over a 'reads' axis whose partial vote
+sums are combined with psum — the framework's segmented reduction. All
+collectives ride ICI within a slice; nothing crosses DCN per batch.
+"""
+
+from bsseqconsensusreads_tpu.parallel.mesh import (  # noqa: F401
+    default_mesh,
+    make_mesh,
+    pad_families,
+)
+from bsseqconsensusreads_tpu.parallel.sharding import (  # noqa: F401
+    sharded_duplex_pipeline,
+    sharded_molecular_consensus,
+)
+from bsseqconsensusreads_tpu.parallel.deep_family import (  # noqa: F401
+    deep_family_consensus,
+)

@@ -1,0 +1,70 @@
+"""Deep-family consensus: split the template axis across devices.
+
+BASELINE.json config 3 calls out targeted panels with >500 reads per MI.
+A single such family's [T, 2, W] tensor can dominate one device while the
+rest idle; here the template axis is sharded over the mesh's 'reads' axis and
+the vote's partial sums are combined with psum — the framework's segmented
+reduction (SURVEY.md §5.7: "splitting deep families across devices with a
+segmented reduction" is this workload's analog of sequence parallelism).
+
+The vote decomposes exactly: log-likelihood, depth, and error counts are all
+sums over reads (models.molecular.vote_partials / count_errors), so each
+device computes its shard's partials, psums them over the reads axis, and
+finalizes identically (replicated argmax/posterior — no further traffic).
+The family axis is simultaneously sharded over 'data', making this the 2D
+(dp x sp) configuration of the framework.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bsseqconsensusreads_tpu.models.molecular import (
+    count_errors,
+    narrow_outputs,
+    overlap_cocall,
+    vote_finalize,
+    vote_partials,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+
+
+def deep_family_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams()):
+    """Molecular consensus with families over 'data' AND templates over
+    'reads'. bases/quals: [F, T, 2, W]; F divisible by the data-axis size,
+    T by the reads-axis size. Returns the molecular_consensus output dict.
+    """
+    in_spec = P(DATA_AXIS, READS_AXIS)
+    out_spec = P(DATA_AXIS)
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=(in_spec, in_spec), out_specs=out_spec)
+    def fn(bases, quals):
+        quals = quals.astype(jnp.float32)
+        if params.consensus_call_overlapping_bases:
+            # co-call is within-template: local to each reads shard
+            bases, quals = overlap_cocall(bases, quals)
+
+        def one_family(b, q):
+            # b, q: [T_local, 2, W]
+            outs = []
+            for role in range(2):
+                ll, depth = vote_partials(b[:, role, :], q[:, role, :], params)
+                ll = jax.lax.psum(ll, READS_AXIS)
+                depth = jax.lax.psum(depth, READS_AXIS)
+                cons, qual = vote_finalize(ll, depth, params)
+                errors = jax.lax.psum(
+                    count_errors(b[:, role, :], q[:, role, :], cons, params),
+                    READS_AXIS,
+                )
+                outs.append(
+                    {"base": cons, "qual": qual, "depth": depth, "errors": errors}
+                )
+            return jax.tree.map(lambda a, c: jnp.stack([a, c]), outs[0], outs[1])
+
+        return narrow_outputs(jax.vmap(one_family)(bases, quals))
+
+    return fn

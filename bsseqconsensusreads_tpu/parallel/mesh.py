@@ -1,0 +1,65 @@
+"""Device mesh construction and batch padding helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+#: Mesh axis names: 'data' shards the MI-family axis (embarrassingly
+#: parallel); 'reads' shards the template axis of deep families.
+DATA_AXIS = "data"
+READS_AXIS = "reads"
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_reads: int = 1,
+    devices=None,
+) -> Mesh:
+    """A (data, reads) mesh over the given (default: all) devices.
+
+    n_data defaults to n_devices // n_reads. For single-chip runs this is a
+    (1, 1) mesh and shard_map degenerates to plain execution.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_data is None:
+        n_data = len(devices) // n_reads
+    need = n_data * n_reads
+    if need > len(devices):
+        raise ValueError(
+            f"mesh ({n_data} x {n_reads}) needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(n_data, n_reads)
+    return Mesh(grid, (DATA_AXIS, READS_AXIS))
+
+
+def default_mesh() -> Mesh:
+    """All devices on the data axis — the right default for this workload
+    (families are independent; SURVEY.md §5.8)."""
+    return make_mesh()
+
+
+def pad_families(arrays: dict | tuple, n_families: int, multiple: int):
+    """Pad the leading family axis of every array to a multiple of the mesh's
+    data-axis size (shard_map needs even shards). Pad rows are empty families
+    (bases stay at the N sentinel via zero/NBASE fill chosen per dtype).
+
+    Returns (padded_arrays, padded_n). Callers slice outputs back to
+    n_families.
+    """
+    pad_to = ((n_families + multiple - 1) // multiple) * multiple
+    extra = pad_to - n_families
+
+    def pad(a):
+        if extra == 0:
+            return a
+        widths = [(0, extra)] + [(0, 0)] * (a.ndim - 1)
+        fill = 4 if a.dtype == np.int8 else (False if a.dtype == bool else 0)
+        return np.pad(a, widths, constant_values=fill)
+
+    if isinstance(arrays, dict):
+        return {k: pad(v) for k, v in arrays.items()}, pad_to
+    return tuple(pad(a) for a in arrays), pad_to

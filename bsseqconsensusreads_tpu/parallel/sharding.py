@@ -1,0 +1,55 @@
+"""shard_map wrappers: the family axis across the mesh's data axis.
+
+Families are independent (no operator couples them — SURVEY.md §2.3), so
+these wrappers contain zero collectives: each device runs the identical
+kernel on its family shard. XLA therefore overlaps nothing but the initial
+scatter / final gather of batch arrays, which ride ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+
+
+def family_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [F, ...] batch arrays: families over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def sharded_molecular_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams()):
+    """molecular_consensus sharded over families. F must divide evenly by the
+    data-axis size (use parallel.mesh.pad_families)."""
+    spec = P(DATA_AXIS)
+
+    @jax.jit
+    @jax.shard_map(mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    def fn(bases, quals):
+        return molecular_consensus(bases, quals, params)
+
+    return fn
+
+
+def sharded_duplex_pipeline(
+    mesh: Mesh, params: ConsensusParams = ConsensusParams(min_reads=0)
+):
+    """The fused convert+extend+duplex stage sharded over families."""
+    spec = P(DATA_AXIS)
+
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=spec,
+    )
+    def fn(bases, quals, cover, ref, convert_mask, extend_eligible):
+        return duplex_call_pipeline(
+            bases, quals, cover, ref, convert_mask, extend_eligible, params=params
+        )
+
+    return fn

@@ -41,7 +41,12 @@ from bsseqconsensusreads_tpu.io.bam import (
     FUNMAP,
     CMATCH,
 )
-from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+import jax
+
+from bsseqconsensusreads_tpu.models.duplex import (
+    duplex_call_pipeline_packed,
+    unpack_duplex_outputs,
+)
 from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops.encode import (
@@ -400,7 +405,7 @@ def call_duplex(
         used = int(batch.cover.sum())
         stats.pad_cells += batch.cover.size - used
         stats.used_cells += used
-        out = duplex_call_pipeline(
+        packed, _la, _rd = duplex_call_pipeline_packed(
             batch.bases,
             batch.quals,
             batch.cover,
@@ -409,12 +414,17 @@ def call_duplex(
             batch.extend_eligible,
             params=params,
         )
-        base = np.asarray(out["base"])
-        qual = np.asarray(out["qual"])
-        depth = np.asarray(out["depth"])
-        errors = np.asarray(out["errors"])
-        a_depth = np.asarray(out["a_depth"])
-        b_depth = np.asarray(out["b_depth"])
+        out = unpack_duplex_outputs(
+            jax.device_get(packed),
+            f=batch.bases.shape[0],
+            w=batch.bases.shape[-1],
+        )
+        base = out["base"]
+        qual = out["qual"]
+        depth = out["depth"]
+        errors = out["errors"]
+        a_depth = out["a_depth"]
+        b_depth = out["b_depth"]
         for fi, meta in enumerate(batch.meta):
             stats.families += 1
             if meta.n_templates < params.min_reads:

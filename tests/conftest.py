@@ -1,16 +1,24 @@
-"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Tests never touch the real TPU; multi-chip sharding is validated on
 xla_force_host_platform_device_count=8 CPU devices, per the build contract.
+
+Note: this environment's sitecustomize registers the TPU ('axon') PJRT
+backend on interpreter start and overrides JAX_PLATFORMS, so the env-var
+route is not enough — the config must be updated after importing jax but
+before any backend initializes.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -169,6 +169,34 @@ class TestDuplexMerge:
                     assert int(np.asarray(out["base"])[fi, role, w]) == wb
                     assert int(np.asarray(out["depth"])[fi, role, w]) == wd
 
+    def test_packed_roundtrip_with_quality_filter(self):
+        # b_depth = depth - a_depth must hold under min_input_base_quality:
+        # a column whose only base is a low-qual A-strand one must not
+        # produce a negative b_depth through the packed wire format.
+        from bsseqconsensusreads_tpu.models.duplex import (
+            duplex_call_pipeline_packed,
+            unpack_duplex_outputs,
+        )
+
+        W = 128
+        bases = np.full((1, 4, W), NBASE, np.int8)
+        quals = np.zeros((1, 4, W), np.float32)
+        cover = np.zeros((1, 4, W), bool)
+        bases[0, 0, :10] = 0
+        quals[0, 0, :10] = 5.0  # below the filter
+        cover[0, 0, :10] = True
+        ref = np.full((1, W + 1), NBASE, np.int8)
+        cm = np.zeros((1, 4), bool)
+        el = np.ones(1, bool)
+        params = ConsensusParams(min_reads=0, min_input_base_quality=20)
+        packed, la, rd = duplex_call_pipeline_packed(
+            bases, quals, cover, ref, cm, el, params=params
+        )
+        out = unpack_duplex_outputs(np.asarray(packed), f=1, w=W)
+        assert (out["b_depth"] >= 0).all()
+        assert (out["a_depth"] == 0).all()  # filtered out of the vote
+        assert (out["depth"][0, 0, :10] == 0).all()
+
     def test_single_strand_family_emits(self):
         # min-reads=0 semantics: one strand only still produces output.
         W = 128

@@ -1,0 +1,148 @@
+"""Multi-device sharding tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.encode import encode_duplex_families, iter_mi_groups
+from bsseqconsensusreads_tpu.parallel import (
+    deep_family_consensus,
+    default_mesh,
+    make_mesh,
+    pad_families,
+    sharded_duplex_pipeline,
+    sharded_molecular_consensus,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    random_genome,
+)
+
+
+def tree_equal(a, b):
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()
+
+
+class TestMesh:
+    def test_default_mesh_all_data(self, eight_devices):
+        mesh = default_mesh()
+        assert mesh.shape == {"data": 8, "reads": 1}
+
+    def test_2d_mesh(self, eight_devices):
+        mesh = make_mesh(n_data=4, n_reads=2)
+        assert mesh.shape == {"data": 4, "reads": 2}
+
+    def test_mesh_too_big_raises(self, eight_devices):
+        with pytest.raises(ValueError, match="needs"):
+            make_mesh(n_data=16, n_reads=2)
+
+    def test_pad_families(self):
+        arrs = (
+            np.ones((5, 3), np.int8),
+            np.ones((5, 2), np.float32),
+            np.ones(5, bool),
+        )
+        (a, b, c), n = pad_families(arrs, 5, 4)
+        assert n == 8
+        assert a.shape == (8, 3) and (a[5:] == NBASE).all()
+        assert b.shape == (8, 2) and (b[5:] == 0).all()
+        assert c.shape == (8,) and (~c[5:]).all()
+
+
+class TestShardedMolecular:
+    def test_matches_unsharded(self, eight_devices):
+        rng = np.random.default_rng(41)
+        params = ConsensusParams()
+        F, T, W = 16, 6, 128
+        bases = rng.integers(0, 4, size=(F, T, 2, W)).astype(np.int8)
+        bases[rng.random(bases.shape) < 0.2] = NBASE
+        quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+        mesh = default_mesh()
+        sharded = sharded_molecular_consensus(mesh, params)
+        got = sharded(bases, quals)
+        want = molecular_consensus(bases, quals, params)
+        tree_equal(got, want)
+
+    def test_with_family_padding(self, eight_devices):
+        rng = np.random.default_rng(42)
+        params = ConsensusParams()
+        F = 5  # not divisible by 8
+        bases = rng.integers(0, 4, size=(F, 4, 2, 128)).astype(np.int8)
+        quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+        (pb, pq), padded_n = pad_families((bases, quals), F, 8)
+        mesh = default_mesh()
+        out = sharded_molecular_consensus(mesh, params)(pb, pq)
+        want = molecular_consensus(bases, quals, params)
+        got = {k: np.asarray(v)[:F] for k, v in out.items()}
+        tree_equal(got, want)
+        # pad families decode to all-no-call
+        assert (np.asarray(out["base"])[F:] == NBASE).all()
+
+
+class TestDeepFamilySplit:
+    def test_segmented_reduction_matches_unsharded(self, eight_devices):
+        rng = np.random.default_rng(43)
+        params = ConsensusParams()
+        F, T, W = 4, 64, 128  # T split over 2 devices
+        bases = rng.integers(0, 4, size=(F, T, 2, W)).astype(np.int8)
+        bases[rng.random(bases.shape) < 0.3] = NBASE
+        quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+        mesh = make_mesh(n_data=4, n_reads=2)
+        deep = deep_family_consensus(mesh, params)
+        got = deep(bases, quals)
+        want = molecular_consensus(bases, quals, params)
+        for k in ("base", "depth", "errors"):
+            np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+        # float reduction order differs across shards: quals within 1
+        dq = np.abs(
+            np.asarray(got["qual"], np.int32) - np.asarray(want["qual"], np.int32)
+        )
+        assert dq.max() <= 1
+
+    def test_wide_reads_axis(self, eight_devices):
+        rng = np.random.default_rng(44)
+        params = ConsensusParams(consensus_call_overlapping_bases=False)
+        F, T, W = 1, 512, 128  # one deep family over all 8 devices
+        bases = rng.integers(0, 4, size=(F, T, 2, W)).astype(np.int8)
+        quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+        mesh = make_mesh(n_data=1, n_reads=8)
+        got = deep_family_consensus(mesh, params)(bases, quals)
+        want = molecular_consensus(bases, quals, params)
+        np.testing.assert_array_equal(np.asarray(got["base"]), np.asarray(want["base"]))
+        np.testing.assert_array_equal(np.asarray(got["depth"]), np.asarray(want["depth"]))
+
+
+class TestShardedDuplex:
+    def test_matches_unsharded(self, eight_devices):
+        rng = np.random.default_rng(45)
+        name, genome = random_genome(rng, 3000)
+        recs = []
+        for mi in range(8):
+            recs += make_aligned_duplex_group(rng, name, genome, mi, 30 + mi * 150, 80)
+        groups = iter_mi_groups(recs, strip_suffix=True)
+        batch, _, _ = encode_duplex_families(groups, lambda n, s, e: genome[s:e], [name])
+        params = ConsensusParams(min_reads=0)
+        mesh = default_mesh()
+        sharded = sharded_duplex_pipeline(mesh, params)
+        got = sharded(
+            batch.bases, batch.quals, batch.cover, batch.ref,
+            batch.convert_mask, batch.extend_eligible,
+        )
+        want = duplex_call_pipeline(
+            batch.bases, batch.quals, batch.cover, batch.ref,
+            batch.convert_mask, batch.extend_eligible, params=params,
+        )
+        tree_equal(got, want)
