@@ -243,6 +243,46 @@ def _encode_tags(tags: dict[str, tuple[str, Any]]) -> bytes:
     return bytes(out)
 
 
+def _select_bgzf(engine: str, native_factory, python_factory):
+    """Shared engine selection for reader and writer paths.
+
+    'auto' prefers the native C++ codec when built; 'native' demands it
+    (raising with the recorded build/load diagnostic when absent); 'python'
+    forces the pure codec. Anything else is an error, not a silent
+    fallback. File-level errors from the chosen factory propagate as-is.
+    """
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown engine {engine!r}; use auto|native|python")
+    if engine in ("auto", "native"):
+        from bsseqconsensusreads_tpu.io import native
+
+        if native.available():
+            return native_factory()
+        if engine == "native":
+            raise OSError(f"native codec unavailable: {native.load_error()}")
+    return python_factory()
+
+
+def _open_bgzf(path: str, engine: str):
+    def native_factory():
+        from bsseqconsensusreads_tpu.io.native import NativeBgzfReader
+
+        return NativeBgzfReader(path)
+
+    return _select_bgzf(engine, native_factory, lambda: BgzfReader.open(path))
+
+
+def _create_bgzf(path: str, engine: str, level: int):
+    def native_factory():
+        from bsseqconsensusreads_tpu.io.native import NativeBgzfWriter
+
+        return NativeBgzfWriter(path, level)
+
+    return _select_bgzf(
+        engine, native_factory, lambda: BgzfWriter.open(path, level=level)
+    )
+
+
 _REC_FIXED = struct.Struct("<iiBBHHHIiii")  # refID..tlen after block_size (32 bytes)
 
 
@@ -308,23 +348,32 @@ def encode_record(rec: BamRecord) -> bytes:
 
 
 class BamReader:
-    """Streaming BAM reader (iterate to get BamRecords)."""
+    """Streaming BAM reader (iterate to get BamRecords).
 
-    def __init__(self, path: str):
-        self._bgzf = BgzfReader.open(path)
-        magic = self._bgzf.read(4)
-        if magic != BAM_MAGIC:
-            raise BamError(f"{path}: not a BAM file")
-        (l_text,) = struct.unpack("<i", self._bgzf.read(4))
-        text = self._bgzf.read(l_text).decode("utf-8", "replace").rstrip("\x00")
-        (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
-        refs = []
-        for _ in range(n_ref):
-            (l_name,) = struct.unpack("<i", self._bgzf.read(4))
-            name = self._bgzf.read(l_name)[:-1].decode("ascii")
-            (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
-            refs.append((name, l_ref))
-        self.header = BamHeader(text, refs)
+    engine: 'auto' uses the native C++ BGZF codec when built (native/
+    libbamio.so), falling back to the pure-Python codec; 'python'/'native'
+    force one.
+    """
+
+    def __init__(self, path: str, engine: str = "auto"):
+        self._bgzf = _open_bgzf(path, engine)
+        try:
+            magic = self._bgzf.read(4)
+            if magic != BAM_MAGIC:
+                raise BamError(f"{path}: not a BAM file")
+            (l_text,) = struct.unpack("<i", self._bgzf.read(4))
+            text = self._bgzf.read(l_text).decode("utf-8", "replace").rstrip("\x00")
+            (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
+            refs = []
+            for _ in range(n_ref):
+                (l_name,) = struct.unpack("<i", self._bgzf.read(4))
+                name = self._bgzf.read(l_name)[:-1].decode("ascii")
+                (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
+                refs.append((name, l_ref))
+            self.header = BamHeader(text, refs)
+        except BaseException:
+            self._bgzf.close()
+            raise
 
     def __iter__(self) -> Iterator[BamRecord]:
         while True:
@@ -351,20 +400,26 @@ class BamReader:
 
 
 class BamWriter:
-    """Streaming BAM writer; pass the header (e.g. reader.header) up front."""
+    """Streaming BAM writer; pass the header (e.g. reader.header) up front.
 
-    def __init__(self, path: str, header: BamHeader, level: int = 6):
+    engine as in BamReader ('auto' prefers the native C++ codec)."""
+
+    def __init__(self, path: str, header: BamHeader, level: int = 6, engine: str = "auto"):
         self.header = header
-        self._bgzf = BgzfWriter.open(path, level=level)
-        text = header.text.encode("utf-8")
-        out = bytearray(BAM_MAGIC)
-        out += struct.pack("<i", len(text))
-        out += text
-        out += struct.pack("<i", len(header.references))
-        for name, length in header.references:
-            nb = name.encode("ascii") + b"\x00"
-            out += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
-        self._bgzf.write(bytes(out))
+        self._bgzf = _create_bgzf(path, engine, level)
+        try:
+            text = header.text.encode("utf-8")
+            out = bytearray(BAM_MAGIC)
+            out += struct.pack("<i", len(text))
+            out += text
+            out += struct.pack("<i", len(header.references))
+            for name, length in header.references:
+                nb = name.encode("ascii") + b"\x00"
+                out += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+            self._bgzf.write(bytes(out))
+        except BaseException:
+            self._bgzf.close()
+            raise
 
     def write(self, rec: BamRecord) -> None:
         self._bgzf.write(encode_record(rec))

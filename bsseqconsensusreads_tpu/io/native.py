@@ -1,0 +1,306 @@
+"""ctypes bindings for the native C++ BGZF/BAM codec (native/bamio.cpp).
+
+Loads native/libbamio.so (building it with `make -C native` on first use if a
+compiler is available). Every entry point has a pure-Python fallback in
+io.bgzf / io.bam; callers use `available()` or the factory functions which
+degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "libbamio.so")
+
+_lib = None
+_load_error: str | None = None
+
+
+def _try_load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return
+    if not os.path.exists(_SO_PATH):
+        src_dir = os.path.dirname(_SO_PATH)
+        if os.path.exists(os.path.join(src_dir, "bamio.cpp")):
+            try:
+                subprocess.run(
+                    ["make", "-C", src_dir],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception as e:  # no compiler / make failure
+                _load_error = f"native build failed: {e}"
+                return
+        else:
+            _load_error = "native sources not found"
+            return
+    try:
+        lib = C.CDLL(_SO_PATH)
+    except OSError as e:
+        _load_error = f"cannot load {_SO_PATH}: {e}"
+        return
+    lib.bamio_open.restype = C.c_void_p
+    lib.bamio_open.argtypes = [C.c_char_p, C.c_char_p, C.c_int]
+    lib.bamio_read.restype = C.c_int64
+    lib.bamio_read.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
+    lib.bamio_error.restype = C.c_char_p
+    lib.bamio_error.argtypes = [C.c_void_p]
+    lib.bamio_close.argtypes = [C.c_void_p]
+    lib.bamio_create.restype = C.c_void_p
+    lib.bamio_create.argtypes = [C.c_char_p, C.c_int, C.c_char_p, C.c_int]
+    lib.bamio_write.restype = C.c_int
+    lib.bamio_write.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
+    lib.bamio_writer_error.restype = C.c_char_p
+    lib.bamio_writer_error.argtypes = [C.c_void_p]
+    lib.bamio_finish.restype = C.c_int
+    lib.bamio_finish.argtypes = [C.c_void_p]
+    lib.bamio_parse_records.restype = C.c_int64
+    lib.bamio_parse_records.argtypes = [
+        C.c_void_p, C.c_int64,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p,
+        C.c_void_p, C.c_void_p, C.c_int64, C.c_void_p,
+        C.c_void_p, C.c_int64, C.c_void_p,
+        C.c_char_p, C.c_int, C.c_char_p, C.c_int, C.c_char_p, C.c_int,
+    ]
+    _lib = lib
+
+
+def available() -> bool:
+    _try_load()
+    return _lib is not None
+
+
+def load_error() -> str | None:
+    _try_load()
+    return _load_error
+
+
+class NativeBgzfReader:
+    """Drop-in for io.bgzf.BgzfReader backed by the C++ codec.
+
+    Reads cross the ctypes boundary in 4 MiB chunks and are served from a
+    Python-side buffer — per-record 4-byte reads would otherwise pay a
+    ctypes round trip each."""
+
+    _CHUNK = 1 << 22
+
+    def __init__(self, path: str):
+        _try_load()
+        if _lib is None:
+            raise OSError(_load_error or "native codec unavailable")
+        err = C.create_string_buffer(256)
+        self._h = _lib.bamio_open(path.encode(), err, 256)
+        if not self._h:
+            raise IOError(err.value.decode())
+        self._buf = b""
+        self._off = 0
+
+    def _fill(self) -> bool:
+        buf = C.create_string_buffer(self._CHUNK)
+        got = _lib.bamio_read(self._h, buf, self._CHUNK)
+        if got < 0:
+            raise IOError(_lib.bamio_error(self._h).decode())
+        if got == 0:
+            return False
+        self._buf = buf.raw[:got]
+        self._off = 0
+        return True
+
+    def read(self, n: int) -> bytes:
+        avail = len(self._buf) - self._off
+        if avail >= n:  # fast path: serve from buffer
+            out = self._buf[self._off : self._off + n]
+            self._off += n
+            return out
+        parts = [self._buf[self._off :]]
+        need = n - avail
+        self._buf, self._off = b"", 0
+        while need > 0:
+            if not self._fill():
+                break
+            take = min(need, len(self._buf))
+            parts.append(self._buf[:take])
+            self._off = take
+            need -= take
+        return b"".join(parts)
+
+    def read_unbuffered(self, n: int) -> bytes:
+        """Exact read through ctypes with NO Python-side buffering — required
+        before handing self._h to bamio_parse_records (which reads from the
+        native stream position and must not skip buffered bytes)."""
+        assert self._off == len(self._buf), "unbuffered read after buffered read"
+        buf = C.create_string_buffer(n)
+        got = _lib.bamio_read(self._h, buf, n)
+        if got < 0:
+            raise IOError(_lib.bamio_error(self._h).decode())
+        return buf.raw[:got]
+
+    def read_all(self, chunk: int = 1 << 22) -> bytes:
+        parts = []
+        while True:
+            b = self.read(chunk)
+            if not b:
+                return b"".join(parts)
+            parts.append(b)
+
+    def close(self) -> None:
+        if self._h:
+            _lib.bamio_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class NativeBgzfWriter:
+    """Drop-in for io.bgzf.BgzfWriter backed by the C++ codec."""
+
+    def __init__(self, path: str, level: int = 6):
+        _try_load()
+        if _lib is None:
+            raise OSError(_load_error or "native codec unavailable")
+        err = C.create_string_buffer(256)
+        self._h = _lib.bamio_create(path.encode(), level, err, 256)
+        if not self._h:
+            raise IOError(err.value.decode())
+
+    def write(self, data: bytes) -> None:
+        if _lib.bamio_write(self._h, data, len(data)) != 0:
+            raise IOError(_lib.bamio_writer_error(self._h).decode())
+
+    def flush(self) -> None:
+        pass  # blocks flush on finish; partial flush not needed
+
+    def close(self) -> None:
+        if self._h:
+            rc = _lib.bamio_finish(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError("bamio_finish failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ColumnarBatch:
+    """One parsed batch of records as flat numpy arrays.
+
+    seq codes are already in the framework alphabet (A=0..T=3, N=4); per
+    record i the bases/quals live at var_off[i] : var_off[i]+l_seq[i] and the
+    cigar at cigar_off[i] : cigar_off[i]+n_cigar[i] (u32, len<<4|op).
+    """
+
+    __slots__ = (
+        "n", "ref_id", "pos", "flag", "mapq", "l_seq", "next_ref",
+        "next_pos", "tlen", "n_cigar", "seq", "qual", "var_off",
+        "cigar", "cigar_off", "qname", "mi", "rx",
+    )
+
+    def __init__(self, n, **arrays):
+        self.n = n
+        for k, v in arrays.items():
+            setattr(self, k, v)
+
+
+def read_columnar(
+    path: str,
+    batch_records: int = 1 << 16,
+    var_bytes: int = 1 << 25,
+    qname_width: int = 64,
+    tag_width: int = 48,
+):
+    """Stream a BAM file as ColumnarBatches (header is parsed separately by
+    BamReader — this starts from a fresh native stream and skips the header).
+
+    Yields (header_bytes_consumed_only_first) ColumnarBatch objects.
+    """
+    import struct
+
+    r = NativeBgzfReader(path)
+    try:
+        magic = r.read_unbuffered(4)
+        if magic != b"BAM\x01":
+            raise IOError(f"{path}: not a BAM file")
+        (l_text,) = struct.unpack("<i", r.read_unbuffered(4))
+        r.read_unbuffered(l_text)
+        (n_ref,) = struct.unpack("<i", r.read_unbuffered(4))
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", r.read_unbuffered(4))
+            r.read_unbuffered(l_name + 4)
+        while True:
+            n = batch_records
+            fixed = {
+                "ref_id": np.empty(n, np.int32),
+                "pos": np.empty(n, np.int32),
+                "flag": np.empty(n, np.uint16),
+                "mapq": np.empty(n, np.uint8),
+                "l_seq": np.empty(n, np.int32),
+                "next_ref": np.empty(n, np.int32),
+                "next_pos": np.empty(n, np.int32),
+                "tlen": np.empty(n, np.int32),
+                "n_cigar": np.empty(n, np.uint16),
+            }
+            seq = np.empty(var_bytes, np.uint8)
+            qual = np.empty(var_bytes, np.uint8)
+            var_off = np.empty(n, np.int64)
+            cigar = np.empty(var_bytes // 16, np.uint32)
+            cigar_off = np.empty(n, np.int64)
+            qname = C.create_string_buffer(n * qname_width)
+            mi = C.create_string_buffer(n * tag_width)
+            rx = C.create_string_buffer(n * tag_width)
+            got = _lib.bamio_parse_records(
+                r._h, n,
+                *(a.ctypes.data_as(C.c_void_p) for a in (
+                    fixed["ref_id"], fixed["pos"], fixed["flag"], fixed["mapq"],
+                    fixed["l_seq"], fixed["next_ref"], fixed["next_pos"],
+                    fixed["tlen"], fixed["n_cigar"],
+                )),
+                seq.ctypes.data_as(C.c_void_p),
+                qual.ctypes.data_as(C.c_void_p),
+                var_bytes,
+                var_off.ctypes.data_as(C.c_void_p),
+                cigar.ctypes.data_as(C.c_void_p),
+                var_bytes // 16,
+                cigar_off.ctypes.data_as(C.c_void_p),
+                qname, qname_width, mi, tag_width, rx, tag_width,
+            )
+            if got < 0:
+                raise IOError(_lib.bamio_error(r._h).decode())
+            if got == 0:
+                return
+            qn = np.frombuffer(qname.raw, dtype=f"S{qname_width}", count=got)
+            mis = np.frombuffer(mi.raw, dtype=f"S{tag_width}", count=got)
+            rxs = np.frombuffer(rx.raw, dtype=f"S{tag_width}", count=got)
+            yield ColumnarBatch(
+                int(got),
+                **{k: v[:got] for k, v in fixed.items()},
+                seq=seq,
+                qual=qual,
+                var_off=var_off[:got],
+                cigar=cigar,
+                cigar_off=cigar_off[:got],
+                qname=qn,
+                mi=mis,
+                rx=rxs,
+            )
+            # a short batch means either EOF or a capacity stop with a
+            # pending record; the next parse call distinguishes (got==0 ends)
+    finally:
+        r.close()

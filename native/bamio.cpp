@@ -1,0 +1,424 @@
+// Native BGZF/BAM codec for bsseqconsensusreads_tpu.
+//
+// The reference delegates its hot record I/O to C (htslib via pysam and
+// samtools; SURVEY.md §2.2). This is the framework's equivalent: a zlib-based
+// BGZF stream codec plus a columnar record parser that converts the BAM
+// alignment stream straight into flat arrays (positions, flags, base codes,
+// quals, cigars, MI/RX tags) so the Python layer never touches per-record
+// objects on the hot path. Exposed as a plain C ABI for ctypes
+// (bsseqconsensusreads_tpu/io/native.py); the pure-Python codec remains the
+// fallback.
+//
+// Build: make -C native   (produces libbamio.so)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+constexpr size_t kMaxBlock = 65536;
+
+struct Reader {
+  FILE* fh = nullptr;
+  std::vector<uint8_t> carry;  // decompressed bytes not yet consumed
+  size_t carry_off = 0;
+  std::vector<uint8_t> pending;  // parsed-but-unreturned record body
+  bool last_block_empty = false;
+  bool eof = false;
+  std::string err;
+};
+
+struct Writer {
+  FILE* fh = nullptr;
+  std::vector<uint8_t> buf;
+  int level = 6;
+  std::string err;
+};
+
+const uint8_t kEofBlock[28] = {0x1f, 0x8b, 0x08, 0x04, 0,    0,    0,    0,
+                               0,    0xff, 0x06, 0x00, 0x42, 0x43, 0x02, 0x00,
+                               0x1b, 0x00, 0x03, 0x00, 0,    0,    0,    0,
+                               0,    0,    0,    0};
+
+// nt16 code -> framework base code (A=0 C=1 G=2 T=3 N/other=4)
+const int8_t kNt16ToCode[16] = {4, 0, 1, 4, 2, 4, 4, 4, 3, 4, 4, 4, 4, 4, 4, 4};
+
+bool read_block(Reader* r) {
+  uint8_t head[12];
+  size_t got = fread(head, 1, 12, r->fh);
+  if (got == 0) {
+    if (!r->last_block_empty) {
+      r->err = "BGZF EOF marker missing (file truncated?)";
+      return false;
+    }
+    r->eof = true;
+    return true;
+  }
+  if (got < 12 || head[0] != 0x1f || head[1] != 0x8b || head[2] != 8 ||
+      !(head[3] & 4)) {
+    r->err = "not a BGZF stream";
+    return false;
+  }
+  uint16_t xlen = uint16_t(head[10]) | (uint16_t(head[11]) << 8);
+  std::vector<uint8_t> extra(xlen);
+  if (fread(extra.data(), 1, xlen, r->fh) != xlen) {
+    r->err = "truncated BGZF extra field";
+    return false;
+  }
+  int bsize = -1;
+  for (size_t off = 0; off + 4 <= extra.size();) {
+    uint8_t si1 = extra[off], si2 = extra[off + 1];
+    uint16_t slen = uint16_t(extra[off + 2]) | (uint16_t(extra[off + 3]) << 8);
+    if (si1 == 0x42 && si2 == 0x43 && slen == 2) {
+      bsize = (int(extra[off + 4]) | (int(extra[off + 5]) << 8)) + 1;
+      break;
+    }
+    off += 4 + slen;
+  }
+  if (bsize < 0) {
+    r->err = "BGZF block missing BC subfield";
+    return false;
+  }
+  long cdata_len = long(bsize) - 12 - xlen - 8;
+  if (cdata_len < 0) {
+    r->err = "corrupt BGZF BSIZE";
+    return false;
+  }
+  std::vector<uint8_t> cdata(cdata_len);
+  uint8_t tail[8];
+  if (fread(cdata.data(), 1, cdata_len, r->fh) != size_t(cdata_len) ||
+      fread(tail, 1, 8, r->fh) != 8) {
+    r->err = "truncated BGZF block";
+    return false;
+  }
+  uint32_t crc = uint32_t(tail[0]) | (uint32_t(tail[1]) << 8) |
+                 (uint32_t(tail[2]) << 16) | (uint32_t(tail[3]) << 24);
+  uint32_t isize = uint32_t(tail[4]) | (uint32_t(tail[5]) << 8) |
+                   (uint32_t(tail[6]) << 16) | (uint32_t(tail[7]) << 24);
+  size_t base = r->carry.size() - r->carry_off;
+  // compact the carry before appending
+  if (r->carry_off > 0) {
+    r->carry.erase(r->carry.begin(), r->carry.begin() + r->carry_off);
+    r->carry_off = 0;
+  }
+  size_t old = r->carry.size();
+  r->carry.resize(old + isize);
+  (void)base;
+  if (isize > 0) {
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, -15) != Z_OK) {
+      r->err = "inflateInit failed";
+      return false;
+    }
+    zs.next_in = cdata.data();
+    zs.avail_in = uInt(cdata.size());
+    zs.next_out = r->carry.data() + old;
+    zs.avail_out = isize;
+    int rc = inflate(&zs, Z_FINISH);
+    inflateEnd(&zs);
+    if (rc != Z_STREAM_END || zs.total_out != isize) {
+      r->err = "BGZF inflate failed / ISIZE mismatch";
+      return false;
+    }
+    if (crc32(0L, r->carry.data() + old, isize) != crc) {
+      r->err = "BGZF CRC mismatch";
+      return false;
+    }
+  }
+  r->last_block_empty = (isize == 0);
+  return true;
+}
+
+// ensure >= n unconsumed bytes in carry; false on eof-before-n or error
+bool ensure(Reader* r, size_t n) {
+  while (r->carry.size() - r->carry_off < n) {
+    if (r->eof) return false;
+    if (!read_block(r)) return false;
+  }
+  return true;
+}
+
+bool flush_block(Writer* w, const uint8_t* data, size_t n) {
+  std::vector<uint8_t> cdata(kMaxBlock);
+  for (int attempt_level = w->level;; attempt_level = 0) {
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (deflateInit2(&zs, attempt_level, Z_DEFLATED, -15, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK) {
+      w->err = "deflateInit failed";
+      return false;
+    }
+    zs.next_in = const_cast<uint8_t*>(data);
+    zs.avail_in = uInt(n);
+    zs.next_out = cdata.data();
+    zs.avail_out = uInt(cdata.size());
+    int rc = deflate(&zs, Z_FINISH);
+    size_t clen = zs.total_out;
+    deflateEnd(&zs);
+    if (rc != Z_STREAM_END) {
+      if (attempt_level != 0) continue;  // retry stored
+      w->err = "deflate failed";
+      return false;
+    }
+    size_t bsize = clen + 12 + 6 + 8;
+    if (bsize > 65536) {
+      if (attempt_level != 0) continue;
+      w->err = "block too large even stored";
+      return false;
+    }
+    uint8_t head[18] = {0x1f, 0x8b, 8,    4,    0, 0, 0, 0, 0,
+                        0xff, 6,    0,    0x42, 0x43, 2, 0, 0, 0};
+    uint16_t bs = uint16_t(bsize - 1);
+    head[16] = uint8_t(bs & 0xff);
+    head[17] = uint8_t(bs >> 8);
+    uint32_t crc = crc32(0L, data, n);
+    uint8_t tail[8] = {uint8_t(crc), uint8_t(crc >> 8), uint8_t(crc >> 16),
+                       uint8_t(crc >> 24), uint8_t(n), uint8_t(n >> 8),
+                       uint8_t(n >> 16), uint8_t(n >> 24)};
+    if (fwrite(head, 1, 18, w->fh) != 18 ||
+        fwrite(cdata.data(), 1, clen, w->fh) != clen ||
+        fwrite(tail, 1, 8, w->fh) != 8) {
+      w->err = "write failed";
+      return false;
+    }
+    return true;
+  }
+}
+
+inline int32_t rd_i32(const uint8_t* p) {
+  int32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+inline uint32_t rd_u32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+inline uint16_t rd_u16(const uint8_t* p) {
+  uint16_t v;
+  memcpy(&v, p, 2);
+  return v;
+}
+
+// Extract a Z-type tag's value into out (NUL-terminated, truncated to w-1).
+void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
+                int w) {
+  out[0] = '\0';
+  size_t off = 0;
+  while (off + 3 <= n) {
+    char t0 = char(tags[off]), t1 = char(tags[off + 1]);
+    char tc = char(tags[off + 2]);
+    off += 3;
+    size_t len = 0;
+    switch (tc) {
+      case 'A': case 'c': case 'C': len = 1; break;
+      case 's': case 'S': len = 2; break;
+      case 'i': case 'I': case 'f': len = 4; break;
+      case 'Z': case 'H': {
+        size_t e = off;
+        while (e < n && tags[e] != 0) e++;
+        if (t0 == key[0] && t1 == key[1]) {
+          size_t cnt = e - off;
+          if (cnt > size_t(w - 1)) cnt = w - 1;
+          memcpy(out, tags + off, cnt);
+          out[cnt] = '\0';
+          return;
+        }
+        off = e + 1;
+        continue;
+      }
+      case 'B': {
+        if (off + 5 > n) return;
+        char sub = char(tags[off]);
+        uint32_t cnt = rd_u32(tags + off + 1);
+        size_t esz = (sub == 'c' || sub == 'C') ? 1
+                     : (sub == 's' || sub == 'S') ? 2 : 4;
+        off += 5 + size_t(cnt) * esz;
+        continue;
+      }
+      default:
+        return;  // unknown tag type: stop scanning
+    }
+    off += len;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+Reader* bamio_open(const char* path, char* err, int errlen) {
+  Reader* r = new Reader();
+  r->fh = fopen(path, "rb");
+  if (!r->fh) {
+    snprintf(err, errlen, "cannot open %s", path);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// Read up to n decompressed bytes. Returns bytes read (0 at EOF), -1 error.
+int64_t bamio_read(Reader* r, uint8_t* buf, int64_t n) {
+  int64_t total = 0;
+  while (total < n) {
+    size_t avail = r->carry.size() - r->carry_off;
+    if (avail == 0) {
+      if (r->eof) break;
+      if (!read_block(r)) return -1;
+      continue;
+    }
+    size_t take = size_t(n - total) < avail ? size_t(n - total) : avail;
+    memcpy(buf + total, r->carry.data() + r->carry_off, take);
+    r->carry_off += take;
+    total += take;
+  }
+  return total;
+}
+
+const char* bamio_error(Reader* r) { return r->err.c_str(); }
+
+void bamio_close(Reader* r) {
+  if (r->fh) fclose(r->fh);
+  delete r;
+}
+
+// Parse up to max_records alignment records into columnar arrays.
+// Fixed per-record: ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
+// n_cigar. Variable: seq codes + quals at var_off[i] (l_seq[i] bytes each,
+// capacity var_cap), cigar ops at cigar_off[i] (n_cigar u32), qname/mi/rx
+// fixed-width NUL-terminated strings. Returns records parsed, -1 on error.
+// Stops early (returning fewer) when a capacity would be exceeded; the
+// blocking record is buffered internally and returned by the next call.
+int64_t bamio_parse_records(
+    Reader* r, int64_t max_records,
+    int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
+    int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
+    uint16_t* n_cigar,
+    uint8_t* seq_codes, uint8_t* quals, int64_t var_cap, int64_t* var_off,
+    uint32_t* cigar, int64_t cigar_cap, int64_t* cigar_off,
+    char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w) {
+  int64_t nrec = 0;
+  int64_t vused = 0, cused = 0;
+  std::vector<uint8_t> body;
+  while (nrec < max_records) {
+    if (!r->pending.empty()) {
+      body.swap(r->pending);
+      r->pending.clear();
+    } else {
+      uint8_t szbuf[4];
+      int64_t got = bamio_read(r, szbuf, 4);
+      if (got == 0) break;
+      if (got != 4) {
+        r->err = r->err.empty() ? "truncated record size" : r->err;
+        return -1;
+      }
+      int32_t bs = rd_i32(szbuf);
+      if (bs < 32 || bs > (1 << 28)) {
+        r->err = "corrupt record size";
+        return -1;
+      }
+      body.resize(bs);
+      if (bamio_read(r, body.data(), bs) != bs) {
+        r->err = r->err.empty() ? "truncated record body" : r->err;
+        return -1;
+      }
+    }
+    const uint8_t* p = body.data();
+    size_t bs = body.size();
+    int32_t lseq = rd_i32(p + 16);
+    uint16_t ncig = rd_u16(p + 12);
+    if (vused + lseq > var_cap || cused + ncig > cigar_cap) {
+      r->pending.swap(body);  // doesn't fit: hand back next call
+      break;
+    }
+    uint8_t l_qname = p[8];
+    ref_id[nrec] = rd_i32(p + 0);
+    pos[nrec] = rd_i32(p + 4);
+    mapq[nrec] = p[9];
+    n_cigar[nrec] = ncig;
+    flag[nrec] = rd_u16(p + 14);
+    l_seq[nrec] = lseq;
+    next_ref[nrec] = rd_i32(p + 20);
+    next_pos[nrec] = rd_i32(p + 24);
+    tlen[nrec] = rd_i32(p + 28);
+    size_t off = 32;
+    {
+      size_t cnt = l_qname - 1;
+      if (cnt > size_t(qname_w - 1)) cnt = qname_w - 1;
+      memcpy(qname + nrec * qname_w, p + off, cnt);
+      qname[nrec * qname_w + cnt] = '\0';
+    }
+    off += l_qname;
+    memcpy(cigar + cused, p + off, size_t(ncig) * 4);
+    cigar_off[nrec] = cused;
+    cused += ncig;
+    off += size_t(ncig) * 4;
+    var_off[nrec] = vused;
+    const uint8_t* sp = p + off;
+    for (int32_t i = 0; i < lseq; i++) {
+      uint8_t b = sp[i >> 1];
+      uint8_t code = (i & 1) ? (b & 0xf) : (b >> 4);
+      seq_codes[vused + i] = uint8_t(kNt16ToCode[code]);
+    }
+    off += (lseq + 1) / 2;
+    memcpy(quals + vused, p + off, lseq);
+    off += lseq;
+    vused += lseq;
+    find_z_tag(p + off, bs - off, "MI", mi + nrec * mi_w, mi_w);
+    find_z_tag(p + off, bs - off, "RX", rx + nrec * rx_w, rx_w);
+    nrec++;
+  }
+  return nrec;
+}
+
+Writer* bamio_create(const char* path, int level, char* err, int errlen) {
+  Writer* w = new Writer();
+  w->fh = fopen(path, "wb");
+  w->level = level;
+  if (!w->fh) {
+    snprintf(err, errlen, "cannot create %s", path);
+    delete w;
+    return nullptr;
+  }
+  w->buf.reserve(65280);
+  return w;
+}
+
+int bamio_write(Writer* w, const uint8_t* data, int64_t n) {
+  int64_t off = 0;
+  while (off < n) {
+    size_t room = 65280 - w->buf.size();
+    size_t take = size_t(n - off) < room ? size_t(n - off) : room;
+    w->buf.insert(w->buf.end(), data + off, data + off + take);
+    off += take;
+    if (w->buf.size() == 65280) {
+      if (!flush_block(w, w->buf.data(), w->buf.size())) return -1;
+      w->buf.clear();
+    }
+  }
+  return 0;
+}
+
+const char* bamio_writer_error(Writer* w) { return w->err.c_str(); }
+
+int bamio_finish(Writer* w) {
+  int rc = 0;
+  if (!w->buf.empty()) {
+    if (!flush_block(w, w->buf.data(), w->buf.size())) rc = -1;
+    w->buf.clear();
+  }
+  if (rc == 0 && fwrite(kEofBlock, 1, 28, w->fh) != 28) rc = -1;
+  if (fclose(w->fh) != 0) rc = -1;
+  w->fh = nullptr;
+  delete w;
+  return rc;
+}
+
+}  // extern "C"
