@@ -153,6 +153,48 @@ def unpack_duplex_outputs(packed, f: int | None = None, w: int | None = None) ->
     }
 
 
+@partial(jax.jit, static_argnames=("f", "w", "params"))
+def duplex_call_wire(
+    nib, qual, meta, starts, limits, genome,
+    f: int, w: int,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+):
+    """The tunnel-optimal fused duplex stage: ONE flat u32 array each way.
+
+    Inputs are the ops.wire packed arrays plus the device-resident genome
+    (ops.refstore) — per-family reference windows are gathered on device, so
+    the wire carries 4 bits/cell of bases+cover, 1 B/cell of quals, and
+    8 B/family of offsets instead of the ~5 B/cell of the unpacked path.
+
+    Returns one u32 wire array: pack_duplex_outputs columns [f*w words]
+    followed by la/rd bytes [ceil(f/4) words]; split host-side with
+    unpack_duplex_wire_outputs.
+    """
+    from bsseqconsensusreads_tpu.ops.refstore import gather_windows
+    from bsseqconsensusreads_tpu.ops.wire import pack_lard, unpack_duplex_inputs
+
+    bases, quals, cover, convert_mask, eligible = unpack_duplex_inputs(
+        nib, qual, meta, f, w
+    )
+    ref = gather_windows(genome, starts, limits, w + 1)
+    out = duplex_call_pipeline(
+        bases, quals, cover, ref, convert_mask, eligible, params=params
+    )
+    packed = pack_duplex_outputs(out)
+    return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
+
+
+def unpack_duplex_wire_outputs(wire, f: int, w: int) -> dict:
+    """numpy split+unpack of the duplex_call_wire result (host side)."""
+    from bsseqconsensusreads_tpu.ops.wire import unpack_lard
+    import numpy as np
+
+    wire = np.asarray(wire)
+    out = unpack_duplex_outputs(wire[: f * w], f=f, w=w)
+    out["la"], out["rd"] = unpack_lard(wire[f * w :], f)
+    return out
+
+
 @partial(jax.jit, static_argnames=("params",))
 def duplex_call_pipeline_packed(
     bases, quals, cover, ref, convert_mask, extend_eligible,

@@ -8,7 +8,8 @@ duplex merge) is a dense per-column tensor op.
 
 Bucketed padding bounds pad waste across the 1-2-read cfDNA tail and deep
 (>500 read) families (SURVEY.md §5.7): template counts round up to powers of
-two and window lengths to multiples of 128 (the TPU lane width).
+two and window lengths to multiples of WINDOW_GRAN (sized for wire bytes —
+see the granularity note below).
 """
 
 from __future__ import annotations
@@ -32,8 +33,14 @@ from bsseqconsensusreads_tpu.io.bam import (
 from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE
 from bsseqconsensusreads_tpu.utils.flags import CONVERT_FLAGS, GROUP_ORDER
 
-# TPU-friendly padding granularity.
+# Padding granularities. Template counts bucket to powers of two. Window
+# widths bucket to 32 columns: the wire format (ops.wire) ships exactly the
+# bucketed width, and on the tunnel-bound hot path wire bytes cost far more
+# than the VMEM lane padding XLA adds internally (a 153-col duplex window
+# buckets to 160 on the wire; XLA pads the minor dim to 128-lane tiles on
+# device either way).
 LANE = 128
+WINDOW_GRAN = 32
 MAX_TEMPLATES_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
@@ -110,7 +117,7 @@ def bucket_templates(t: int) -> int:
 
 
 def bucket_window(w: int) -> int:
-    return max(LANE, _round_up(w, LANE))
+    return max(WINDOW_GRAN, _round_up(w, WINDOW_GRAN))
 
 
 #: Families deeper than this are skipped AND reported (never silent):

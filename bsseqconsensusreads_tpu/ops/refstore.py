@@ -1,0 +1,108 @@
+"""Device-resident reference genome with on-device window gather.
+
+The reference pipeline fetches a reference window per read on the host
+(tools/1.convert_AG_to_CT.py:102-107, via pysam.FastaFile). Shipping those
+windows to the device costs wire bytes every batch; instead the genome is
+uploaded ONCE as a flat int8 code array (one byte per base, contigs
+concatenated) and each batch sends only an int32 start offset per family —
+the [F, W+1] window tensor is gathered on device.
+
+A human-scale genome is ~3.1 GB as int8, well within a v4 chip's HBM next to
+the batch tensors. Out-of-range windows (start < 0, or columns past the
+contig limit) gather NBASE, reproducing the reference's all-N fallback for
+failed fetches (tools/1.convert_AG_to_CT.py:106-109) and its N-padding for
+short fetches (:116-117).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bsseqconsensusreads_tpu.alphabet import BASE_CODE, NBASE
+
+
+#: starts value meaning "no reference for this family" (all-N window).
+#: uint32 so a human-scale (~3.1 Gbp > 2**31) concatenated genome indexes
+#: without overflow; the genome length cap is 2**32 - 2**16.
+NO_REF = np.uint32(0xFFFFFFFF)
+MAX_GENOME = (1 << 32) - (1 << 16)
+
+
+@partial(jax.jit, static_argnames=("width",))
+def gather_windows(genome, starts, limits, width: int):
+    """Gather [F, width] reference windows from the flat genome on device.
+
+    genome: int8 [G] (all contigs concatenated); starts/limits: uint32 [F]
+    global offsets (start of window / one past the end of its contig).
+    starts == NO_REF yields an all-N row; columns at/past `limits` yield N.
+    """
+    starts = starts.astype(jnp.uint32)
+    idx = starts[:, None] + jnp.arange(width, dtype=jnp.uint32)
+    valid = (starts[:, None] != NO_REF) & (idx < limits[:, None].astype(jnp.uint32))
+    safe = jnp.minimum(idx, jnp.uint32(genome.shape[0] - 1))
+    ref = jnp.take(genome, safe, axis=0)
+    return jnp.where(valid, ref, jnp.int8(NBASE))
+
+
+class RefStore:
+    """Concatenated genome codes + per-contig offsets, uploaded to device once."""
+
+    def __init__(self, names, seqs=None, codes=None, lengths=None):
+        self.names = list(names)
+        if codes is None:
+            parts = [
+                BASE_CODE[np.frombuffer(s.encode("ascii"), dtype=np.uint8)]
+                for s in seqs
+            ]
+            lengths = [len(p) for p in parts]
+            codes = (
+                np.concatenate(parts) if parts else np.zeros(0, dtype=np.int8)
+            )
+        self.lengths = np.asarray(lengths, dtype=np.int64)
+        self.offsets = np.concatenate([[0], np.cumsum(self.lengths)])[:-1]
+        self._index = {n: i for i, n in enumerate(self.names)}
+        self.codes = np.ascontiguousarray(codes, dtype=np.int8)
+        if self.codes.size > MAX_GENOME:
+            raise ValueError(
+                f"genome of {self.codes.size} bases exceeds the uint32 "
+                f"offset cap {MAX_GENOME}; shard contigs across RefStores"
+            )
+        self._device = None
+
+    @classmethod
+    def from_fasta(cls, path: str) -> "RefStore":
+        from bsseqconsensusreads_tpu.io.fasta import FastaFile
+
+        with FastaFile(path) as fa:
+            names = fa.references
+            seqs = [fa.fetch(n) for n in names]
+        return cls(names, seqs=seqs)
+
+    @property
+    def device_codes(self):
+        """The genome on device (uploaded lazily, once)."""
+        if self._device is None:
+            self._device = jax.device_put(self.codes)
+        return self._device
+
+    def window_offsets(self, ref_ids, window_starts):
+        """Vectorized (starts, limits) uint32 arrays for gather_windows.
+
+        ref_ids outside [0, n_contigs) or window_starts < 0 map to
+        start = NO_REF (all-N row — the reference's failed-fetch fallback,
+        tools/1.convert_AG_to_CT.py:106-109). Offset math runs in int64 and
+        is range-checked before the uint32 narrowing."""
+        rid = np.asarray(ref_ids, dtype=np.int64)
+        ws = np.asarray(window_starts, dtype=np.int64)
+        ok = (rid >= 0) & (rid < len(self.names)) & (ws >= 0)
+        safe = np.where(ok, rid, 0)
+        starts = self.offsets[safe] + ws
+        ok &= starts < MAX_GENOME
+        starts = np.where(ok, starts, np.int64(NO_REF))
+        limits = np.where(ok, self.offsets[safe] + self.lengths[safe], 0)
+        return starts.astype(np.uint32), limits.astype(np.uint32)

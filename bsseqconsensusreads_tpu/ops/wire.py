@@ -1,0 +1,133 @@
+"""Packed wire formats for the host<->device tunnel.
+
+On tunneled TPU hosts the device link is the stage bottleneck, with three
+measured pathologies (see BASELINE.md / bench.py):
+
+  * D2H of computed arrays runs ~25 MB/s (entropy-dependent — the tunnel
+    compresses) with ~0.1 s fixed cost per fetch, and briefly degrades the
+    H2D direction afterwards;
+  * many small transfers pay the fixed cost repeatedly;
+  * multi-dim narrow-dtype arrays move slower than flat word-sized ones.
+
+So every hot-path tensor crosses the wire as ONE flat uint32 array per
+direction, packed to its information content:
+
+  input  nib:  4 bits/cell  = base code (3b) | cover (1b), 2 cells/byte
+  input  qual: 8 bits/cell  (Phred 0..93)
+  input  meta: 8 bits/family = convert_mask rows (4b) | extend_eligible (1b)
+  output wire: pack_duplex_outputs columns (2 B/col) ++ la/rd (1 B/family)
+
+The reference streams everything through BAM files between processes
+(SURVEY.md §3.1); this module is the equivalent "serialization boundary" of
+the TPU design, sized for the tunnel instead of the filesystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_to_words(flat_u8: np.ndarray) -> np.ndarray:
+    pad = (-flat_u8.size) % 4
+    if pad:
+        flat_u8 = np.concatenate([flat_u8, np.zeros(pad, dtype=np.uint8)])
+    return flat_u8.view(np.uint32)
+
+
+@dataclasses.dataclass
+class DuplexWire:
+    """Host-side packed input batch for duplex_call_wire."""
+
+    nib: np.ndarray  # uint32 [F*R*W/8]   base|cover nibbles
+    qual: np.ndarray  # uint32 [F*R*W/4]  Phred bytes
+    meta: np.ndarray  # uint32 [ceil(F/4)] convert_mask|eligible bytes
+    starts: np.ndarray  # uint32 [F] global genome offset of window (NO_REF = all-N)
+    limits: np.ndarray  # uint32 [F] global genome offset one past the contig end
+    f: int
+    w: int
+
+
+def pack_duplex_inputs(
+    bases: np.ndarray,
+    quals: np.ndarray,
+    cover: np.ndarray,
+    convert_mask: np.ndarray,
+    eligible: np.ndarray,
+    starts: np.ndarray,
+    limits: np.ndarray,
+) -> DuplexWire:
+    """numpy pack of a DuplexBatch into flat u32 wire arrays.
+
+    bases int8/uint8 [F, R, W] (NBASE where uncovered), quals uint8 [F, R, W],
+    cover bool [F, R, W], convert_mask bool [F, R], eligible bool [F].
+    W must be even.
+    """
+    f, r, w = bases.shape
+    if w % 2:
+        raise ValueError(f"window width must be even, got {w}")
+    nib = (bases.astype(np.uint8) & 0x7) | (cover.astype(np.uint8) << 3)
+    nib = nib.reshape(f * r * w // 2, 2)
+    nib_packed = (nib[:, 0] | (nib[:, 1] << 4)).astype(np.uint8)
+    meta = np.zeros(f, dtype=np.uint8)
+    for row in range(min(r, 4)):
+        meta |= convert_mask[:, row].astype(np.uint8) << row
+    meta |= eligible.astype(np.uint8) << 4
+    return DuplexWire(
+        nib=_pad_to_words(nib_packed),
+        qual=_pad_to_words(quals.astype(np.uint8).reshape(-1)),
+        meta=_pad_to_words(meta),
+        starts=np.asarray(starts, dtype=np.uint32),
+        limits=np.asarray(limits, dtype=np.uint32),
+        f=f,
+        w=w,
+    )
+
+
+def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4):
+    """Device-side (jit-traceable) inverse of pack_duplex_inputs.
+
+    Returns (bases int8 [f,r,w], quals uint8 [f,r,w], cover bool [f,r,w],
+    convert_mask bool [f,r], eligible bool [f])."""
+    nib_u8 = jax.lax.bitcast_convert_type(nib, jnp.uint8).reshape(-1)[
+        : f * r * w // 2
+    ]
+    lo = nib_u8 & 0xF
+    hi = nib_u8 >> 4
+    cells = jnp.stack([lo, hi], axis=-1).reshape(f, r, w)
+    bases = (cells & 0x7).astype(jnp.int8)
+    cover = (cells >> 3).astype(jnp.bool_)
+    quals = jax.lax.bitcast_convert_type(qual, jnp.uint8).reshape(-1)[
+        : f * r * w
+    ].reshape(f, r, w)
+    meta_u8 = jax.lax.bitcast_convert_type(meta, jnp.uint8).reshape(-1)[:f]
+    convert_mask = jnp.stack(
+        [(meta_u8 >> row) & 1 for row in range(min(r, 4))], axis=-1
+    ).astype(jnp.bool_)
+    eligible = ((meta_u8 >> 4) & 1).astype(jnp.bool_)
+    return bases, quals, cover, convert_mask, eligible
+
+
+def pack_lard(la, rd):
+    """Device-side pack of la/rd [..., F, 4] int8 into u32 words (1 B/family)."""
+    bits = jnp.zeros(la.shape[:-1], dtype=jnp.uint8)
+    for row in range(la.shape[-1]):
+        bits = bits | (la[..., row].astype(jnp.uint8) << row)
+        bits = bits | (rd[..., row].astype(jnp.uint8) << (4 + row))
+    flat = bits.reshape(-1)
+    pad = (-flat.shape[0]) % 4
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=jnp.uint8)])
+    return jax.lax.bitcast_convert_type(flat.reshape(-1, 4), jnp.uint32)
+
+
+def unpack_lard(words: np.ndarray, f: int, r: int = 4):
+    """numpy inverse of pack_lard -> (la, rd) int8 [f, r]."""
+    bits = np.asarray(words).view(np.uint8)[:f]
+    la = np.stack([(bits >> row) & 1 for row in range(r)], axis=-1)
+    rd = np.stack([(bits >> (4 + row)) & 1 for row in range(r)], axis=-1)
+    return la.astype(np.int8), rd.astype(np.int8)

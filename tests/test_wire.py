@@ -1,0 +1,127 @@
+"""Wire-format + device-resident-genome parity tests.
+
+The packed tunnel path (ops.wire + ops.refstore + duplex_call_wire) must be
+bit-identical to the unpacked duplex_call_pipeline path — it is a transport
+optimization, not a model change.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from bsseqconsensusreads_tpu.alphabet import BASE_CODE, NBASE
+from bsseqconsensusreads_tpu.models.duplex import (
+    duplex_call_pipeline,
+    duplex_call_wire,
+    unpack_duplex_wire_outputs,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.refstore import RefStore, gather_windows
+from bsseqconsensusreads_tpu.ops.wire import (
+    pack_duplex_inputs,
+    pack_lard,
+    unpack_duplex_inputs,
+    unpack_lard,
+)
+
+PARAMS = ConsensusParams(min_reads=0)
+
+
+def random_batch(f=6, w=32, seed=0):
+    rng = np.random.default_rng(seed)
+    bases = rng.integers(0, 5, size=(f, 4, w)).astype(np.int8)
+    cover = np.zeros((f, 4, w), dtype=bool)
+    for fi in range(f):
+        for r in range(4):
+            a, b = sorted(rng.integers(1, w, size=2))
+            cover[fi, r, a : b + 1] = True
+    bases[~cover] = NBASE
+    quals = np.where(cover, rng.integers(2, 41, size=(f, 4, w)), 0).astype(np.uint8)
+    convert_mask = rng.integers(0, 2, size=(f, 4)).astype(bool)
+    eligible = rng.integers(0, 2, size=f).astype(bool)
+    return bases, quals, cover, convert_mask, eligible
+
+
+def test_input_roundtrip():
+    f, w = 5, 18
+    bases, quals, cover, cmask, elig = random_batch(f, w, seed=1)
+    starts = np.arange(f, dtype=np.int32)
+    limits = np.full(f, 1000, dtype=np.int32)
+    wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
+    b, q, c, m, e = unpack_duplex_inputs(wire.nib, wire.qual, wire.meta, f, w)
+    # all codes (0..4 incl. NBASE=4) fit the 3-bit field exactly
+    np.testing.assert_array_equal(np.asarray(b), bases)
+    np.testing.assert_array_equal(np.asarray(q), quals)
+    np.testing.assert_array_equal(np.asarray(c), cover)
+    np.testing.assert_array_equal(np.asarray(m), cmask)
+    np.testing.assert_array_equal(np.asarray(e), elig)
+
+
+def test_lard_roundtrip():
+    rng = np.random.default_rng(2)
+    f = 7
+    la = rng.integers(0, 2, size=(f, 4)).astype(np.int8)
+    rd = rng.integers(0, 2, size=(f, 4)).astype(np.int8)
+    words = np.asarray(pack_lard(la, rd))
+    la2, rd2 = unpack_lard(words, f)
+    np.testing.assert_array_equal(la2, la)
+    np.testing.assert_array_equal(rd2, rd)
+
+
+def test_refstore_window_gather_matches_host_fetch():
+    rng = np.random.default_rng(3)
+    seqs = {
+        "chr1": "".join(rng.choice(list("ACGT"), size=300)),
+        "chr2": "".join(rng.choice(list("ACGT"), size=120)),
+    }
+    store = RefStore(list(seqs), seqs=list(seqs.values()))
+    width = 40
+    cases = [(0, 10), (0, 280), (1, 0), (1, 100), (0, -5), (7, 10)]
+    starts, limits = store.window_offsets(
+        [c[0] for c in cases], [c[1] for c in cases]
+    )
+    got = np.asarray(
+        gather_windows(jax.device_put(store.codes), starts, limits, width)
+    )
+    names = list(seqs)
+    for i, (rid, ws) in enumerate(cases):
+        want = np.full(width, NBASE, dtype=np.int8)
+        if 0 <= rid < len(names) and ws >= 0:
+            s = seqs[names[rid]][ws : ws + width]
+            want[: len(s)] = BASE_CODE[
+                np.frombuffer(s.encode(), dtype=np.uint8)
+            ]
+        np.testing.assert_array_equal(got[i], want, err_msg=f"case {i}: {rid},{ws}")
+
+
+def test_wire_path_matches_unpacked_pipeline():
+    f, w = 8, 32
+    bases, quals, cover, cmask, elig = random_batch(f, w, seed=4)
+    rng = np.random.default_rng(5)
+    genome_codes = rng.integers(0, 4, size=2000).astype(np.int8)
+    store = RefStore(["g"], codes=genome_codes, lengths=[2000])
+    window_starts = rng.integers(0, 1900, size=f)
+    starts, limits = store.window_offsets(np.zeros(f, dtype=int), window_starts)
+
+    ref = np.asarray(gather_windows(store.device_codes, starts, limits, w + 1))
+    want = jax.device_get(
+        duplex_call_pipeline(
+            bases, quals.astype(np.float32), cover, ref, cmask, elig, params=PARAMS
+        )
+    )
+
+    wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
+    out_wire = duplex_call_wire(
+        wire.nib, wire.qual, wire.meta, wire.starts, wire.limits,
+        store.device_codes, f, w, PARAMS,
+    )
+    got = unpack_duplex_wire_outputs(jax.device_get(out_wire), f=f, w=w)
+
+    np.testing.assert_array_equal(got["base"], np.asarray(want["base"]))
+    np.testing.assert_array_equal(got["qual"], np.asarray(want["qual"]))
+    np.testing.assert_array_equal(got["depth"], np.asarray(want["depth"]))
+    np.testing.assert_array_equal(got["errors"], np.asarray(want["errors"]))
+    np.testing.assert_array_equal(got["a_depth"], np.asarray(want["a_depth"]))
+    np.testing.assert_array_equal(got["la"], np.asarray(want["la"]))
+    np.testing.assert_array_equal(got["rd"], np.asarray(want["rd"]))
