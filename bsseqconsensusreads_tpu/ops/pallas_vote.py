@@ -1,0 +1,214 @@
+"""Pallas TPU kernel for the consensus column vote.
+
+The vote (models/molecular.py column_vote) is the framework's hot op: for
+every window column, reduce [reads] observations into per-candidate-base
+log-likelihood sums, pick the argmax, and convert its posterior into a Phred
+quality (fgbio error-model semantics; reference flag surface at
+main.snake.py:54,163). The stock XLA lowering materializes a one-hot
+[reads, W, 4] float32 tensor per family; this kernel instead streams read
+chunks HBM->VMEM and keeps only the [4, W] accumulators resident, fusing the
+whole reduction + finalize into one pass:
+
+  grid = (G/GB, T/TC)        G = independent vote groups (family x role),
+                             T = reads axis, W = window columns
+  per step: load [GB, TC, W] bases+quals, accumulate
+    ll[GB, 4, W]  += quality-weighted log-likelihood partials
+    cnt[GB, 4, W] += per-base observation counts
+  epilogue (last T chunk): argmax/softmax finalize, errors = depth - cnt[cons]
+
+The count trick makes the disagreement tally (models/molecular.count_errors)
+a free epilogue lookup instead of a second pass over the reads axis.
+
+Numerics are the exact jnp expressions of ops/phred.py; results match the
+XLA kernel exactly on every column whose argmax is unambiguous. On exact-tie
+columns (two candidate bases with equal log-likelihood — equal posterior, so
+either pick is correct) summation-order ulps may break the tie differently;
+tests/test_pallas.py compares tie-aware. The kernel is selected via
+pipeline.calling's vote_kernel argument or BSSEQ_TPU_VOTE_KERNEL=pallas|xla.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bsseqconsensusreads_tpu.alphabet import NBASE, NUM_BASES
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops import phred
+
+GB = 8  # vote groups per grid step (f32 sublane tile)
+TC = 128  # max reads per chunk streamed through VMEM
+WC = 512  # max window columns per grid step (VMEM: 8*128*512*4 B = 2 MB/block)
+
+
+def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
+                 ll_acc, cnt_acc, *, params: ConsensusParams, num_t: int):
+    """Grid step (i, j, t): accumulate group block i / column tile j's read
+    chunk t (t is the innermost grid axis, so the scratch accumulators belong
+    to one (i, j) tile at a time).
+
+    All vector ops are 2D [TC, W] / [4, W] / [1, W] — Mosaic's layout engine
+    rejects 3D i1 relayouts and >2D gathers, so the group dim is a static
+    python unroll and the argmax lookups are 4-way selects.
+    Scratch rows g*4+b hold group g's accumulator for candidate base b.
+    """
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        ll_acc[:] = jnp.zeros_like(ll_acc)
+        cnt_acc[:] = jnp.zeros_like(cnt_acc)
+
+    for g in range(GB):
+        # Widen bases to f32 at load: the VPU has no i8 vector compare, and
+        # base codes 0..4 are exact in f32.
+        bases = bases_ref[g].astype(jnp.float32)  # [TC, W]
+        quals = quals_ref[g]  # [TC, W] f32
+        # Mask-free accumulate: Mosaic's layout engine rejects relayouts of
+        # full-size i1 vectors, so masks become exact {0,1} f32 indicator
+        # products (x*1 and x*0 are exact; log terms are finite after the
+        # phred clip, so 0*log never produces nan).
+        w_obs = (bases != float(NBASE)).astype(jnp.float32) * (
+            quals >= params.min_input_base_quality
+        ).astype(jnp.float32)
+        p_err = phred.adjust_quals_post_umi(quals, params.error_rate_post_umi)
+        log_ok, log_err = phred.log_likelihoods(p_err)
+        for b in range(NUM_BASES):
+            hit = (bases == float(b)).astype(jnp.float32)
+            contrib = (hit * log_ok + (1.0 - hit) * log_err) * w_obs
+            row = slice(g * NUM_BASES + b, g * NUM_BASES + b + 1)
+            ll_acc[row, :] += jnp.sum(contrib, axis=0, keepdims=True)
+            cnt_acc[row, :] += jnp.sum(hit * w_obs, axis=0, keepdims=True)
+
+    @pl.when(t == num_t - 1)
+    def _finalize():
+        for g in range(GB):
+            rows = slice(g * NUM_BASES, (g + 1) * NUM_BASES)
+            ll = ll_acc[rows, :]  # [4, W]
+            cnt = cnt_acc[rows, :]  # [4, W] f32 (exact: counts < 2^24)
+            depth = jnp.sum(cnt, axis=0, keepdims=True)  # [1, W]
+            called = depth > 0
+            cons = jnp.argmax(ll, axis=0, keepdims=True)  # [1, W]
+            post = jax.nn.softmax(ll, axis=0)
+
+            def pick(arr, idx):
+                out = jnp.zeros_like(arr[0:1, :])
+                for b in range(NUM_BASES):
+                    out = jnp.where(idx == b, arr[b : b + 1, :], out)
+                return out
+
+            p_cons = 1.0 - pick(post, cons)
+            p_final = phred.prob_error_two_trials(
+                p_cons, phred.phred_to_prob(params.error_rate_pre_umi)
+            )
+            qual = phred.prob_to_phred(p_final)
+            low = qual < params.min_consensus_base_quality
+            keep = called & ~low
+            cons = jnp.where(keep, cons, NBASE)
+            qual = jnp.where(keep, qual, float(phred.NO_CALL_QUAL))
+            agree = pick(cnt, cons)
+            out_row = slice(g, g + 1)
+            base_out[out_row, :] = cons.astype(jnp.int32)
+            qual_out[out_row, :] = jnp.round(qual).astype(jnp.int32)
+            depth_out[out_row, :] = depth.astype(jnp.int32)
+            err_out[out_row, :] = jnp.where(
+                cons != NBASE, depth - agree, 0.0
+            ).astype(jnp.int32)
+
+
+def _pad_to(x, axis: int, mult: int, fill):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def column_vote_groups(bases, quals, params: ConsensusParams,
+                       interpret: bool | None = None):
+    """Pallas column vote over independent groups.
+
+    bases: int8 [G, T, W] (NBASE = no observation), quals: float32 [G, T, W].
+    Returns dict of [G, W] arrays matching models.molecular.column_vote:
+    base (int8), qual (uint8), depth (int32), errors (int32).
+    interpret=None compiles on accelerators (incl. the tunneled 'axon' TPU
+    backend) and interprets on the CPU test mesh.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    g, t, w = bases.shape
+    quals = quals.astype(jnp.float32)
+    # Chunk sizes adapt to the input: shallow families (t=1-2, the cfDNA
+    # common case) pad reads only to the 8-row sublane tile instead of a full
+    # TC chunk, and wide windows tile the column axis so VMEM blocks stay
+    # bounded (max_window=4096 would otherwise need 16 MB/block).
+    tc = min(TC, max(8, -(-t // 8) * 8))
+    wc = min(WC, w)
+    bases = _pad_to(_pad_to(bases, 0, GB, NBASE), 1, tc, NBASE)
+    quals = _pad_to(_pad_to(quals, 0, GB, 0.0), 1, tc, 0.0)
+    bases = _pad_to(bases, 2, wc, NBASE)
+    quals = _pad_to(quals, 2, wc, 0.0)
+    gp, tp, wp = bases.shape
+    num_t = tp // tc
+    grid = (gp // GB, wp // wc, num_t)  # t innermost: accumulators are per (i, j)
+    out_spec = pl.BlockSpec((GB, wc), lambda i, j, t_: (i, j),
+                            memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_vote_kernel, params=params, num_t=num_t),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((GB, tc, wc), lambda i, j, t_: (i, t_, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((GB, tc, wc), lambda i, j, t_: (i, t_, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[out_spec] * 4,
+        out_shape=[jax.ShapeDtypeStruct((gp, wp), jnp.int32)] * 4,
+        scratch_shapes=[
+            pltpu.VMEM((GB * NUM_BASES, wc), jnp.float32),
+            pltpu.VMEM((GB * NUM_BASES, wc), jnp.float32),
+        ],
+        interpret=interpret,
+    )(bases, quals)
+    base, qual, depth, errors = (o[:g, :w] for o in outs)
+    return {
+        "base": base.astype(jnp.int8),
+        "qual": qual.astype(jnp.uint8),
+        "depth": depth,
+        "errors": errors,
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def molecular_consensus_pallas(bases, quals,
+                               params: ConsensusParams = ConsensusParams(),
+                               interpret: bool | None = None):
+    """Pallas-backed models.molecular.molecular_consensus.
+
+    bases: int8 [F, T, 2, W], quals: uint8/f32 [F, T, 2, W]; returns the same
+    narrowed dict of [F, 2, W] arrays. The R1/R2 overlap co-call stays in XLA
+    (cheap elementwise); the reads-axis vote reduction runs in the kernel.
+    """
+    from bsseqconsensusreads_tpu.models.molecular import (
+        narrow_outputs,
+        overlap_cocall,
+    )
+
+    f, t, _, w = bases.shape
+    quals = quals.astype(jnp.float32)
+    if params.consensus_call_overlapping_bases:
+        bases, quals = jax.vmap(
+            lambda b, q: overlap_cocall(b, q)
+        )(bases, quals)
+    # [F, T, 2, W] -> [F*2 groups, T, W]: roles vote independently.
+    gb = bases.transpose(0, 2, 1, 3).reshape(f * 2, t, w)
+    gq = quals.transpose(0, 2, 1, 3).reshape(f * 2, t, w)
+    out = column_vote_groups(gb, gq, params, interpret=interpret)
+    out = {k: v.reshape(f, 2, w) for k, v in out.items()}
+    return narrow_outputs(out)

@@ -58,6 +58,24 @@ from bsseqconsensusreads_tpu.ops.encode import (
 _COMPLEMENT = dict(zip("ACGTN", "TGCAN"))
 
 
+def _molecular_kernel(vote_kernel: str | None):
+    """Resolve the molecular vote kernel: 'xla' (default) or 'pallas'
+    (ops.pallas_vote — the fused Mosaic reduction). Overridable per call or
+    via BSSEQ_TPU_VOTE_KERNEL for whole-pipeline experiments."""
+    import os
+
+    choice = vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
+    if choice == "pallas":
+        from bsseqconsensusreads_tpu.ops.pallas_vote import (
+            molecular_consensus_pallas,
+        )
+
+        return molecular_consensus_pallas
+    if choice != "xla":
+        raise ValueError(f"unknown vote kernel {choice!r} (want 'xla'|'pallas')")
+    return molecular_consensus
+
+
 def _revcomp(seq: str) -> str:
     return "".join(_COMPLEMENT[c] for c in reversed(seq))
 
@@ -294,6 +312,7 @@ def call_molecular(
     max_window: int = 4096,
     grouping: str = "gather",
     stats: StageStats | None = None,
+    vote_kernel: str | None = None,
 ) -> Iterator[BamRecord]:
     """Molecular (single-strand) consensus over MI families.
 
@@ -303,6 +322,7 @@ def call_molecular(
     input (see stream_mi_groups), 'gather' holds the whole input.
     """
     stats = stats if stats is not None else StageStats()
+    consensus_fn = _molecular_kernel(vote_kernel)
     t0 = time.monotonic()
     groups = stream_mi_groups(records, grouping=grouping, stats=stats)
     for chunk in _group_batches(groups, batch_families):
@@ -314,7 +334,7 @@ def call_molecular(
         used = int((batch.bases != NBASE).sum())
         stats.pad_cells += batch.bases.size - used
         stats.used_cells += used
-        out = molecular_consensus(batch.bases, batch.quals, params)
+        out = consensus_fn(batch.bases, batch.quals, params)
         base = np.asarray(out["base"])
         qual = np.asarray(out["qual"])
         depth = np.asarray(out["depth"])

@@ -1,0 +1,133 @@
+"""Pallas column-vote kernel vs the XLA reference kernel.
+
+Runs the kernel in interpret mode on CPU (the test mesh never touches the
+real TPU); the kernel body is the exact jnp expression set of ops/phred.py,
+so results must be bitwise identical to models.molecular.column_vote /
+molecular_consensus.
+"""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.molecular import (
+    column_vote,
+    molecular_consensus,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.pallas_vote import (
+    column_vote_groups,
+    molecular_consensus_pallas,
+)
+
+
+def _random_groups(rng, g, t, w, p_cover=0.8):
+    bases = rng.integers(0, 5, size=(g, t, w)).astype(np.int8)
+    cover = rng.random((g, t, w)) < p_cover
+    bases[~cover] = NBASE
+    quals = np.where(
+        bases != NBASE, rng.integers(2, 41, size=(g, t, w)), 0
+    ).astype(np.float32)
+    return bases, quals
+
+
+def _tie_columns(bases_g, quals_g, params):
+    """Columns whose top-2 log-likelihoods tie (within float noise): the
+    consensus pick there is genuinely ambiguous — equal posterior — and
+    summation-order ulps may break the tie differently between the XLA and
+    Pallas reductions. Those columns are excluded from exact comparison."""
+    from bsseqconsensusreads_tpu.models.molecular import vote_partials
+
+    ll = np.asarray(vote_partials(bases_g, quals_g, params)[0])  # [W, 4]
+    top2 = np.sort(ll, axis=-1)[:, -2:]
+    return np.abs(top2[:, 1] - top2[:, 0]) <= 1e-4
+
+
+def _assert_vote_matches(got_g, want, tie, tag=""):
+    free = ~tie
+    for k in ("base", "qual", "depth", "errors"):
+        a, b = np.asarray(got_g[k]), np.asarray(want[k])
+        np.testing.assert_array_equal(a[free], b[free], err_msg=f"{k}{tag}")
+    # tie columns: depth is still exact, qual within rounding of the tie
+    np.testing.assert_array_equal(
+        np.asarray(got_g["depth"])[tie], np.asarray(want["depth"])[tie]
+    )
+    assert (
+        np.abs(
+            np.asarray(got_g["qual"])[tie].astype(int)
+            - np.asarray(want["qual"])[tie].astype(int)
+        )
+        <= 1
+    ).all()
+
+
+@pytest.mark.parametrize(
+    "g,t,w",
+    [
+        (3, 5, 40),
+        (8, 128, 160),
+        (9, 130, 33),
+        (2, 1, 16),  # cfDNA tail: single-read family, tiny read chunk
+        (3, 4, 600),  # wide window: exercises the column-tile grid axis
+    ],
+)
+def test_vote_groups_match_xla(rng, g, t, w):
+    bases, quals = _random_groups(rng, g, t, w)
+    params = ConsensusParams()
+    got = column_vote_groups(bases, quals, params, interpret=True)
+    for gi in range(g):
+        want = column_vote(bases[gi], quals[gi], params)
+        tie = _tie_columns(bases[gi], quals[gi], params)
+        _assert_vote_matches(
+            {k: got[k][gi] for k in got}, want, tie, tag=f"[{gi}]"
+        )
+
+
+def test_vote_groups_empty_columns(rng):
+    bases = np.full((2, 4, 16), NBASE, dtype=np.int8)
+    quals = np.zeros((2, 4, 16), dtype=np.float32)
+    out = column_vote_groups(bases, quals, ConsensusParams(), interpret=True)
+    assert (np.asarray(out["base"]) == NBASE).all()
+    assert (np.asarray(out["depth"]) == 0).all()
+    assert (np.asarray(out["errors"]) == 0).all()
+
+
+def test_vote_groups_min_quality_filter(rng):
+    bases, quals = _random_groups(rng, 4, 6, 24)
+    params = ConsensusParams(min_input_base_quality=20)
+    got = column_vote_groups(bases, quals, params, interpret=True)
+    for gi in range(4):
+        want = column_vote(bases[gi], quals[gi], params)
+        tie = _tie_columns(bases[gi], quals[gi], params)
+        _assert_vote_matches(
+            {k: got[k][gi] for k in got}, want, tie, tag=f"[{gi}]"
+        )
+
+
+@pytest.mark.parametrize("f,t,w", [(2, 3, 48), (5, 17, 160)])
+def test_molecular_pallas_matches_xla(rng, f, t, w):
+    bases = rng.integers(0, 5, size=(f, t, 2, w)).astype(np.int8)
+    cover = rng.random((f, t, 2, w)) < 0.7
+    bases[~cover] = NBASE
+    quals = np.where(bases != NBASE, rng.integers(2, 41, size=bases.shape), 0).astype(
+        np.uint8
+    )
+    params = ConsensusParams()
+    got = molecular_consensus_pallas(bases, quals, params, interpret=True)
+    want = molecular_consensus(bases, quals, params)
+    # tie columns (ambiguous argmax) per family x role, on the cocalled data
+    from bsseqconsensusreads_tpu.models.molecular import overlap_cocall
+    import jax
+
+    cb, cq = jax.vmap(overlap_cocall)(
+        np.asarray(bases), np.asarray(quals, dtype=np.float32)
+    )
+    cb, cq = np.asarray(cb), np.asarray(cq)
+    for k in want:
+        assert got[k].dtype == want[k].dtype, k
+    for fi in range(f):
+        for role in range(2):
+            want_r = {k: np.asarray(want[k])[fi, role] for k in want}
+            got_r = {k: np.asarray(got[k])[fi, role] for k in got}
+            tie = _tie_columns(cb[fi, :, role], cq[fi, :, role], params)
+            _assert_vote_matches(got_r, want_r, tie, tag=f"[{fi},{role}]")
