@@ -13,12 +13,12 @@ per batch: host nibble-pack + host->device transfer + on-device genome window
 gather + convert + extend + duplex vote + device->host fetch + host unpack.
 
 Transport design (the tunnel, not compute, bounds this stage — see
-ops/wire.py): inputs cross as flat u32 arrays at 4 bits/cell bases+cover and
-1 B/cell quals; the genome lives on device (ops.refstore) so only an int32
-offset per family is sent; outputs come back as one u32 array at 2 B/column.
-Input quals are drawn from the 4-level RTA3 binning ({2,12,23,37}) that
-current Illumina instruments emit — representative entropy for the
-compressing tunnel, and the same data the CPU oracle times against.
+ops/wire.py): ONE flat u32 array per direction. Inputs carry 4 bits/cell
+bases+cover and 2 bits/cell quals (the adaptive 'q2' codebook — the RTA3
+4-level binning {2,12,23,37} that current Illumina instruments emit fits a
+4-entry codebook); the genome lives on device (ops.refstore) so only 8 B of
+window offsets per family are sent; outputs come back at 2 B/column. The
+CPU oracle times against the same RTA3-binned data.
 """
 
 from __future__ import annotations
@@ -32,7 +32,7 @@ import jax
 
 from bsseqconsensusreads_tpu.alphabet import NBASE
 from bsseqconsensusreads_tpu.models.duplex import (
-    duplex_call_wire,
+    duplex_call_wire_fused,
     unpack_duplex_wire_outputs,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
@@ -87,15 +87,13 @@ def bench_tpu(iters: int = 10) -> float:
     starts, limits = store.window_offsets(np.zeros(F, dtype=int), wstarts)
 
     def run(prev):
-        # host pack (timed: it is real per-batch work)
-        wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
-        out = duplex_call_wire(
-            jax.device_put(wire.nib),
-            jax.device_put(wire.qual),
-            jax.device_put(wire.meta),
-            jax.device_put(wire.starts),
-            jax.device_put(wire.limits),
-            genome, F, W, PARAMS,
+        # host pack (timed: it is real per-batch work); ONE H2D transfer.
+        # RTA3's 4 qual levels auto-select the q2 codebook: 2 bits/qual.
+        wire = pack_duplex_inputs(
+            bases, quals, cover, cmask, elig, starts, limits, qual_mode="auto"
+        )
+        out = duplex_call_wire_fused(
+            jax.device_put(wire.to_words()), genome, F, W, PARAMS, wire.qual_mode,
         )
         out.copy_to_host_async()
         if prev is not None:

@@ -153,11 +153,12 @@ def unpack_duplex_outputs(packed, f: int | None = None, w: int | None = None) ->
     }
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params"))
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode"))
 def duplex_call_wire(
     nib, qual, meta, starts, limits, genome,
     f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
+    qual_mode: str = "q8",
 ):
     """The tunnel-optimal fused duplex stage: ONE flat u32 array each way.
 
@@ -174,7 +175,7 @@ def duplex_call_wire(
     from bsseqconsensusreads_tpu.ops.wire import pack_lard, unpack_duplex_inputs
 
     bases, quals, cover, convert_mask, eligible = unpack_duplex_inputs(
-        nib, qual, meta, f, w
+        nib, qual, meta, f, w, qual_mode=qual_mode
     )
     ref = gather_windows(genome, starts, limits, w + 1)
     out = duplex_call_pipeline(
@@ -182,6 +183,30 @@ def duplex_call_wire(
     )
     packed = pack_duplex_outputs(out)
     return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
+
+
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode"))
+def duplex_call_wire_fused(
+    words, genome, f: int, w: int,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    qual_mode: str = "q8",
+):
+    """duplex_call_wire with ONE u32 input array (DuplexWire.to_words()).
+
+    The five wire sections (starts, limits, meta, nib, qual) ride a single
+    H2D transfer and are split on device at static offsets — the tunnel's
+    ~0.1 s-class fixed cost per transfer is paid once per direction per
+    batch, completing the one-array-per-direction design this module's wire
+    format exists for.
+    """
+    from bsseqconsensusreads_tpu.ops.wire import split_duplex_wire
+
+    nib, qual, meta, starts, limits = split_duplex_wire(
+        words, f, w, qual_mode=qual_mode
+    )
+    return duplex_call_wire(
+        nib, qual, meta, starts, limits, genome, f, w, params, qual_mode
+    )
 
 
 def unpack_duplex_wire_outputs(wire, f: int, w: int) -> dict:

@@ -13,7 +13,13 @@ So every hot-path tensor crosses the wire as ONE flat uint32 array per
 direction, packed to its information content:
 
   input  nib:  4 bits/cell  = base code (3b) | cover (1b), 2 cells/byte
-  input  qual: 8 bits/cell  (Phred 0..93)
+  input  qual: adaptive codebook — current Illumina instruments emit 4
+               (RTA3: {2,12,23,37}) or 8 quality levels, so the covered
+               cells' distinct Phred values usually fit a tiny codebook:
+               'q2' = 2 bits/cell + 4-entry codebook, 'q4' = 4 bits/cell +
+               16-entry codebook, 'q8' = raw 8 bits/cell fallback.
+               Uncovered cells carry codebook[0]; their qualities are
+               never observed (bases there are NBASE, outside every mask).
   input  meta: 8 bits/family = convert_mask rows (4b) | extend_eligible (1b)
   output wire: pack_duplex_outputs columns (2 B/col) ++ la/rd (1 B/family)
 
@@ -39,17 +45,138 @@ def _pad_to_words(flat_u8: np.ndarray) -> np.ndarray:
     return flat_u8.view(np.uint32)
 
 
+QUAL_MODE_BITS = {"q2": 2, "q4": 4}
+
+
+def _qual_codebook_words(mode: str) -> int:
+    return (1 << QUAL_MODE_BITS[mode]) // 4
+
+
+_QUAL_SENTINEL = 255  # > max legal Phred (93): marks uncovered cells
+
+
+def _masked_quals(quals: np.ndarray, cover: np.ndarray) -> np.ndarray:
+    """Flat quals with uncovered cells replaced by the sentinel — shared by
+    level detection and index packing so the batch is traversed once each."""
+    return np.where(cover.reshape(-1), quals.reshape(-1), _QUAL_SENTINEL)
+
+
+def _qual_levels(masked: np.ndarray, n_uncovered: int):
+    """(distinct covered Phred values, covered-cells-carry-255 flag).
+
+    bincount beats np.unique ~10x on the 10M-cell hot-path batches: one
+    pass, no sort. A covered 255 is indistinguishable from the sentinel in
+    `masked`, so it is detected by count: the 255 bin exceeding the
+    uncovered-cell population means real 0xff quals are present."""
+    counts = np.bincount(masked, minlength=256)
+    levels = np.nonzero(counts[:_QUAL_SENTINEL])[0].astype(np.uint8)
+    if not levels.size:
+        levels = np.zeros(1, np.uint8)
+    return levels, int(counts[_QUAL_SENTINEL]) > n_uncovered
+
+
+def _pack_qual_codes(masked: np.ndarray, mode: str, levels: np.ndarray):
+    """Codebook-encode quals: returns u32 [codebook ++ packed indices].
+
+    Only covered cells' values enter the codebook; the sentinel (uncovered)
+    maps to index 0 — never observed downstream, see module docstring."""
+    bits = QUAL_MODE_BITS[mode]
+    if len(levels) > (1 << bits):
+        raise ValueError(
+            f"{len(levels)} distinct covered quals exceed {mode}'s "
+            f"{1 << bits}-entry codebook; use qual_mode='auto'"
+        )
+    if levels.size and int(levels[-1]) > 93:
+        raise ValueError(
+            f"covered qual {int(levels[-1])} > 93 (BAM printable max) cannot "
+            "ride a codebook mode; use qual_mode='q8' or 'auto'"
+        )
+    book = np.zeros(1 << bits, dtype=np.uint8)
+    book[: len(levels)] = levels
+    # 256-entry LUT instead of searchsorted: one gather over the batch,
+    # and lut[sentinel] = 0 handles uncovered cells for free
+    lut = np.zeros(256, dtype=np.uint8)
+    lut[levels] = np.arange(len(levels), dtype=np.uint8)
+    idx = lut[masked]
+    per = 8 // bits
+    pad = (-idx.size) % per
+    if pad:
+        idx = np.concatenate([idx, np.zeros(pad, dtype=np.uint8)])
+    idx = idx.reshape(-1, per)
+    packed = np.zeros(len(idx), dtype=np.uint8)
+    for i in range(per):
+        packed |= idx[:, i] << (bits * i)
+    return np.concatenate([book.view(np.uint32), _pad_to_words(packed)])
+
+
+def _unpack_qual_codes(words, f: int, w: int, r: int, mode: str):
+    """Device-side inverse of _pack_qual_codes -> uint8 [f, r, w]."""
+    bits = QUAL_MODE_BITS[mode]
+    nbook = 1 << bits
+    book_u8 = jax.lax.bitcast_convert_type(
+        words[: nbook // 4], jnp.uint8
+    ).reshape(-1)
+    packed = jax.lax.bitcast_convert_type(
+        words[nbook // 4 :], jnp.uint8
+    ).reshape(-1)
+    per = 8 // bits
+    mask = nbook - 1
+    idx = jnp.stack(
+        [(packed >> (bits * i)) & mask for i in range(per)], axis=-1
+    ).reshape(-1)[: f * r * w]
+    return jnp.take(book_u8, idx, axis=0).reshape(f, r, w)
+
+
 @dataclasses.dataclass
 class DuplexWire:
     """Host-side packed input batch for duplex_call_wire."""
 
     nib: np.ndarray  # uint32 [F*R*W/8]   base|cover nibbles
-    qual: np.ndarray  # uint32 [F*R*W/4]  Phred bytes
+    qual: np.ndarray  # uint32 — q8: [F*R*W/4] raw Phred bytes; q2/q4:
+    #                   codebook words ++ [F*R*W*bits/32] packed indices
     meta: np.ndarray  # uint32 [ceil(F/4)] convert_mask|eligible bytes
     starts: np.ndarray  # uint32 [F] global genome offset of window (NO_REF = all-N)
     limits: np.ndarray  # uint32 [F] global genome offset one past the contig end
     f: int
     w: int
+    qual_mode: str = "q8"  # 'q2'/'q4' codebook or raw 'q8' (see module doc)
+    r: int = 4  # reads per family (duplex window rows)
+
+    def to_words(self) -> np.ndarray:
+        """ONE flat u32 array for the whole input direction — a single H2D
+        transfer instead of five, so the tunnel's fixed per-transfer cost is
+        paid once per batch. Section order/sizes are static given
+        (f, w, r, qual_mode); split on device with split_duplex_wire."""
+        return np.concatenate(
+            [self.starts, self.limits, self.meta, self.nib, self.qual]
+        )
+
+
+def wire_section_sizes(
+    f: int, w: int, r: int = 4, qual_mode: str = "q8"
+) -> tuple[int, ...]:
+    """u32 word counts of the to_words() sections, in order:
+    starts, limits, meta, nib, qual."""
+    cells = f * r * w
+    if qual_mode == "q8":
+        qual_words = -(-cells // 4)
+    else:
+        bits = QUAL_MODE_BITS[qual_mode]
+        qual_words = _qual_codebook_words(qual_mode) + -(-(cells * bits) // 32)
+    return (f, f, (f + 3) // 4, -(-(cells // 2) // 4), qual_words)
+
+
+def split_duplex_wire(words, f: int, w: int, r: int = 4, qual_mode: str = "q8"):
+    """Device-side (jit-traceable) split of DuplexWire.to_words() back into
+    the (nib, qual, meta, starts, limits) section arrays."""
+    sizes = wire_section_sizes(f, w, r, qual_mode)
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + s)
+    starts, limits, meta, nib, qual = (
+        words[offs[i] : offs[i + 1]] for i in range(5)
+    )
+    return nib, qual, meta, starts, limits
 
 
 def pack_duplex_inputs(
@@ -60,16 +187,34 @@ def pack_duplex_inputs(
     eligible: np.ndarray,
     starts: np.ndarray,
     limits: np.ndarray,
+    qual_mode: str = "q8",
 ) -> DuplexWire:
     """numpy pack of a DuplexBatch into flat u32 wire arrays.
 
     bases int8/uint8 [F, R, W] (NBASE where uncovered), quals uint8 [F, R, W],
     cover bool [F, R, W], convert_mask bool [F, R], eligible bool [F].
-    W must be even.
+    W must be even. qual_mode 'auto' picks the smallest codebook the covered
+    cells' distinct qual values fit ('q2' <= 4 levels, 'q4' <= 16, else
+    'q8' raw bytes); the default stays raw 'q8' so pack/unpack defaults
+    round-trip — the chosen mode travels in DuplexWire.qual_mode and MUST be
+    passed to the unpack/duplex_call_wire side.
     """
     f, r, w = bases.shape
     if w % 2:
         raise ValueError(f"window width must be even, got {w}")
+    masked = levels = None
+    if qual_mode != "q8":
+        n_uncovered = int(cover.size - np.count_nonzero(cover))
+    if qual_mode == "auto":
+        masked = _masked_quals(np.asarray(quals, dtype=np.uint8), cover)
+        levels, has_255 = _qual_levels(masked, n_uncovered)
+        n = len(levels)
+        # Phred > 93 is outside the BAM printable range ('~'); 255 would
+        # collide with the uncovered-cell sentinel — raw bytes are always safe
+        if n > 16 or has_255 or int(levels[-1]) > 93:
+            qual_mode = "q8"
+        else:
+            qual_mode = "q2" if n <= 4 else "q4"
     nib = (bases.astype(np.uint8) & 0x7) | (cover.astype(np.uint8) << 3)
     nib = nib.reshape(f * r * w // 2, 2)
     nib_packed = (nib[:, 0] | (nib[:, 1] << 4)).astype(np.uint8)
@@ -77,22 +222,38 @@ def pack_duplex_inputs(
     for row in range(min(r, 4)):
         meta |= convert_mask[:, row].astype(np.uint8) << row
     meta |= eligible.astype(np.uint8) << 4
+    if qual_mode == "q8":
+        qual_words = _pad_to_words(quals.astype(np.uint8).reshape(-1))
+    else:
+        if masked is None:
+            masked = _masked_quals(np.asarray(quals, dtype=np.uint8), cover)
+            levels, has_255 = _qual_levels(masked, n_uncovered)
+            if has_255:
+                raise ValueError(
+                    "covered qual 255 (> 93, BAM printable max) cannot ride "
+                    f"a {qual_mode} codebook; use qual_mode='q8' or 'auto'"
+                )
+        qual_words = _pack_qual_codes(masked, qual_mode, levels)
     return DuplexWire(
         nib=_pad_to_words(nib_packed),
-        qual=_pad_to_words(quals.astype(np.uint8).reshape(-1)),
+        qual=qual_words,
         meta=_pad_to_words(meta),
         starts=np.asarray(starts, dtype=np.uint32),
         limits=np.asarray(limits, dtype=np.uint32),
         f=f,
         w=w,
+        qual_mode=qual_mode,
+        r=r,
     )
 
 
-def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4):
+def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4,
+                         qual_mode: str = "q8"):
     """Device-side (jit-traceable) inverse of pack_duplex_inputs.
 
     Returns (bases int8 [f,r,w], quals uint8 [f,r,w], cover bool [f,r,w],
-    convert_mask bool [f,r], eligible bool [f])."""
+    convert_mask bool [f,r], eligible bool [f]). Uncovered cells' quals are
+    codebook[0] under q2/q4 (never observed — bases there are NBASE)."""
     nib_u8 = jax.lax.bitcast_convert_type(nib, jnp.uint8).reshape(-1)[
         : f * r * w // 2
     ]
@@ -101,9 +262,12 @@ def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4):
     cells = jnp.stack([lo, hi], axis=-1).reshape(f, r, w)
     bases = (cells & 0x7).astype(jnp.int8)
     cover = (cells >> 3).astype(jnp.bool_)
-    quals = jax.lax.bitcast_convert_type(qual, jnp.uint8).reshape(-1)[
-        : f * r * w
-    ].reshape(f, r, w)
+    if qual_mode == "q8":
+        quals = jax.lax.bitcast_convert_type(qual, jnp.uint8).reshape(-1)[
+            : f * r * w
+        ].reshape(f, r, w)
+    else:
+        quals = _unpack_qual_codes(qual, f, w, r, qual_mode)
     meta_u8 = jax.lax.bitcast_convert_type(meta, jnp.uint8).reshape(-1)[:f]
     convert_mask = jnp.stack(
         [(meta_u8 >> row) & 1 for row in range(min(r, 4))], axis=-1

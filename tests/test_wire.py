@@ -49,7 +49,9 @@ def test_input_roundtrip():
     starts = np.arange(f, dtype=np.int32)
     limits = np.full(f, 1000, dtype=np.int32)
     wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
-    b, q, c, m, e = unpack_duplex_inputs(wire.nib, wire.qual, wire.meta, f, w)
+    b, q, c, m, e = unpack_duplex_inputs(
+        wire.nib, wire.qual, wire.meta, f, w, qual_mode=wire.qual_mode
+    )
     # all codes (0..4 incl. NBASE=4) fit the 3-bit field exactly
     np.testing.assert_array_equal(np.asarray(b), bases)
     np.testing.assert_array_equal(np.asarray(q), quals)
@@ -114,7 +116,7 @@ def test_wire_path_matches_unpacked_pipeline():
     wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
     out_wire = duplex_call_wire(
         wire.nib, wire.qual, wire.meta, wire.starts, wire.limits,
-        store.device_codes, f, w, PARAMS,
+        store.device_codes, f, w, PARAMS, wire.qual_mode,
     )
     got = unpack_duplex_wire_outputs(jax.device_get(out_wire), f=f, w=w)
 
@@ -125,3 +127,129 @@ def test_wire_path_matches_unpacked_pipeline():
     np.testing.assert_array_equal(got["a_depth"], np.asarray(want["a_depth"]))
     np.testing.assert_array_equal(got["la"], np.asarray(want["la"]))
     np.testing.assert_array_equal(got["rd"], np.asarray(want["rd"]))
+
+
+@pytest.mark.parametrize("n_levels,want_mode", [(3, "q2"), (9, "q4"), (30, "q8")])
+def test_qual_codebook_roundtrip(n_levels, want_mode):
+    rng = np.random.default_rng(31 + n_levels)
+    f, w = 5, 24
+    bases, _, cover, cmask, elig = random_batch(f, w, seed=8)
+    levels = np.sort(rng.choice(np.arange(2, 60), size=n_levels, replace=False))
+    quals = np.where(
+        cover, levels[rng.integers(0, n_levels, size=(f, 4, w))], 0
+    ).astype(np.uint8)
+    starts = np.arange(f, dtype=np.uint32)
+    limits = np.full(f, 900, dtype=np.uint32)
+    wire = pack_duplex_inputs(
+        bases, quals, cover, cmask, elig, starts, limits, qual_mode="auto"
+    )
+    assert wire.qual_mode == want_mode
+    from bsseqconsensusreads_tpu.ops.wire import wire_section_sizes
+
+    assert wire.to_words().size == sum(wire_section_sizes(f, w, qual_mode=want_mode))
+    b, q, c, m, e = unpack_duplex_inputs(
+        wire.nib, wire.qual, wire.meta, f, w, qual_mode=wire.qual_mode
+    )
+    # covered cells round-trip exactly; uncovered cells are never observed
+    np.testing.assert_array_equal(np.asarray(q)[cover], quals[cover])
+    np.testing.assert_array_equal(np.asarray(b), bases)
+    np.testing.assert_array_equal(np.asarray(c), cover)
+
+
+def test_out_of_range_quals_refuse_codebook_modes():
+    """Phred > 93 (e.g. 0xff 'unavailable' bytes) must not silently alias the
+    uncovered-cell sentinel: auto falls back to raw q8, explicit q2 raises."""
+    f, w = 3, 16
+    bases, _, cover, cmask, elig = random_batch(f, w, seed=12)
+    quals = np.where(cover, 255, 0).astype(np.uint8)
+    starts = np.arange(f, dtype=np.uint32)
+    limits = np.full(f, 500, dtype=np.uint32)
+    wire = pack_duplex_inputs(
+        bases, quals, cover, cmask, elig, starts, limits, qual_mode="auto"
+    )
+    assert wire.qual_mode == "q8"
+    _, q, *_ = unpack_duplex_inputs(
+        wire.nib, wire.qual, wire.meta, f, w, qual_mode=wire.qual_mode
+    )
+    np.testing.assert_array_equal(np.asarray(q)[cover], quals[cover])
+    with pytest.raises(ValueError, match="93"):
+        pack_duplex_inputs(
+            bases, quals, cover, cmask, elig, starts, limits, qual_mode="q2"
+        )
+
+
+def test_q2_wire_output_matches_q8_wire_output():
+    """Quantized-qual transport must not change results: uncovered cells'
+    qual placeholder (codebook[0] vs raw 0) must never leak into outputs."""
+    from bsseqconsensusreads_tpu.models.duplex import duplex_call_wire_fused
+
+    f, w = 8, 32
+    bases, _, cover, cmask, elig = random_batch(f, w, seed=9)
+    rta3 = np.array([2, 12, 23, 37], dtype=np.uint8)
+    rng = np.random.default_rng(10)
+    quals = np.where(cover, rta3[rng.integers(0, 4, size=(f, 4, w))], 0).astype(
+        np.uint8
+    )
+    genome_codes = rng.integers(0, 4, size=1200).astype(np.int8)
+    store = RefStore(["g"], codes=genome_codes, lengths=[1200])
+    starts, limits = store.window_offsets(
+        np.zeros(f, dtype=int), rng.integers(0, 1100, size=f)
+    )
+    outs = {}
+    for mode in ("q2", "q8"):
+        wire = pack_duplex_inputs(
+            bases, quals, cover, cmask, elig, starts, limits, qual_mode=mode
+        )
+        assert wire.qual_mode == mode
+        outs[mode] = np.asarray(
+            duplex_call_wire_fused(
+                wire.to_words(), store.device_codes, f, w, PARAMS, mode
+            )
+        )
+    np.testing.assert_array_equal(outs["q2"], outs["q8"])
+
+
+def test_fused_single_array_wire_matches_five_array_wire():
+    from bsseqconsensusreads_tpu.models.duplex import duplex_call_wire_fused
+    from bsseqconsensusreads_tpu.ops.wire import (
+        split_duplex_wire,
+        wire_section_sizes,
+    )
+
+    f, w = 7, 30
+    bases, quals, cover, cmask, elig = random_batch(f, w, seed=6)
+    rng = np.random.default_rng(7)
+    genome_codes = rng.integers(0, 4, size=1500).astype(np.int8)
+    store = RefStore(["g"], codes=genome_codes, lengths=[1500])
+    starts, limits = store.window_offsets(
+        np.zeros(f, dtype=int), rng.integers(0, 1400, size=f)
+    )
+    wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
+    words = wire.to_words()
+    assert words.dtype == np.uint32
+    assert words.size == sum(wire_section_sizes(f, w, qual_mode=wire.qual_mode))
+
+    # device-side split restores the five sections exactly
+    nib, qual, meta, st, li = (
+        np.asarray(x)
+        for x in split_duplex_wire(words, f, w, qual_mode=wire.qual_mode)
+    )
+    np.testing.assert_array_equal(nib, wire.nib)
+    np.testing.assert_array_equal(qual, wire.qual)
+    np.testing.assert_array_equal(meta, wire.meta)
+    np.testing.assert_array_equal(st, wire.starts)
+    np.testing.assert_array_equal(li, wire.limits)
+
+    # end-to-end: fused call == five-array call, bit for bit
+    want = np.asarray(
+        duplex_call_wire(
+            wire.nib, wire.qual, wire.meta, wire.starts, wire.limits,
+            store.device_codes, f, w, PARAMS, wire.qual_mode,
+        )
+    )
+    got = np.asarray(
+        duplex_call_wire_fused(
+            words, store.device_codes, f, w, PARAMS, wire.qual_mode
+        )
+    )
+    np.testing.assert_array_equal(got, want)
