@@ -35,6 +35,11 @@ class FrameworkConfig:
     #: coordinate-sorted input; 'adjacent' for MI-grouped input; 'gather'
     #: holds everything (any order). See pipeline.calling.stream_mi_groups.
     grouping: str = "coordinate"
+    #: intra-stage checkpoint interval in kernel batches (0 = rule-boundary
+    #: checkpoints only, the reference's granularity). When > 0, consensus
+    #: stages write durable shards every N batches and resume mid-stage
+    #: after a crash (pipeline.checkpoint; SURVEY.md §5.4).
+    checkpoint_every: int = 0
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

@@ -185,11 +185,12 @@ def duplex_call_wire(
     return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode"))
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r"))
 def duplex_call_wire_fused(
     words, genome, f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
+    r: int = 4,
 ):
     """duplex_call_wire with ONE u32 input array (DuplexWire.to_words()).
 
@@ -201,8 +202,12 @@ def duplex_call_wire_fused(
     """
     from bsseqconsensusreads_tpu.ops.wire import split_duplex_wire
 
+    if r != 4:
+        raise ValueError(
+            f"duplex windows have 4 rows (flags 99/163/83/147); got r={r}"
+        )
     nib, qual, meta, starts, limits = split_duplex_wire(
-        words, f, w, qual_mode=qual_mode
+        words, f, w, r=r, qual_mode=qual_mode
     )
     return duplex_call_wire(
         nib, qual, meta, starts, limits, genome, f, w, params, qual_mode

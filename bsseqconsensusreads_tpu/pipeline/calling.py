@@ -54,6 +54,7 @@ from bsseqconsensusreads_tpu.ops.encode import (
     encode_duplex_families,
     encode_molecular_families,
 )
+from bsseqconsensusreads_tpu.utils import observe
 
 _COMPLEMENT = dict(zip("ACGTN", "TGCAN"))
 
@@ -82,7 +83,12 @@ def _revcomp(seq: str) -> str:
 
 @dataclass
 class StageStats:
-    """Observability for one streaming stage (SURVEY.md §5.5)."""
+    """Observability for one streaming stage (SURVEY.md §5.5).
+
+    metrics holds per-phase wall-clock splits (encode / kernel+fetch /
+    emit) so a slow stage can be attributed to host tensorization, device
+    work, or record building without a profiler run.
+    """
 
     records_in: int = 0
     families: int = 0
@@ -94,6 +100,7 @@ class StageStats:
     pad_cells: int = 0
     used_cells: int = 0
     wall_seconds: float = 0.0
+    metrics: "observe.Metrics" = field(default_factory=lambda: observe.Metrics())
 
     @property
     def pad_waste(self) -> float:
@@ -116,6 +123,7 @@ class StageStats:
             "pad_waste": round(self.pad_waste, 4),
             "families_per_second": round(self.families_per_second, 1),
             "wall_seconds": round(self.wall_seconds, 3),
+            **self.metrics.as_dict(),
         }
 
 
@@ -304,7 +312,7 @@ def _emit_read(
     )
 
 
-def call_molecular(
+def call_molecular_batches(
     records: Iterable[BamRecord],
     params: ConsensusParams = ConsensusParams(min_reads=1),
     mode: str = "unaligned",
@@ -313,8 +321,13 @@ def call_molecular(
     grouping: str = "gather",
     stats: StageStats | None = None,
     vote_kernel: str | None = None,
-) -> Iterator[BamRecord]:
-    """Molecular (single-strand) consensus over MI families.
+    skip_batches: int = 0,
+) -> Iterator[list[BamRecord]]:
+    """Molecular (single-strand) consensus over MI families, one list of
+    consensus records per kernel batch — the checkpoint/resume granularity
+    (pipeline.checkpoint): batching is deterministic given identical input
+    and parameters, so skip_batches replays the stream past already-
+    checkpointed batches without re-running encode or the TPU kernel.
 
     min_reads filters whole families by raw read count (fgbio --min-reads=1
     drops nothing; larger values drop shallow families). grouping controls
@@ -325,20 +338,31 @@ def call_molecular(
     consensus_fn = _molecular_kernel(vote_kernel)
     t0 = time.monotonic()
     groups = stream_mi_groups(records, grouping=grouping, stats=stats)
+    batch_index = 0
     for chunk in _group_batches(groups, batch_families):
-        batch, skipped = encode_molecular_families(chunk, max_window=max_window)
+        batch_index += 1
+        if batch_index <= skip_batches:
+            continue
+        with stats.metrics.timed("encode"):
+            batch, skipped = encode_molecular_families(chunk, max_window=max_window)
         stats.skipped_families += len(skipped)
         if not batch.meta:
+            # one (possibly empty) yield per input chunk keeps the yielded
+            # batch count aligned with skip_batches across resumes
+            yield []
             continue
         stats.batches += 1
         used = int((batch.bases != NBASE).sum())
         stats.pad_cells += batch.bases.size - used
         stats.used_cells += used
-        out = consensus_fn(batch.bases, batch.quals, params)
-        base = np.asarray(out["base"])
-        qual = np.asarray(out["qual"])
-        depth = np.asarray(out["depth"])
-        errors = np.asarray(out["errors"])
+        with stats.metrics.timed("kernel"):
+            out = consensus_fn(batch.bases, batch.quals, params)
+            base = np.asarray(out["base"])
+            qual = np.asarray(out["qual"])
+            depth = np.asarray(out["depth"])
+            errors = np.asarray(out["errors"])
+        # emit time = wall_seconds - encode_seconds - kernel_seconds
+        emitted: list[BamRecord] = []
         for fi, meta in enumerate(batch.meta):
             stats.families += 1
             n_reads = int((batch.bases[fi] != NBASE).any(axis=-1).sum())
@@ -369,7 +393,7 @@ def call_molecular(
                         meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
                     )
                     tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
-                yield _emit_read(
+                emitted.append(_emit_read(
                     qname=meta.mi,
                     role=role,
                     seq_fwd=seq_fwd,
@@ -382,12 +406,31 @@ def call_molecular(
                     mate_pos=starts[other],
                     mate_reverse=meta.role_reverse[other],
                     tlen=tlen,
-                )
+                ))
                 stats.consensus_out += 1
+        yield emitted
     stats.wall_seconds += time.monotonic() - t0
 
 
-def call_duplex(
+def call_molecular(
+    records: Iterable[BamRecord],
+    params: ConsensusParams = ConsensusParams(min_reads=1),
+    mode: str = "unaligned",
+    batch_families: int = 512,
+    max_window: int = 4096,
+    grouping: str = "gather",
+    stats: StageStats | None = None,
+    vote_kernel: str | None = None,
+) -> Iterator[BamRecord]:
+    """Flat-record view of call_molecular_batches (same arguments)."""
+    for batch in call_molecular_batches(
+        records, params, mode, batch_families, max_window, grouping, stats,
+        vote_kernel,
+    ):
+        yield from batch
+
+
+def call_duplex_batches(
     records: Iterable[BamRecord],
     ref_fetch,
     ref_names: Sequence[str],
@@ -397,8 +440,11 @@ def call_duplex(
     max_window: int = 4096,
     grouping: str = "gather",
     stats: StageStats | None = None,
-) -> Iterator[BamRecord]:
-    """The fused duplex stage: convert + extend + duplex merge per MI group.
+    skip_batches: int = 0,
+) -> Iterator[list[BamRecord]]:
+    """The fused duplex stage: convert + extend + duplex merge per MI group,
+    one list of consensus records per kernel batch (the checkpoint/resume
+    unit — see call_molecular_batches for the skip_batches contract).
 
     Input: the aligned, tag-zipped, mapped-only molecular consensus BAM
     (reference checkpoint `…_aunamerged_aligned.bam`) — or, in self-aligned
@@ -413,38 +459,46 @@ def call_duplex(
     stats = stats if stats is not None else StageStats()
     t0 = time.monotonic()
     groups = stream_mi_groups(records, strip_suffix=True, grouping=grouping, stats=stats)
+    batch_index = 0
     for chunk in _group_batches(groups, batch_families):
-        batch, leftovers, skipped = encode_duplex_families(
-            chunk, ref_fetch, ref_names, max_window=max_window
-        )
+        batch_index += 1
+        if batch_index <= skip_batches:
+            continue
+        with stats.metrics.timed("encode"):
+            batch, leftovers, skipped = encode_duplex_families(
+                chunk, ref_fetch, ref_names, max_window=max_window
+            )
         stats.skipped_families += len(skipped)
         stats.leftover_records += len(leftovers)
         if not batch.meta:
+            yield []
             continue
         stats.batches += 1
         used = int(batch.cover.sum())
         stats.pad_cells += batch.cover.size - used
         stats.used_cells += used
-        packed, _la, _rd = duplex_call_pipeline_packed(
-            batch.bases,
-            batch.quals,
-            batch.cover,
-            batch.ref,
-            batch.convert_mask,
-            batch.extend_eligible,
-            params=params,
-        )
-        out = unpack_duplex_outputs(
-            jax.device_get(packed),
-            f=batch.bases.shape[0],
-            w=batch.bases.shape[-1],
-        )
+        with stats.metrics.timed("kernel"):
+            packed, _la, _rd = duplex_call_pipeline_packed(
+                batch.bases,
+                batch.quals,
+                batch.cover,
+                batch.ref,
+                batch.convert_mask,
+                batch.extend_eligible,
+                params=params,
+            )
+            out = unpack_duplex_outputs(
+                jax.device_get(packed),
+                f=batch.bases.shape[0],
+                w=batch.bases.shape[-1],
+            )
         base = out["base"]
         qual = out["qual"]
         depth = out["depth"]
         errors = out["errors"]
         a_depth = out["a_depth"]
         b_depth = out["b_depth"]
+        emitted: list[BamRecord] = []
         for fi, meta in enumerate(batch.meta):
             stats.families += 1
             if meta.n_templates < params.min_reads:
@@ -477,7 +531,7 @@ def call_duplex(
                     tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
                 # duplex R1 merges the forward-mapped pair (99,163): emit
                 # forward; duplex R2 merges the reverse pair (83,147).
-                yield _emit_read(
+                emitted.append(_emit_read(
                     qname=meta.mi,
                     role=role,
                     seq_fwd=seq_fwd,
@@ -490,6 +544,26 @@ def call_duplex(
                     mate_pos=starts[other],
                     mate_reverse=not bool(role),
                     tlen=tlen,
-                )
+                ))
                 stats.consensus_out += 1
+        yield emitted
     stats.wall_seconds += time.monotonic() - t0
+
+
+def call_duplex(
+    records: Iterable[BamRecord],
+    ref_fetch,
+    ref_names: Sequence[str],
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    mode: str = "unaligned",
+    batch_families: int = 512,
+    max_window: int = 4096,
+    grouping: str = "gather",
+    stats: StageStats | None = None,
+) -> Iterator[BamRecord]:
+    """Flat-record view of call_duplex_batches (same arguments)."""
+    for batch in call_duplex_batches(
+        records, ref_fetch, ref_names, params, mode, batch_families,
+        max_window, grouping, stats,
+    ):
+        yield from batch

@@ -1,0 +1,162 @@
+"""Intra-stage checkpoint/resume for the streaming consensus callers.
+
+The reference's checkpointing is the rule-boundary file DAG: a crashed run
+re-runs whole rules (Snakemake --rerun-incomplete, reference README.md:62;
+SURVEY.md §5.4). That is hours of lost work when a 100M-read consensus stage
+dies at 95%. This module adds the finer granularity the TPU design makes
+natural: the kernel batch.
+
+Protocol
+--------
+Consensus batches (call_molecular_batches / call_duplex_batches) are
+deterministic given identical input + parameters. BatchCheckpoint writes
+them into numbered BAM shard files next to the target
+(`<target>.part00000.bam`, …), registering each completed shard in a
+manifest (`<target>.ckpt.json`) via atomic rename. On resume, the caller
+asks for `skip_batches=ck.batches_done` — the stream replays group parsing
+(host I/O) but skips tensor encode and the TPU kernel for everything
+already durable. `finalize()` streams the shards into the target BAM and
+removes the scratch files; a crash mid-finalize resumes by re-finalizing.
+
+A partially-written shard (crash before its manifest rename) is simply
+overwritten on resume — the manifest is the single source of truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable, Iterator
+
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamRecord, BamWriter
+
+
+@dataclasses.dataclass
+class _Manifest:
+    batches_done: int = 0
+    shards: list[str] = dataclasses.field(default_factory=list)
+    records: int = 0
+    fingerprint: dict = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "_Manifest":
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as fh:
+            d = json.load(fh)
+        return cls(
+            d["batches_done"], d["shards"], d["records"], d.get("fingerprint", {})
+        )
+
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(dataclasses.asdict(self), fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+
+class BatchCheckpoint:
+    """Durable batch-granular writer for one consensus stage target.
+
+    every: batches per shard file — the checkpoint interval. Larger values
+    mean fewer files and fsyncs but more recomputation after a crash.
+
+    fingerprint: anything identifying the (input, batching parameters) the
+    shards were computed from — e.g. input path+size+mtime, batch_families,
+    params repr. A stale manifest whose fingerprint mismatches is discarded
+    (with its shards) instead of splicing old-input shards into a new run.
+    """
+
+    def __init__(self, target: str, header: BamHeader, every: int = 16,
+                 fingerprint: dict | None = None):
+        if every < 1:
+            raise ValueError(f"checkpoint interval must be >= 1, got {every}")
+        self.target = target
+        self.header = header
+        self.every = every
+        self.manifest_path = target + ".ckpt.json"
+        self.manifest = _Manifest.load(self.manifest_path)
+        fingerprint = fingerprint or {}
+        if self.manifest.shards and self.manifest.fingerprint != fingerprint:
+            self._discard_scratch()
+            self.manifest = _Manifest()
+        self.manifest.fingerprint = fingerprint
+
+    def _discard_scratch(self) -> None:
+        d = os.path.dirname(self.target)
+        for shard in self.manifest.shards:
+            try:
+                os.remove(os.path.join(d, shard))
+            except FileNotFoundError:
+                pass
+        try:
+            os.remove(self.manifest_path)
+        except FileNotFoundError:
+            pass
+
+    @property
+    def batches_done(self) -> int:
+        """Batches already durable — pass as skip_batches on resume."""
+        return self.manifest.batches_done
+
+    def _shard_path(self, index: int) -> str:
+        return f"{self.target}.part{index:05d}.bam"
+
+    def write_batches(self, batches: Iterable[list[BamRecord]]) -> None:
+        """Consume a batch stream (already offset by skip_batches), flushing
+        a shard + manifest update every `every` batches."""
+        buf: list[BamRecord] = []
+        pending = 0
+        for batch in batches:
+            buf.extend(batch)
+            pending += 1
+            if pending == self.every:
+                self._flush(buf, pending)
+                buf, pending = [], 0
+        if pending:
+            self._flush(buf, pending)
+
+    def _flush(self, records: list[BamRecord], n_batches: int) -> None:
+        path = self._shard_path(len(self.manifest.shards))
+        with BamWriter(path, self.header) as w:
+            w.write_all(records)
+        # the shard must hit disk BEFORE the manifest claims it durable
+        with open(path, "rb") as fh:
+            os.fsync(fh.fileno())
+        self.manifest.batches_done += n_batches
+        self.manifest.shards.append(os.path.basename(path))
+        self.manifest.records += len(records)
+        self.manifest.save(self.manifest_path)
+
+    def iter_records(self) -> Iterator[BamRecord]:
+        """Stream every durable record in batch order (for finalize or a
+        sorted rewrite)."""
+        d = os.path.dirname(self.target)
+        for shard in self.manifest.shards:
+            with BamReader(os.path.join(d, shard)) as r:
+                yield from r
+
+    def finalize(self, records: Iterable[BamRecord] | None = None) -> int:
+        """Concatenate shards into the target BAM and remove scratch files.
+
+        records: optionally a transformed stream (e.g. coordinate-sorted
+        iter_records()) to write instead of the raw shard order.
+        Returns the record count.
+
+        The target appears atomically (tmp + rename): a crash mid-finalize
+        leaves no partial target for the workflow's mtime check to mistake
+        for a completed rule — the manifest survives and the rerun
+        re-finalizes from the durable shards.
+        """
+        n = 0
+        tmp = self.target + ".finalize.tmp"
+        with BamWriter(tmp, self.header) as w:
+            for rec in (records if records is not None else self.iter_records()):
+                w.write(rec)
+                n += 1
+        os.replace(tmp, self.target)
+        self._discard_scratch()
+        return n

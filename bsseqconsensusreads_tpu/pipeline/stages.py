@@ -30,15 +30,17 @@ from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
 from bsseqconsensusreads_tpu.io.sam import read_sam
 from bsseqconsensusreads_tpu.pipeline.calling import (
     StageStats,
-    call_duplex,
-    call_molecular,
+    call_duplex_batches,
+    call_molecular_batches,
 )
+from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
 from bsseqconsensusreads_tpu.pipeline.record_ops import (
     coordinate_sort,
     filter_mapped,
     zipper_bams,
 )
 from bsseqconsensusreads_tpu.pipeline.workflow import Workflow, WorkflowError
+from bsseqconsensusreads_tpu.utils import observe
 
 
 def sample_name(bam_path: str) -> str:
@@ -67,10 +69,48 @@ class PipelineBuilder:
             h.text = "@HD\tVN:1.6\tSO:unsorted\n" + h.text
         return h
 
+    def _write_stage_output(self, batches, out_path: str, header, mode: str,
+                            ck: BatchCheckpoint | None) -> None:
+        """Write a consensus batch stream: straight through, or via durable
+        per-batch shards when intra-stage checkpointing is on (the batch
+        stream is already offset by ck.batches_done)."""
+        if ck is not None:
+            ck.write_batches(batches)
+            recs = ck.iter_records()
+            ck.finalize(coordinate_sort(recs) if mode == "self" else recs)
+            return
+        out = [rec for batch in batches for rec in batch]
+        if mode == "self":
+            out = coordinate_sort(out)
+        with BamWriter(out_path, header) as writer:
+            writer.write_all(out)
+
+    def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
+        """Arm intra-stage checkpointing for one stage target, fingerprinted
+        so shards from a different input/config are discarded, not resumed."""
+        if self.cfg.checkpoint_every <= 0:
+            return None
+        src = rule.inputs[0]
+        st = os.stat(src)
+        fingerprint = {
+            "input": os.path.abspath(src),
+            "size": st.st_size,
+            "mtime": st.st_mtime,
+            "batch_families": self.cfg.batch_families,
+            "max_window": self.cfg.max_window,
+            "grouping": self.cfg.grouping,
+            "params": repr(getattr(self.cfg, stage)),
+        }
+        return BatchCheckpoint(
+            rule.outputs[0], header, every=self.cfg.checkpoint_every,
+            fingerprint=fingerprint,
+        )
+
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())
-        with BamReader(rule.inputs[0]) as reader:
-            recs = call_molecular(
+        with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
+            ck = self._checkpointed("molecular", rule, reader.header)
+            batches = call_molecular_batches(
                 reader,
                 params=self.cfg.molecular,
                 mode=mode,
@@ -78,19 +118,17 @@ class PipelineBuilder:
                 max_window=self.cfg.max_window,
                 grouping=self.cfg.grouping,
                 stats=stats,
+                skip_batches=ck.batches_done if ck else 0,
             )
-            out = list(recs)
-            if mode == "self":
-                out = coordinate_sort(out)
-            with BamWriter(rule.outputs[0], reader.header) as writer:
-                writer.write_all(out)
+            self._write_stage_output(batches, rule.outputs[0], reader.header, mode, ck)
 
     def run_duplex(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("duplex", StageStats())
         fasta = FastaFile(self.cfg.genome_fasta)
-        with BamReader(rule.inputs[0]) as reader:
+        with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("duplex"):
             names = [n for n, _ in reader.header.references]
-            recs = call_duplex(
+            ck = self._checkpointed("duplex", rule, reader.header)
+            batches = call_duplex_batches(
                 reader,
                 fasta.fetch,
                 names,
@@ -100,12 +138,9 @@ class PipelineBuilder:
                 max_window=self.cfg.max_window,
                 grouping=self.cfg.grouping,
                 stats=stats,
+                skip_batches=ck.batches_done if ck else 0,
             )
-            out = list(recs)
-            if mode == "self":
-                out = coordinate_sort(out)
-            with BamWriter(rule.outputs[0], reader.header) as writer:
-                writer.write_all(out)
+            self._write_stage_output(batches, rule.outputs[0], reader.header, mode, ck)
 
     def run_sam_to_fastq(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:
@@ -202,8 +237,11 @@ class PipelineBuilder:
 def run_pipeline(
     cfg: FrameworkConfig, bam_path: str, outdir: str = "output", force: bool = False
 ):
-    """Build and run the pipeline; returns (target, rule results, stats)."""
+    """Build and run the pipeline; returns (target, rule results, stats).
+    Per-stage stats are emitted as JSON lines when BSSEQ_TPU_STATS is set
+    (utils.observe)."""
     builder = PipelineBuilder(cfg, bam_path, outdir)
     wf, target = builder.build()
     results = wf.run([target], force=force)
+    observe.emit_stage_stats(builder.stats, sample=builder.sample)
     return target, results, builder.stats

@@ -1,0 +1,107 @@
+"""Observability: structured per-stage stats, counters, and profiler traces.
+
+The reference's only observability is tqdm bars and one bwameth stderr log
+(reference: main.snake.py:88-89; SURVEY.md §5.1/§5.5). This framework emits
+structured JSON-line stats per pipeline stage (families/sec, pad waste,
+batches, leftovers — pipeline.calling.StageStats) plus arbitrary named
+counters, and can wrap any stage in a JAX profiler trace for kernel-level
+timing.
+
+Activation is environment-driven so the CLI and library paths share it:
+
+  BSSEQ_TPU_STATS=-            emit stats JSON lines to stderr
+  BSSEQ_TPU_STATS=/path.jsonl  append them to a file
+  BSSEQ_TPU_TRACE=/path/dir    wrap stages in jax.profiler.trace(dir)
+                               (view with tensorboard / xprof)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+def stats_sink() -> str | None:
+    """Where stats lines go: '-' (stderr), a path, or None (disabled)."""
+    return os.environ.get("BSSEQ_TPU_STATS") or None
+
+
+def trace_dir() -> str | None:
+    return os.environ.get("BSSEQ_TPU_TRACE") or None
+
+
+def emit(event: str, payload: dict, sink: str | None = None) -> None:
+    """Write one JSON line {ts, event, **payload} to the configured sink."""
+    sink = sink if sink is not None else stats_sink()
+    if sink is None:
+        return
+    line = json.dumps({"ts": round(time.time(), 3), "event": event, **payload})
+    if sink == "-":
+        print(line, file=sys.stderr)
+    else:
+        with open(sink, "a") as fh:
+            fh.write(line + "\n")
+
+
+@dataclass
+class Metrics:
+    """Named counters + wall-clock timers for one run.
+
+    Counters accumulate (records moved, bytes packed); timers accumulate
+    seconds per named phase via the `timed` context manager. as_dict()
+    flattens to one JSON-able payload; rates are derived, not stored.
+    """
+
+    counters: dict = field(default_factory=dict)
+    seconds: dict = field(default_factory=dict)
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (
+                self.seconds.get(name, 0.0) + time.monotonic() - t0
+            )
+
+    def rate(self, counter: str, timer: str) -> float:
+        dt = self.seconds.get(timer, 0.0)
+        return self.counters.get(counter, 0) / dt if dt else 0.0
+
+    def as_dict(self) -> dict:
+        out = {k: v for k, v in self.counters.items()}
+        out.update({f"{k}_seconds": round(v, 3) for k, v in self.seconds.items()})
+        return out
+
+
+@contextlib.contextmanager
+def maybe_trace(label: str, directory: str | None = None):
+    """jax.profiler.trace when BSSEQ_TPU_TRACE (or `directory`) is set, else a
+    no-op — stages call this unconditionally."""
+    directory = directory if directory is not None else trace_dir()
+    if not directory:
+        yield
+        return
+    import jax
+
+    path = os.path.join(directory, label)
+    os.makedirs(path, exist_ok=True)
+    with jax.profiler.trace(path):
+        yield
+
+
+def emit_stage_stats(stage_stats: dict, sample: str | None = None) -> None:
+    """Emit one 'stage_stats' line per pipeline stage (StageStats.as_dict)."""
+    for stage, stats in stage_stats.items():
+        payload = {"stage": stage, **stats.as_dict()}
+        if sample:
+            payload["sample"] = sample
+        emit("stage_stats", payload)

@@ -1,0 +1,196 @@
+"""Intra-stage checkpoint/resume (pipeline.checkpoint) + observability
+(utils.observe).
+
+The crash-resume contract: killing a consensus stage between batches loses
+at most `every` batches of work; the resumed run skips the durable prefix
+(no re-encode, no kernel) and the final BAM is identical to an uninterrupted
+run's.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_molecular,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
+from bsseqconsensusreads_tpu.utils import observe
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+@pytest.fixture(scope="module")
+def grouped():
+    rng = np.random.default_rng(77)
+    gname, genome = random_genome(rng, 3000)
+    header, records = make_grouped_bam_records(rng, gname, genome, n_families=40)
+    return header, records
+
+
+def _canon(path):
+    with BamReader(path) as r:
+        return [(x.qname, x.flag, x.seq, x.qual) for x in r]
+
+
+BATCH_FAMILIES = 8  # 40 families x 2 strand-groups -> 10 batches
+
+
+def test_crash_and_resume_reproduces_uninterrupted_output(grouped, tmp_path):
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+
+    want = list(call_molecular(iter(records), batch_families=BATCH_FAMILIES))
+    want = [(x.qname, x.flag, x.seq, x.qual) for x in want]
+
+    target = str(tmp_path / "consensus.bam")
+    ck = BatchCheckpoint(target, uh, every=2)
+
+    # "crash" after 5 of 10 batches: a wrapper that dies mid-stream
+    def dying(batches, after):
+        for i, b in enumerate(batches):
+            if i == after:
+                raise KeyboardInterrupt
+            yield b
+
+    with pytest.raises(KeyboardInterrupt):
+        ck.write_batches(
+            dying(call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES), 5)
+        )
+    assert ck.batches_done == 4  # two full shards of 2; 5th batch not durable
+    manifest = json.loads((tmp_path / "consensus.bam.ckpt.json").read_text())
+    assert manifest["batches_done"] == 4
+    assert len(manifest["shards"]) == 2
+
+    # resume in a fresh checkpoint object (fresh process simulation)
+    ck2 = BatchCheckpoint(target, uh, every=2)
+    assert ck2.batches_done == 4
+    stats = StageStats()
+    ck2.write_batches(
+        call_molecular_batches(
+            iter(records), batch_families=BATCH_FAMILIES,
+            skip_batches=ck2.batches_done, stats=stats,
+        )
+    )
+    n = ck2.finalize()
+    assert n == len(want)
+    # the resumed run ran only the non-durable suffix through the kernel
+    assert stats.batches <= 10 - 4
+    assert _canon(target) == want
+    # scratch files gone
+    assert not list(tmp_path.glob("*.part*")) and not list(tmp_path.glob("*.ckpt*"))
+
+
+def test_checkpoint_noop_run_matches_plain(grouped, tmp_path):
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "plain.bam")
+    ck = BatchCheckpoint(target, uh, every=3)
+    ck.write_batches(call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES))
+    ck.finalize()
+    want = [
+        (x.qname, x.flag, x.seq, x.qual)
+        for x in call_molecular(iter(records), batch_families=BATCH_FAMILIES)
+    ]
+    assert _canon(target) == want
+
+
+def test_skip_batches_alignment_with_empty_batches():
+    """Batches that tensorize to nothing still count for skip alignment."""
+    from bsseqconsensusreads_tpu.io.bam import BamRecord
+
+    # records with MI tags but unusable flags -> encoder yields empty batches
+    rng = np.random.default_rng(5)
+    gname, genome = random_genome(rng, 800)
+    header, records = make_grouped_bam_records(rng, gname, genome, n_families=6)
+    full = list(call_molecular_batches(iter(records), batch_families=2))
+    skipped = list(
+        call_molecular_batches(iter(records), batch_families=2, skip_batches=2)
+    )
+    assert [
+        [(r.qname, r.flag) for r in b] for b in skipped
+    ] == [[(r.qname, r.flag) for r in b] for b in full[2:]]
+
+
+def test_stale_fingerprint_discards_shards(grouped, tmp_path):
+    """A manifest from a different input/config must not be resumed."""
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "fp.bam")
+    ck = BatchCheckpoint(target, uh, every=2, fingerprint={"input": "A"})
+    batches = call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES)
+    ck.write_batches(batch for i, batch in enumerate(batches) if i < 4)
+    assert ck.batches_done == 4
+
+    # same fingerprint resumes
+    assert BatchCheckpoint(target, uh, every=2, fingerprint={"input": "A"}).batches_done == 4
+    # changed fingerprint discards shards + manifest and starts over
+    ck3 = BatchCheckpoint(target, uh, every=2, fingerprint={"input": "B"})
+    assert ck3.batches_done == 0
+    assert not list(tmp_path.glob("fp.bam.part*"))
+
+
+def test_finalize_is_atomic(grouped, tmp_path):
+    """finalize writes tmp + rename: no partial target file exists at any
+    point, so a crash mid-finalize cannot fake rule completion."""
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "atomic.bam")
+    ck = BatchCheckpoint(target, uh, every=4)
+    ck.write_batches(call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES))
+
+    import os
+    real_replace = os.replace
+    seen = {}
+    def spying_replace(src, dst):
+        if dst == target:
+            seen["target_exists_before_rename"] = os.path.exists(target)
+        return real_replace(src, dst)
+    os.replace = spying_replace
+    try:
+        ck.finalize()
+    finally:
+        os.replace = real_replace
+    assert seen["target_exists_before_rename"] is False
+    assert os.path.exists(target)
+
+
+def test_observe_emit_and_metrics(tmp_path, monkeypatch):
+    sink = str(tmp_path / "stats.jsonl")
+    monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+    m = observe.Metrics()
+    m.count("reads", 100)
+    with m.timed("pack"):
+        pass
+    observe.emit("stage_stats", {"stage": "molecular", **m.as_dict()})
+    line = json.loads(open(sink).read().strip())
+    assert line["event"] == "stage_stats"
+    assert line["reads"] == 100
+    assert "pack_seconds" in line
+    assert m.rate("reads", "pack") >= 0
+
+
+def test_observe_disabled_is_silent(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("BSSEQ_TPU_STATS", raising=False)
+    observe.emit("x", {"y": 1})
+    assert capsys.readouterr().err == ""
+
+
+def test_maybe_trace_noop_and_active(tmp_path, monkeypatch):
+    monkeypatch.delenv("BSSEQ_TPU_TRACE", raising=False)
+    with observe.maybe_trace("stage"):
+        pass
+    tdir = str(tmp_path / "traces")
+    with observe.maybe_trace("stage", directory=tdir):
+        import jax.numpy as jnp
+
+        (jnp.ones(8) * 2).block_until_ready()
+    import os
+
+    assert os.path.isdir(os.path.join(tdir, "stage"))

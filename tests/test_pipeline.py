@@ -331,6 +331,36 @@ class TestStreaming:
         assert ndiff <= 0.02 * total, f"{ndiff}/{total} bases differ"
 
 
+class TestCheckpointedPipeline:
+    def test_checkpointed_run_matches_plain_run(self, pipeline_env):
+        from bsseqconsensusreads_tpu.config import FrameworkConfig
+        from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+        env = pipeline_env
+        base = dict(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+            batch_families=4,
+            grouping="gather",
+        )
+        outs = {}
+        for tag, every in (("plain", 0), ("ckpt", 2)):
+            outdir = str(env["tmp"] / f"out_{tag}")
+            cfg = FrameworkConfig(**base, checkpoint_every=every)
+            target, _, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+            with BamReader(target) as r:
+                outs[tag] = [(x.qname, x.flag, x.pos, x.seq, x.qual) for x in r]
+            assert stats["molecular"].batches > 1
+            # no scratch left behind
+            leftovers = [
+                p for p in os.listdir(outdir)
+                if ".part" in p or ".ckpt" in p
+            ]
+            assert leftovers == []
+        assert outs["ckpt"] == outs["plain"]
+
+
 class TestMinReadsFilters:
     def test_duplex_min_reads_filters_families(self, pipeline_env):
         env = pipeline_env
