@@ -40,6 +40,11 @@ class FrameworkConfig:
     #: stages write durable shards every N batches and resume mid-stage
     #: after a crash (pipeline.checkpoint; SURVEY.md §5.4).
     checkpoint_every: int = 0
+    #: indel-read handling in the molecular stage: 'drop' = parity (the
+    #: reference drops any read with I/D CIGAR ops,
+    #: tools/1.convert_AG_to_CT.py:79-80); 'align' = recover them with the
+    #: banded intra-family aligner (ops.banded, above-parity).
+    indel_policy: str = "drop"
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

@@ -1,0 +1,191 @@
+"""Banded intra-family alignment for indel-bearing reads (above-parity).
+
+The reference simply DROPS any read whose CIGAR contains an insertion,
+deletion, or hardclip (tools/1.convert_AG_to_CT.py:79-80,
+tools/2.extend_gap.py:160-161) — those reads never contribute to consensus.
+This op recovers them: a banded Needleman-Wunsch in window space aligns the
+read against its family's anchor sequence (the per-column majority of the
+directly-placed reads), so PCR-stutter/homopolymer indel reads add depth
+instead of vanishing. Parity mode keeps the reference's drop behavior
+(ops.encode indel_policy='drop', the default).
+
+Design (TPU-first):
+ * The DP is a jit/vmap'd lax.scan over read positions. Band coordinates
+   d = col - (offset + i - 1) ∈ [-B, B]: a row's three moves become two
+   vectorized shifts plus a cummax closure over the deletion chain —
+   no data-dependent control flow, fixed [L, 2B+1] shapes.
+ * Traceback is host-side numpy, vectorized over the read batch: indel
+   reads are a small minority of real libraries, and the path walk is
+   O(L + 2B) fancy-indexed steps regardless of batch size.
+ * Scoring is bisulfite-aware: read T over anchor C and read A over anchor
+   G are the expected conversion signals on the two strands, scored as
+   neutral rather than mismatch.
+
+Output is window-space (bases, quals, cover) rows ready to drop into the
+family tensor: matched chars land on their column, inserted chars vanish
+(no column), deleted columns stay uncovered — exactly the "no observation"
+semantics the consensus vote (models.molecular) already has.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bsseqconsensusreads_tpu.alphabet import A, C, G, NBASE, T
+
+NEG = -1e9  # effectively -inf for f32 score cells
+
+
+@functools.partial(
+    jax.jit, static_argnames=("band", "match", "mismatch", "gap", "bs_neutral")
+)
+def banded_scores(reads, ref, offsets, band: int = 8,
+                  match: float = 4.0, mismatch: float = -6.0,
+                  gap: float = -8.0, bs_neutral: float = 1.0):
+    """Banded NW score matrices.
+
+    reads: int8 [N, L] (NBASE-padded), ref: int8 [N, W] window anchor codes
+    (NBASE = uncovered column), offsets: int32 [N] expected window column of
+    each read's first char. Returns M float32 [N, L+1, 2B+1]:
+    M[n, i, d] = best score of consuming i read chars with char i at window
+    column offsets[n] + i - 1 + (d - B). Padded chars (NBASE) keep rows
+    constant so one scan serves mixed lengths.
+    """
+    n, l = reads.shape
+    w = ref.shape[-1]
+    width = 2 * band + 1
+    ds = jnp.arange(width) - band  # [width]
+
+    def sub_score(x, r):
+        """Score of read char x over anchor char r (both int8)."""
+        is_n = (x == NBASE) | (r == NBASE)
+        bs = ((x == T) & (r == C)) | ((x == A) & (r == G))
+        return jnp.where(
+            is_n, 0.0,
+            jnp.where(x == r, match, jnp.where(bs, bs_neutral, mismatch)),
+        )
+
+    def row(prev, xi_i):
+        xi, i = xi_i  # [N] char codes, scalar position (1-based)
+        cols = offsets[:, None] + (i - 1) + ds[None, :]  # [N, width]
+        in_win = (cols >= 0) & (cols < w)
+        ref_d = jnp.take_along_axis(
+            ref, jnp.clip(cols, 0, w - 1), axis=-1
+        )  # [N, width]
+        diag = prev + jnp.where(in_win, sub_score(xi[:, None], ref_d), NEG)
+        up = (
+            jnp.concatenate([prev[:, 1:], jnp.full((n, 1), NEG)], axis=-1) + gap
+        )
+        pre = jnp.maximum(diag, up)
+        # deletion-chain closure: M[d] = max_{k<=d} pre[k] + gap*(d-k)
+        shifted = jax.lax.cummax(pre - gap * ds[None, :], axis=1)
+        closed = shifted + gap * ds[None, :]
+        # padded chars: carry the previous row through unchanged
+        out = jnp.where((xi == NBASE)[:, None], prev, closed)
+        return out, out
+
+    init = gap * jnp.abs(ds)[None, :].repeat(n, axis=0)  # net start shift
+    _, rows = jax.lax.scan(
+        row, init, (reads.T.astype(jnp.int8), jnp.arange(1, l + 1))
+    )
+    return jnp.concatenate([init[None], rows], axis=0).transpose(1, 0, 2)
+
+
+def _sub_np(x, r, match, mismatch, bs_neutral):
+    is_n = (x == NBASE) | (r == NBASE)
+    bs = ((x == T) & (r == C)) | ((x == A) & (r == G))
+    return np.where(
+        is_n, 0.0, np.where(x == r, match, np.where(bs, bs_neutral, mismatch))
+    )
+
+
+def banded_align(reads, quals, ref, offsets, band: int = 8,
+                 match: float = 4.0, mismatch: float = -6.0,
+                 gap: float = -8.0, bs_neutral: float = 1.0,
+                 min_score_per_base: float = 0.0):
+    """Align indel reads into window space.
+
+    reads int8 [N, L] (NBASE-padded), quals uint8 [N, L], ref int8 [N, W]
+    anchors, offsets int32 [N]. Returns (bases int8 [N, W], quals uint8
+    [N, W], ok bool [N]): window rows with aligned chars on their columns
+    (NBASE elsewhere), and ok=False for reads whose best banded score is
+    below min_score_per_base * length (unalignable within the band — caller
+    keeps the drop behavior for those).
+
+    The DP runs on device (banded_scores); the traceback walks the score
+    matrix host-side, vectorized over the batch.
+    """
+    reads = np.asarray(reads, dtype=np.int8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    ref = np.asarray(ref, dtype=np.int8)
+    offsets = np.asarray(offsets, dtype=np.int32)
+    n, l = reads.shape
+    w = ref.shape[-1]
+    width = 2 * band + 1
+    m = np.asarray(
+        banded_scores(reads, ref, offsets, band, match, mismatch, gap, bs_neutral)
+    )  # [N, L+1, width]
+
+    lens = (reads != NBASE).sum(axis=-1)
+    out_b = np.full((n, w), NBASE, dtype=np.int8)
+    out_q = np.zeros((n, w), dtype=np.uint8)
+    # NBASE chars (pad AND mid-read Ns) carry the scan row through unchanged,
+    # so the last row is every read's final row: start traceback at i=l.
+    best_d = np.argmax(m[:, l], axis=-1)
+    best = m[np.arange(n), l, best_d]
+    ok = best >= min_score_per_base * np.maximum(lens, 1)
+
+    i = np.full(n, l)  # current read position (1-based char index)
+    d = best_d.astype(np.int64)
+    active = ok.copy()
+    rows = np.arange(n)
+    ds = np.arange(width) - band
+    eps = 1e-4
+    for _ in range(2 * l + 2 * width + 4):
+        if not active.any():
+            break
+        cur = m[rows, i, d]
+        cols = offsets + (i - 1) + ds[d]
+        xi = np.take_along_axis(reads, np.maximum(i - 1, 0)[:, None], 1)[:, 0]
+        # NBASE char rows were carried through: step i without moving d
+        is_pad = (i > 0) & (xi == NBASE)
+        in_win = (cols >= 0) & (cols < w)
+        ref_d = ref[rows, np.clip(cols, 0, w - 1)]
+        diag = np.where(
+            (i > 0) & in_win,
+            m[rows, np.maximum(i - 1, 0), d]
+            + _sub_np(xi, ref_d, match, mismatch, bs_neutral),
+            NEG,
+        )
+        up = np.where(
+            (i > 0) & (d + 1 < width), m[rows, np.maximum(i - 1, 0), np.minimum(d + 1, width - 1)] + gap, NEG
+        )
+        left = np.where(d > 0, m[rows, i, np.maximum(d - 1, 0)] + gap, NEG)
+
+        take_pad = active & is_pad
+        take_diag = active & ~is_pad & (np.abs(diag - cur) <= eps)
+        take_up = active & ~is_pad & ~take_diag & (np.abs(up - cur) <= eps)
+        take_left = active & ~is_pad & ~take_diag & ~take_up
+
+        # diag: char i-1 (0-based) sits at column cols
+        place = take_diag & in_win
+        out_b[rows[place], cols[place]] = np.take_along_axis(
+            reads, (i - 1)[:, None], 1
+        )[:, 0][place]
+        out_q[rows[place], cols[place]] = np.take_along_axis(
+            quals, (i - 1)[:, None], 1
+        )[:, 0][place]
+
+        i = np.where(take_pad | take_diag | take_up, np.maximum(i - 1, 0), i)
+        d = np.where(take_up, np.minimum(d + 1, width - 1), d)
+        d = np.where(take_left, np.maximum(d - 1, 0), d)
+        active = active & (i > 0)
+
+    cover = out_b != NBASE
+    out_b[~cover] = NBASE
+    return out_b, out_q, ok

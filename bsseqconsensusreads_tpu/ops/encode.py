@@ -30,7 +30,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     FREVERSE,
 )
 
-from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE
+from bsseqconsensusreads_tpu.alphabet import BASE_CHAR, BASE_CODE, NBASE, NUM_BASES
 from bsseqconsensusreads_tpu.utils.flags import CONVERT_FLAGS, GROUP_ORDER
 
 # Padding granularities. Template counts bucket to powers of two. Window
@@ -57,8 +57,22 @@ def trim_softclips(rec: BamRecord) -> tuple[np.ndarray, np.ndarray, int] | None:
     read must be dropped (indel or hardclip CIGAR ops — the reference drops
     these too: tools/1.convert_AG_to_CT.py:79-80, tools/2.extend_gap.py:160).
     """
-    if any(op in (CINS, CDEL, CHARD_CLIP) for op, _ in rec.cigar):
+    trimmed = trim_softclips_keep_indels(rec)
+    if trimmed is None or trimmed[3]:
         return None
+    return trimmed[:3]
+
+
+def trim_softclips_keep_indels(
+    rec: BamRecord,
+) -> tuple[np.ndarray, np.ndarray, int, bool] | None:
+    """Like trim_softclips but indel reads survive: returns (codes, quals,
+    pos, has_indel). Hardclipped reads still return None (their bases are
+    physically absent from the record). Used by indel_policy='align'
+    (ops.banded — above-parity recovery of reads the reference drops)."""
+    if any(op == CHARD_CLIP for op, _ in rec.cigar):
+        return None
+    has_indel = any(op in (CINS, CDEL) for op, _ in rec.cigar)
     codes = seq_to_codes(rec.seq)
     quals = (
         np.frombuffer(rec.qual, dtype=np.uint8)
@@ -70,7 +84,7 @@ def trim_softclips(rec: BamRecord) -> tuple[np.ndarray, np.ndarray, int] | None:
         start = rec.cigar[0][1]
     if rec.cigar and rec.cigar[-1][0] == CSOFT_CLIP:
         end -= rec.cigar[-1][1]
-    return codes[start:end], quals[start:end], rec.pos
+    return codes[start:end], quals[start:end], rec.pos, has_indel
 
 
 @dataclasses.dataclass
@@ -98,6 +112,10 @@ class MolecularBatch:
     bases: np.ndarray  # int8 [F, T, 2, W]
     quals: np.ndarray  # uint8 [F, T, 2, W]
     meta: list[FamilyMeta]
+    #: indel_policy='align' accounting: reads recovered by the banded
+    #: aligner / reads it refused (unalignable within the band or no anchor)
+    indel_aligned: int = 0
+    indel_dropped: int = 0
 
     @property
     def shape(self) -> tuple[int, int, int]:
@@ -126,20 +144,35 @@ def bucket_window(w: int) -> int:
 MAX_TEMPLATES = 4096
 
 
+#: band half-width for indel_policy='align'; also the extra window margin
+#: reserved for deletions pushing an indel read's reference span past its
+#: query length.
+INDEL_BAND = 8
+
+
 def encode_molecular_families(
     families: Sequence[tuple[str, Sequence[BamRecord]]],
     max_window: int = 4096,
     max_templates: int = MAX_TEMPLATES,
+    indel_policy: str = "drop",
 ) -> tuple[MolecularBatch, list[str]]:
     """Encode MI families (already grouped, e.g. by io streaming) into one
     padded batch. Families whose window exceeds max_window or whose template
     count exceeds max_templates are skipped and reported (never silently
     dropped — SURVEY.md §7.3 'no silent caps').
 
+    indel_policy: 'drop' (parity — the reference drops indel reads,
+    tools/1.convert_AG_to_CT.py:79-80) or 'align' (above-parity: recover
+    them via the banded intra-family aligner, ops.banded, against the
+    per-column majority of the directly-placed reads).
+
     Returns (batch, skipped_mi_list).
     """
+    if indel_policy not in ("drop", "align"):
+        raise ValueError(f"indel_policy must be 'drop'|'align', got {indel_policy!r}")
     placed = []
     skipped: list[str] = []
+    indel_dropped = 0
     max_t = 1
     max_w = LANE
     for mi, records in families:
@@ -148,19 +181,23 @@ def encode_molecular_families(
         rx_counts: dict[str, int] = defaultdict(int)
         lo, hi = None, None
         for rec in records:
-            trimmed = trim_softclips(rec)
+            trimmed = trim_softclips_keep_indels(rec)
             if trimmed is None:
                 continue
-            codes, quals, pos = trimmed
+            codes, quals, pos, has_indel = trimmed
+            if has_indel and indel_policy == "drop":
+                continue
             if len(codes) == 0:
                 continue
             ref_id = rec.ref_id
             role = 1 if rec.flag & FREAD2 else 0
-            templates[rec.qname][role] = (codes, quals, pos, bool(rec.flag & FREVERSE))
+            templates[rec.qname][role] = (
+                codes, quals, pos, bool(rec.flag & FREVERSE), has_indel
+            )
             if rec.has_tag("RX"):
                 rx_counts[rec.get_tag("RX")] += 1
             lo = pos if lo is None else min(lo, pos)
-            e = pos + len(codes)
+            e = pos + len(codes) + (INDEL_BAND if has_indel else 0)
             hi = e if hi is None else max(hi, e)
         if lo is None:
             skipped.append(mi)
@@ -174,7 +211,7 @@ def encode_molecular_families(
         # (template, role) slot; duplicates overwrite, so vote the survivor)
         rev_votes = [[0, 0], [0, 0]]
         for roles in templates.values():
-            for role, (_, _, _, rev) in roles.items():
+            for role, (_, _, _, rev, _hi) in roles.items():
                 rev_votes[role][1 if rev else 0] += 1
         role_rev = (rev_votes[0][1] > rev_votes[0][0], rev_votes[1][1] > rev_votes[1][0])
         placed.append((mi, ref_id, lo, window, rx, templates, role_rev))
@@ -187,14 +224,61 @@ def encode_molecular_families(
     bases = np.full((f, t_pad, 2, w_pad), NBASE, dtype=np.int8)
     quals = np.zeros((f, t_pad, 2, w_pad), dtype=np.uint8)
     meta: list[FamilyMeta] = []
+    pending: list[tuple[int, int, int, np.ndarray, np.ndarray, int]] = []
     for fi, (mi, ref_id, lo, window, rx, templates, role_rev) in enumerate(placed):
         for ti, (qname, roles) in enumerate(templates.items()):
-            for role, (codes, q, pos, _rev) in roles.items():
+            for role, (codes, q, pos, _rev, has_indel) in roles.items():
                 off = pos - lo
+                if has_indel:
+                    pending.append((fi, ti, role, codes, q, off))
+                    continue
                 bases[fi, ti, role, off : off + len(codes)] = codes
                 quals[fi, ti, role, off : off + len(codes)] = q
         meta.append(FamilyMeta(mi, ref_id, lo, len(templates), rx, role_reverse=role_rev))
-    return MolecularBatch(bases, quals, meta), skipped
+    indel_aligned = 0
+    if pending:
+        indel_aligned, n_refused = _align_pending(bases, quals, pending)
+        indel_dropped += n_refused
+    return (
+        MolecularBatch(bases, quals, meta, indel_aligned, indel_dropped),
+        skipped,
+    )
+
+
+def _align_pending(bases, quals, pending) -> tuple[int, int]:
+    """Banded-align indel reads against their family/role anchors and write
+    the window-space rows into the batch arrays. Returns (aligned, refused)."""
+    from bsseqconsensusreads_tpu.ops.banded import banded_align
+
+    w = bases.shape[-1]
+    n = len(pending)
+    lmax = max(len(p[3]) for p in pending)
+    r_codes = np.full((n, lmax), NBASE, dtype=np.int8)
+    r_quals = np.zeros((n, lmax), dtype=np.uint8)
+    anchors = np.empty((n, w), dtype=np.int8)
+    offsets = np.zeros(n, dtype=np.int32)
+    for i, (fi, ti, role, codes, q, off) in enumerate(pending):
+        r_codes[i, : len(codes)] = codes
+        r_quals[i, : len(codes)] = q
+        offsets[i] = off
+        # anchor: per-column majority of the directly-placed reads of this
+        # (family, role); NBASE where nothing is placed
+        fam = bases[fi, :, role, :]  # [T, W]
+        counts = (fam[:, :, None] == np.arange(NUM_BASES)[None, None, :]).sum(0)
+        depth = counts.sum(-1)
+        anchors[i] = np.where(depth > 0, counts.argmax(-1), NBASE).astype(np.int8)
+    out_b, out_q, ok = banded_align(
+        r_codes, r_quals, anchors, offsets, band=INDEL_BAND,
+        min_score_per_base=1.0,
+    )
+    for i, (fi, ti, role, codes, q, off) in enumerate(pending):
+        if not ok[i]:
+            continue
+        cov = out_b[i] != NBASE
+        bases[fi, ti, role, cov] = out_b[i][cov]
+        quals[fi, ti, role, cov] = out_q[i][cov]
+    aligned = int(ok.sum())
+    return aligned, n - aligned
 
 
 #: Flags the duplex stage accepts, and their row in the family tensor —

@@ -100,6 +100,8 @@ class StageStats:
     pad_cells: int = 0
     used_cells: int = 0
     wall_seconds: float = 0.0
+    indel_aligned: int = 0
+    indel_dropped: int = 0
     metrics: "observe.Metrics" = field(default_factory=lambda: observe.Metrics())
 
     @property
@@ -123,6 +125,8 @@ class StageStats:
             "pad_waste": round(self.pad_waste, 4),
             "families_per_second": round(self.families_per_second, 1),
             "wall_seconds": round(self.wall_seconds, 3),
+            "indel_aligned": self.indel_aligned,
+            "indel_dropped": self.indel_dropped,
             **self.metrics.as_dict(),
         }
 
@@ -322,6 +326,7 @@ def call_molecular_batches(
     stats: StageStats | None = None,
     vote_kernel: str | None = None,
     skip_batches: int = 0,
+    indel_policy: str = "drop",
 ) -> Iterator[list[BamRecord]]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -344,8 +349,12 @@ def call_molecular_batches(
         if batch_index <= skip_batches:
             continue
         with stats.metrics.timed("encode"):
-            batch, skipped = encode_molecular_families(chunk, max_window=max_window)
+            batch, skipped = encode_molecular_families(
+                chunk, max_window=max_window, indel_policy=indel_policy
+            )
         stats.skipped_families += len(skipped)
+        stats.indel_aligned += batch.indel_aligned
+        stats.indel_dropped += batch.indel_dropped
         if not batch.meta:
             # one (possibly empty) yield per input chunk keeps the yielded
             # batch count aligned with skip_batches across resumes

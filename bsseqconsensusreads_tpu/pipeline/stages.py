@@ -99,6 +99,7 @@ class PipelineBuilder:
             "batch_families": self.cfg.batch_families,
             "max_window": self.cfg.max_window,
             "grouping": self.cfg.grouping,
+            "indel_policy": self.cfg.indel_policy,
             "params": repr(getattr(self.cfg, stage)),
         }
         return BatchCheckpoint(
@@ -119,6 +120,7 @@ class PipelineBuilder:
                 grouping=self.cfg.grouping,
                 stats=stats,
                 skip_batches=ck.batches_done if ck else 0,
+                indel_policy=self.cfg.indel_policy,
             )
             self._write_stage_output(batches, rule.outputs[0], reader.header, mode, ck)
 

@@ -1,0 +1,201 @@
+"""Banded intra-family aligner (ops.banded) vs a brute-force scalar oracle.
+
+The reference drops indel reads outright (tools/1.convert_AG_to_CT.py:79-80);
+this op is above-parity, so its contract is defined here: same recurrence as
+the scalar DP, correct window projection for match/insert/delete paths, and
+a refuse-to-align gate for garbage.
+"""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import BASE_CODE, NBASE
+from bsseqconsensusreads_tpu.ops.banded import banded_align, banded_scores
+
+MATCH, MISMATCH, GAP, BS = 4.0, -6.0, -8.0, 1.0
+
+
+def codes(s):
+    return BASE_CODE[np.frombuffer(s.encode(), dtype=np.uint8)].astype(np.int8)
+
+
+def oracle_best_score(read, ref, off, band):
+    """Scalar banded NW: same recurrence, python loops."""
+    width = 2 * band + 1
+    NEGI = -1e9
+
+    def sub(x, r):
+        if x == NBASE or r == NBASE:
+            return 0.0
+        if x == r:
+            return MATCH
+        if (x, r) in ((3, 1), (0, 2)):  # T over C, A over G
+            return BS
+        return MISMATCH
+
+    l = len(read)
+    w = len(ref)
+    m = [[GAP * abs(d - band) for d in range(width)]]
+    for i in range(1, l + 1):
+        x = read[i - 1]
+        if x == NBASE:
+            m.append(list(m[i - 1]))
+            continue
+        pre = []
+        for d in range(width):
+            col = off + (i - 1) + (d - band)
+            diag = m[i - 1][d] + (sub(x, ref[col]) if 0 <= col < w else NEGI)
+            up = (m[i - 1][d + 1] + GAP) if d + 1 < width else NEGI
+            pre.append(max(diag, up))
+        row = [NEGI] * width
+        for d in range(width):
+            for k in range(d + 1):
+                row[d] = max(row[d], pre[k] + GAP * (d - k))
+        m.append(row)
+    return max(m[l])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_scores_match_oracle(seed):
+    rng = np.random.default_rng(seed)
+    band, w, l = 4, 48, 20
+    n = 6
+    reads = rng.integers(0, 4, size=(n, l)).astype(np.int8)
+    reads[0, 15:] = NBASE  # short read with trailing pad
+    reads[1, 7] = NBASE  # mid-read N
+    ref = rng.integers(0, 4, size=(n, w)).astype(np.int8)
+    offsets = rng.integers(2, 10, size=n).astype(np.int32)
+    m = np.asarray(banded_scores(reads, ref, offsets, band, MATCH, MISMATCH, GAP, BS))
+    for i in range(n):
+        want = oracle_best_score(list(reads[i]), list(ref[i]), int(offsets[i]), band)
+        got = m[i, l].max()
+        assert got == pytest.approx(want), f"read {i}"
+
+
+def test_exact_read_places_at_offset():
+    anchor = codes("ACGTACGTACGTACGTACGT")
+    ref = np.full(32, NBASE, np.int8)
+    ref[4:24] = anchor
+    read = np.full((1, 20), NBASE, np.int8)
+    read[0] = anchor
+    quals = np.full((1, 20), 30, np.uint8)
+    b, q, ok = banded_align(read, quals, ref[None], np.array([4], np.int32), band=4)
+    assert ok[0]
+    np.testing.assert_array_equal(b[0, 4:24], anchor)
+    assert (b[0, :4] == NBASE).all() and (b[0, 24:] == NBASE).all()
+    assert (q[0, 4:24] == 30).all()
+
+
+def test_deletion_read_shifts_right():
+    """Read missing anchor base 10: chars after it land one column right."""
+    anchor = codes("ACGTTGCAACGTTGCAACGT")
+    ref = np.full(32, NBASE, np.int8)
+    ref[4:24] = anchor
+    read_seq = np.concatenate([anchor[:10], anchor[11:]])  # 19 chars
+    read = np.full((1, 19), NBASE, np.int8)
+    read[0] = read_seq
+    quals = np.full((1, 19), 30, np.uint8)
+    b, q, ok = banded_align(read, quals, ref[None], np.array([4], np.int32), band=4)
+    assert ok[0]
+    np.testing.assert_array_equal(b[0, 4:14], anchor[:10])
+    assert b[0, 14] == NBASE  # deleted column: no observation
+    np.testing.assert_array_equal(b[0, 15:24], anchor[11:])
+
+
+def test_insertion_read_drops_inserted_char():
+    anchor = codes("ACGTTGCAACGTTGCAACGT")
+    ref = np.full(32, NBASE, np.int8)
+    ref[4:24] = anchor
+    read_seq = np.concatenate([anchor[:10], [NBASE - 1], anchor[10:]])  # 21 chars, insert 'T'
+    read_seq[10] = 3  # T inserted
+    read = np.full((1, 21), NBASE, np.int8)
+    read[0] = read_seq
+    quals = np.full((1, 21), 30, np.uint8)
+    b, q, ok = banded_align(read, quals, ref[None], np.array([4], np.int32), band=4)
+    assert ok[0]
+    np.testing.assert_array_equal(b[0, 4:24], anchor)  # insertion vanished
+
+
+def test_bisulfite_lenient_t_over_c():
+    anchor = codes("ACCCCACCCCACCCCACCCC")
+    ref = np.full(28, NBASE, np.int8)
+    ref[2:22] = anchor
+    read_seq = anchor.copy()
+    read_seq[anchor == 1] = 3  # every C read as T (full conversion)
+    read = read_seq[None].astype(np.int8)
+    quals = np.full((1, 20), 30, np.uint8)
+    b, _, ok = banded_align(read, quals, ref[None], np.array([2], np.int32), band=3)
+    assert ok[0]
+    np.testing.assert_array_equal(b[0, 2:22], read_seq)
+
+
+def test_garbage_read_refused():
+    rng = np.random.default_rng(9)
+    ref = rng.integers(0, 4, size=(1, 40)).astype(np.int8)
+    read = ((ref[0, 5:25] + 2) % 4)[None].astype(np.int8)  # all mismatches
+    quals = np.full((1, 20), 30, np.uint8)
+    _, _, ok = banded_align(
+        read, quals, ref, np.array([5], np.int32), band=4, min_score_per_base=1.0
+    )
+    assert not ok[0]
+
+
+def test_encode_align_policy_recovers_indel_read():
+    """End-to-end through the encoder: with indel_policy='drop' (parity) an
+    indel read contributes nothing; with 'align' it adds depth everywhere
+    except the deleted column."""
+    from bsseqconsensusreads_tpu.io.bam import BamRecord, CDEL, CMATCH
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq, encode_molecular_families
+
+    rng = np.random.default_rng(3)
+    frag = rng.integers(0, 4, size=40).astype(np.int8)
+    seq = codes_to_seq(frag)
+    qual = bytes([30] * 40)
+
+    def rec(qname, s, cigar, pos=100):
+        return BamRecord(
+            qname=qname, flag=0x1 | 0x40, ref_id=0, pos=pos,
+            cigar=cigar, seq=s, qual=bytes([30] * len(s)),
+            tags={"MI": ("Z", "7/A"), "RX": ("Z", "AA-CC")},
+        )
+
+    normal = [rec(f"t{i}", seq, [(CMATCH, 40)]) for i in range(2)]
+    # third template: deletion of base 20 (19M 1D 20M)
+    del_seq = codes_to_seq(np.concatenate([frag[:19], frag[20:]]))
+    indel = rec("t2", del_seq, [(CMATCH, 19), (CDEL, 1), (CMATCH, 20)])
+
+    fam = [("7", normal + [indel])]
+    drop_batch, _ = encode_molecular_families(fam, indel_policy="drop")
+    align_batch, _ = encode_molecular_families(fam, indel_policy="align")
+    assert drop_batch.indel_aligned == 0
+    assert align_batch.indel_aligned == 1 and align_batch.indel_dropped == 0
+
+    def depth(batch):
+        return (batch.bases[0, :, 0, :] != NBASE).sum(axis=0)
+
+    d_drop, d_align = depth(drop_batch), depth(align_batch)
+    assert d_drop[:40].max() == 2
+    # recovered read adds depth on matched columns, none on the deleted one
+    assert (d_align[:19] == 3).all()
+    assert d_align[19] == 2
+    assert (d_align[20:40] == 3).all()
+    # and the recovered bases agree with the fragment
+    row = align_batch.bases[0, 2, 0]
+    np.testing.assert_array_equal(row[:19], frag[:19])
+    assert row[19] == NBASE
+    np.testing.assert_array_equal(row[20:40], frag[20:])
+
+
+def test_mid_read_n_skipped_not_placed():
+    anchor = codes("ACGTACGTACGTACGTACGT")
+    ref = np.full(30, NBASE, np.int8)
+    ref[3:23] = anchor
+    read_seq = anchor.copy()
+    read_seq[7] = NBASE
+    read = read_seq[None].astype(np.int8)
+    quals = np.full((1, 20), 30, np.uint8)
+    b, _, ok = banded_align(read, quals, ref[None], np.array([3], np.int32), band=3)
+    assert ok[0]
+    assert b[0, 10] == NBASE  # the N char's column stays unobserved
+    np.testing.assert_array_equal(b[0, 3:10], anchor[:7])
+    np.testing.assert_array_equal(b[0, 11:23], anchor[8:])
