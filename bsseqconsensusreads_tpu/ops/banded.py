@@ -167,10 +167,29 @@ def banded_align(reads, quals, ref, offsets, band: int = 8,
         )
         left = np.where(d > 0, m[rows, i, np.maximum(d - 1, 0)] + gap, NEG)
 
+        # Move priority on exact score ties: left (deletion) > diag > up.
+        # Within a repeat run (e.g. an AA dinucleotide) every gap placement
+        # scores identically. Walking BACKWARDS, taking the deletion move
+        # first pins the gap at the rightmost tied column — a fixed,
+        # deterministic convention — whereas diag-first drifts the gap one
+        # column left per tie, parking the preceding base on a column it was
+        # not observed at (the depth-misplacement bug this ordering fixes:
+        # a 19M 1D 20M read lost its base adjacent to the deletion).
         take_pad = active & is_pad
-        take_diag = active & ~is_pad & (np.abs(diag - cur) <= eps)
-        take_up = active & ~is_pad & ~take_diag & (np.abs(up - cur) <= eps)
-        take_left = active & ~is_pad & ~take_diag & ~take_up
+        take_left = active & ~is_pad & (np.abs(left - cur) <= eps)
+        take_diag = active & ~is_pad & ~take_left & (np.abs(diag - cur) <= eps)
+        take_up = (
+            active & ~is_pad & ~take_left & ~take_diag
+            & (np.abs(up - cur) <= eps)
+        )
+        # No move matches the cell score (numerical drift / invalid band
+        # edge): deactivate and mark the read unaligned rather than spinning
+        # to the iteration cap with a partially placed row still ok=True.
+        no_move = active & ~is_pad & ~take_left & ~take_diag & ~take_up
+        ok[no_move] = False
+        out_b[no_move] = NBASE
+        out_q[no_move] = 0
+        active = active & ~no_move
 
         # diag: char i-1 (0-based) sits at column cols
         place = take_diag & in_win

@@ -202,6 +202,11 @@ def pack_duplex_inputs(
     f, r, w = bases.shape
     if w % 2:
         raise ValueError(f"window width must be even, got {w}")
+    if qual_mode not in ("q8", "auto", "q2", "q4"):
+        raise ValueError(
+            f"qual_mode must be one of 'q8', 'auto', 'q2', 'q4'; "
+            f"got {qual_mode!r}"
+        )
     masked = levels = None
     if qual_mode != "q8":
         n_uncovered = int(cover.size - np.count_nonzero(cover))

@@ -101,6 +101,9 @@ class PipelineBuilder:
             "grouping": self.cfg.grouping,
             "indel_policy": self.cfg.indel_policy,
             "params": repr(getattr(self.cfg, stage)),
+            # kernel choice changes tie-break behavior; resuming shards
+            # produced under a different kernel would splice divergent bases
+            "vote_kernel": os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla"),
         }
         return BatchCheckpoint(
             rule.outputs[0], header, every=self.cfg.checkpoint_every,
