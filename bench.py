@@ -4,6 +4,14 @@ Prints ONE JSON line:
   {"metric": "duplex consensus reads/sec/chip", "value": N,
    "unit": "reads/sec", "vs_baseline": R}
 
+Resilience: the TPU ('axon') backend in this environment initializes over a
+tunnel and has been observed to hang or fail at init (BENCH_r01 rc=1). The
+device measurement therefore runs in a CHILD process with a hard timeout and
+bounded retries (--child flag); on exhaustion the parent falls back to
+measuring the same fused JAX path on the host CPU backend, labels the result
+{"backend": "cpu-fallback", ...} with the failure diagnostic, and still
+prints the one JSON line. A crash is never the output.
+
 The baseline is the measured per-read rate of the scalar-Python oracle
 pipeline (oracle_convert_read + oracle_extend_group + oracle_column_vote) on
 the same data — the stand-in for the reference's pysam/JVM per-read loops
@@ -24,6 +32,10 @@ CPU oracle times against the same RTA3-binned data.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -152,20 +164,101 @@ def bench_oracle(n_families: int = 150) -> float:
     return n_families * READS_PER_FAMILY / dt
 
 
+def _child(backend: str) -> None:
+    """Device-measurement child: prints ONE JSON line {"rate", "backend"}.
+
+    backend 'device' leaves platform selection to the environment (the real
+    chip); 'cpu' forces the host CPU backend before any init so the fallback
+    measurement can never touch the hanging tunnel."""
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif jax.default_backend() == "cpu":
+        # no accelerator present at all: don't grind the heavy batch through
+        # CPU under a device-sized timeout — fail fast so the parent's
+        # dedicated cpu attempt (with its own budget) takes over
+        print("device attempt found only the cpu backend", file=sys.stderr)
+        raise SystemExit(3)
+    rate = max(bench_tpu(iters=5) for _ in range(2))
+    print(json.dumps({"rate": rate, "backend": jax.default_backend()}))
+
+
+# (mode, timeout seconds): two bounded tries at the real chip, then the
+# labeled CPU fallback. Bounded so a hung tunnel init can never make the
+# bench itself hang (BENCH_r01 failure mode).
+_ATTEMPTS = (("device", 420), ("device", 180), ("cpu", 900))
+
+
+def _measure_device() -> dict:
+    """Run the device benchmark in a child with timeout + bounded retries."""
+    failures: list[str] = []
+    for mode, tmo in _ATTEMPTS:
+        # per-mode override (testing / slow tunnels); applies to every
+        # attempt of that mode, flattening the 420/180 escalation — fine
+        # for an explicit operator choice. Malformed values fall back.
+        try:
+            tmo = int(os.environ.get(f"BSSEQ_BENCH_{mode.upper()}_TIMEOUT", tmo))
+        except (TypeError, ValueError):
+            pass
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", mode]
+        # new session: a timeout must kill the whole process GROUP, or a
+        # hung tunnel helper forked by backend init would outlive the child
+        # and poison the retries by holding the device
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            start_new_session=True,
+        )
+        try:
+            stdout, stderr = proc.communicate(timeout=tmo)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            failures.append(f"{mode}: killed after {tmo}s (backend hang)")
+            continue
+        if proc.returncode == 0:
+            for line in reversed(stdout.strip().splitlines()):
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(d, dict) and isinstance(d.get("rate"), (int, float)):
+                    d["failures"] = failures
+                    return d
+            failures.append(f"{mode}: no rate JSON in child stdout")
+        else:
+            tail = (stderr or "").strip().replace("\n", " | ")[-300:]
+            failures.append(f"{mode}: rc={proc.returncode}: {tail}")
+    return {"rate": None, "backend": "none", "failures": failures}
+
+
 def main() -> None:
-    tpu_rate = max(bench_tpu(iters=5) for _ in range(2))
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+        return
+    dev = _measure_device()
     # best-of-3 so a background-load hiccup doesn't skew the ratio
     cpu_rate = max(bench_oracle() for _ in range(3))
-    print(
-        json.dumps(
-            {
-                "metric": "duplex consensus reads/sec/chip",
-                "value": round(tpu_rate, 1),
-                "unit": "reads/sec",
-                "vs_baseline": round(tpu_rate / cpu_rate, 2),
-            }
+    out = {
+        "metric": "duplex consensus reads/sec/chip",
+        "value": 0.0,
+        "unit": "reads/sec",
+        "vs_baseline": 0.0,
+        "baseline_reads_per_sec": round(cpu_rate, 1),
+    }
+    if dev["rate"] is not None:
+        out["value"] = round(dev["rate"], 1)
+        out["vs_baseline"] = round(dev["rate"] / cpu_rate, 2)
+        out["backend"] = (
+            "cpu-fallback" if dev["backend"] == "cpu" else dev["backend"]
         )
-    )
+    else:
+        out["backend"] = "none"
+        out["error"] = "device benchmark failed on all attempts"
+    if dev["failures"]:
+        out["attempt_failures"] = dev["failures"]
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
