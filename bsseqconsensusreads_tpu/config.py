@@ -45,6 +45,10 @@ class FrameworkConfig:
     #: tools/1.convert_AG_to_CT.py:79-80); 'align' = recover them with the
     #: banded intra-family aligner (ops.banded, above-parity).
     indel_policy: str = "drop"
+    #: spill threshold (records) for the external-merge sorts backing every
+    #: sort/zip step (pipeline.extsort) — the bounded-memory replacement for
+    #: the reference's 60-100 GB in-RAM sorts (main.snake.py:106,152).
+    sort_buffer_records: int = 100_000
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

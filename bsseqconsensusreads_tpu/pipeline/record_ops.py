@@ -2,6 +2,13 @@
 
 Each function is the in-process equivalent of one shell step of the reference
 pipeline; citations point at the rule that invokes the original.
+
+Two tiers:
+* in-memory list sorts (name_sort/coordinate_sort/…) — convenience for
+  small inputs and tests;
+* streaming variants over pipeline.extsort — the production path, bounded
+  host memory at any input size (the reference's equivalents need 60-100 GB
+  JVM heaps, main.snake.py:106,152; README.md:83).
 """
 
 from __future__ import annotations
@@ -9,9 +16,14 @@ from __future__ import annotations
 from typing import Iterable, Iterator
 
 from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
     BamRecord,
     FREAD2,
     FUNMAP,
+)
+from bsseqconsensusreads_tpu.pipeline.extsort import (
+    DEFAULT_BUFFER_RECORDS,
+    external_sort,
 )
 
 #: Consensus/UMI tags ZipperBams grafts from the unaligned onto the aligned
@@ -27,43 +39,110 @@ def filter_mapped(records: Iterable[BamRecord]) -> Iterator[BamRecord]:
             yield rec
 
 
-def name_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
-    """`samtools sort -n` — queryname order (main.snake.py:106). R1 before R2
+# ---- sort keys (shared by the in-memory and external sorts) ---------------
+
+
+def name_key(r: BamRecord) -> tuple:
+    """`samtools sort -n` order (main.snake.py:106): queryname, R1 before R2
     within a name, matching htslib's flag-based tiebreak closely enough for
     the zipper pass that consumes it."""
-    return sorted(records, key=lambda r: (r.qname, bool(r.flag & FREAD2), r.flag))
+    return (r.qname, bool(r.flag & FREAD2), r.flag)
 
 
-def coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+def coordinate_key(r: BamRecord) -> tuple:
     """`--sort Coordinate` of ZipperBams (main.snake.py:106): by (ref, pos);
     unmapped records go last."""
-    return sorted(
-        records,
-        key=lambda r: (
-            r.ref_id if r.ref_id >= 0 else 1 << 30,
-            r.pos if r.pos >= 0 else 1 << 30,
-            r.qname,
-            r.flag,
-        ),
+    return (
+        r.ref_id if r.ref_id >= 0 else 1 << 30,
+        r.pos if r.pos >= 0 else 1 << 30,
+        r.qname,
+        r.flag,
     )
 
 
-def template_coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+def template_coordinate_key(r: BamRecord) -> tuple:
     """`fgbio SortBam -s TemplateCoordinate` (main.snake.py:152): order by the
     template's earliest coordinate so both strands of a duplex group become
     adjacent — the sole purpose it serves in the reference pipeline. Key:
-    (ref, min(pos, matepos), MI-without-suffix, qname, flag).
+    (ref, min(pos, matepos), MI-without-suffix, qname, flag)."""
+    mi = str(r.get_tag("MI")).split("/")[0] if r.has_tag("MI") else ""
+    lo = min(
+        r.pos if r.pos >= 0 else 1 << 30,
+        r.next_pos if r.next_pos >= 0 else 1 << 30,
+    )
+    return (r.ref_id if r.ref_id >= 0 else 1 << 30, lo, mi, r.qname, r.flag)
+
+
+# ---- in-memory sorts (small inputs / tests) -------------------------------
+
+
+def name_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """In-memory `samtools sort -n` (see name_key)."""
+    return sorted(records, key=name_key)
+
+
+def coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """In-memory coordinate sort (see coordinate_key)."""
+    return sorted(records, key=coordinate_key)
+
+
+def template_coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
+    """In-memory TemplateCoordinate sort (see template_coordinate_key)."""
+    return sorted(records, key=template_coordinate_key)
+
+
+# ---- streaming production path --------------------------------------------
+
+
+def _graft(rec: BamRecord, src: BamRecord, tags: tuple[str, ...]) -> None:
+    for tag in tags:
+        if src.has_tag(tag) and not rec.has_tag(tag):
+            rec.tags[tag] = src.tags[tag]
+
+
+def zipper_bams_stream(
+    aligned: Iterable[BamRecord],
+    unaligned: Iterable[BamRecord],
+    header: BamHeader,
+    tags: tuple[str, ...] = GRAFT_TAGS,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+) -> Iterator[BamRecord]:
+    """`fgbio ZipperBams --unmapped … --sort Coordinate` (main.snake.py:106)
+    with bounded memory: graft molecule-level tags from the unaligned
+    consensus BAM onto the aligned records (bwameth strips them), emit in
+    coordinate order.
+
+    Both sides are externally name-sorted, joined by a streaming two-pointer
+    walk on (qname, read-of-pair) — so secondary/supplementary alignments
+    receive the same tags as their primary, and aligned records with no
+    unaligned partner pass through untouched — then externally
+    coordinate-sorted. Peak memory is O(sort buffer), never O(file),
+    replacing the reference's -Xmx100G ZipperBams step.
     """
 
-    def key(r: BamRecord):
-        mi = str(r.get_tag("MI")).split("/")[0] if r.has_tag("MI") else ""
-        lo = min(
-            r.pos if r.pos >= 0 else 1 << 30,
-            r.next_pos if r.next_pos >= 0 else 1 << 30,
-        )
-        return (r.ref_id if r.ref_id >= 0 else 1 << 30, lo, mi, r.qname, r.flag)
+    def join_key(r: BamRecord) -> tuple:
+        return (r.qname, bool(r.flag & FREAD2))
 
-    return sorted(records, key=key)
+    def joined() -> Iterator[BamRecord]:
+        a_iter = external_sort(
+            aligned, name_key, header, workdir, buffer_records
+        )
+        u_iter = external_sort(
+            unaligned, name_key, header, workdir, buffer_records
+        )
+        u = next(u_iter, None)
+        for rec in a_iter:
+            ka = join_key(rec)
+            while u is not None and join_key(u) < ka:
+                u = next(u_iter, None)
+            if u is not None and join_key(u) == ka:
+                _graft(rec, u, tags)
+            yield rec
+
+    yield from external_sort(
+        joined(), coordinate_key, header, workdir, buffer_records
+    )
 
 
 def zipper_bams(
@@ -71,14 +150,7 @@ def zipper_bams(
     unaligned: Iterable[BamRecord],
     tags: tuple[str, ...] = GRAFT_TAGS,
 ) -> list[BamRecord]:
-    """`fgbio ZipperBams --unmapped … --sort Coordinate` (main.snake.py:106):
-    graft molecule-level tags from the unaligned consensus BAM onto the
-    aligned records (bwameth strips them), then coordinate-sort.
-
-    Records are matched by (qname, read-of-pair). Secondary/supplementary
-    alignments receive the same tags as their primary. Aligned records with
-    no unaligned partner pass through untouched.
-    """
+    """In-memory zipper (see zipper_bams_stream for the production path)."""
     lookup: dict[tuple[str, bool], BamRecord] = {}
     for rec in unaligned:
         lookup[(rec.qname, bool(rec.flag & FREAD2))] = rec
@@ -86,8 +158,6 @@ def zipper_bams(
     for rec in aligned:
         src = lookup.get((rec.qname, bool(rec.flag & FREAD2)))
         if src is not None:
-            for tag in tags:
-                if src.has_tag(tag) and not rec.has_tag(tag):
-                    rec.tags[tag] = src.tags[tag]
+            _graft(rec, src, tags)
         out.append(rec)
     return coordinate_sort(out)

@@ -34,10 +34,11 @@ from bsseqconsensusreads_tpu.pipeline.calling import (
     call_molecular_batches,
 )
 from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
+from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
 from bsseqconsensusreads_tpu.pipeline.record_ops import (
-    coordinate_sort,
+    coordinate_key,
     filter_mapped,
-    zipper_bams,
+    zipper_bams_stream,
 )
 from bsseqconsensusreads_tpu.pipeline.workflow import Workflow, WorkflowError
 from bsseqconsensusreads_tpu.utils import observe
@@ -69,21 +70,30 @@ class PipelineBuilder:
             h.text = "@HD\tVN:1.6\tSO:unsorted\n" + h.text
         return h
 
+    def _sorted(self, records, header):
+        """Bounded-memory coordinate sort (external merge over BGZF runs)."""
+        return external_sort(
+            records, coordinate_key, header,
+            workdir=self.cfg.tmp or None,
+            buffer_records=self.cfg.sort_buffer_records,
+        )
+
     def _write_stage_output(self, batches, out_path: str, header, mode: str,
                             ck: BatchCheckpoint | None) -> None:
         """Write a consensus batch stream: straight through, or via durable
         per-batch shards when intra-stage checkpointing is on (the batch
-        stream is already offset by ck.batches_done)."""
+        stream is already offset by ck.batches_done). The 'self' mode's
+        coordinate sort is external-merge, never whole-file in RAM."""
         if ck is not None:
             ck.write_batches(batches)
             recs = ck.iter_records()
-            ck.finalize(coordinate_sort(recs) if mode == "self" else recs)
+            ck.finalize(self._sorted(recs, header) if mode == "self" else recs)
             return
-        out = [rec for batch in batches for rec in batch]
+        recs = (rec for batch in batches for rec in batch)
         if mode == "self":
-            out = coordinate_sort(out)
+            recs = self._sorted(recs, header)
         with BamWriter(out_path, header) as writer:
-            writer.write_all(out)
+            writer.write_all(recs)
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
         """Arm intra-stage checkpointing for one stage target, fingerprinted
@@ -172,7 +182,11 @@ class PipelineBuilder:
 
     def run_zipper(self, rule) -> None:
         with BamReader(rule.inputs[0]) as aligned, BamReader(rule.inputs[1]) as unaligned:
-            merged = zipper_bams(list(aligned), list(unaligned))
+            merged = zipper_bams_stream(
+                aligned, unaligned, aligned.header,
+                workdir=self.cfg.tmp or None,
+                buffer_records=self.cfg.sort_buffer_records,
+            )
             with BamWriter(rule.outputs[0], aligned.header) as writer:
                 writer.write_all(merged)
 

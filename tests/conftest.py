@@ -23,6 +23,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-minute subprocess tests (peak-RSS bounds)"
+    )
+
+
 @pytest.fixture(scope="session")
 def rng():
     import numpy as np
