@@ -17,6 +17,8 @@ The family axis is simultaneously sharded over 'data', making this the 2D
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
@@ -32,6 +34,7 @@ from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
 
 
+@functools.lru_cache(maxsize=16)
 def deep_family_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams()):
     """Molecular consensus with families over 'data' AND templates over
     'reads'. bases/quals: [F, T, 2, W]; F divisible by the data-axis size,

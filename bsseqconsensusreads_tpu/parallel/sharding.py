@@ -8,10 +8,15 @@ scatter / final gather of batch arrays, which ride ICI.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+from bsseqconsensusreads_tpu.models.duplex import (
+    duplex_call_pipeline,
+    duplex_call_pipeline_packed,
+)
 from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
@@ -22,15 +27,52 @@ def family_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def sharded_molecular_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams()):
+@functools.lru_cache(maxsize=64)
+def sharded_molecular_consensus(
+    mesh: Mesh,
+    params: ConsensusParams = ConsensusParams(),
+    kernel_fn=None,
+):
     """molecular_consensus sharded over families. F must divide evenly by the
-    data-axis size (use parallel.mesh.pad_families)."""
+    data-axis size (use parallel.mesh.pad_families). kernel_fn swaps in an
+    alternative per-shard kernel with the same signature (e.g. the Pallas
+    vote, ops.pallas_vote.molecular_consensus_pallas)."""
+    kernel_fn = kernel_fn or molecular_consensus
+    spec = P(DATA_AXIS)
+
+    # check_vma=False: the map is collective-free (each shard independent),
+    # and pallas_call outputs don't carry vma metadata for the checker.
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )
+    def fn(bases, quals):
+        return kernel_fn(bases, quals, params)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def sharded_duplex_packed(
+    mesh: Mesh, params: ConsensusParams = ConsensusParams(min_reads=0)
+):
+    """duplex_call_pipeline_packed (the production fused duplex stage with
+    packed transport outputs) sharded over families — what
+    pipeline.calling.call_duplex_batches dispatches on a multi-device
+    backend. Returns (packed, la, rd), all family-sharded."""
     spec = P(DATA_AXIS)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(spec, spec), out_specs=spec)
-    def fn(bases, quals):
-        return molecular_consensus(bases, quals, params)
+    @jax.shard_map(
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, spec),
+    )
+    def fn(bases, quals, cover, ref, convert_mask, extend_eligible):
+        return duplex_call_pipeline_packed(
+            bases, quals, cover, ref, convert_mask, extend_eligible,
+            params=params,
+        )
 
     return fn
 

@@ -59,6 +59,40 @@ from bsseqconsensusreads_tpu.utils import observe
 _COMPLEMENT = dict(zip("ACGTN", "TGCAN"))
 
 
+def _resolve_mesh(mesh):
+    """'auto' -> an all-devices data mesh when >1 device is visible, else
+    None (plain single-device dispatch). A Mesh or None passes through."""
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(f"mesh must be 'auto', None, or a Mesh; got {mesh!r}")
+        if jax.device_count() <= 1:
+            return None
+        from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(n_data=jax.device_count(), n_reads=1)
+    return mesh
+
+
+#: Hard ceiling for deep-family routing: keeps per-column depth inside the
+#: int16 transport dtypes (models.molecular.narrow_outputs) with margin.
+#: Families beyond it are skipped AND reported, as before.
+DEEP_TEMPLATE_CAP = 16_384
+
+
+def _split_deep(chunk, threshold: int):
+    """Partition (mi, records) groups by template count: families whose
+    qname count exceeds `threshold` go to the deep-family path (sharded
+    segmented reduction) instead of being skipped at encode's
+    max_templates cap (ops.encode.MAX_TEMPLATES)."""
+    normal, deep = [], []
+    for mi, records in chunk:
+        if len({r.qname for r in records}) > threshold:
+            deep.append((mi, records))
+        else:
+            normal.append((mi, records))
+    return normal, deep
+
+
 def _molecular_kernel(vote_kernel: str | None):
     """Resolve the molecular vote kernel: 'xla' (default) or 'pallas'
     (ops.pallas_vote — the fused Mosaic reduction). Overridable per call or
@@ -316,6 +350,62 @@ def _emit_read(
     )
 
 
+def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
+    """Build consensus records from one molecular kernel output batch.
+    Shared by the single-device, family-sharded, and deep-family paths."""
+    base = np.asarray(out["base"])
+    qual = np.asarray(out["qual"])
+    depth = np.asarray(out["depth"])
+    errors = np.asarray(out["errors"])
+    emitted: list[BamRecord] = []
+    for fi, meta in enumerate(batch.meta):
+        stats.families += 1
+        n_reads = int((batch.bases[fi] != NBASE).any(axis=-1).sum())
+        if n_reads < params.min_reads:
+            stats.skipped_families += 1
+            continue
+        spans = []
+        for role in range(2):
+            cov = np.nonzero(depth[fi, role] > 0)[0]
+            spans.append(cov)
+        starts = [
+            meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+        ]
+        for role in range(2):
+            cov = spans[role]
+            if len(cov) == 0:
+                continue
+            seq_fwd = codes_to_seq(base[fi, role, cov])
+            quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+            tags = _consensus_tags(
+                depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+            )
+            other = 1 - role
+            tlen = 0
+            if starts[0] >= 0 and starts[1] >= 0:
+                lo = min(starts)
+                hi = max(
+                    meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                )
+                tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
+            emitted.append(_emit_read(
+                qname=meta.mi,
+                role=role,
+                seq_fwd=seq_fwd,
+                quals_fwd=quals_fwd,
+                tags=tags,
+                mode=mode,
+                reverse=meta.role_reverse[role],
+                ref_id=meta.ref_id,
+                pos=starts[role],
+                mate_pos=starts[other],
+                mate_reverse=meta.role_reverse[other],
+                tlen=tlen,
+            ))
+            stats.consensus_out += 1
+    return emitted
+
+
 def call_molecular_batches(
     records: Iterable[BamRecord],
     params: ConsensusParams = ConsensusParams(min_reads=1),
@@ -327,6 +417,8 @@ def call_molecular_batches(
     vote_kernel: str | None = None,
     skip_batches: int = 0,
     indel_policy: str = "drop",
+    mesh="auto",
+    deep_threshold: int | None = None,
 ) -> Iterator[list[BamRecord]]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -338,85 +430,119 @@ def call_molecular_batches(
     drops nothing; larger values drop shallow families). grouping controls
     host memory: 'coordinate'/'adjacent' stream with bounded memory on sorted
     input (see stream_mi_groups), 'gather' holds the whole input.
+
+    mesh: 'auto' (shard the family axis across all visible devices when
+    there are more than one — each family still computed whole on one
+    device, so results are identical to single-device), None (single
+    device), or an explicit parallel.mesh Mesh.
+
+    Families deeper than deep_threshold templates (default: encode's
+    MAX_TEMPLATES) are routed to the deep-family path — their template axis
+    sharded across the mesh's devices with a psum segmented reduction
+    (parallel.deep_family) — instead of being skipped; only beyond
+    DEEP_TEMPLATE_CAP (int16 transport ceiling) are they skipped+reported.
     """
+    from bsseqconsensusreads_tpu.ops import encode as encode_mod
+
     stats = stats if stats is not None else StageStats()
     consensus_fn = _molecular_kernel(vote_kernel)
+    if deep_threshold is None:
+        deep_threshold = encode_mod.MAX_TEMPLATES
     t0 = time.monotonic()
+    mesh = _resolve_mesh(mesh)
+    sharded_fn = None
+    deep_state: dict = {}
+    if mesh is not None:
+        from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
+        from bsseqconsensusreads_tpu.parallel.sharding import (
+            sharded_molecular_consensus,
+        )
+
+        data_size = mesh.shape[DATA_AXIS]
+        sharded_fn = sharded_molecular_consensus(mesh, params, kernel_fn=consensus_fn)
+
+    def run_kernel(batch):
+        if sharded_fn is None:
+            return consensus_fn(batch.bases, batch.quals, params)
+        f = batch.bases.shape[0]
+        (pb, pq), _ = pad_families((batch.bases, batch.quals), f, data_size)
+        out = sharded_fn(pb, pq)
+        return {k: np.asarray(v)[:f] for k, v in out.items()}
+
+    def run_deep_kernel(batch):
+        """One deep family [1, T, 2, W]: template axis over the devices."""
+        if mesh is None:
+            return consensus_fn(batch.bases, batch.quals, params)
+        if "fn" not in deep_state:
+            from bsseqconsensusreads_tpu.parallel.deep_family import (
+                deep_family_consensus,
+            )
+            from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+            devices = list(mesh.devices.flat)
+            deep_state["n"] = len(devices)
+            deep_state["fn"] = deep_family_consensus(
+                make_mesh(n_data=1, n_reads=len(devices), devices=devices),
+                params,
+            )
+        n = deep_state["n"]
+        b, q = batch.bases, batch.quals
+        t = b.shape[1]
+        pad = (-t) % n
+        if pad:  # empty pad reads: NBASE bases contribute nothing to the vote
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            b = np.pad(b, widths, constant_values=NBASE)
+            q = np.pad(q, widths, constant_values=0)
+        return deep_state["fn"](b, q)
+
     groups = stream_mi_groups(records, grouping=grouping, stats=stats)
     batch_index = 0
     for chunk in _group_batches(groups, batch_families):
         batch_index += 1
         if batch_index <= skip_batches:
             continue
+        normal, deep = _split_deep(chunk, deep_threshold)
         with stats.metrics.timed("encode"):
+            # cap must track the routing threshold: a family the splitter
+            # classified 'normal' (<= deep_threshold templates) must never
+            # hit encode's default cap and be silently skipped
             batch, skipped = encode_molecular_families(
-                chunk, max_window=max_window, indel_policy=indel_policy
+                normal, max_window=max_window,
+                max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
+                indel_policy=indel_policy,
             )
         stats.skipped_families += len(skipped)
         stats.indel_aligned += batch.indel_aligned
         stats.indel_dropped += batch.indel_dropped
-        if not batch.meta:
-            # one (possibly empty) yield per input chunk keeps the yielded
-            # batch count aligned with skip_batches across resumes
-            yield []
-            continue
-        stats.batches += 1
-        used = int((batch.bases != NBASE).sum())
-        stats.pad_cells += batch.bases.size - used
-        stats.used_cells += used
-        with stats.metrics.timed("kernel"):
-            out = consensus_fn(batch.bases, batch.quals, params)
-            base = np.asarray(out["base"])
-            qual = np.asarray(out["qual"])
-            depth = np.asarray(out["depth"])
-            errors = np.asarray(out["errors"])
-        # emit time = wall_seconds - encode_seconds - kernel_seconds
         emitted: list[BamRecord] = []
-        for fi, meta in enumerate(batch.meta):
-            stats.families += 1
-            n_reads = int((batch.bases[fi] != NBASE).any(axis=-1).sum())
-            if n_reads < params.min_reads:
-                stats.skipped_families += 1
-                continue
-            spans = []
-            for role in range(2):
-                cov = np.nonzero(depth[fi, role] > 0)[0]
-                spans.append(cov)
-            starts = [
-                meta.window_start + int(c[0]) if len(c) else -1 for c in spans
-            ]
-            for role in range(2):
-                cov = spans[role]
-                if len(cov) == 0:
-                    continue
-                seq_fwd = codes_to_seq(base[fi, role, cov])
-                quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
-                tags = _consensus_tags(
-                    depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+        if batch.meta:
+            stats.batches += 1
+            used = int((batch.bases != NBASE).sum())
+            stats.pad_cells += batch.bases.size - used
+            stats.used_cells += used
+            with stats.metrics.timed("kernel"):
+                out = run_kernel(batch)
+            # emit time = wall_seconds - encode_seconds - kernel_seconds
+            emitted.extend(_emit_molecular_batch(batch, out, params, mode, stats))
+        for mi, deep_records in deep:
+            with stats.metrics.timed("encode"):
+                dbatch, dskipped = encode_molecular_families(
+                    [(mi, deep_records)], max_window=max_window,
+                    max_templates=DEEP_TEMPLATE_CAP, indel_policy=indel_policy,
                 )
-                other = 1 - role
-                tlen = 0
-                if starts[0] >= 0 and starts[1] >= 0:
-                    lo = min(starts)
-                    hi = max(
-                        meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
-                    )
-                    tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
-                emitted.append(_emit_read(
-                    qname=meta.mi,
-                    role=role,
-                    seq_fwd=seq_fwd,
-                    quals_fwd=quals_fwd,
-                    tags=tags,
-                    mode=mode,
-                    reverse=meta.role_reverse[role],
-                    ref_id=meta.ref_id,
-                    pos=starts[role],
-                    mate_pos=starts[other],
-                    mate_reverse=meta.role_reverse[other],
-                    tlen=tlen,
-                ))
-                stats.consensus_out += 1
+            stats.skipped_families += len(dskipped)
+            stats.indel_aligned += dbatch.indel_aligned
+            stats.indel_dropped += dbatch.indel_dropped
+            if not dbatch.meta:
+                continue
+            stats.batches += 1
+            with stats.metrics.timed("kernel"):
+                dout = run_deep_kernel(dbatch)
+            emitted.extend(
+                _emit_molecular_batch(dbatch, dout, params, mode, stats)
+            )
+        # one (possibly empty) yield per input chunk keeps the yielded
+        # batch count aligned with skip_batches across resumes
         yield emitted
     stats.wall_seconds += time.monotonic() - t0
 
@@ -450,6 +576,7 @@ def call_duplex_batches(
     grouping: str = "gather",
     stats: StageStats | None = None,
     skip_batches: int = 0,
+    mesh="auto",
 ) -> Iterator[list[BamRecord]]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -464,9 +591,37 @@ def call_duplex_batches(
     duplicate flags, indel reads) are counted as leftovers and dropped — a
     documented deviation: the reference would pass some of these through to
     fgbio (SURVEY.md §7.3).
+
+    mesh: 'auto' shards the family axis across all visible devices when
+    more than one is present (results identical to single-device — every
+    family is computed whole on one device); None forces single-device.
     """
     stats = stats if stats is not None else StageStats()
     t0 = time.monotonic()
+    mesh = _resolve_mesh(mesh)
+    sharded_fn = None
+    if mesh is not None:
+        from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
+        from bsseqconsensusreads_tpu.parallel.sharding import sharded_duplex_packed
+
+        data_size = mesh.shape[DATA_AXIS]
+        sharded_fn = sharded_duplex_packed(mesh, params)
+
+    def run_kernel(batch):
+        f, w = batch.bases.shape[0], batch.bases.shape[-1]
+        arrays = (
+            batch.bases, batch.quals, batch.cover, batch.ref,
+            batch.convert_mask, batch.extend_eligible,
+        )
+        if sharded_fn is None:
+            packed, _la, _rd = duplex_call_pipeline_packed(*arrays, params=params)
+            pf = f
+        else:
+            padded, pf = pad_families(arrays, f, data_size)
+            packed, _la, _rd = sharded_fn(*padded)
+        out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
+        return {k: v[:f] for k, v in out.items()}
+
     groups = stream_mi_groups(records, strip_suffix=True, grouping=grouping, stats=stats)
     batch_index = 0
     for chunk in _group_batches(groups, batch_families):
@@ -487,20 +642,7 @@ def call_duplex_batches(
         stats.pad_cells += batch.cover.size - used
         stats.used_cells += used
         with stats.metrics.timed("kernel"):
-            packed, _la, _rd = duplex_call_pipeline_packed(
-                batch.bases,
-                batch.quals,
-                batch.cover,
-                batch.ref,
-                batch.convert_mask,
-                batch.extend_eligible,
-                params=params,
-            )
-            out = unpack_duplex_outputs(
-                jax.device_get(packed),
-                f=batch.bases.shape[0],
-                w=batch.bases.shape[-1],
-            )
+            out = run_kernel(batch)
         base = out["base"]
         qual = out["qual"]
         depth = out["depth"]
