@@ -1,0 +1,14 @@
+"""Interop/compat layer: run third-party pysam scripts on the first-party
+io stack (no pysam/htslib in this environment).
+
+The point (SURVEY.md §4 plan item 1): golden differential testing — execute
+the ACTUAL reference tools (tools/1.convert_AG_to_CT.py,
+tools/2.extend_gap.py, pure Python+pysam) against synthetic BAMs via this
+shim and diff their output record-for-record against the framework's JAX
+transforms, removing the shared-blind-spot risk of self-authored oracles.
+"""
+
+from bsseqconsensusreads_tpu.compat.pysam_shim import install_shim
+from bsseqconsensusreads_tpu.compat.refrunner import run_pysam_script
+
+__all__ = ["install_shim", "run_pysam_script"]

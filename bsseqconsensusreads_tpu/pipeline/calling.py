@@ -79,14 +79,29 @@ def _resolve_mesh(mesh):
 DEEP_TEMPLATE_CAP = 16_384
 
 
-def _split_deep(chunk, threshold: int):
-    """Partition (mi, records) groups by template count: families whose
-    qname count exceeds `threshold` go to the deep-family path (sharded
+def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
+    """Partition (mi, records) groups by encodable template count: families
+    whose count exceeds `threshold` go to the deep-family path (sharded
     segmented reduction) instead of being skipped at encode's
-    max_templates cap (ops.encode.MAX_TEMPLATES)."""
+    max_templates cap (ops.encode.MAX_TEMPLATES).
+
+    Counts distinct qnames of records the encoder would keep — hardclipped
+    reads never encode, indel reads don't under indel_policy='drop'
+    (ops.encode.trim_softclips_keep_indels) — so a family padded with
+    droppable reads isn't misrouted onto the one-family deep path."""
+    from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CDEL, CINS
+
+    drop_ops = (
+        (CINS, CDEL, CHARD_CLIP) if indel_policy == "drop" else (CHARD_CLIP,)
+    )
     normal, deep = [], []
     for mi, records in chunk:
-        if len({r.qname for r in records}) > threshold:
+        qnames = {
+            r.qname
+            for r in records
+            if not any(op in drop_ops for op, _ in r.cigar)
+        }
+        if len(qnames) > threshold:
             deep.append((mi, records))
         else:
             normal.append((mi, records))
@@ -462,8 +477,11 @@ def call_molecular_batches(
         sharded_fn = sharded_molecular_consensus(mesh, params, kernel_fn=consensus_fn)
 
     def run_kernel(batch):
+        # np.asarray inside this (timed) scope: materializing here keeps the
+        # 'kernel' metric the device wait, not just the async dispatch
         if sharded_fn is None:
-            return consensus_fn(batch.bases, batch.quals, params)
+            out = consensus_fn(batch.bases, batch.quals, params)
+            return {k: np.asarray(v) for k, v in out.items()}
         f = batch.bases.shape[0]
         (pb, pq), _ = pad_families((batch.bases, batch.quals), f, data_size)
         out = sharded_fn(pb, pq)
@@ -472,7 +490,8 @@ def call_molecular_batches(
     def run_deep_kernel(batch):
         """One deep family [1, T, 2, W]: template axis over the devices."""
         if mesh is None:
-            return consensus_fn(batch.bases, batch.quals, params)
+            out = consensus_fn(batch.bases, batch.quals, params)
+            return {k: np.asarray(v) for k, v in out.items()}
         if "fn" not in deep_state:
             from bsseqconsensusreads_tpu.parallel.deep_family import (
                 deep_family_consensus,
@@ -493,7 +512,8 @@ def call_molecular_batches(
             widths = ((0, 0), (0, pad), (0, 0), (0, 0))
             b = np.pad(b, widths, constant_values=NBASE)
             q = np.pad(q, widths, constant_values=0)
-        return deep_state["fn"](b, q)
+        out = deep_state["fn"](b, q)
+        return {k: np.asarray(v) for k, v in out.items()}
 
     groups = stream_mi_groups(records, grouping=grouping, stats=stats)
     batch_index = 0
@@ -501,7 +521,7 @@ def call_molecular_batches(
         batch_index += 1
         if batch_index <= skip_batches:
             continue
-        normal, deep = _split_deep(chunk, deep_threshold)
+        normal, deep = _split_deep(chunk, deep_threshold, indel_policy)
         with stats.metrics.timed("encode"):
             # cap must track the routing threshold: a family the splitter
             # classified 'normal' (<= deep_threshold templates) must never
@@ -536,6 +556,9 @@ def call_molecular_batches(
             if not dbatch.meta:
                 continue
             stats.batches += 1
+            dused = int((dbatch.bases != NBASE).sum())
+            stats.pad_cells += dbatch.bases.size - dused
+            stats.used_cells += dused
             with stats.metrics.timed("kernel"):
                 dout = run_deep_kernel(dbatch)
             emitted.extend(
