@@ -49,6 +49,12 @@ class FrameworkConfig:
     #: sort/zip step (pipeline.extsort) — the bounded-memory replacement for
     #: the reference's 60-100 GB in-RAM sorts (main.snake.py:106,152).
     sort_buffer_records: int = 100_000
+    #: reference-parity emission of off-vocabulary records at the duplex
+    #: stage: True writes leftover records (flag 0, non-4-group members, …)
+    #: through to the output the way the reference chain would
+    #: (tools/1.convert_AG_to_CT.py:70-73, tools/2.extend_gap.py:114-115);
+    #: False (default) drops them, counted in stats.leftover_records.
+    duplex_passthrough: bool = False
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

@@ -72,6 +72,39 @@ class BamHeader:
     def copy(self) -> "BamHeader":
         return BamHeader(self.text, list(self.references))
 
+    def with_pg(
+        self,
+        program: str,
+        version: str = "",
+        command_line: str = "",
+    ) -> "BamHeader":
+        """A copy with an @PG provenance line appended, chained to the
+        previous program via PP — what samtools/fgbio do on every step the
+        reference runs (the reference even opts out once with --no-PG,
+        main.snake.py:106; downstream tooling expects the chain)."""
+        ids = []
+        for line in self.text.splitlines():
+            if line.startswith("@PG"):
+                for part in line.split("\t")[1:]:
+                    if part.startswith("ID:"):
+                        ids.append(part[3:])
+        pg_id = program
+        n = 1
+        while pg_id in ids:
+            pg_id = f"{program}.{n}"
+            n += 1
+        fields = [f"@PG\tID:{pg_id}", f"PN:{program}"]
+        if ids:
+            fields.append(f"PP:{ids[-1]}")
+        if version:
+            fields.append(f"VN:{version}")
+        if command_line:
+            fields.append(f"CL:{command_line}")
+        text = self.text
+        if text and not text.endswith("\n"):
+            text += "\n"
+        return BamHeader(text + "\t".join(fields) + "\n", list(self.references))
+
 
 @dataclass
 class BamRecord:

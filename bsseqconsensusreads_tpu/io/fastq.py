@@ -27,21 +27,40 @@ def qual_to_ascii(qual: bytes | None, length: int) -> str:
     return "".join(chr(min(q, 93) + 33) for q in qual)
 
 
+def _fq_entry(rec: BamRecord, role: int) -> str:
+    seq, qual = rec.seq, qual_to_ascii(rec.qual, len(rec.seq))
+    if rec.flag & FREVERSE:
+        seq = reverse_complement(seq)
+        qual = qual[::-1]
+    return f"@{rec.qname}/{role}\n{seq}\n+\n{qual}\n"
+
+
 def sam_to_fastq(records: Iterable[BamRecord], fq1_path: str, fq2_path: str) -> tuple[int, int]:
-    """Split records into paired gzipped FASTQs; returns (n_r1, n_r2)."""
+    """Split records into paired gzipped FASTQs; returns (n_r1, n_r2).
+
+    Pairs are matched by qname and written IN STEP: the two files always
+    hold the same templates at the same line offsets, because downstream
+    paired aligners (bwameth, main.snake.py:93,188) pair entries
+    positionally — one orphan record written to only one file would shift
+    and silently mispair everything after it. Records without a same-name
+    mate of the opposite read-of-pair (orphans, e.g. duplex passthrough
+    leftovers) are therefore skipped, like Picard SamToFastq refuses
+    incomplete pairs rather than emitting desynchronized files.
+    """
     n1 = n2 = 0
+    pending: dict[str, BamRecord] = {}
     with gzip.open(fq1_path, "wt") as f1, gzip.open(fq2_path, "wt") as f2:
         for rec in records:
             if rec.flag & 0x900:  # secondary/supplementary never exported
                 continue
-            seq, qual = rec.seq, qual_to_ascii(rec.qual, len(rec.seq))
-            if rec.flag & FREVERSE:
-                seq = reverse_complement(seq)
-                qual = qual[::-1]
-            if rec.flag & FREAD2:
-                f2.write(f"@{rec.qname}/2\n{seq}\n+\n{qual}\n")
-                n2 += 1
-            else:
-                f1.write(f"@{rec.qname}/1\n{seq}\n+\n{qual}\n")
-                n1 += 1
+            mate = pending.get(rec.qname)
+            if mate is None or bool(mate.flag & FREAD2) == bool(rec.flag & FREAD2):
+                pending[rec.qname] = rec  # first of the pair (or duplicate)
+                continue
+            del pending[rec.qname]
+            r1, r2 = (mate, rec) if rec.flag & FREAD2 else (rec, mate)
+            f1.write(_fq_entry(r1, 1))
+            f2.write(_fq_entry(r2, 2))
+            n1 += 1
+            n2 += 1
     return n1, n2

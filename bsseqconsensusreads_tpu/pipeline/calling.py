@@ -588,6 +588,63 @@ def call_molecular(
         yield from batch
 
 
+#: Reference flag vocabulary at the convert stage: pass-through set and
+#: B-strand conversion set (tools/1.convert_AG_to_CT.py:70-73); any other
+#: flag is silently dropped there (:69-186 structure — no else branch).
+_PASS_FLAGS = (0, 99, 147)
+_CONVERT_FLAGS = (1, 83, 163)
+
+
+def _passthrough_records(leftovers, ref_fetch, ref_names) -> list[BamRecord]:
+    """Reference-parity emission for records the duplex tensorizer rejected
+    (off-vocabulary flags, duplicate rows, non-4-group members).
+
+    Mirrors what the reference chain would do with them before fgbio:
+    flags {0,99,147} pass through verbatim (tools/1.convert_AG_to_CT.py:
+    70-72); flags {1,83,163} are softclip-trimmed and CT-converted (:73-186,
+    via the scalar oracle transcription, incl. LA/RD tags; CIGAR emitted as
+    one M run of the final length); indel/hardclip conversion candidates
+    and every other flag are dropped (:79-80, no-else). Reads empty after
+    trimming are dropped (they cannot be written as records).
+    """
+    from bsseqconsensusreads_tpu.io.bam import CDEL, CHARD_CLIP, CINS
+    from bsseqconsensusreads_tpu.ops.encode import trim_softclips
+    from bsseqconsensusreads_tpu.utils.oracle import oracle_convert_read
+
+    out: list[BamRecord] = []
+    for rec in leftovers:
+        if rec.flag in _PASS_FLAGS:
+            out.append(rec)
+            continue
+        if rec.flag not in _CONVERT_FLAGS:
+            continue
+        if any(op in (CINS, CDEL, CHARD_CLIP) for op, _ in rec.cigar):
+            continue
+        trimmed = trim_softclips(rec)
+        if trimmed is None or len(trimmed[0]) == 0:
+            continue
+        codes, quals, pos = trimmed
+        seq = codes_to_seq(codes)
+        ws = max(pos - 1, 0)
+        window = ref_fetch(ref_names[rec.ref_id], ws, pos + len(seq) + 2) if (
+            0 <= rec.ref_id < len(ref_names)
+        ) else ""
+        cseq, cquals, cpos, la, rd = oracle_convert_read(
+            seq, [int(q) for q in quals], pos - ws, window
+        )
+        new = BamRecord(
+            qname=rec.qname, flag=rec.flag, ref_id=rec.ref_id,
+            pos=cpos + ws, mapq=rec.mapq, cigar=[(CMATCH, len(cseq))],
+            next_ref_id=rec.next_ref_id, next_pos=rec.next_pos,
+            tlen=rec.tlen, seq=cseq, qual=bytes(int(q) for q in cquals),
+            tags=dict(rec.tags),
+        )
+        new.tags["LA"] = ("i", la)
+        new.tags["RD"] = ("i", rd)
+        out.append(new)
+    return out
+
+
 def call_duplex_batches(
     records: Iterable[BamRecord],
     ref_fetch,
@@ -600,6 +657,7 @@ def call_duplex_batches(
     stats: StageStats | None = None,
     skip_batches: int = 0,
     mesh="auto",
+    passthrough: bool = False,
 ) -> Iterator[list[BamRecord]]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -611,9 +669,13 @@ def call_duplex_batches(
     every group (README.md:9 "not filtered").
 
     Records that cannot be tensorized (flags outside {99,163,83,147},
-    duplicate flags, indel reads) are counted as leftovers and dropped — a
-    documented deviation: the reference would pass some of these through to
-    fgbio (SURVEY.md §7.3).
+    duplicate flags, indel reads) are counted as leftovers. By default they
+    are dropped — a documented deviation: the reference would pass some of
+    these through to fgbio (SURVEY.md §7.3). passthrough=True restores
+    reference parity: such records are written through to the output with
+    the reference's convert-stage treatment (_passthrough_records —
+    pass-through flags verbatim, B-strand flags CT-converted with LA/RD
+    tags, everything else silently dropped like tools/1:69-80).
 
     mesh: 'auto' shards the family axis across all visible devices when
     more than one is present (results identical to single-device — every
@@ -657,8 +719,11 @@ def call_duplex_batches(
             )
         stats.skipped_families += len(skipped)
         stats.leftover_records += len(leftovers)
+        passed: list[BamRecord] = []
+        if passthrough and leftovers:
+            passed = _passthrough_records(leftovers, ref_fetch, ref_names)
         if not batch.meta:
-            yield []
+            yield passed
             continue
         stats.batches += 1
         used = int(batch.cover.sum())
@@ -693,8 +758,20 @@ def call_duplex_batches(
                 tags = _consensus_tags(
                     depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
                 )
-                tags["aD"] = ("i", int(a_depth[fi, role, cov].max()))
-                tags["bD"] = ("i", int(b_depth[fi, role, cov].max()))
+                # fgbio duplex per-strand tag surface (README.md:9 contract;
+                # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
+                # min depth, ad/bd per-base depth arrays. At this stage each
+                # strand contributes its single-strand consensus read, so
+                # per-column strand depth is presence (0/1); the raw-read
+                # depths live in the molecular stage's cD/cd tags upstream.
+                a_cov = a_depth[fi, role, cov]
+                b_cov = b_depth[fi, role, cov]
+                tags["aD"] = ("i", int(a_cov.max()))
+                tags["bD"] = ("i", int(b_cov.max()))
+                tags["aM"] = ("i", int(a_cov.min()))
+                tags["bM"] = ("i", int(b_cov.min()))
+                tags["ad"] = ("B", ("S", [int(v) for v in a_cov]))
+                tags["bd"] = ("B", ("S", [int(v) for v in b_cov]))
                 other = 1 - role
                 tlen = 0
                 if starts[0] >= 0 and starts[1] >= 0:
@@ -720,7 +797,7 @@ def call_duplex_batches(
                     tlen=tlen,
                 ))
                 stats.consensus_out += 1
-        yield emitted
+        yield emitted + passed
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -734,10 +811,11 @@ def call_duplex(
     max_window: int = 4096,
     grouping: str = "gather",
     stats: StageStats | None = None,
+    passthrough: bool = False,
 ) -> Iterator[BamRecord]:
     """Flat-record view of call_duplex_batches (same arguments)."""
     for batch in call_duplex_batches(
         records, ref_fetch, ref_names, params, mode, batch_families,
-        max_window, grouping, stats,
+        max_window, grouping, stats, passthrough=passthrough,
     ):
         yield from batch

@@ -29,7 +29,10 @@ from bsseqconsensusreads_tpu.pipeline.extsort import (
 #: Consensus/UMI tags ZipperBams grafts from the unaligned onto the aligned
 #: record (fgbio semantics: attributes of the source molecule, not the
 #: alignment).
-GRAFT_TAGS = ("MI", "RX", "cD", "cM", "cE", "cd", "ce", "aD", "bD", "aM", "bM")
+GRAFT_TAGS = (
+    "MI", "RX", "cD", "cM", "cE", "cd", "ce",
+    "aD", "bD", "aM", "bM", "ad", "bd",
+)
 
 
 def filter_mapped(records: Iterable[BamRecord]) -> Iterator[BamRecord]:

@@ -115,15 +115,30 @@ class PipelineBuilder:
             # produced under a different kernel would splice divergent bases
             "vote_kernel": os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla"),
         }
+        if stage == "duplex":
+            # changes the duplex record set only — scoping it here keeps a
+            # toggle from discarding unrelated molecular-stage shards
+            fingerprint["passthrough"] = self.cfg.duplex_passthrough
         return BatchCheckpoint(
             rule.outputs[0], header, every=self.cfg.checkpoint_every,
             fingerprint=fingerprint,
         )
 
+    def _pg(self, header: BamHeader, stage: str) -> BamHeader:
+        """@PG provenance line for one stage output (samtools/fgbio both
+        append these on every reference step; SURVEY.md §2.2)."""
+        from bsseqconsensusreads_tpu import __version__
+
+        return header.with_pg(
+            "bsseqconsensusreads_tpu", __version__,
+            f"{stage} sample={self.sample}",
+        )
+
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
-            ck = self._checkpointed("molecular", rule, reader.header)
+            header = self._pg(reader.header, "molecular")
+            ck = self._checkpointed("molecular", rule, header)
             batches = call_molecular_batches(
                 reader,
                 params=self.cfg.molecular,
@@ -135,14 +150,15 @@ class PipelineBuilder:
                 skip_batches=ck.batches_done if ck else 0,
                 indel_policy=self.cfg.indel_policy,
             )
-            self._write_stage_output(batches, rule.outputs[0], reader.header, mode, ck)
+            self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 
     def run_duplex(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("duplex", StageStats())
         fasta = FastaFile(self.cfg.genome_fasta)
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("duplex"):
             names = [n for n, _ in reader.header.references]
-            ck = self._checkpointed("duplex", rule, reader.header)
+            header = self._pg(reader.header, "duplex")
+            ck = self._checkpointed("duplex", rule, header)
             batches = call_duplex_batches(
                 reader,
                 fasta.fetch,
@@ -154,8 +170,9 @@ class PipelineBuilder:
                 grouping=self.cfg.grouping,
                 stats=stats,
                 skip_batches=ck.batches_done if ck else 0,
+                passthrough=self.cfg.duplex_passthrough,
             )
-            self._write_stage_output(batches, rule.outputs[0], reader.header, mode, ck)
+            self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 
     def run_sam_to_fastq(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:
@@ -182,17 +199,19 @@ class PipelineBuilder:
 
     def run_zipper(self, rule) -> None:
         with BamReader(rule.inputs[0]) as aligned, BamReader(rule.inputs[1]) as unaligned:
+            header = self._pg(aligned.header, "zipper")
             merged = zipper_bams_stream(
-                aligned, unaligned, aligned.header,
+                aligned, unaligned, header,
                 workdir=self.cfg.tmp or None,
                 buffer_records=self.cfg.sort_buffer_records,
             )
-            with BamWriter(rule.outputs[0], aligned.header) as writer:
+            with BamWriter(rule.outputs[0], header) as writer:
                 writer.write_all(merged)
 
     def run_filter_mapped(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:
-            with BamWriter(rule.outputs[0], reader.header) as writer:
+            header = self._pg(reader.header, "filter-mapped")
+            with BamWriter(rule.outputs[0], header) as writer:
                 writer.write_all(filter_mapped(reader))
 
     # ---- pipeline assembly --------------------------------------------

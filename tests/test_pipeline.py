@@ -427,3 +427,94 @@ class TestCli:
         err = capsys.readouterr().err
         stats = json.loads(err.strip().splitlines()[-1])
         assert stats["families"] == 12
+
+
+class TestFgbioTagSurfaceAndPG:
+    def test_duplex_tags_and_pg_header(self, pipeline_env):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output_tags")
+        target, _, _ = run_pipeline(cfg, env["bam"], outdir=outdir)
+        with BamReader(target) as r:
+            header, duplex = r.header, list(r)
+        # @PG provenance chain: molecular stage then duplex stage
+        pg = [ln for ln in header.text.splitlines() if ln.startswith("@PG")]
+        assert len(pg) == 2
+        assert all("PN:bsseqconsensusreads_tpu" in ln for ln in pg)
+        assert "PP:" in pg[1] and "PP:" not in pg[0]
+        assert "VN:" in pg[0]
+        # full fgbio duplex per-strand tag surface
+        for d in duplex:
+            for tag in ("cD", "cM", "cE", "cd", "ce",
+                        "aD", "bD", "aM", "bM", "ad", "bd"):
+                assert d.has_tag(tag), tag
+            # both strands present on every column of these clean families
+            assert d.get_tag("aD") == 1 and d.get_tag("bD") == 1
+            assert d.get_tag("aM") == 1 and d.get_tag("bM") == 1
+            kind, ad = d.get_tag("ad")
+            assert kind == "S" and len(ad) == len(d.seq)
+            kind, bd = d.get_tag("bd")
+            assert kind == "S" and len(bd) == len(d.seq)
+
+    def test_pg_chain_unique_ids(self):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader
+
+        h = BamHeader("@HD\tVN:1.6\n", [("c", 10)])
+        h1 = h.with_pg("toolx", "1.0", "step one")
+        h2 = h1.with_pg("toolx", "1.0", "step two")
+        pg = [ln for ln in h2.text.splitlines() if ln.startswith("@PG")]
+        assert len(pg) == 2
+        assert "ID:toolx\t" in pg[0] + "\t"
+        assert "ID:toolx.1" in pg[1]
+        assert "PP:toolx" in pg[1]
+
+
+class TestSamToFastqPairing:
+    def test_orphans_never_desync_pairs(self, tmp_path):
+        """An orphan record must not shift R1/R2 positional pairing
+        (bwameth pairs FASTQ entries by line offset, main.snake.py:93)."""
+        import gzip as _gzip
+
+        from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
+
+        def pair(name, n1=True, n2=True):
+            out = []
+            if n1:
+                out.append(rec(name, 0x1 | 0x40, seq="ACGT"))
+            if n2:
+                out.append(rec(name, 0x1 | 0x80, seq="TTTT"))
+            return out
+
+        records = pair("a") + pair("orphan", n2=False) + pair("b") + pair("c")
+        fq1, fq2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+        n1, n2 = sam_to_fastq(iter(records), fq1, fq2)
+        assert (n1, n2) == (3, 3)
+        names1 = [l.split("/")[0][1:] for l in _gzip.open(fq1, "rt")
+                  if l.startswith("@")]
+        names2 = [l.split("/")[0][1:] for l in _gzip.open(fq2, "rt")
+                  if l.startswith("@")]
+        assert names1 == names2 == ["a", "b", "c"]
+
+    def test_nonadjacent_mates_still_pair(self, tmp_path):
+        import gzip as _gzip
+
+        from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
+
+        records = [
+            rec("x", 0x1 | 0x40, seq="AAAA"),
+            rec("y", 0x1 | 0x40, seq="CCCC"),
+            rec("y", 0x1 | 0x80, seq="GGGG"),
+            rec("x", 0x1 | 0x80, seq="TTTT"),
+        ]
+        fq1, fq2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+        n1, n2 = sam_to_fastq(iter(records), fq1, fq2)
+        assert (n1, n2) == (2, 2)
+        names1 = [l.split("/")[0][1:] for l in _gzip.open(fq1, "rt")
+                  if l.startswith("@")]
+        names2 = [l.split("/")[0][1:] for l in _gzip.open(fq2, "rt")
+                  if l.startswith("@")]
+        assert names1 == names2 == ["y", "x"]
