@@ -91,7 +91,7 @@ def make_store(seed: int = 7) -> RefStore:
     return RefStore(["bench"], codes=codes, lengths=[GENOME_LEN])
 
 
-def bench_tpu(iters: int = 10) -> float:
+def bench_tpu(iters: int = 10, vote_kernel: str = "xla") -> float:
     """Returns raw consensus input reads/sec through the fused duplex stage."""
     store = make_store()
     genome = store.device_codes  # one-time upload, like a real run
@@ -105,7 +105,8 @@ def bench_tpu(iters: int = 10) -> float:
             bases, quals, cover, cmask, elig, starts, limits, qual_mode="auto"
         )
         out = duplex_call_wire_fused(
-            jax.device_put(wire.to_words()), genome, F, W, PARAMS, wire.qual_mode,
+            jax.device_put(wire.to_words()), genome, F, W, PARAMS,
+            wire.qual_mode, vote_kernel=vote_kernel,
         )
         out.copy_to_host_async()
         if prev is not None:
@@ -178,8 +179,20 @@ def _child(backend: str) -> None:
         # dedicated cpu attempt (with its own budget) takes over
         print("device attempt found only the cpu backend", file=sys.stderr)
         raise SystemExit(3)
-    rate = max(bench_tpu(iters=5) for _ in range(2))
-    print(json.dumps({"rate": rate, "backend": jax.default_backend()}))
+    kernels = {"xla": max(bench_tpu(iters=5) for _ in range(2))}
+    if jax.default_backend() != "cpu":
+        # BSSEQ_TPU_VOTE_KERNEL=pallas coverage: the fused Mosaic vote for
+        # the duplex merge. Compiled path only — on the cpu fallback the
+        # kernel would run in interpret mode, a debugging aid not a perf
+        # path. A lowering failure must not cost the bench its xla number.
+        try:
+            kernels["pallas"] = bench_tpu(iters=5, vote_kernel="pallas")
+        except Exception as e:  # noqa: BLE001 — diagnostic, never fatal
+            kernels["pallas_error"] = str(e).replace("\n", " | ")[:300]
+    best = max(v for v in kernels.values() if isinstance(v, float))
+    print(json.dumps(
+        {"rate": best, "backend": jax.default_backend(), "kernels": kernels}
+    ))
 
 
 # (mode, timeout seconds): two bounded tries at the real chip, then the
@@ -253,6 +266,11 @@ def main() -> None:
         out["backend"] = (
             "cpu-fallback" if dev["backend"] == "cpu" else dev["backend"]
         )
+        if "kernels" in dev:
+            out["kernels"] = {
+                k: round(v, 1) if isinstance(v, float) else v
+                for k, v in dev["kernels"].items()
+            }
     else:
         out["backend"] = "none"
         out["error"] = "device benchmark failed on all attempts"

@@ -49,6 +49,13 @@ class FrameworkConfig:
     #: sort/zip step (pipeline.extsort) — the bounded-memory replacement for
     #: the reference's 60-100 GB in-RAM sorts (main.snake.py:106,152).
     sort_buffer_records: int = 100_000
+    #: consensus-stage record ingest: 'native' streams flat columnar arrays
+    #: from the C++ decoder (pipeline.ingest — skips per-record Python
+    #: object construction on the hot path), 'python' uses the pure-Python
+    #: BamReader, 'auto' picks native when the library is built. The duplex
+    #: stage falls back to python ingest under duplex_passthrough (native
+    #: views carry only MI/RX, not the full tag set leftovers must keep).
+    ingest: str = "auto"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)
     #: through to the output the way the reference chain would

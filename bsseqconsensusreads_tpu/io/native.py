@@ -223,9 +223,13 @@ def read_columnar(
     path: str,
     batch_records: int = 1 << 16,
     var_bytes: int = 1 << 25,
-    qname_width: int = 64,
+    qname_width: int = 256,
     tag_width: int = 48,
 ):
+    # qname_width=256 covers the BAM format's hard limit (l_read_name is a
+    # uint8: <=254 chars + NUL), so the parser's clamp can never truncate a
+    # legal qname — truncation would silently merge distinct templates that
+    # share a prefix (encode pairs R1/R2 by qname).
     """Stream a BAM file as ColumnarBatches (header is parsed separately by
     BamReader — this starts from a fresh native stream and skips the header).
 

@@ -80,10 +80,11 @@ def duplex_consensus(bases, quals, params: ConsensusParams = ConsensusParams(min
     return narrow_outputs(out)
 
 
-@partial(jax.jit, static_argnames=("params",))
+@partial(jax.jit, static_argnames=("params", "vote_kernel"))
 def duplex_call_pipeline(
     bases, quals, cover, ref, convert_mask, extend_eligible=None,
     params: ConsensusParams = ConsensusParams(min_reads=0),
+    vote_kernel: str = "xla",
 ):
     """The fused TPU duplex stage: AG->CT conversion -> gap extension ->
     duplex merge, one compiled program per batch shape.
@@ -93,11 +94,24 @@ def duplex_call_pipeline(
     TemplateCoordinate sort is obviated because families are already grouped
     on the family axis. Inputs are DuplexBatch arrays; returns the
     duplex_consensus output dict plus 'la'/'rd' [F, 4] for parity inspection.
+
+    vote_kernel: 'xla' (stock lowering) or 'pallas'
+    (ops.pallas_vote.duplex_consensus_pallas — the fused VMEM-streaming
+    reduction) for the merge step; convert/extend stay XLA either way.
     """
     b, q, c, la, rd = convert_ag_to_ct(bases, quals, cover, ref, convert_mask)
     b, q, c = extend_gap(b, q, c, la, rd, extend_eligible)
     b = jnp.where(c, b, NBASE)
-    out = duplex_consensus(b, q, params)
+    if vote_kernel == "pallas":
+        from bsseqconsensusreads_tpu.ops.pallas_vote import (
+            duplex_consensus_pallas,
+        )
+
+        out = duplex_consensus_pallas(b, q, params)
+    elif vote_kernel == "xla":
+        out = duplex_consensus(b, q, params)
+    else:
+        raise ValueError(f"unknown vote kernel {vote_kernel!r} (want 'xla'|'pallas')")
     out["la"] = la
     out["rd"] = rd
     return out
@@ -153,12 +167,13 @@ def unpack_duplex_outputs(packed, f: int | None = None, w: int | None = None) ->
     }
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode"))
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "vote_kernel"))
 def duplex_call_wire(
     nib, qual, meta, starts, limits, genome,
     f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
+    vote_kernel: str = "xla",
 ):
     """The tunnel-optimal fused duplex stage: ONE flat u32 array each way.
 
@@ -179,18 +194,20 @@ def duplex_call_wire(
     )
     ref = gather_windows(genome, starts, limits, w + 1)
     out = duplex_call_pipeline(
-        bases, quals, cover, ref, convert_mask, eligible, params=params
+        bases, quals, cover, ref, convert_mask, eligible, params=params,
+        vote_kernel=vote_kernel,
     )
     packed = pack_duplex_outputs(out)
     return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r"))
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r", "vote_kernel"))
 def duplex_call_wire_fused(
     words, genome, f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
     r: int = 4,
+    vote_kernel: str = "xla",
 ):
     """duplex_call_wire with ONE u32 input array (DuplexWire.to_words()).
 
@@ -210,7 +227,8 @@ def duplex_call_wire_fused(
         words, f, w, r=r, qual_mode=qual_mode
     )
     return duplex_call_wire(
-        nib, qual, meta, starts, limits, genome, f, w, params, qual_mode
+        nib, qual, meta, starts, limits, genome, f, w, params, qual_mode,
+        vote_kernel,
     )
 
 
@@ -225,10 +243,11 @@ def unpack_duplex_wire_outputs(wire, f: int, w: int) -> dict:
     return out
 
 
-@partial(jax.jit, static_argnames=("params",))
+@partial(jax.jit, static_argnames=("params", "vote_kernel"))
 def duplex_call_pipeline_packed(
     bases, quals, cover, ref, convert_mask, extend_eligible,
     params: ConsensusParams = ConsensusParams(min_reads=0),
+    vote_kernel: str = "xla",
 ):
     """duplex_call_pipeline with per-column outputs packed for one fetch.
 
@@ -236,6 +255,7 @@ def duplex_call_pipeline_packed(
     rd int8 [F, 4]); unpack with unpack_duplex_outputs(packed, f, w).
     """
     out = duplex_call_pipeline(
-        bases, quals, cover, ref, convert_mask, extend_eligible, params=params
+        bases, quals, cover, ref, convert_mask, extend_eligible, params=params,
+        vote_kernel=vote_kernel,
     )
     return pack_duplex_outputs(out), out["la"], out["rd"]

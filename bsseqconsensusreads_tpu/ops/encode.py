@@ -70,20 +70,28 @@ def trim_softclips_keep_indels(
     pos, has_indel). Hardclipped reads still return None (their bases are
     physically absent from the record). Used by indel_policy='align'
     (ops.banded — above-parity recovery of reads the reference drops)."""
-    if any(op == CHARD_CLIP for op, _ in rec.cigar):
+    cigar = rec.cigar
+    if any(op == CHARD_CLIP for op, _ in cigar):
         return None
-    has_indel = any(op in (CINS, CDEL) for op, _ in rec.cigar)
-    codes = seq_to_codes(rec.seq)
-    quals = (
-        np.frombuffer(rec.qual, dtype=np.uint8)
-        if rec.qual is not None
-        else np.zeros(len(rec.seq), dtype=np.uint8)
-    )
+    has_indel = any(op in (CINS, CDEL) for op, _ in cigar)
+    # columnar ingest fast path (pipeline.ingest.ColumnarRecordView): base
+    # codes and quals come straight from the native parser's buffers, no
+    # string round-trip
+    precoded = getattr(rec, "codes_quals", None)
+    if precoded is not None:
+        codes, quals = precoded
+    else:
+        codes = seq_to_codes(rec.seq)
+        quals = (
+            np.frombuffer(rec.qual, dtype=np.uint8)
+            if rec.qual is not None
+            else np.zeros(len(rec.seq), dtype=np.uint8)
+        )
     start, end = 0, len(codes)
-    if rec.cigar and rec.cigar[0][0] == CSOFT_CLIP:
-        start = rec.cigar[0][1]
-    if rec.cigar and rec.cigar[-1][0] == CSOFT_CLIP:
-        end -= rec.cigar[-1][1]
+    if cigar and cigar[0][0] == CSOFT_CLIP:
+        start = cigar[0][1]
+    if cigar and cigar[-1][0] == CSOFT_CLIP:
+        end -= cigar[-1][1]
     return codes[start:end], quals[start:end], rec.pos, has_indel
 
 

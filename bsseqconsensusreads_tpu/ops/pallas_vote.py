@@ -212,3 +212,42 @@ def molecular_consensus_pallas(bases, quals,
     out = column_vote_groups(gb, gq, params, interpret=interpret)
     out = {k: v.reshape(f, 2, w) for k, v in out.items()}
     return narrow_outputs(out)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def duplex_consensus_pallas(bases, quals,
+                            params: ConsensusParams = ConsensusParams(min_reads=0),
+                            interpret: bool | None = None):
+    """Pallas-backed models.duplex.duplex_consensus.
+
+    bases: int8 [F, 4, W] (rows 99/163/83/147), quals uint8/f32 [F, 4, W];
+    returns the same narrowed dict of [F, 2, W] arrays. The duplex merge is
+    the molecular column vote at depth 2 (models/duplex.py _merge), so the
+    same fused kernel serves: duplex R1 votes rows (99, 163), R2 votes
+    (83, 147) — [F*2 groups, 2, W]. The per-strand depth planes (a_depth/
+    b_depth) are cheap elementwise XLA, as in the reference kernel.
+    """
+    from bsseqconsensusreads_tpu.models.duplex import A_ROWS, R1_ROWS, R2_ROWS
+    from bsseqconsensusreads_tpu.models.molecular import narrow_outputs
+
+    f, r, w = bases.shape
+    if r != 4:
+        raise ValueError(f"duplex families have 4 rows, got {r}")
+    quals = quals.astype(jnp.float32)
+    rows = (R1_ROWS, R2_ROWS)
+    gb = jnp.stack([bases[:, rr, :] for rr in rows], axis=1).reshape(f * 2, 2, w)
+    gq = jnp.stack([quals[:, rr, :] for rr in rows], axis=1).reshape(f * 2, 2, w)
+    out = column_vote_groups(gb, gq, params, interpret=interpret)
+    out = {k: v.reshape(f, 2, w) for k, v in out.items()}
+    strand = {}
+    for role, rr in enumerate(rows):
+        a_row, b_row = (rr[0], rr[1]) if rr[0] in A_ROWS else (rr[1], rr[0])
+        for key, row in (("a_depth", a_row), ("b_depth", b_row)):
+            obs = (
+                (bases[:, row, :] != NBASE)
+                & (quals[:, row, :] >= params.min_input_base_quality)
+            ).astype(jnp.int32)
+            strand.setdefault(key, []).append(obs)
+    for key, planes in strand.items():
+        out[key] = jnp.stack(planes, axis=1)  # [F, 2, W]
+    return narrow_outputs(out)

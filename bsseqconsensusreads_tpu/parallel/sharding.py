@@ -54,7 +54,9 @@ def sharded_molecular_consensus(
 
 @functools.lru_cache(maxsize=64)
 def sharded_duplex_packed(
-    mesh: Mesh, params: ConsensusParams = ConsensusParams(min_reads=0)
+    mesh: Mesh,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    vote_kernel: str = "xla",
 ):
     """duplex_call_pipeline_packed (the production fused duplex stage with
     packed transport outputs) sharded over families — what
@@ -62,16 +64,19 @@ def sharded_duplex_packed(
     backend. Returns (packed, la, rd), all family-sharded."""
     spec = P(DATA_AXIS)
 
+    # check_vma=False: collective-free map; pallas_call outputs carry no
+    # vma metadata for the checker (same rationale as the molecular wrap)
     @jax.jit
     @jax.shard_map(
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=(spec, spec, spec),
+        check_vma=False,
     )
     def fn(bases, quals, cover, ref, convert_mask, extend_eligible):
         return duplex_call_pipeline_packed(
             bases, quals, cover, ref, convert_mask, extend_eligible,
-            params=params,
+            params=params, vote_kernel=vote_kernel,
         )
 
     return fn

@@ -250,27 +250,55 @@ def stream_mi_groups(
     open_groups: dict[str, list[BamRecord]] = {}
     group_end: dict[str, tuple[int, int]] = {}  # mi -> (ref_id, max end)
     flushed: set[str] = set()
+    # Sweeping every open group per record is O(records x open_groups) —
+    # the profile showed it dominating ingest. Sweep only after the stream
+    # advances a fraction of the margin (or changes contig): same flush
+    # rule at sweep time, amortized cost, groups just linger marginally
+    # longer within the same bounded envelope.
+    sweep_stride = max(flush_margin // 4, 1)
+    last_sweep = (-1, -(1 << 62))
     for rec in records:
         if stats is not None:
             stats.records_in += 1
         mi = mi_of(rec)
-        if rec.pos >= 0 and open_groups:
+        pos = rec.pos
+        ref_id = rec.ref_id
+        if (
+            pos >= 0
+            and open_groups
+            and (ref_id != last_sweep[0] or pos - last_sweep[1] >= sweep_stride)
+        ):
             done = [
                 g
                 for g, (rid, end) in group_end.items()
-                if rid != rec.ref_id or end + flush_margin < rec.pos
+                if rid != ref_id or end + flush_margin < pos
             ]
             for g in done:
                 yield g, open_groups.pop(g)
                 del group_end[g]
                 flushed.add(g)
+            last_sweep = (ref_id, pos)
         if mi in flushed and mi not in open_groups and stats is not None:
             stats.refragmented_families += 1
         open_groups.setdefault(mi, []).append(rec)
-        if rec.pos >= 0:
-            rid, end = group_end.get(mi, (rec.ref_id, -1))
-            group_end[mi] = (rec.ref_id, max(end, rec.reference_end))
+        if pos >= 0:
+            rid, end = group_end.get(mi, (ref_id, -1))
+            group_end[mi] = (ref_id, max(end, rec.reference_end))
     yield from open_groups.items()
+
+
+def _timed_groups(groups, metrics: "observe.Metrics"):
+    """Accumulate the time spent pulling groups — record decode + MI
+    grouping, i.e. the ingest phase — under metrics 'ingest'. records/sec
+    for the phase is records_in / ingest_seconds (the VERDICT-mandated
+    before/after measurement for the columnar decoder)."""
+    while True:
+        with metrics.timed("ingest"):
+            try:
+                item = next(groups)
+            except StopIteration:
+                return
+        yield item
 
 
 def _group_batches(
@@ -515,7 +543,10 @@ def call_molecular_batches(
         out = deep_state["fn"](b, q)
         return {k: np.asarray(v) for k, v in out.items()}
 
-    groups = stream_mi_groups(records, grouping=grouping, stats=stats)
+    groups = _timed_groups(
+        stream_mi_groups(records, grouping=grouping, stats=stats),
+        stats.metrics,
+    )
     batch_index = 0
     for chunk in _group_batches(groups, batch_families):
         batch_index += 1
@@ -658,6 +689,7 @@ def call_duplex_batches(
     skip_batches: int = 0,
     mesh="auto",
     passthrough: bool = False,
+    vote_kernel: str | None = None,
 ) -> Iterator[list[BamRecord]]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -681,7 +713,10 @@ def call_duplex_batches(
     more than one is present (results identical to single-device — every
     family is computed whole on one device); None forces single-device.
     """
+    import os
+
     stats = stats if stats is not None else StageStats()
+    kernel = vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
     sharded_fn = None
@@ -690,7 +725,7 @@ def call_duplex_batches(
         from bsseqconsensusreads_tpu.parallel.sharding import sharded_duplex_packed
 
         data_size = mesh.shape[DATA_AXIS]
-        sharded_fn = sharded_duplex_packed(mesh, params)
+        sharded_fn = sharded_duplex_packed(mesh, params, vote_kernel=kernel)
 
     def run_kernel(batch):
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
@@ -699,7 +734,9 @@ def call_duplex_batches(
             batch.convert_mask, batch.extend_eligible,
         )
         if sharded_fn is None:
-            packed, _la, _rd = duplex_call_pipeline_packed(*arrays, params=params)
+            packed, _la, _rd = duplex_call_pipeline_packed(
+                *arrays, params=params, vote_kernel=kernel
+            )
             pf = f
         else:
             padded, pf = pad_families(arrays, f, data_size)
@@ -707,7 +744,12 @@ def call_duplex_batches(
         out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
         return {k: v[:f] for k, v in out.items()}
 
-    groups = stream_mi_groups(records, strip_suffix=True, grouping=grouping, stats=stats)
+    groups = _timed_groups(
+        stream_mi_groups(
+            records, strip_suffix=True, grouping=grouping, stats=stats
+        ),
+        stats.metrics,
+    )
     batch_index = 0
     for chunk in _group_batches(groups, batch_families):
         batch_index += 1

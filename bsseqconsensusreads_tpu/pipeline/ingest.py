@@ -1,0 +1,173 @@
+"""Columnar ingest: feed the encoders from the native C++ decoder.
+
+The per-record Python path (io.bam.decode_record) builds a full BamRecord —
+qname/cigar/tags dicts — for every read; on a 100M-read input that Python
+object churn bounds the encode phase. The native parser
+(native/bamio.cpp, io.native.read_columnar) decodes the alignment stream
+into flat numpy arrays in C; this module exposes those rows through
+ColumnarRecordView, a lazy per-record facade with the exact attribute
+surface the group streamer and encoders touch (flag/pos/cigar/tags/...),
+plus a precoded (codes, quals) fast path that ops.encode uses to skip the
+string round-trip entirely.
+
+The replaced capability is pysam's C-backed record iteration
+(reference tools iterate AlignmentFile, tools/2.extend_gap.py:158) —
+this is the framework's equivalent of htslib feeding the Python layer.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.io import native
+from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+
+_CIGAR_CACHE_MAX = 1 << 4  # ops per record before falling back to a list
+
+
+class ColumnarRecordView:
+    """One record of a ColumnarBatch with BamRecord's read-side surface.
+
+    Lazy: nothing is decoded until touched; `codes_quals` hands the
+    encoders numpy slices straight out of the C parser's buffers.
+    """
+
+    __slots__ = ("_b", "_i", "_cigar")
+
+    def __init__(self, batch, i: int):
+        self._b = batch
+        self._i = i
+        self._cigar = None
+
+    # --- fixed fields ------------------------------------------------------
+
+    @property
+    def flag(self) -> int:
+        return int(self._b.flag[self._i])
+
+    @property
+    def ref_id(self) -> int:
+        return int(self._b.ref_id[self._i])
+
+    @property
+    def pos(self) -> int:
+        return int(self._b.pos[self._i])
+
+    @property
+    def mapq(self) -> int:
+        return int(self._b.mapq[self._i])
+
+    @property
+    def next_ref_id(self) -> int:
+        return int(self._b.next_ref[self._i])
+
+    @property
+    def next_pos(self) -> int:
+        return int(self._b.next_pos[self._i])
+
+    @property
+    def tlen(self) -> int:
+        return int(self._b.tlen[self._i])
+
+    @property
+    def qname(self) -> str:
+        raw = self._b.qname[self._i]
+        return raw.rstrip(b"\x00").decode("ascii", "replace")
+
+    # --- cigar -------------------------------------------------------------
+
+    @property
+    def cigar(self) -> list[tuple[int, int]]:
+        if self._cigar is None:
+            i = self._i
+            off = int(self._b.cigar_off[i])
+            n = int(self._b.n_cigar[i])
+            ops = self._b.cigar[off : off + n]
+            self._cigar = [(int(v & 0xF), int(v >> 4)) for v in ops]
+        return self._cigar
+
+    @property
+    def reference_end(self) -> int:
+        # M/D/N/=/X consume reference (io.bam.BamRecord.reference_end)
+        span = sum(n for op, n in self.cigar if op in (0, 2, 3, 7, 8))
+        return self.pos + span
+
+    # --- sequence ----------------------------------------------------------
+
+    @property
+    def codes_quals(self):
+        """(codes int8[L], quals uint8[L]) views into the parser buffers —
+        the encoder fast path (ops.encode.trim_softclips_keep_indels).
+        Missing qualities (BAM 0xFF fill, '*') become zeros, matching the
+        BamRecord path's qual=None -> zeros substitution — 0xFF fed raw
+        would vote every base at Phred 255."""
+        i = self._i
+        off = int(self._b.var_off[i])
+        l_seq = int(self._b.l_seq[i])
+        quals = self._b.qual[off : off + l_seq]
+        if l_seq and quals[0] == 0xFF:
+            quals = np.zeros(l_seq, dtype=np.uint8)
+        return self._b.seq[off : off + l_seq].view("int8"), quals
+
+    @property
+    def seq(self) -> str:
+        return codes_to_seq(self.codes_quals[0])
+
+    @property
+    def qual(self) -> bytes | None:
+        """Raw Phred bytes, or None when the record has no qualities
+        (io.bam.decode_record parity: first byte 0xFF means missing)."""
+        i = self._i
+        off = int(self._b.var_off[i])
+        l_seq = int(self._b.l_seq[i])
+        raw = self._b.qual[off : off + l_seq]
+        if l_seq == 0 or raw[0] == 0xFF:
+            return None
+        return bytes(raw)
+
+    # --- tags (MI/RX are the only tags the hot path reads) -----------------
+
+    def _tag(self, name: str) -> str | None:
+        if name == "MI":
+            raw = self._b.mi[self._i]
+        elif name == "RX":
+            raw = self._b.rx[self._i]
+        else:
+            return None
+        s = raw.rstrip(b"\x00")
+        return s.decode("ascii", "replace") if s else None
+
+    def has_tag(self, name: str) -> bool:
+        return self._tag(name) is not None
+
+    def get_tag(self, name: str):
+        v = self._tag(name)
+        if v is None:
+            raise KeyError(name)
+        return v
+
+    @property
+    def tags(self) -> dict:
+        out = {}
+        for name in ("MI", "RX"):
+            v = self._tag(name)
+            if v is not None:
+                out[name] = ("Z", v)
+        return out
+
+
+def columnar_records(path: str, batch_records: int = 1 << 16) -> Iterator[ColumnarRecordView]:
+    """Stream a BAM file as ColumnarRecordViews via the native decoder.
+    Views of one batch stay valid while any of them is referenced (they
+    pin the batch's arrays); the group streamer's bounded buffering keeps
+    at most a couple of batches alive."""
+    for batch in native.read_columnar(path, batch_records=batch_records):
+        for i in range(batch.n):
+            yield ColumnarRecordView(batch, i)
+
+
+def available() -> bool:
+    """True when the native decoder is built and loadable."""
+    return native.available()

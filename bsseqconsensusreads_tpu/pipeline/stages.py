@@ -124,6 +124,33 @@ class PipelineBuilder:
             fingerprint=fingerprint,
         )
 
+    def _ingest_records(self, path: str, reader, stats: StageStats,
+                        allow_native: bool = True):
+        """Record stream for a consensus stage: the native columnar decoder
+        (pipeline.ingest) when configured+built, else the BamReader. The
+        chosen engine lands in stats.metrics ('ingest_native' counter) so
+        the ingest-phase records/sec (records_in / ingest_seconds) is
+        attributable."""
+        from bsseqconsensusreads_tpu.pipeline import ingest
+
+        choice = self.cfg.ingest
+        if choice not in ("auto", "native", "python"):
+            raise WorkflowError(f"unknown ingest {choice!r}")
+        # 'gather' grouping would pin every columnar batch's buffers for
+        # the whole file; only the streaming groupings keep ingest bounded
+        allow_native = allow_native and self.cfg.grouping != "gather"
+        use_native = allow_native and (
+            choice == "native"
+            or (choice == "auto" and ingest.available())
+        )
+        if use_native and not ingest.available():
+            raise WorkflowError(
+                "ingest 'native' requested but the native decoder is not "
+                "built (make -C native)"
+            )
+        stats.metrics.count("ingest_native", int(use_native))
+        return ingest.columnar_records(path) if use_native else reader
+
     def _pg(self, header: BamHeader, stage: str) -> BamHeader:
         """@PG provenance line for one stage output (samtools/fgbio both
         append these on every reference step; SURVEY.md §2.2)."""
@@ -140,7 +167,7 @@ class PipelineBuilder:
             header = self._pg(reader.header, "molecular")
             ck = self._checkpointed("molecular", rule, header)
             batches = call_molecular_batches(
-                reader,
+                self._ingest_records(rule.inputs[0], reader, stats),
                 params=self.cfg.molecular,
                 mode=mode,
                 batch_families=self.cfg.batch_families,
@@ -160,7 +187,12 @@ class PipelineBuilder:
             header = self._pg(reader.header, "duplex")
             ck = self._checkpointed("duplex", rule, header)
             batches = call_duplex_batches(
-                reader,
+                self._ingest_records(
+                    rule.inputs[0], reader, stats,
+                    # leftovers written through must keep their full tag
+                    # set; native views carry only MI/RX
+                    allow_native=not self.cfg.duplex_passthrough,
+                ),
                 fasta.fetch,
                 names,
                 params=self.cfg.duplex,

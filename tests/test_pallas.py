@@ -131,3 +131,61 @@ def test_molecular_pallas_matches_xla(rng, f, t, w):
             got_r = {k: np.asarray(got[k])[fi, role] for k in got}
             tie = _tie_columns(cb[fi, :, role], cq[fi, :, role], params)
             _assert_vote_matches(got_r, want_r, tie, tag=f"[{fi},{role}]")
+
+
+@pytest.mark.parametrize("f,w", [(5, 64), (11, 130)])
+def test_duplex_pallas_matches_xla(rng, f, w):
+    """duplex_consensus_pallas vs models.duplex.duplex_consensus: same
+    tie-aware comparison as the molecular kernel (duplex depth is 2, so
+    disagreeing strands of equal quality tie by construction)."""
+    from bsseqconsensusreads_tpu.models.duplex import duplex_consensus
+    from bsseqconsensusreads_tpu.ops.pallas_vote import duplex_consensus_pallas
+
+    bases, quals = _random_groups(rng, f, 4, w)
+    params = ConsensusParams(min_reads=0)
+    got = duplex_consensus_pallas(bases, quals, params, interpret=True)
+    want = duplex_consensus(bases, quals, params)
+    pair_rows = ((0, 1), (2, 3))
+    for fi in range(f):
+        for role, rows in enumerate(pair_rows):
+            tie = _tie_columns(bases[fi, list(rows)], quals[fi, list(rows)], params)
+            _assert_vote_matches(
+                {k: np.asarray(got[k])[fi, role] for k in
+                 ("base", "qual", "depth", "errors")},
+                {k: np.asarray(want[k])[fi, role] for k in
+                 ("base", "qual", "depth", "errors")},
+                tie, tag=f"[{fi},{role}]",
+            )
+    for k in ("a_depth", "b_depth"):
+        np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+
+
+def test_duplex_pipeline_pallas_kernel_end_to_end(rng):
+    """The fused duplex pipeline with vote_kernel='pallas' agrees with the
+    xla kernel on real (non-tie-heavy) duplex family windows."""
+    from bsseqconsensusreads_tpu.models.duplex import duplex_call_pipeline
+
+    f, w = 6, 96
+    bases = rng.integers(0, 4, size=(f, 4, w)).astype(np.int8)
+    cover = np.zeros((f, 4, w), dtype=bool)
+    cover[:, :, 4 : w - 4] = True
+    bases[~cover] = NBASE
+    # identical strand pairs: no vote ties, exact agreement expected
+    bases[:, 1] = bases[:, 0]
+    bases[:, 3] = bases[:, 2]
+    quals = np.where(cover, rng.integers(10, 41, size=(f, 4, w)), 0).astype(np.float32)
+    ref = rng.integers(0, 4, size=(f, w + 1)).astype(np.int8)
+    cmask = np.zeros((f, 4), dtype=bool)
+    cmask[:, 1] = cmask[:, 2] = True
+    elig = np.ones(f, dtype=bool)
+    params = ConsensusParams(min_reads=0)
+    out_x = duplex_call_pipeline(bases, quals, cover, ref, cmask, elig,
+                                 params=params, vote_kernel="xla")
+    out_p = duplex_call_pipeline(bases, quals, cover, ref, cmask, elig,
+                                 params=params, vote_kernel="pallas")
+    for k in ("base", "depth", "errors", "a_depth", "b_depth", "la", "rd"):
+        np.testing.assert_array_equal(
+            np.asarray(out_x[k]), np.asarray(out_p[k]), err_msg=k
+        )
+    assert (np.abs(np.asarray(out_x["qual"]).astype(int)
+                   - np.asarray(out_p["qual"]).astype(int)) <= 1).all()
