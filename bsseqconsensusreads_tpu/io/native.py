@@ -266,9 +266,11 @@ def read_columnar(
             var_off = np.empty(n, np.int64)
             cigar = np.empty(var_bytes // 16, np.uint32)
             cigar_off = np.empty(n, np.int64)
-            qname = C.create_string_buffer(n * qname_width)
-            mi = C.create_string_buffer(n * tag_width)
-            rx = C.create_string_buffer(n * tag_width)
+            # calloc-backed numpy buffers: create_string_buffer would memset
+            # ~20 MB per batch eagerly, dominating small files
+            qname = np.zeros(n * qname_width, np.uint8)
+            mi = np.zeros(n * tag_width, np.uint8)
+            rx = np.zeros(n * tag_width, np.uint8)
             got = _lib.bamio_parse_records(
                 r._h, n,
                 *(a.ctypes.data_as(C.c_void_p) for a in (
@@ -283,15 +285,17 @@ def read_columnar(
                 cigar.ctypes.data_as(C.c_void_p),
                 var_bytes // 16,
                 cigar_off.ctypes.data_as(C.c_void_p),
-                qname, qname_width, mi, tag_width, rx, tag_width,
+                qname.ctypes.data_as(C.c_char_p), qname_width,
+                mi.ctypes.data_as(C.c_char_p), tag_width,
+                rx.ctypes.data_as(C.c_char_p), tag_width,
             )
             if got < 0:
                 raise IOError(_lib.bamio_error(r._h).decode())
             if got == 0:
                 return
-            qn = np.frombuffer(qname.raw, dtype=f"S{qname_width}", count=got)
-            mis = np.frombuffer(mi.raw, dtype=f"S{tag_width}", count=got)
-            rxs = np.frombuffer(rx.raw, dtype=f"S{tag_width}", count=got)
+            qn = qname.view(f"S{qname_width}")[:got]
+            mis = mi.view(f"S{tag_width}")[:got]
+            rxs = rx.view(f"S{tag_width}")[:got]
             yield ColumnarBatch(
                 int(got),
                 **{k: v[:got] for k, v in fixed.items()},
