@@ -91,37 +91,51 @@ def make_store(seed: int = 7) -> RefStore:
     return RefStore(["bench"], codes=codes, lengths=[GENOME_LEN])
 
 
-def bench_tpu(iters: int = 10, vote_kernel: str = "xla") -> float:
-    """Returns raw consensus input reads/sec through the fused duplex stage."""
+def bench_tpu(iters: int = 10, vote_kernel: str = "xla", f: int = F) -> float:
+    """Returns raw consensus input reads/sec through the fused duplex stage.
+
+    The loop is a depth-2 software pipeline: each iteration packs + submits
+    a batch and requests its D2H copy, then retires the batch submitted two
+    iterations earlier. With two output transfers in flight the tunnel's
+    per-fetch fixed cost overlaps the previous fetch's bandwidth phase, and
+    all host pack/unpack work (native/wirepack.cpp) hides under the D2H —
+    steady-state throughput is bounded by the tunnel's D2H bandwidth alone,
+    which is what the planar output layout (models/duplex.py) minimizes.
+    """
+    from collections import deque
+
     store = make_store()
     genome = store.device_codes  # one-time upload, like a real run
-    bases, quals, cover, cmask, elig, wstarts = make_batch(F)
-    starts, limits = store.window_offsets(np.zeros(F, dtype=int), wstarts)
+    bases, quals, cover, cmask, elig, wstarts = make_batch(f)
+    starts, limits = store.window_offsets(np.zeros(f, dtype=int), wstarts)
 
-    def run(prev):
+    def submit():
         # host pack (timed: it is real per-batch work); ONE H2D transfer.
         # RTA3's 4 qual levels auto-select the q2 codebook: 2 bits/qual.
         wire = pack_duplex_inputs(
             bases, quals, cover, cmask, elig, starts, limits, qual_mode="auto"
         )
         out = duplex_call_wire_fused(
-            jax.device_put(wire.to_words()), genome, F, W, PARAMS,
+            jax.device_put(wire.to_words()), genome, f, W, PARAMS,
             wire.qual_mode, vote_kernel=vote_kernel,
         )
         out.copy_to_host_async()
-        if prev is not None:
-            unpack_duplex_wire_outputs(jax.device_get(prev), f=F, w=W)
         return out
 
-    prev = run(None)  # warmup/compile
-    jax.device_get(prev)
+    def retire(out):
+        unpack_duplex_wire_outputs(jax.device_get(out), f=f, w=W)
+
+    retire(submit())  # warmup/compile
+    inflight: deque = deque()
     t0 = time.monotonic()
-    prev = None
     for _ in range(iters):
-        prev = run(prev)
-    unpack_duplex_wire_outputs(jax.device_get(prev), f=F, w=W)
+        inflight.append(submit())
+        if len(inflight) > 2:
+            retire(inflight.popleft())
+    while inflight:
+        retire(inflight.popleft())
     dt = time.monotonic() - t0
-    return F * READS_PER_FAMILY * iters / dt
+    return f * READS_PER_FAMILY * iters / dt
 
 
 def bench_oracle(n_families: int = 150) -> float:
@@ -181,6 +195,12 @@ def _child(backend: str) -> None:
         raise SystemExit(3)
     kernels = {"xla": max(bench_tpu(iters=5) for _ in range(2))}
     if jax.default_backend() != "cpu":
+        # Larger batches amortize the tunnel's fixed per-transfer cost;
+        # probe 2F and keep whichever the hardware prefers.
+        try:
+            kernels["xla_2f"] = bench_tpu(iters=5, f=2 * F)
+        except Exception as e:  # noqa: BLE001 — diagnostic, never fatal
+            kernels["xla_2f_error"] = str(e).replace("\n", " | ")[:300]
         # BSSEQ_TPU_VOTE_KERNEL=pallas coverage: the fused Mosaic vote for
         # the duplex merge. Compiled path only — on the cpu fallback the
         # kernel would run in interpret mode, a debugging aid not a perf

@@ -1,0 +1,52 @@
+"""Shared build-on-first-use loader for the native C++ libraries.
+
+Both io.native (libbamio) and io.wirepack (libwirepack) need the same
+scaffold: locate the .so under native/, build its explicit make target if
+missing (so one library's compile failure can't block the other), load it
+with ctypes, and degrade gracefully when no compiler exists. This module
+holds that logic once.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+NATIVE_DIR = os.path.join(REPO_ROOT, "native")
+
+
+def load_library(
+    so_name: str,
+    source_name: str,
+    env_flag: str | None = None,
+) -> tuple[C.CDLL | None, str | None]:
+    """Load native/<so_name>, building `make <so_name>` on first use.
+
+    Returns (lib, None) on success or (None, reason) on any failure —
+    callers cache both outcomes. env_flag names an environment variable
+    that disables the library when set to "0".
+    """
+    if env_flag and os.environ.get(env_flag, "1") == "0":
+        return None, f"disabled via {env_flag}=0"
+    so_path = os.path.join(NATIVE_DIR, so_name)
+    if not os.path.exists(so_path):
+        if os.path.exists(os.path.join(NATIVE_DIR, source_name)):
+            try:
+                subprocess.run(
+                    ["make", "-C", NATIVE_DIR, so_name],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception as e:  # no compiler / make failure
+                return None, f"native build failed: {e}"
+        else:
+            return None, "native sources not found"
+    try:
+        return C.CDLL(so_path), None
+    except OSError as e:
+        return None, f"cannot load {so_path}: {e}"

@@ -9,15 +9,10 @@ degrade gracefully.
 from __future__ import annotations
 
 import ctypes as C
-import os
-import subprocess
 
 import numpy as np
 
-_REPO_ROOT = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-_SO_PATH = os.path.join(_REPO_ROOT, "native", "libbamio.so")
+from bsseqconsensusreads_tpu.io._nativelib import load_library
 
 _lib = None
 _load_error: str | None = None
@@ -27,26 +22,8 @@ def _try_load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:
         return
-    if not os.path.exists(_SO_PATH):
-        src_dir = os.path.dirname(_SO_PATH)
-        if os.path.exists(os.path.join(src_dir, "bamio.cpp")):
-            try:
-                subprocess.run(
-                    ["make", "-C", src_dir],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception as e:  # no compiler / make failure
-                _load_error = f"native build failed: {e}"
-                return
-        else:
-            _load_error = "native sources not found"
-            return
-    try:
-        lib = C.CDLL(_SO_PATH)
-    except OSError as e:
-        _load_error = f"cannot load {_SO_PATH}: {e}"
+    lib, _load_error = load_library("libbamio.so", "bamio.cpp")
+    if lib is None:
         return
     lib.bamio_open.restype = C.c_void_p
     lib.bamio_open.argtypes = [C.c_char_p, C.c_char_p, C.c_int]

@@ -1,0 +1,151 @@
+"""ctypes bindings for the native wire packer (native/wirepack.cpp).
+
+The duplex tunnel stage's host time is dominated by the numpy input pack
+(~130 ms/batch at F=16384: codebook detection + 2-bit index packing over
+~10M cells) and output unpack (~20 ms). The C++ sweep does the same work
+byte-for-byte in single-digit milliseconds, so host serialization stops
+competing with the device transfer for wall clock.
+
+Same loading contract as io.native: build on first use, degrade to the
+numpy implementations in ops.wire / models.duplex when no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.io._nativelib import load_library
+
+_lib = None
+_load_error: str | None = None
+
+# Error codes from native/wirepack.cpp.
+_ERR_TOO_MANY_LEVELS = -2
+_ERR_QUAL_TOO_HIGH = -3
+
+
+def _try_load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return
+    lib, _load_error = load_library(
+        "libwirepack.so", "wirepack.cpp", env_flag="BSSEQ_TPU_NATIVE_WIRE"
+    )
+    if lib is None:
+        return
+    lib.wirepack_pack_duplex.restype = C.c_int
+    lib.wirepack_pack_duplex.argtypes = [
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_int64, C.c_int64, C.c_int64, C.c_int,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+    ]
+    lib.wirepack_unpack_duplex_outputs.restype = None
+    lib.wirepack_unpack_duplex_outputs.argtypes = [
+        C.c_void_p, C.c_int64, C.c_int64,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p,
+    ]
+    _lib = lib
+
+
+def available() -> bool:
+    _try_load()
+    return _lib is not None
+
+
+def load_error() -> str | None:
+    _try_load()
+    return _load_error
+
+
+_MODE_BITS = {"q8": 8, "q4": 4, "q2": 2, "auto": 0}
+_BITS_MODE = {8: "q8", 4: "q4", 2: "q2"}
+
+
+def pack_duplex(bases, quals, cover, convert_mask, eligible, qual_mode):
+    """Native pack of a duplex batch -> (nib, qual, meta u32 arrays, mode).
+
+    Inputs as ops.wire.pack_duplex_inputs; returns the three packed wire
+    sections plus the resolved qual mode. Raises the same ValueErrors as the
+    numpy path for codebook overflow / out-of-range quals.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    f, r, w = bases.shape
+    cells = f * r * w
+    bases = np.ascontiguousarray(bases, dtype=np.int8)
+    quals = np.ascontiguousarray(quals, dtype=np.uint8)
+    cover = np.ascontiguousarray(cover, dtype=np.uint8)
+    cmask = np.ascontiguousarray(convert_mask, dtype=np.uint8)
+    elig = np.ascontiguousarray(eligible, dtype=np.uint8)
+    nib = np.empty((cells // 2 + 3) // 4 * 4, dtype=np.uint8)
+    meta = np.empty((f + 3) // 4 * 4, dtype=np.uint8)
+    qual = np.empty(cells + 24, dtype=np.uint8)
+    qual_len = C.c_int64(0)
+    nlevels = C.c_int(0)
+    bits = _lib.wirepack_pack_duplex(
+        bases.ctypes.data_as(C.c_void_p),
+        quals.ctypes.data_as(C.c_void_p),
+        cover.ctypes.data_as(C.c_void_p),
+        cmask.ctypes.data_as(C.c_void_p),
+        elig.ctypes.data_as(C.c_void_p),
+        f, r, w, _MODE_BITS[qual_mode],
+        nib.ctypes.data_as(C.c_void_p),
+        meta.ctypes.data_as(C.c_void_p),
+        qual.ctypes.data_as(C.c_void_p),
+        C.byref(qual_len),
+        C.byref(nlevels),
+    )
+    if bits == _ERR_QUAL_TOO_HIGH:
+        raise ValueError(
+            "covered qual > 93 (BAM printable max) cannot ride a "
+            f"{qual_mode} codebook; use qual_mode='q8' or 'auto'"
+        )
+    if bits == _ERR_TOO_MANY_LEVELS:
+        raise ValueError(
+            f"{nlevels.value} distinct covered quals exceed {qual_mode}'s "
+            f"{1 << _MODE_BITS[qual_mode]}-entry codebook; use "
+            "qual_mode='auto'"
+        )
+    if bits < 0:
+        raise ValueError(f"native wirepack error {bits}")
+    # zero the nib/meta word padding the C side never touches
+    nib[cells // 2 :] = 0
+    meta[f:] = 0
+    return (
+        nib.view(np.uint32),
+        qual[: qual_len.value].view(np.uint32).copy(),
+        meta.view(np.uint32),
+        _BITS_MODE[bits],
+    )
+
+
+def unpack_duplex_outputs(wire_u8: np.ndarray, f: int, w: int) -> dict:
+    """Native unpack of the family-major planar output wire ([f, 4, w] u8:
+    b0 planes then qual planes per family) -> dict of [f, 2, w] arrays."""
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    cols = f * 2 * w
+    wire_u8 = np.ascontiguousarray(wire_u8[: 2 * cols], dtype=np.uint8)
+    out = {
+        "base": np.empty(cols, np.int8),
+        "qual": np.empty(cols, np.uint8),
+        "depth": np.empty(cols, np.int16),
+        "errors": np.empty(cols, np.int16),
+        "a_depth": np.empty(cols, np.int8),
+        "b_depth": np.empty(cols, np.int8),
+    }
+    _lib.wirepack_unpack_duplex_outputs(
+        wire_u8.ctypes.data_as(C.c_void_p), f, w,
+        out["base"].ctypes.data_as(C.c_void_p),
+        out["qual"].ctypes.data_as(C.c_void_p),
+        out["depth"].ctypes.data_as(C.c_void_p),
+        out["errors"].ctypes.data_as(C.c_void_p),
+        out["a_depth"].ctypes.data_as(C.c_void_p),
+        out["b_depth"].ctypes.data_as(C.c_void_p),
+    )
+    return {k: v.reshape(f, 2, w) for k, v in out.items()}

@@ -1,0 +1,194 @@
+// Native hot path for the host<->device wire formats (ops/wire.py).
+//
+// The tunnel-bound duplex stage moves ~10M cells per batch each way; the
+// numpy pack (nibble merge + qual codebook detection + 2-bit index packing)
+// costs ~130 ms/batch and the output unpack ~20 ms — all host time that
+// serializes with the device transfer. This file is the single-sweep C++
+// equivalent: one pass builds the nibble plane, the covered-qual histogram,
+// and the meta bytes; a second pass (codebook modes) emits the packed qual
+// indices. Byte-for-byte identical to the numpy reference implementation in
+// bsseqconsensusreads_tpu/ops/wire.py (tests/test_wirepack.py asserts it).
+//
+// Role in the reference design: the reference serializes between stages via
+// BAM files and pysam/htslib C loops (SURVEY.md section 3.1); this is the
+// TPU framework's equivalent native serialization layer, sized for the
+// device tunnel instead of the filesystem.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// Error codes mirrored by the Python wrapper (io/wirepack.py).
+constexpr int kErrTooManyLevels = -2;  // explicit mode, levels overflow book
+constexpr int kErrQualTooHigh = -3;    // covered qual > 93 (BAM printable max)
+constexpr int kErrBadMode = -4;
+
+inline int resolve_auto(int nlevels, bool has_255, int max_level) {
+  if (nlevels > 16 || has_255 || max_level > 93) return 8;
+  return nlevels <= 4 ? 2 : 4;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack the duplex input batch. Arrays are C-contiguous:
+//   bases  int8  [f*r*w]   (framework codes, NBASE=4 where uncovered)
+//   quals  uint8 [f*r*w]
+//   cover  uint8 [f*r*w]   (0/1)
+//   cmask  uint8 [f*r]     (0/1 convert_mask rows)
+//   elig   uint8 [f]       (0/1 extend_eligible)
+// mode: 8 (raw), 4, 2, or 0 = auto (smallest codebook that fits).
+// Outputs:
+//   nib_out  uint8 [cells/2]           cell0 low nibble, cell1 high
+//   meta_out uint8 [f]                 cmask bits 0..3 | elig << 4
+//   qual_out uint8 [>= cells + 16]     q8: raw bytes; q2/q4: codebook
+//            (2^bits bytes) ++ packed indices, zero-padded to u32 words
+//   qual_len_out -> bytes written to qual_out (word-aligned)
+//   nlevels_out  -> distinct covered qual values found (0 if q8 fast path)
+// Returns resolved bits (8/4/2) or a negative error code.
+int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
+                         const uint8_t* cover, const uint8_t* cmask,
+                         const uint8_t* elig, int64_t f, int64_t r, int64_t w,
+                         int mode, uint8_t* nib_out, uint8_t* meta_out,
+                         uint8_t* qual_out, int64_t* qual_len_out,
+                         int* nlevels_out) {
+  if (mode != 0 && mode != 2 && mode != 4 && mode != 8) return kErrBadMode;
+  const int64_t cells = f * r * w;
+  const int64_t rows4 = r < 4 ? r : 4;
+
+  // Sweep 1: nibble plane + covered-qual histogram (skipped for plain q8,
+  // where levels are never consulted).
+  int64_t hist[256];
+  const bool need_hist = mode != 8;
+  if (need_hist) std::memset(hist, 0, sizeof(hist));
+  for (int64_t i = 0; i < cells; i += 2) {
+    const uint8_t c0 = cover[i] ? 1 : 0, c1 = cover[i + 1] ? 1 : 0;
+    const uint8_t n0 = (uint8_t(bases[i]) & 0x7) | uint8_t(c0 << 3);
+    const uint8_t n1 = (uint8_t(bases[i + 1]) & 0x7) | uint8_t(c1 << 3);
+    nib_out[i >> 1] = uint8_t(n0 | (n1 << 4));
+    if (need_hist) {
+      if (c0) hist[quals[i]]++;
+      if (c1) hist[quals[i + 1]]++;
+    }
+  }
+
+  // Meta bytes: convert_mask rows 0..3 then eligible bit 4.
+  for (int64_t fam = 0; fam < f; ++fam) {
+    uint8_t m = 0;
+    for (int64_t row = 0; row < rows4; ++row)
+      m |= uint8_t((cmask[fam * r + row] ? 1 : 0) << row);
+    m |= uint8_t((elig[fam] ? 1 : 0) << 4);
+    meta_out[fam] = m;
+  }
+
+  // Codebook from the histogram (matching ops/wire._qual_levels: empty ->
+  // single level 0; covered 255 flagged separately).
+  uint8_t levels[256];
+  int nlevels = 0;
+  bool has_255 = false;
+  int max_level = 0;
+  if (need_hist) {
+    for (int v = 0; v < 255; ++v)
+      if (hist[v]) {
+        levels[nlevels++] = uint8_t(v);
+        max_level = v;
+      }
+    has_255 = hist[255] != 0;
+    if (nlevels == 0) {
+      levels[0] = 0;
+      nlevels = 1;
+      max_level = 0;
+    }
+  }
+  if (nlevels_out) *nlevels_out = nlevels;
+
+  int bits = mode;
+  if (mode == 0) bits = resolve_auto(nlevels, has_255, max_level);
+  if (bits == 2 || bits == 4) {
+    if (has_255 || max_level > 93) return kErrQualTooHigh;
+    if (nlevels > (1 << bits)) return kErrTooManyLevels;
+  }
+
+  if (bits == 8) {
+    std::memcpy(qual_out, quals, size_t(cells));
+    int64_t len = cells;
+    while (len & 3) qual_out[len++] = 0;
+    *qual_len_out = len;
+    return 8;
+  }
+
+  // Codebook section: 2^bits bytes, unfilled entries zero.
+  const int book = 1 << bits;
+  std::memset(qual_out, 0, size_t(book));
+  std::memcpy(qual_out, levels, size_t(nlevels));
+  uint8_t lut[256];
+  std::memset(lut, 0, sizeof(lut));
+  for (int i = 0; i < nlevels; ++i) lut[levels[i]] = uint8_t(i);
+
+  // Sweep 2: pack qual indices little-bit-endian within each byte
+  // (index of cell j occupies bits [bits*j % 8, ...)); uncovered cells
+  // carry index 0 — matching _pack_qual_codes' sentinel->0 LUT.
+  uint8_t* dst = qual_out + book;
+  const int per = 8 / bits;
+  int64_t nbytes = (cells + per - 1) / per;
+  if (bits == 2) {
+    int64_t i = 0, b = 0;
+    const int64_t full = cells / 4;
+    for (; b < full; ++b, i += 4) {
+      const uint8_t i0 = cover[i] ? lut[quals[i]] : 0;
+      const uint8_t i1 = cover[i + 1] ? lut[quals[i + 1]] : 0;
+      const uint8_t i2 = cover[i + 2] ? lut[quals[i + 2]] : 0;
+      const uint8_t i3 = cover[i + 3] ? lut[quals[i + 3]] : 0;
+      dst[b] = uint8_t(i0 | (i1 << 2) | (i2 << 4) | (i3 << 6));
+    }
+    if (i < cells) {
+      uint8_t acc = 0;
+      for (int s = 0; i < cells; ++i, ++s)
+        acc |= uint8_t((cover[i] ? lut[quals[i]] : 0) << (2 * s));
+      dst[b++] = acc;
+    }
+  } else {  // bits == 4
+    int64_t i = 0, b = 0;
+    const int64_t full = cells / 2;
+    for (; b < full; ++b, i += 2) {
+      const uint8_t i0 = cover[i] ? lut[quals[i]] : 0;
+      const uint8_t i1 = cover[i + 1] ? lut[quals[i + 1]] : 0;
+      dst[b] = uint8_t(i0 | (i1 << 4));
+    }
+    if (i < cells) dst[b++] = cover[i] ? lut[quals[i]] : 0;
+  }
+  while (nbytes & 3) dst[nbytes++] = 0;
+  *qual_len_out = book + nbytes;
+  return bits;
+}
+
+// Unpack the family-major planar duplex output wire
+// (models/duplex.pack_duplex_outputs): wire uint8 [f, 4, w] — per family,
+// rows 0-1 = byte0 planes of duplex R1/R2
+// (base(3b)|depth(2b)<<3|errors(2b)<<5|a_depth(1b)<<7), rows 2-3 = the
+// consensus qual planes. Fills six C-contiguous [f*2*w] arrays.
+void wirepack_unpack_duplex_outputs(const uint8_t* wire, int64_t f, int64_t w,
+                                    int8_t* base, uint8_t* qual,
+                                    int16_t* depth, int16_t* errors,
+                                    int8_t* a_depth, int8_t* b_depth) {
+  for (int64_t fam = 0; fam < f; ++fam) {
+    const uint8_t* plane_b = wire + fam * 4 * w;
+    const uint8_t* plane_q = plane_b + 2 * w;
+    const int64_t out0 = fam * 2 * w;
+    for (int64_t i = 0; i < 2 * w; ++i) {
+      const uint8_t b0 = plane_b[i];
+      const int16_t d = int16_t((b0 >> 3) & 0x3);
+      const int8_t a = int8_t((b0 >> 7) & 0x1);
+      base[out0 + i] = int8_t(b0 & 0x7);
+      qual[out0 + i] = plane_q[i];
+      depth[out0 + i] = d;
+      errors[out0 + i] = int16_t((b0 >> 5) & 0x3);
+      a_depth[out0 + i] = a;
+      b_depth[out0 + i] = int8_t(d - a);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,129 @@
+"""Native wire packer (native/wirepack.cpp) vs the numpy reference path.
+
+The C++ sweep must be byte-for-byte identical to ops.wire's numpy pack and
+models.duplex's numpy unpack — it is a pure speed substitution on the
+tunnel hot path, so any divergence is silent corruption of consensus
+inputs/outputs. Each case packs with both implementations and diffs the
+wire words.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io import wirepack
+
+
+pytestmark = pytest.mark.skipif(
+    not wirepack.available(), reason=f"native wirepack: {wirepack.load_error()}"
+)
+
+
+def _numpy_pack(bases, quals, cover, cmask, elig, starts, limits, qual_mode):
+    """Force the numpy reference implementation of pack_duplex_inputs."""
+    import bsseqconsensusreads_tpu.ops.wire as wire_mod
+
+    real_available = wirepack.available
+    wirepack.available = lambda: False
+    try:
+        return wire_mod.pack_duplex_inputs(
+            bases, quals, cover, cmask, elig, starts, limits,
+            qual_mode=qual_mode,
+        )
+    finally:
+        wirepack.available = real_available
+
+
+def _random_batch(f, w, n_levels, seed, cover_p=0.7):
+    rng = np.random.default_rng(seed)
+    cover = rng.random((f, 4, w)) < cover_p
+    bases = np.where(
+        cover, rng.integers(0, 4, size=(f, 4, w)), 4
+    ).astype(np.int8)
+    levels = np.sort(
+        rng.choice(np.arange(0, 80), size=n_levels, replace=False)
+    ).astype(np.uint8)
+    quals = np.where(
+        cover, levels[rng.integers(0, n_levels, size=(f, 4, w))], 0
+    ).astype(np.uint8)
+    cmask = rng.random((f, 4)) < 0.5
+    elig = rng.random(f) < 0.8
+    starts = rng.integers(0, 1000, size=f).astype(np.uint32)
+    limits = np.full(f, 2000, np.uint32)
+    return bases, quals, cover, cmask, elig, starts, limits
+
+
+@pytest.mark.parametrize("qual_mode", ["q8", "q2", "q4", "auto"])
+@pytest.mark.parametrize("n_levels,f,w", [(3, 7, 26), (11, 5, 32), (25, 3, 150)])
+def test_native_pack_matches_numpy(qual_mode, n_levels, f, w):
+    batch = _random_batch(f, w, n_levels, seed=n_levels * 7 + w)
+    if qual_mode in ("q2", "q4") and n_levels > (1 << (2 if qual_mode == "q2" else 4)):
+        with pytest.raises(ValueError):
+            _numpy_pack(*batch, qual_mode)
+        from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
+
+        with pytest.raises(ValueError):
+            pack_duplex_inputs(*batch, qual_mode=qual_mode)
+        return
+    want = _numpy_pack(*batch, qual_mode)
+    from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
+
+    got = pack_duplex_inputs(*batch, qual_mode=qual_mode)
+    assert got.qual_mode == want.qual_mode
+    assert (got.f, got.w, got.r) == (want.f, want.w, want.r)
+    np.testing.assert_array_equal(got.nib, want.nib)
+    np.testing.assert_array_equal(got.qual, want.qual)
+    np.testing.assert_array_equal(got.meta, want.meta)
+    np.testing.assert_array_equal(got.to_words(), want.to_words())
+
+
+def test_native_pack_matches_numpy_edge_cases():
+    # all-uncovered batch: auto must resolve to q2 with a single zero level
+    f, w = 3, 16
+    bases = np.full((f, 4, w), 4, np.int8)
+    quals = np.zeros((f, 4, w), np.uint8)
+    cover = np.zeros((f, 4, w), bool)
+    cmask = np.zeros((f, 4), bool)
+    elig = np.zeros(f, bool)
+    starts = np.zeros(f, np.uint32)
+    limits = np.zeros(f, np.uint32)
+    args = (bases, quals, cover, cmask, elig, starts, limits)
+    want = _numpy_pack(*args, "auto")
+    from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
+
+    got = pack_duplex_inputs(*args, qual_mode="auto")
+    assert got.qual_mode == want.qual_mode == "q2"
+    np.testing.assert_array_equal(got.to_words(), want.to_words())
+
+    # covered 255 qual: auto falls back to q8 both ways, explicit q2 raises
+    quals2 = np.where(np.ones_like(cover), 255, 0).astype(np.uint8)
+    cover2 = np.ones((f, 4, w), bool)
+    args2 = (bases, quals2, cover2, cmask, elig, starts, limits)
+    want2 = _numpy_pack(*args2, "auto")
+    got2 = pack_duplex_inputs(*args2, qual_mode="auto")
+    assert got2.qual_mode == want2.qual_mode == "q8"
+    np.testing.assert_array_equal(got2.to_words(), want2.to_words())
+    with pytest.raises(ValueError, match="93"):
+        pack_duplex_inputs(*args2, qual_mode="q2")
+
+
+def test_native_unpack_matches_numpy():
+    rng = np.random.default_rng(3)
+    f, w = 9, 40
+    cols = f * 2 * w
+    wire = rng.integers(0, 256, size=2 * cols, dtype=np.int64).astype(np.uint8)
+
+    import bsseqconsensusreads_tpu.models.duplex as duplex_mod
+
+    real_available = wirepack.available
+    wirepack.available = lambda: False
+    try:
+        want = duplex_mod.unpack_duplex_outputs(wire.view(np.uint32), f=f, w=w)
+    finally:
+        wirepack.available = real_available
+    got = wirepack.unpack_duplex_outputs(wire, f=f, w=w)
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+        assert got[k].dtype == want[k].dtype, k
