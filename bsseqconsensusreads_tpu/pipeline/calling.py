@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Iterable, Iterator, Sequence
 
 import numpy as np
@@ -106,6 +107,31 @@ def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
         else:
             normal.append((mi, records))
     return normal, deep
+
+
+def _pipelined(events):
+    """Depth-1 dispatch/retire software pipeline shared by the batch callers.
+
+    `events` yields one ("now", records) or ("deferred", retire_fn) item per
+    input chunk. "now" results pass straight through; a "deferred" retire
+    (the blocking device fetch + record emit of an already-dispatched
+    kernel batch) is held until the NEXT event arrives, so its D2H transfer
+    streams while the host encodes the following chunk. Exactly one yield
+    per event, in event order — the invariant checkpoint resume's
+    skip_batches counting depends on (pipeline.checkpoint), kept in this
+    one place for both the molecular and duplex stages.
+    """
+    pending = None
+    for kind, payload in events:
+        if pending is not None:
+            yield pending()
+            pending = None
+        if kind == "deferred":
+            pending = payload
+        else:
+            yield payload
+    if pending is not None:
+        yield pending()
 
 
 def _molecular_kernel(vote_kernel: str | None):
@@ -504,16 +530,36 @@ def call_molecular_batches(
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_molecular_consensus(mesh, params, kernel_fn=consensus_fn)
 
-    def run_kernel(batch):
-        # np.asarray inside this (timed) scope: materializing here keeps the
-        # 'kernel' metric the device wait, not just the async dispatch
+    def dispatch_kernel(batch):
+        """Submit one batch; returns (device output dict, trim). The D2H
+        copies are requested immediately so they stream while the host
+        encodes the next chunk / emits the previous one (depth-1 software
+        pipeline, same rationale as call_duplex_batches)."""
         if sharded_fn is None:
             out = consensus_fn(batch.bases, batch.quals, params)
-            return {k: np.asarray(v) for k, v in out.items()}
-        f = batch.bases.shape[0]
-        (pb, pq), _ = pad_families((batch.bases, batch.quals), f, data_size)
-        out = sharded_fn(pb, pq)
-        return {k: np.asarray(v)[:f] for k, v in out.items()}
+            trim = None
+        else:
+            f = batch.bases.shape[0]
+            (pb, pq), _ = pad_families(
+                (batch.bases, batch.quals), f, data_size
+            )
+            out = sharded_fn(pb, pq)
+            trim = f
+        for v in out.values():
+            copy_async = getattr(v, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
+        return out, trim
+
+    def retire_and_emit(out_dev, trim, batch, deep_emitted):
+        with stats.metrics.timed("fetch"):
+            out = jax.device_get(out_dev)
+            if trim is not None:
+                out = {k: v[:trim] for k, v in out.items()}
+        return (
+            _emit_molecular_batch(batch, out, params, mode, stats)
+            + deep_emitted
+        )
 
     def run_deep_kernel(batch):
         """One deep family [1, T, 2, W]: template axis over the devices."""
@@ -547,57 +593,63 @@ def call_molecular_batches(
         stream_mi_groups(records, grouping=grouping, stats=stats),
         stats.metrics,
     )
-    batch_index = 0
-    for chunk in _group_batches(groups, batch_families):
-        batch_index += 1
-        if batch_index <= skip_batches:
-            continue
-        normal, deep = _split_deep(chunk, deep_threshold, indel_policy)
-        with stats.metrics.timed("encode"):
-            # cap must track the routing threshold: a family the splitter
-            # classified 'normal' (<= deep_threshold templates) must never
-            # hit encode's default cap and be silently skipped
-            batch, skipped = encode_molecular_families(
-                normal, max_window=max_window,
-                max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
-                indel_policy=indel_policy,
-            )
-        stats.skipped_families += len(skipped)
-        stats.indel_aligned += batch.indel_aligned
-        stats.indel_dropped += batch.indel_dropped
-        emitted: list[BamRecord] = []
-        if batch.meta:
+
+    def events():
+        batch_index = 0
+        for chunk in _group_batches(groups, batch_families):
+            batch_index += 1
+            if batch_index <= skip_batches:
+                continue
+            normal, deep = _split_deep(chunk, deep_threshold, indel_policy)
+            with stats.metrics.timed("encode"):
+                # cap must track the routing threshold: a family the
+                # splitter classified 'normal' (<= deep_threshold
+                # templates) must never hit encode's default cap and be
+                # silently skipped
+                batch, skipped = encode_molecular_families(
+                    normal, max_window=max_window,
+                    max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
+                    indel_policy=indel_policy,
+                )
+            stats.skipped_families += len(skipped)
+            stats.indel_aligned += batch.indel_aligned
+            stats.indel_dropped += batch.indel_dropped
+            deep_emitted: list[BamRecord] = []
+            for mi, deep_records in deep:
+                with stats.metrics.timed("encode"):
+                    dbatch, dskipped = encode_molecular_families(
+                        [(mi, deep_records)], max_window=max_window,
+                        max_templates=DEEP_TEMPLATE_CAP,
+                        indel_policy=indel_policy,
+                    )
+                stats.skipped_families += len(dskipped)
+                stats.indel_aligned += dbatch.indel_aligned
+                stats.indel_dropped += dbatch.indel_dropped
+                if not dbatch.meta:
+                    continue
+                stats.batches += 1
+                dused = int((dbatch.bases != NBASE).sum())
+                stats.pad_cells += dbatch.bases.size - dused
+                stats.used_cells += dused
+                with stats.metrics.timed("kernel"):
+                    dout = run_deep_kernel(dbatch)
+                deep_emitted.extend(
+                    _emit_molecular_batch(dbatch, dout, params, mode, stats)
+                )
+            if not batch.meta:
+                yield "now", deep_emitted
+                continue
             stats.batches += 1
             used = int((batch.bases != NBASE).sum())
             stats.pad_cells += batch.bases.size - used
             stats.used_cells += used
             with stats.metrics.timed("kernel"):
-                out = run_kernel(batch)
-            # emit time = wall_seconds - encode_seconds - kernel_seconds
-            emitted.extend(_emit_molecular_batch(batch, out, params, mode, stats))
-        for mi, deep_records in deep:
-            with stats.metrics.timed("encode"):
-                dbatch, dskipped = encode_molecular_families(
-                    [(mi, deep_records)], max_window=max_window,
-                    max_templates=DEEP_TEMPLATE_CAP, indel_policy=indel_policy,
-                )
-            stats.skipped_families += len(dskipped)
-            stats.indel_aligned += dbatch.indel_aligned
-            stats.indel_dropped += dbatch.indel_dropped
-            if not dbatch.meta:
-                continue
-            stats.batches += 1
-            dused = int((dbatch.bases != NBASE).sum())
-            stats.pad_cells += dbatch.bases.size - dused
-            stats.used_cells += dused
-            with stats.metrics.timed("kernel"):
-                dout = run_deep_kernel(dbatch)
-            emitted.extend(
-                _emit_molecular_batch(dbatch, dout, params, mode, stats)
+                out_dev, trim = dispatch_kernel(batch)
+            yield "deferred", partial(
+                retire_and_emit, out_dev, trim, batch, deep_emitted
             )
-        # one (possibly empty) yield per input chunk keeps the yielded
-        # batch count aligned with skip_batches across resumes
-        yield emitted
+
+    yield from _pipelined(events())
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -727,8 +779,12 @@ def call_duplex_batches(
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_duplex_packed(mesh, params, vote_kernel=kernel)
 
-    def run_kernel(batch):
-        f, w = batch.bases.shape[0], batch.bases.shape[-1]
+    def dispatch_kernel(batch):
+        """Submit one batch; returns (device wire array, padded f). The D2H
+        copy is requested immediately so it streams while the host encodes
+        the next chunk / emits the previous one (depth-1 software pipeline —
+        on tunneled TPU hosts the transfer, not compute, bounds the stage)."""
+        f = batch.bases.shape[0]
         arrays = (
             batch.bases, batch.quals, batch.cover, batch.ref,
             batch.convert_mask, batch.extend_eligible,
@@ -741,8 +797,17 @@ def call_duplex_batches(
         else:
             padded, pf = pad_families(arrays, f, data_size)
             packed, _la, _rd = sharded_fn(*padded)
-        out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
-        return {k: v[:f] for k, v in out.items()}
+        copy_async = getattr(packed, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+        return packed, pf
+
+    def retire_and_emit(packed, pf, batch, passed):
+        f, w = batch.bases.shape[0], batch.bases.shape[-1]
+        with stats.metrics.timed("fetch"):
+            out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
+            out = {k: v[:f] for k, v in out.items()}
+        return _emit_duplex_batch(batch, out, params, mode, stats) + passed
 
     groups = _timed_groups(
         stream_mi_groups(
@@ -750,97 +815,106 @@ def call_duplex_batches(
         ),
         stats.metrics,
     )
-    batch_index = 0
-    for chunk in _group_batches(groups, batch_families):
-        batch_index += 1
-        if batch_index <= skip_batches:
-            continue
-        with stats.metrics.timed("encode"):
-            batch, leftovers, skipped = encode_duplex_families(
-                chunk, ref_fetch, ref_names, max_window=max_window
-            )
-        stats.skipped_families += len(skipped)
-        stats.leftover_records += len(leftovers)
-        passed: list[BamRecord] = []
-        if passthrough and leftovers:
-            passed = _passthrough_records(leftovers, ref_fetch, ref_names)
-        if not batch.meta:
-            yield passed
-            continue
-        stats.batches += 1
-        used = int(batch.cover.sum())
-        stats.pad_cells += batch.cover.size - used
-        stats.used_cells += used
-        with stats.metrics.timed("kernel"):
-            out = run_kernel(batch)
-        base = out["base"]
-        qual = out["qual"]
-        depth = out["depth"]
-        errors = out["errors"]
-        a_depth = out["a_depth"]
-        b_depth = out["b_depth"]
-        emitted: list[BamRecord] = []
-        for fi, meta in enumerate(batch.meta):
-            stats.families += 1
-            if meta.n_templates < params.min_reads:
-                # family-level --min-reads filter (0 in the reference's
-                # configuration = emit everything, README.md:9)
-                stats.skipped_families += 1
+
+    def events():
+        batch_index = 0
+        for chunk in _group_batches(groups, batch_families):
+            batch_index += 1
+            if batch_index <= skip_batches:
                 continue
-            spans = [np.nonzero(depth[fi, role] > 0)[0] for role in range(2)]
-            starts = [
-                meta.window_start + int(c[0]) if len(c) else -1 for c in spans
-            ]
-            for role in range(2):
-                cov = spans[role]
-                if len(cov) == 0:
-                    continue
-                seq_fwd = codes_to_seq(base[fi, role, cov])
-                quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
-                tags = _consensus_tags(
-                    depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+            with stats.metrics.timed("encode"):
+                batch, leftovers, skipped = encode_duplex_families(
+                    chunk, ref_fetch, ref_names, max_window=max_window
                 )
-                # fgbio duplex per-strand tag surface (README.md:9 contract;
-                # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
-                # min depth, ad/bd per-base depth arrays. At this stage each
-                # strand contributes its single-strand consensus read, so
-                # per-column strand depth is presence (0/1); the raw-read
-                # depths live in the molecular stage's cD/cd tags upstream.
-                a_cov = a_depth[fi, role, cov]
-                b_cov = b_depth[fi, role, cov]
-                tags["aD"] = ("i", int(a_cov.max()))
-                tags["bD"] = ("i", int(b_cov.max()))
-                tags["aM"] = ("i", int(a_cov.min()))
-                tags["bM"] = ("i", int(b_cov.min()))
-                tags["ad"] = ("B", ("S", [int(v) for v in a_cov]))
-                tags["bd"] = ("B", ("S", [int(v) for v in b_cov]))
-                other = 1 - role
-                tlen = 0
-                if starts[0] >= 0 and starts[1] >= 0:
-                    lo = min(starts)
-                    hi = max(
-                        meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
-                    )
-                    tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
-                # duplex R1 merges the forward-mapped pair (99,163): emit
-                # forward; duplex R2 merges the reverse pair (83,147).
-                emitted.append(_emit_read(
-                    qname=meta.mi,
-                    role=role,
-                    seq_fwd=seq_fwd,
-                    quals_fwd=quals_fwd,
-                    tags=tags,
-                    mode=mode,
-                    reverse=bool(role),
-                    ref_id=meta.ref_id,
-                    pos=starts[role],
-                    mate_pos=starts[other],
-                    mate_reverse=not bool(role),
-                    tlen=tlen,
-                ))
-                stats.consensus_out += 1
-        yield emitted + passed
+            stats.skipped_families += len(skipped)
+            stats.leftover_records += len(leftovers)
+            passed: list[BamRecord] = []
+            if passthrough and leftovers:
+                passed = _passthrough_records(leftovers, ref_fetch, ref_names)
+            if not batch.meta:
+                yield "now", passed
+                continue
+            stats.batches += 1
+            used = int(batch.cover.sum())
+            stats.pad_cells += batch.cover.size - used
+            stats.used_cells += used
+            with stats.metrics.timed("kernel"):
+                packed, pf = dispatch_kernel(batch)
+            yield "deferred", partial(retire_and_emit, packed, pf, batch, passed)
+
+    yield from _pipelined(events())
     stats.wall_seconds += time.monotonic() - t0
+
+
+def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
+    """Decode one retired duplex kernel batch into consensus BamRecords."""
+    base = out["base"]
+    qual = out["qual"]
+    depth = out["depth"]
+    errors = out["errors"]
+    a_depth = out["a_depth"]
+    b_depth = out["b_depth"]
+    emitted: list[BamRecord] = []
+    for fi, meta in enumerate(batch.meta):
+        stats.families += 1
+        if meta.n_templates < params.min_reads:
+            # family-level --min-reads filter (0 in the reference's
+            # configuration = emit everything, README.md:9)
+            stats.skipped_families += 1
+            continue
+        spans = [np.nonzero(depth[fi, role] > 0)[0] for role in range(2)]
+        starts = [
+            meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+        ]
+        for role in range(2):
+            cov = spans[role]
+            if len(cov) == 0:
+                continue
+            seq_fwd = codes_to_seq(base[fi, role, cov])
+            quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+            tags = _consensus_tags(
+                depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+            )
+            # fgbio duplex per-strand tag surface (README.md:9 contract;
+            # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
+            # min depth, ad/bd per-base depth arrays. At this stage each
+            # strand contributes its single-strand consensus read, so
+            # per-column strand depth is presence (0/1); the raw-read
+            # depths live in the molecular stage's cD/cd tags upstream.
+            a_cov = a_depth[fi, role, cov]
+            b_cov = b_depth[fi, role, cov]
+            tags["aD"] = ("i", int(a_cov.max()))
+            tags["bD"] = ("i", int(b_cov.max()))
+            tags["aM"] = ("i", int(a_cov.min()))
+            tags["bM"] = ("i", int(b_cov.min()))
+            tags["ad"] = ("B", ("S", [int(v) for v in a_cov]))
+            tags["bd"] = ("B", ("S", [int(v) for v in b_cov]))
+            other = 1 - role
+            tlen = 0
+            if starts[0] >= 0 and starts[1] >= 0:
+                lo = min(starts)
+                hi = max(
+                    meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                )
+                tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
+            # duplex R1 merges the forward-mapped pair (99,163): emit
+            # forward; duplex R2 merges the reverse pair (83,147).
+            emitted.append(_emit_read(
+                qname=meta.mi,
+                role=role,
+                seq_fwd=seq_fwd,
+                quals_fwd=quals_fwd,
+                tags=tags,
+                mode=mode,
+                reverse=bool(role),
+                ref_id=meta.ref_id,
+                pos=starts[role],
+                mate_pos=starts[other],
+                mate_reverse=not bool(role),
+                tlen=tlen,
+            ))
+            stats.consensus_out += 1
+    return emitted
 
 
 def call_duplex(

@@ -554,3 +554,38 @@ class TestReferenceConfigInterop:
         )
         assert cfg.aligner == "bwameth" and cfg.batch_families == 64
         assert cfg.bwameth == "/path/to/bwameth.py"
+
+
+class TestPipelinedYields:
+    """The depth-1 dispatch/retire pipeline (calling._pipelined) must emit
+    exactly one result per event, in event order — checkpoint resume's
+    skip_batches counting depends on it."""
+
+    def test_order_and_count(self):
+        from bsseqconsensusreads_tpu.pipeline.calling import _pipelined
+
+        log = []
+
+        def deferred(tag):
+            def retire():
+                log.append(f"retire:{tag}")
+                return [tag]
+            return "deferred", retire
+
+        events = [
+            deferred("a"),
+            ("now", ["b"]),
+            ("now", ["c"]),
+            deferred("d"),
+            deferred("e"),
+        ]
+        out = list(_pipelined(iter(events)))
+        assert out == [["a"], ["b"], ["c"], ["d"], ["e"]]
+        # a's retire is deferred until event b arrives; e's runs at drain
+        assert log == ["retire:a", "retire:d", "retire:e"]
+
+    def test_empty_and_single(self):
+        from bsseqconsensusreads_tpu.pipeline.calling import _pipelined
+
+        assert list(_pipelined(iter([]))) == []
+        assert list(_pipelined(iter([("deferred", lambda: [1])]))) == [[1]]
