@@ -28,7 +28,7 @@ under jit/shard_map.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -165,3 +165,74 @@ def molecular_consensus(bases, quals, params: ConsensusParams = ConsensusParams(
     """
     out = jax.vmap(lambda b, q: _family_consensus(b, q, params))(bases, quals)
     return narrow_outputs(out)
+
+
+def pack_molecular_outputs(out: dict):
+    """Pack the molecular output dict into one family-major planar u32 wire.
+
+    Same rationale as models.duplex.pack_duplex_outputs: the tunneled D2H
+    hop pays a fixed cost per array and compresses byte streams, so the
+    four per-column arrays ride ONE flat array as per-family byte planes
+    ([F, 12, W] u8 rows): 0-1 base, 2-3 qual, 4-5 depth lo, 6-7 depth hi,
+    8-9 errors lo, 10-11 errors hi (role-major within each pair; u16
+    counts split into byte planes — the hi planes are ~all zero at normal
+    depths, which the tunnel's compressor collapses). The family axis
+    stays leading so shard_map concatenation preserves the layout.
+    Unpack host-side with unpack_molecular_outputs.
+    """
+    d8 = jax.lax.bitcast_convert_type(
+        out["depth"].astype(jnp.uint16), jnp.uint8
+    )  # [..., F, 2, W, 2] little-endian
+    e8 = jax.lax.bitcast_convert_type(out["errors"].astype(jnp.uint16), jnp.uint8)
+    planes = jnp.concatenate(
+        [
+            out["base"].astype(jnp.uint8),
+            out["qual"].astype(jnp.uint8),
+            d8[..., 0], d8[..., 1],
+            e8[..., 0], e8[..., 1],
+        ],
+        axis=-2,
+    )  # [..., F, 12, W]
+    return jax.lax.bitcast_convert_type(
+        planes.reshape(-1, 4), jnp.uint32
+    ).reshape(-1)
+
+
+def unpack_molecular_outputs(wire, f: int, w: int) -> dict:
+    """numpy inverse of pack_molecular_outputs -> dict of [f, 2, w] arrays
+    (host side)."""
+    import numpy as np
+
+    wire = np.asarray(wire)
+    u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
+    planes = u8[: f * 12 * w].reshape(f, 12, w)
+    depth = (
+        planes[:, 4:6].astype(np.uint16)
+        | (planes[:, 6:8].astype(np.uint16) << 8)
+    ).astype(np.int16)
+    errors = (
+        planes[:, 8:10].astype(np.uint16)
+        | (planes[:, 10:12].astype(np.uint16) << 8)
+    ).astype(np.int16)
+    return {
+        "base": planes[:, 0:2].astype(np.int8),
+        "qual": planes[:, 2:4].copy(),
+        "depth": depth,
+        "errors": errors,
+    }
+
+
+@lru_cache(maxsize=64)
+def _packed_kernel_cached(kernel_fn):
+    @partial(jax.jit, static_argnames=("params",))
+    def fn(bases, quals, params: ConsensusParams = ConsensusParams()):
+        return pack_molecular_outputs(kernel_fn(bases, quals, params))
+
+    return fn
+
+
+def packed_molecular_kernel(kernel_fn=None):
+    """Jitted `kernel_fn(bases, quals, params) -> packed u32 wire` for any
+    molecular-consensus kernel (stock XLA vote or the Pallas one). Cached
+    per kernel so repeated pipeline batches reuse one compiled program."""
+    return _packed_kernel_cached(kernel_fn or molecular_consensus)

@@ -18,6 +18,7 @@ from bsseqconsensusreads_tpu.parallel.mesh import (  # noqa: F401
 from bsseqconsensusreads_tpu.parallel.sharding import (  # noqa: F401
     sharded_duplex_pipeline,
     sharded_molecular_consensus,
+    sharded_molecular_packed,
 )
 from bsseqconsensusreads_tpu.parallel.deep_family import (  # noqa: F401
     deep_family_consensus,

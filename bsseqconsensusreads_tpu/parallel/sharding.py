@@ -17,7 +17,10 @@ from bsseqconsensusreads_tpu.models.duplex import (
     duplex_call_pipeline,
     duplex_call_pipeline_packed,
 )
-from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
+from bsseqconsensusreads_tpu.models.molecular import (
+    molecular_consensus,
+    pack_molecular_outputs,
+)
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
 
@@ -48,6 +51,30 @@ def sharded_molecular_consensus(
     )
     def fn(bases, quals):
         return kernel_fn(bases, quals, params)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def sharded_molecular_packed(
+    mesh: Mesh,
+    params: ConsensusParams = ConsensusParams(),
+    kernel_fn=None,
+):
+    """sharded_molecular_consensus with the packed planar output wire
+    (models.molecular.pack_molecular_outputs): each device packs its family
+    shard, and the family-major layout makes the gathered concatenation
+    identical to a single-device pack — one D2H array instead of four."""
+    kernel_fn = kernel_fn or molecular_consensus
+    spec = P(DATA_AXIS)
+
+    # check_vma=False: same rationale as sharded_molecular_consensus
+    @jax.jit
+    @jax.shard_map(
+        mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
+    )
+    def fn(bases, quals):
+        return pack_molecular_outputs(kernel_fn(bases, quals, params))
 
     return fn
 

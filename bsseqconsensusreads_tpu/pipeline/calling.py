@@ -48,7 +48,11 @@ from bsseqconsensusreads_tpu.models.duplex import (
     duplex_call_pipeline_packed,
     unpack_duplex_outputs,
 )
-from bsseqconsensusreads_tpu.models.molecular import molecular_consensus
+from bsseqconsensusreads_tpu.models.molecular import (
+    molecular_consensus,
+    packed_molecular_kernel,
+    unpack_molecular_outputs,
+)
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops.encode import (
     codes_to_seq,
@@ -521,41 +525,43 @@ def call_molecular_batches(
     mesh = _resolve_mesh(mesh)
     sharded_fn = None
     deep_state: dict = {}
-    if mesh is not None:
+    if mesh is None:
+        packed_fn = packed_molecular_kernel(consensus_fn)
+    else:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
         from bsseqconsensusreads_tpu.parallel.sharding import (
-            sharded_molecular_consensus,
+            sharded_molecular_packed,
         )
 
         data_size = mesh.shape[DATA_AXIS]
-        sharded_fn = sharded_molecular_consensus(mesh, params, kernel_fn=consensus_fn)
+        sharded_fn = sharded_molecular_packed(mesh, params, kernel_fn=consensus_fn)
 
     def dispatch_kernel(batch):
-        """Submit one batch; returns (device output dict, trim). The D2H
-        copies are requested immediately so they stream while the host
-        encodes the next chunk / emits the previous one (depth-1 software
-        pipeline, same rationale as call_duplex_batches)."""
+        """Submit one batch; returns (device wire array, padded f). Outputs
+        ride the packed planar wire (models.molecular.pack_molecular_outputs
+        — one D2H array instead of four), and the copy is requested
+        immediately so it streams while the host encodes the next chunk /
+        emits the previous one (depth-1 software pipeline, same rationale
+        as call_duplex_batches)."""
+        f = batch.bases.shape[0]
         if sharded_fn is None:
-            out = consensus_fn(batch.bases, batch.quals, params)
-            trim = None
+            wire = packed_fn(batch.bases, batch.quals, params)
+            pf = f
         else:
-            f = batch.bases.shape[0]
-            (pb, pq), _ = pad_families(
+            (pb, pq), pf = pad_families(
                 (batch.bases, batch.quals), f, data_size
             )
-            out = sharded_fn(pb, pq)
-            trim = f
-        for v in out.values():
-            copy_async = getattr(v, "copy_to_host_async", None)
-            if copy_async is not None:
-                copy_async()
-        return out, trim
+            wire = sharded_fn(pb, pq)
+        copy_async = getattr(wire, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+        return wire, pf
 
-    def retire_and_emit(out_dev, trim, batch, deep_emitted):
+    def retire_and_emit(wire, pf, batch, deep_emitted):
+        f, w = batch.bases.shape[0], batch.bases.shape[-1]
         with stats.metrics.timed("fetch"):
-            out = jax.device_get(out_dev)
-            if trim is not None:
-                out = {k: v[:trim] for k, v in out.items()}
+            out = unpack_molecular_outputs(jax.device_get(wire), f=pf, w=w)
+            out = {k: v[:f] for k, v in out.items()}
         return (
             _emit_molecular_batch(batch, out, params, mode, stats)
             + deep_emitted
