@@ -20,3 +20,58 @@ A from-scratch re-design of the capabilities of Wubeizhongxinghua/BSSeqConsensus
 """
 
 __version__ = "0.1.0"
+
+
+def pin_host_backend(warn: bool = True) -> bool:
+    """Pin jax to the host CPU backend. Returns True if the pin took.
+
+    Platform pinning must go through the jax *config*: on tunneled-TPU
+    hosts the site plugin hook wraps jax's backend selection and ignores
+    the JAX_PLATFORMS env var in both directions (the shell may even carry
+    a site-injected value), and a dead tunnel then hangs the first
+    ``jax.device_count()`` call — e.g. at mesh resolution
+    (pipeline.calling._resolve_mesh). The config route is the one the
+    hooks respect, but it only works before any backend initializes; a
+    failed pin is warned about (the run would otherwise proceed on a
+    device the operator configured against)."""
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception as e:
+        if warn:
+            import warnings
+
+            warnings.warn(
+                f"could not pin jax to the host backend ({e}); "
+                "device selection is fixed once backends initialize",
+                stacklevel=2,
+            )
+        return False
+
+
+def _honor_backend_env() -> None:
+    """Honor BSSEQ_TPU_BACKEND=cpu|tpu (case-insensitive) at import time.
+    'cpu' pins the host backend before any backend init; unset or 'tpu'
+    leaves jax's default selection. The config file's `backend:` key does
+    the same per run (pipeline.stages._apply_backend)."""
+    import os
+
+    val = os.environ.get("BSSEQ_TPU_BACKEND", "")
+    if not val:
+        return
+    norm = val.strip().lower()
+    if norm == "cpu":
+        pin_host_backend()
+    elif norm != "tpu":
+        import warnings
+
+        warnings.warn(
+            f"BSSEQ_TPU_BACKEND={val!r} not recognized (want 'cpu'|'tpu'); "
+            "leaving jax's default backend selection",
+            stacklevel=2,
+        )
+
+
+_honor_backend_env()

@@ -304,12 +304,29 @@ class PipelineBuilder:
         return wf, target
 
 
+def _apply_backend(backend: str) -> None:
+    """Honor the config's `backend: tpu|cpu` key (SURVEY.md §5.6).
+
+    'cpu' pins jax to the host backend BEFORE any device query — besides
+    selecting where kernels run, this keeps a broken TPU plugin (e.g. a
+    dead tunnel whose init hangs) from ever being touched. 'tpu' leaves
+    jax's default selection (accelerator when present)."""
+    if backend == "tpu":
+        return
+    if backend != "cpu":
+        raise WorkflowError(f"unknown backend {backend!r} (want 'tpu'|'cpu')")
+    from bsseqconsensusreads_tpu import pin_host_backend
+
+    pin_host_backend()
+
+
 def run_pipeline(
     cfg: FrameworkConfig, bam_path: str, outdir: str = "output", force: bool = False
 ):
     """Build and run the pipeline; returns (target, rule results, stats).
     Per-stage stats are emitted as JSON lines when BSSEQ_TPU_STATS is set
     (utils.observe)."""
+    _apply_backend(cfg.backend)
     builder = PipelineBuilder(cfg, bam_path, outdir)
     wf, target = builder.build()
     results = wf.run([target], force=force)
