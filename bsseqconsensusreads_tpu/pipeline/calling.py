@@ -61,7 +61,7 @@ from bsseqconsensusreads_tpu.ops.encode import (
 )
 from bsseqconsensusreads_tpu.utils import observe
 
-_COMPLEMENT = dict(zip("ACGTN", "TGCAN"))
+from bsseqconsensusreads_tpu.io.fastq import reverse_complement as _revcomp
 
 
 def _resolve_mesh(mesh):
@@ -154,10 +154,6 @@ def _molecular_kernel(vote_kernel: str | None):
     if choice != "xla":
         raise ValueError(f"unknown vote kernel {choice!r} (want 'xla'|'pallas')")
     return molecular_consensus
-
-
-def _revcomp(seq: str) -> str:
-    return "".join(_COMPLEMENT[c] for c in reversed(seq))
 
 
 @dataclass
@@ -345,18 +341,23 @@ def _group_batches(
 
 
 def _consensus_tags(depth_arr, err_arr, mi, rx):
-    """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce."""
-    depth_list = [int(d) for d in depth_arr]
-    err_list = [int(e) for e in err_arr]
-    total = sum(depth_list)
-    errs = sum(err_list)
+    """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce.
+
+    Vectorized: on the 100M-read config this runs once per consensus read
+    — per-element Python loops here dominated the emit phase."""
+    depth_arr = np.asarray(depth_arr)
+    err_arr = np.asarray(err_arr)
+    # int64 accumulators: int16 per-column counts sum past 32767 on deep
+    # families over a full window
+    total = int(depth_arr.sum(dtype=np.int64))
+    errs = int(err_arr.sum(dtype=np.int64))
     tags = {
         "MI": ("Z", mi),
-        "cD": ("i", max(depth_list) if depth_list else 0),
-        "cM": ("i", min(depth_list) if depth_list else 0),
+        "cD": ("i", int(depth_arr.max()) if depth_arr.size else 0),
+        "cM": ("i", int(depth_arr.min()) if depth_arr.size else 0),
         "cE": ("f", errs / total if total else 0.0),
-        "cd": ("B", ("S", depth_list)),
-        "ce": ("B", ("S", err_list)),
+        "cd": ("B", ("S", depth_arr.tolist())),
+        "ce": ("B", ("S", err_arr.tolist())),
     }
     if rx:
         tags["RX"] = ("Z", rx)
@@ -449,7 +450,7 @@ def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             if len(cov) == 0:
                 continue
             seq_fwd = codes_to_seq(base[fi, role, cov])
-            quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+            quals_fwd = qual[fi, role, cov].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
                 depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
             )
@@ -877,7 +878,7 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             if len(cov) == 0:
                 continue
             seq_fwd = codes_to_seq(base[fi, role, cov])
-            quals_fwd = bytes(int(q) for q in qual[fi, role, cov])
+            quals_fwd = qual[fi, role, cov].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
                 depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
             )
@@ -893,8 +894,8 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             tags["bD"] = ("i", int(b_cov.max()))
             tags["aM"] = ("i", int(a_cov.min()))
             tags["bM"] = ("i", int(b_cov.min()))
-            tags["ad"] = ("B", ("S", [int(v) for v in a_cov]))
-            tags["bd"] = ("B", ("S", [int(v) for v in b_cov]))
+            tags["ad"] = ("B", ("S", a_cov.tolist()))
+            tags["bd"] = ("B", ("S", b_cov.tolist()))
             other = 1 - role
             tlen = 0
             if starts[0] >= 0 and starts[1] >= 0:
