@@ -23,3 +23,4 @@ from bsseqconsensusreads_tpu.parallel.sharding import (  # noqa: F401
 from bsseqconsensusreads_tpu.parallel.deep_family import (  # noqa: F401
     deep_family_consensus,
 )
+from bsseqconsensusreads_tpu.parallel import multihost  # noqa: F401

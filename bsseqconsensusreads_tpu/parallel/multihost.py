@@ -1,0 +1,115 @@
+"""Multi-host execution: the family axis across processes (DCN-ready).
+
+The reference has no distributed backend — its processes communicate
+exclusively through BAM files on a shared filesystem (SURVEY.md §5.8,
+main.snake.py shell rules). This module is the TPU framework's scale-out
+equivalent, built on jax.distributed + jax.sharding instead of NCCL/MPI:
+
+* each host process ingests its own slice of the input (files are already
+  the pipeline's durable inter-stage boundary, so per-host BAM shards come
+  for free from the checkpoint layer);
+* the global mesh places every host's devices on the family ('data') axis,
+  host-major, so a host's family rows land only on its own devices —
+  `jax.make_array_from_process_local_data` then builds the global batch
+  without moving a byte off-host;
+* the consensus kernels contain zero cross-family operators
+  (parallel.sharding), so NOTHING crosses DCN per batch: compilation-time
+  coordination is the only cross-host traffic. Deep families (template-axis
+  psum, parallel.deep_family) stay on one host's ICI domain by
+  construction — their dedicated mesh is built from that host's devices.
+
+Single-chip/single-process runs degenerate cleanly: process_count == 1
+makes every helper a thin alias of the parallel.mesh equivalents.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host job (thin wrapper over jax.distributed).
+
+    On TPU pods the three arguments auto-detect from the environment; on
+    CPU/test clusters pass them explicitly. Must run before any backend
+    init. No-op when called with num_processes=1."""
+    if num_processes == 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def multihost_family_mesh() -> Mesh:
+    """All global devices on the family axis, host-major.
+
+    jax.devices() orders devices by process; keeping that order makes each
+    process's family rows map onto its own local devices, which is what
+    lets make_array_from_process_local_data assemble global batches with
+    zero cross-host transfers."""
+    devices = np.array(jax.devices()).reshape(-1, 1)
+    return Mesh(devices, (DATA_AXIS, READS_AXIS))
+
+
+def local_family_count(n_global_families: int, mesh: Mesh) -> tuple[int, int]:
+    """(this process's family count, its starting global row) under an even
+    split of n_global_families over the mesh's data axis. n_global_families
+    must divide evenly by the data size (use parallel.mesh.pad_families on
+    the concatenated global count, or pad per host with equal shares)."""
+    data_size = mesh.shape[DATA_AXIS]
+    if n_global_families % data_size:
+        raise ValueError(
+            f"{n_global_families} families do not split evenly over "
+            f"{data_size} devices; pad first (parallel.mesh.pad_families)"
+        )
+    per_dev = n_global_families // data_size
+    local_devs = [
+        d for d in mesh.devices[:, 0] if d.process_index == jax.process_index()
+    ]
+    first_row = min(
+        int(np.argwhere(mesh.devices[:, 0] == d)[0, 0]) for d in local_devs
+    )
+    return per_dev * len(local_devs), per_dev * first_row
+
+
+def global_family_batch(local_arrays, n_global_families: int, mesh: Mesh):
+    """Assemble global device arrays from per-process local family rows.
+
+    local_arrays: tuple of numpy arrays whose leading axis is this
+    process's family share (local_family_count rows, in global order).
+    Returns jax Arrays with global shape [n_global_families, ...], sharded
+    over the mesh's data axis, each shard resident on its own host."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    out = []
+    for a in local_arrays:
+        global_shape = (n_global_families,) + a.shape[1:]
+        out.append(
+            jax.make_array_from_process_local_data(sharding, a, global_shape)
+        )
+    return tuple(out)
+
+
+def local_rows(global_array, n_local: int) -> np.ndarray:
+    """Fetch this process's rows of a data-sharded output array, in global
+    row order, without touching other hosts' shards."""
+    shards = sorted(
+        global_array.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    parts = [np.asarray(s.data) for s in shards]
+    got = np.concatenate(parts, axis=0)
+    if got.shape[0] != n_local:
+        raise ValueError(
+            f"expected {n_local} local rows, found {got.shape[0]}"
+        )
+    return got

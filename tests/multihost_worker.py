@@ -1,0 +1,79 @@
+"""Worker for tests/test_multihost.py: one simulated host of a 2-process job.
+
+Run: python multihost_worker.py <port> <process_id> <outdir>
+Each process owns 4 virtual CPU devices; the 2-process mesh has 8 global
+devices on the family axis. The worker builds the SAME deterministic global
+batch as the test (same seed), feeds only its local family rows, runs the
+sharded packed molecular kernel over the global mesh, and saves its local
+output wire words. The test concatenates both hosts' words and compares
+against the single-process pack bit-for-bit.
+
+Writes <outdir>/result_<pid>.npz on success, <outdir>/skip_<pid>.txt when
+the distributed runtime is unavailable in this environment, and
+<outdir>/error_<pid>.txt on failure.
+"""
+
+import os
+import sys
+import traceback
+
+
+def main() -> None:
+    port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.parallel import multihost
+
+    try:
+        multihost.init_distributed(f"localhost:{port}", 2, pid)
+        assert jax.process_count() == 2, jax.process_count()
+        assert jax.device_count() == 8, jax.device_count()
+    except Exception as e:  # runtime lacks multi-process support
+        with open(os.path.join(outdir, f"skip_{pid}.txt"), "w") as fh:
+            fh.write(f"{type(e).__name__}: {e}")
+        return
+
+    from bsseqconsensusreads_tpu.models.params import ConsensusParams
+    from bsseqconsensusreads_tpu.parallel.sharding import (
+        sharded_molecular_packed,
+    )
+
+    F, T, W = 16, 5, 64  # divides evenly over 8 devices: 2 families each
+    rng = np.random.default_rng(77)  # SAME batch in every process
+    bases = rng.integers(0, 4, size=(F, T, 2, W)).astype(np.int8)
+    bases[rng.random(bases.shape) < 0.25] = 4
+    quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+
+    mesh = multihost.multihost_family_mesh()
+    n_local, first = multihost.local_family_count(F, mesh)
+    gb, gq = multihost.global_family_batch(
+        (bases[first : first + n_local], quals[first : first + n_local]),
+        F,
+        mesh,
+    )
+    wire = sharded_molecular_packed(mesh, ConsensusParams())(gb, gq)
+    wire.block_until_ready()
+    local_words = multihost.local_rows(wire, wire.shape[0] // 2)
+    np.savez(
+        os.path.join(outdir, f"result_{pid}.npz"),
+        words=local_words,
+        first=first,
+        n_local=n_local,
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        pid = sys.argv[2] if len(sys.argv) > 2 else "x"
+        out = sys.argv[3] if len(sys.argv) > 3 else "."
+        with open(os.path.join(out, f"error_{pid}.txt"), "w") as fh:
+            fh.write(traceback.format_exc())
+        raise

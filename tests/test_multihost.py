@@ -1,0 +1,111 @@
+"""Multi-host (multi-process) family sharding: 2 simulated hosts x 4 CPU
+devices vs the single-process reference, bit-for-bit on the packed wire.
+
+The reference scales by files + processes (SURVEY.md §5.8); this validates
+the framework's jax.distributed equivalent end to end: coordination-service
+init, host-major global mesh, zero-copy global batch assembly from
+process-local rows, sharded execution, and local-shard retrieval.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+class TestMultihostHelpers:
+    """process_count == 1 degeneracy of the multihost helpers (in-process,
+    8 virtual devices from conftest)."""
+
+    def test_mesh_and_local_split(self):
+        import jax
+
+        from bsseqconsensusreads_tpu.parallel import multihost
+
+        mesh = multihost.multihost_family_mesh()
+        assert mesh.shape["data"] == len(jax.devices())
+        n_local, first = multihost.local_family_count(16, mesh)
+        assert (n_local, first) == (16, 0)  # single process owns everything
+        with pytest.raises(ValueError, match="evenly"):
+            multihost.local_family_count(15, mesh)
+
+    def test_global_batch_roundtrip(self):
+        from bsseqconsensusreads_tpu.parallel import multihost
+
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 100, size=(16, 3)).astype(np.int8)
+        mesh = multihost.multihost_family_mesh()
+        (ga,) = multihost.global_family_batch((a,), 16, mesh)
+        assert ga.shape == (16, 3)
+        np.testing.assert_array_equal(multihost.local_rows(ga, 16), a)
+
+
+@pytest.mark.slow
+def test_two_process_packed_molecular_matches_single(tmp_path):
+    """Spawn 2 worker processes forming one jax.distributed job; their
+    local output wire shards concatenated must equal the single-process
+    packed molecular wire for the identical batch."""
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        try:
+            p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+
+    skips = sorted(tmp_path.glob("skip_*.txt"))
+    if skips:
+        pytest.skip(f"distributed runtime unavailable: {skips[0].read_text()}")
+    errors = sorted(tmp_path.glob("error_*.txt"))
+    assert not errors, errors[0].read_text()[-1500:]
+
+    parts = {}
+    for pid in range(2):
+        f = tmp_path / f"result_{pid}.npz"
+        assert f.exists(), f"worker {pid} produced no result"
+        parts[pid] = np.load(f)
+    # host-major mesh: process 0 owns the first half of the family rows
+    assert parts[0]["first"] < parts[1]["first"]
+    got = np.concatenate([parts[0]["words"], parts[1]["words"]])
+
+    from bsseqconsensusreads_tpu.models.molecular import (
+        packed_molecular_kernel,
+    )
+    from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+    F, T, W = 16, 5, 64
+    rng = np.random.default_rng(77)  # the workers' exact batch
+    bases = rng.integers(0, 4, size=(F, T, 2, W)).astype(np.int8)
+    bases[rng.random(bases.shape) < 0.25] = 4
+    quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
+    want = np.asarray(packed_molecular_kernel()(bases, quals, ConsensusParams()))
+    np.testing.assert_array_equal(got, want)
