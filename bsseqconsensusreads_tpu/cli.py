@@ -39,6 +39,14 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
         default="coordinate",
         help="MI-group streaming strategy (coordinate = bounded memory on sorted input)",
     )
+    p.add_argument(
+        "--emit",
+        choices=("auto", "native", "python"),
+        default="auto",
+        help="record emission: native C++ batch serializer vs per-record "
+        "Python objects (auto = native when built; 'self' mode always "
+        "uses python, its output is coordinate-sorted)",
+    )
 
 
 def _params(args, **kw) -> ConsensusParams:
@@ -85,14 +93,34 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _write_batches(batches, out_path: str, header, mode: str) -> None:
+    """Stream consensus batches to the output BAM: straight through
+    (handles RawRecords blocks from the native emitter), or via an
+    external-merge coordinate sort in 'self' mode — never the whole
+    output in RAM."""
+    from bsseqconsensusreads_tpu.io.bam import BamWriter, write_items
+    from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
+    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+    with BamWriter(out_path, header) as writer:
+        if mode == "self":
+            recs = (rec for batch in batches for rec in batch)
+            writer.write_all(external_sort(recs, coordinate_key, header))
+        else:
+            for batch in batches:
+                write_items(writer, batch)
+
+
 def cmd_molecular(args) -> int:
-    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
-    from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_molecular
-    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_sort
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.pipeline.calling import (
+        StageStats,
+        call_molecular_batches,
+    )
 
     stats = StageStats()
     with BamReader(args.input) as reader:
-        recs = call_molecular(
+        batches = call_molecular_batches(
             reader,
             params=_params(args),
             mode=args.mode,
@@ -100,27 +128,26 @@ def cmd_molecular(args) -> int:
             max_window=args.max_window,
             grouping=args.grouping,
             stats=stats,
+            emit="python" if args.mode == "self" else args.emit,
         )
-        out = list(recs)
-        if args.mode == "self":
-            out = coordinate_sort(out)
-        with BamWriter(args.output, reader.header) as writer:
-            writer.write_all(out)
+        _write_batches(batches, args.output, reader.header, args.mode)
     print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 
 
 def cmd_duplex(args) -> int:
-    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.io.bam import BamReader
     from bsseqconsensusreads_tpu.io.fasta import FastaFile
-    from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_duplex
-    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_sort
+    from bsseqconsensusreads_tpu.pipeline.calling import (
+        StageStats,
+        call_duplex_batches,
+    )
 
     stats = StageStats()
     fasta = FastaFile(args.reference)
     with BamReader(args.input) as reader:
         names = [n for n, _ in reader.header.references]
-        recs = call_duplex(
+        batches = call_duplex_batches(
             reader,
             fasta.fetch,
             names,
@@ -130,12 +157,9 @@ def cmd_duplex(args) -> int:
             max_window=args.max_window,
             grouping=args.grouping,
             stats=stats,
+            emit="python" if args.mode == "self" else args.emit,
         )
-        out = list(recs)
-        if args.mode == "self":
-            out = coordinate_sort(out)
-        with BamWriter(args.output, reader.header) as writer:
-            writer.write_all(out)
+        _write_batches(batches, args.output, reader.header, args.mode)
     print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 

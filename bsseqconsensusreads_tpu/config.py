@@ -56,6 +56,13 @@ class FrameworkConfig:
     #: stage falls back to python ingest under duplex_passthrough (native
     #: views carry only MI/RX, not the full tag set leftovers must keep).
     ingest: str = "auto"
+    #: consensus-stage record emission: 'native' serializes whole kernel
+    #: batches to BAM bytes in C++ (io.wirepack.emit_consensus_records —
+    #: byte-identical to the Python path, skips per-record object building
+    #: and encode), 'python' builds BamRecord objects, 'auto' picks native
+    #: when built and the stage output is order-preserving (the 'self'
+    #: aligner mode coordinate-sorts downstream, which needs objects).
+    emit: str = "auto"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)
     #: through to the output the way the reference chain would

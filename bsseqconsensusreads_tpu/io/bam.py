@@ -419,6 +419,20 @@ class BamReader:
                 raise BamError("truncated BAM record")
             yield decode_record(data)
 
+    def raw_records(self) -> Iterator[bytes]:
+        """Stream encoded record blocks (incl. their block_size prefix)
+        WITHOUT decoding — for record-preserving copies (e.g. checkpoint
+        shard concatenation) where parse+re-encode is pure waste."""
+        while True:
+            raw = self._bgzf.read(4)
+            if len(raw) < 4:
+                return
+            (block_size,) = struct.unpack("<i", raw)
+            data = self._bgzf.read(block_size)
+            if len(data) < block_size:
+                raise BamError("truncated BAM record")
+            yield raw + data
+
     def get_reference_name(self, rid: int) -> str:
         return self.header.ref_name(rid)
 
@@ -430,6 +444,34 @@ class BamReader:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class RawRecords:
+    """A block of pre-encoded BAM records (native batch emit output).
+
+    Batch streams may carry these alongside BamRecord objects; writers
+    append the blob verbatim (write_items). count keeps record accounting
+    (checkpoint manifests, stage stats) without decoding."""
+
+    __slots__ = ("blob", "count")
+
+    def __init__(self, blob: bytes, count: int):
+        self.blob = blob
+        self.count = count
+
+
+def write_items(writer: "BamWriter", items) -> int:
+    """Write a mixed sequence of BamRecord / RawRecords; returns the record
+    count written."""
+    n = 0
+    for item in items:
+        if isinstance(item, RawRecords):
+            writer.write_raw(item.blob)
+            n += item.count
+        else:
+            writer.write(item)
+            n += 1
+    return n
 
 
 class BamWriter:
@@ -456,6 +498,13 @@ class BamWriter:
 
     def write(self, rec: BamRecord) -> None:
         self._bgzf.write(encode_record(rec))
+
+    def write_raw(self, blob: bytes) -> None:
+        """Append pre-encoded record bytes (one or more complete records,
+        each with its block_size prefix) — the native batch emitter
+        (io.wirepack.emit_consensus_records) and raw_records() produce
+        these."""
+        self._bgzf.write(blob)
 
     def write_all(self, recs: Iterable[BamRecord]) -> None:
         for rec in recs:

@@ -24,6 +24,7 @@ _load_error: str | None = None
 # Error codes from native/wirepack.cpp.
 _ERR_TOO_MANY_LEVELS = -2
 _ERR_QUAL_TOO_HIGH = -3
+_ERR_QNAME_TOO_LONG = -5
 
 
 def _try_load():
@@ -47,6 +48,14 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p,
     ]
+    lib.wirepack_emit_consensus_records.restype = C.c_int
+    lib.wirepack_emit_consensus_records.argtypes = (
+        [C.c_void_p] * 6
+        + [C.c_int64, C.c_int64]
+        + [C.c_void_p] * 10
+        + [C.c_int, C.c_int, C.c_void_p, C.c_int64]
+        + [C.c_void_p] * 3
+    )
     _lib = lib
 
 
@@ -149,3 +158,104 @@ def unpack_duplex_outputs(wire_u8: np.ndarray, f: int, w: int) -> dict:
         out["b_depth"].ctypes.data_as(C.c_void_p),
     )
     return {k: v.reshape(f, 2, w) for k, v in out.items()}
+
+
+def _string_blob(strings: list[str]):
+    """(blob u8, offsets i32, lengths i32) for a list of ascii strings."""
+    lens = np.fromiter(
+        (len(s) for s in strings), dtype=np.int32, count=len(strings)
+    )
+    offs = np.zeros(len(strings), dtype=np.int32)
+    if len(strings) > 1:
+        np.cumsum(lens[:-1], out=offs[1:])
+    if strings:
+        blob = np.frombuffer(
+            "".join(strings).encode("ascii"), dtype=np.uint8
+        ).copy()
+    else:
+        blob = np.zeros(0, np.uint8)
+    return blob, offs, lens
+
+
+def emit_consensus_records(
+    out: dict,
+    *,
+    ref_id,
+    window_start,
+    n_reads,
+    role_reverse,
+    mi: list[str],
+    rx: list[str],
+    min_reads: int,
+    mode_self: bool,
+    duplex: bool,
+) -> tuple[bytes, int, int]:
+    """Native batch emit: kernel output planes -> BAM record bytes.
+
+    out: dict of [f, 2, w] arrays (base int8, qual uint8, depth/errors
+    int16, plus a_depth/b_depth int8 when duplex). Per-family metadata as
+    documented on wirepack_emit_consensus_records (native/wirepack.cpp).
+    rx entries may be "" (no RX tag). Returns (record bytes, n_records,
+    n_families_skipped); the bytes are ready for BamWriter.write_raw —
+    byte-identical to the Python emit + encode_record path
+    (pipeline.calling cites: _emit_molecular_batch/_emit_duplex_batch).
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    base = np.ascontiguousarray(out["base"], dtype=np.int8)
+    qual = np.ascontiguousarray(out["qual"], dtype=np.uint8)
+    depth = np.ascontiguousarray(out["depth"], dtype=np.int16)
+    errors = np.ascontiguousarray(out["errors"], dtype=np.int16)
+    f, _, w = base.shape
+    if duplex:
+        a_depth = np.ascontiguousarray(out["a_depth"], dtype=np.int8)
+        b_depth = np.ascontiguousarray(out["b_depth"], dtype=np.int8)
+        a_ptr = a_depth.ctypes.data_as(C.c_void_p)
+        b_ptr = b_depth.ctypes.data_as(C.c_void_p)
+    else:
+        a_ptr = b_ptr = None
+    ref_id = np.ascontiguousarray(ref_id, dtype=np.int32)
+    window_start = np.ascontiguousarray(window_start, dtype=np.int64)
+    n_reads = np.ascontiguousarray(n_reads, dtype=np.int32)
+    role_reverse = np.ascontiguousarray(role_reverse, dtype=np.uint8)
+    mi_blob, mi_off, mi_len = _string_blob(mi)
+    rx_blob, rx_off, rx_len = _string_blob(rx)
+    mi_max = int(mi_len.max()) if len(mi) else 0
+    rx_max = int(rx_len.max()) if len(rx) else 0
+    cap = int(f) * 2 * ((10 + 4 * duplex) * int(w) + 2 * mi_max + rx_max + 160)
+    buf = np.empty(max(cap, 4096), dtype=np.uint8)
+    out_len = C.c_int64(0)
+    n_records = C.c_int64(0)
+    n_skipped = C.c_int64(0)
+    rc = _lib.wirepack_emit_consensus_records(
+        base.ctypes.data_as(C.c_void_p),
+        qual.ctypes.data_as(C.c_void_p),
+        depth.ctypes.data_as(C.c_void_p),
+        errors.ctypes.data_as(C.c_void_p),
+        a_ptr, b_ptr, f, w,
+        ref_id.ctypes.data_as(C.c_void_p),
+        window_start.ctypes.data_as(C.c_void_p),
+        n_reads.ctypes.data_as(C.c_void_p),
+        role_reverse.ctypes.data_as(C.c_void_p),
+        mi_blob.ctypes.data_as(C.c_void_p),
+        mi_off.ctypes.data_as(C.c_void_p),
+        mi_len.ctypes.data_as(C.c_void_p),
+        rx_blob.ctypes.data_as(C.c_void_p),
+        rx_off.ctypes.data_as(C.c_void_p),
+        rx_len.ctypes.data_as(C.c_void_p),
+        int(min_reads), int(bool(mode_self)),
+        buf.ctypes.data_as(C.c_void_p), buf.size,
+        C.byref(out_len), C.byref(n_records), C.byref(n_skipped),
+    )
+    if rc == _ERR_QNAME_TOO_LONG:
+        raise ValueError(
+            "an MI qname exceeds BAM's 254-char l_read_name limit"
+        )
+    if rc != 0:
+        raise ValueError(
+            f"native record emit overflowed its {buf.size}-byte buffer"
+        )
+    # tobytes() trims the used span out of the (deliberately oversized)
+    # scratch buffer so downstream holders don't pin the full capacity
+    return buf[: out_len.value].tobytes(), n_records.value, n_skipped.value

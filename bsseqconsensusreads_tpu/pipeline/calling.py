@@ -41,6 +41,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     FREVERSE,
     FUNMAP,
     CMATCH,
+    RawRecords,
 )
 import jax
 
@@ -424,6 +425,84 @@ def _emit_read(
     )
 
 
+def _resolve_emit(emit: str, mode: str) -> str:
+    """'auto' -> the native batch emitter when built AND the stage output
+    is order-preserving (the 'self' modes coordinate-sort downstream, which
+    needs record objects); 'native' demands it; 'python' forces the
+    object path."""
+    if emit not in ("auto", "native", "python"):
+        raise ValueError(f"unknown emit {emit!r}; use auto|native|python")
+    if emit == "python":
+        return "python"
+    from bsseqconsensusreads_tpu.io import wirepack
+
+    if emit == "native":
+        if mode == "self":
+            raise ValueError(
+                "emit 'native' requires an order-preserving mode; the "
+                "'self' stage output is coordinate-sorted downstream"
+            )
+        if not wirepack.available():
+            raise OSError(
+                f"native emit unavailable: {wirepack.load_error()}"
+            )
+        return "native"
+    if mode != "self" and wirepack.available():
+        return "native"
+    return "python"
+
+
+def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
+                    role_reverse, duplex) -> RawRecords:
+    """Native batch emit (io.wirepack) — byte-identical to the Python
+    emit + encode_record path, minus the per-record Python."""
+    from bsseqconsensusreads_tpu.io import wirepack
+
+    blob, n, skipped = wirepack.emit_consensus_records(
+        out,
+        ref_id=[m.ref_id for m in batch.meta],
+        window_start=[m.window_start for m in batch.meta],
+        n_reads=n_reads,
+        role_reverse=role_reverse,
+        mi=[m.mi for m in batch.meta],
+        rx=[m.rx or "" for m in batch.meta],
+        min_reads=params.min_reads,
+        mode_self=(mode == "self"),
+        duplex=duplex,
+    )
+    stats.families += len(batch.meta)
+    stats.skipped_families += skipped
+    stats.consensus_out += n
+    return RawRecords(blob, n)
+
+
+def _emit_molecular_batch_raw(batch, out, params, mode, stats) -> RawRecords:
+    return _emit_batch_raw(
+        batch, out, params, mode, stats,
+        n_reads=(batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
+        .astype(np.int32),
+        role_reverse=np.array(
+            [
+                [int(m.role_reverse[0]), int(m.role_reverse[1])]
+                for m in batch.meta
+            ],
+            np.uint8,
+        ),
+        duplex=False,
+    )
+
+
+def _emit_duplex_batch_raw(batch, out, params, mode, stats) -> RawRecords:
+    """Duplex variant: adds the per-strand tag surface aD/bD/aM/bM/ad/bd;
+    roles are (forward, reverse) by construction."""
+    return _emit_batch_raw(
+        batch, out, params, mode, stats,
+        n_reads=np.array([m.n_templates for m in batch.meta], np.int32),
+        role_reverse=np.tile(np.array([0, 1], np.uint8), (len(batch.meta), 1)),
+        duplex=True,
+    )
+
+
 def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
     """Build consensus records from one molecular kernel output batch.
     Shared by the single-device, family-sharded, and deep-family paths."""
@@ -493,12 +572,18 @@ def call_molecular_batches(
     indel_policy: str = "drop",
     mesh="auto",
     deep_threshold: int | None = None,
-) -> Iterator[list[BamRecord]]:
+    emit: str = "python",
+) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
     (pipeline.checkpoint): batching is deterministic given identical input
     and parameters, so skip_batches replays the stream past already-
     checkpointed batches without re-running encode or the TPU kernel.
+
+    emit: 'python' yields lists of BamRecord; 'native'/'auto' yield lists
+    whose first element may be an io.bam.RawRecords block (the C++ batch
+    emitter — byte-identical records without per-record Python; deep
+    families stay objects). Writers handle both via io.bam.write_items.
 
     min_reads filters whole families by raw read count (fgbio --min-reads=1
     drops nothing; larger values drop shallow families). grouping controls
@@ -520,6 +605,11 @@ def call_molecular_batches(
 
     stats = stats if stats is not None else StageStats()
     consensus_fn = _molecular_kernel(vote_kernel)
+    emit_fn = (
+        _emit_molecular_batch_raw
+        if _resolve_emit(emit, mode) == "native"
+        else _emit_molecular_batch
+    )
     if deep_threshold is None:
         deep_threshold = encode_mod.MAX_TEMPLATES
     t0 = time.monotonic()
@@ -563,10 +653,10 @@ def call_molecular_batches(
         with stats.metrics.timed("fetch"):
             out = unpack_molecular_outputs(jax.device_get(wire), f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
-        return (
-            _emit_molecular_batch(batch, out, params, mode, stats)
-            + deep_emitted
-        )
+        main = emit_fn(batch, out, params, mode, stats)
+        if isinstance(main, RawRecords):
+            return [main] + deep_emitted
+        return main + deep_emitted
 
     def run_deep_kernel(batch):
         """One deep family [1, T, 2, W]: template axis over the devices."""
@@ -749,10 +839,12 @@ def call_duplex_batches(
     mesh="auto",
     passthrough: bool = False,
     vote_kernel: str | None = None,
-) -> Iterator[list[BamRecord]]:
+    emit: str = "python",
+) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
-    unit — see call_molecular_batches for the skip_batches contract).
+    unit — see call_molecular_batches for the skip_batches and `emit`
+    contracts; passthrough records stay objects either way).
 
     Input: the aligned, tag-zipped, mapped-only molecular consensus BAM
     (reference checkpoint `…_aunamerged_aligned.bam`) — or, in self-aligned
@@ -776,6 +868,11 @@ def call_duplex_batches(
 
     stats = stats if stats is not None else StageStats()
     kernel = vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
+    emit_fn = (
+        _emit_duplex_batch_raw
+        if _resolve_emit(emit, mode) == "native"
+        else _emit_duplex_batch
+    )
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
     sharded_fn = None
@@ -814,7 +911,10 @@ def call_duplex_batches(
         with stats.metrics.timed("fetch"):
             out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
-        return _emit_duplex_batch(batch, out, params, mode, stats) + passed
+        main = emit_fn(batch, out, params, mode, stats)
+        if isinstance(main, RawRecords):
+            return [main] + passed
+        return main + passed
 
     groups = _timed_groups(
         stream_mi_groups(

@@ -29,7 +29,13 @@ import json
 import os
 from typing import Iterable, Iterator
 
-from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamRecord, BamWriter
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    write_items,
+)
 
 
 @dataclasses.dataclass
@@ -105,10 +111,12 @@ class BatchCheckpoint:
     def _shard_path(self, index: int) -> str:
         return f"{self.target}.part{index:05d}.bam"
 
-    def write_batches(self, batches: Iterable[list[BamRecord]]) -> None:
+    def write_batches(self, batches: Iterable[list]) -> None:
         """Consume a batch stream (already offset by skip_batches), flushing
-        a shard + manifest update every `every` batches."""
-        buf: list[BamRecord] = []
+        a shard + manifest update every `every` batches. Batch items may be
+        BamRecord objects or io.bam.RawRecords blocks (the native batch
+        emitter) — shards hold identical bytes either way."""
+        buf: list = []
         pending = 0
         for batch in batches:
             buf.extend(batch)
@@ -119,16 +127,16 @@ class BatchCheckpoint:
         if pending:
             self._flush(buf, pending)
 
-    def _flush(self, records: list[BamRecord], n_batches: int) -> None:
+    def _flush(self, items: list, n_batches: int) -> None:
         path = self._shard_path(len(self.manifest.shards))
         with BamWriter(path, self.header) as w:
-            w.write_all(records)
+            n = write_items(w, items)
         # the shard must hit disk BEFORE the manifest claims it durable
         with open(path, "rb") as fh:
             os.fsync(fh.fileno())
         self.manifest.batches_done += n_batches
         self.manifest.shards.append(os.path.basename(path))
-        self.manifest.records += len(records)
+        self.manifest.records += n
         self.manifest.save(self.manifest_path)
 
     def iter_records(self) -> Iterator[BamRecord]:
@@ -154,9 +162,19 @@ class BatchCheckpoint:
         n = 0
         tmp = self.target + ".finalize.tmp"
         with BamWriter(tmp, self.header) as w:
-            for rec in (records if records is not None else self.iter_records()):
-                w.write(rec)
-                n += 1
+            if records is None:
+                # raw-order concatenation: copy each shard's record bytes
+                # verbatim (no decode/re-encode round trip)
+                d = os.path.dirname(self.target)
+                for shard in self.manifest.shards:
+                    with BamReader(os.path.join(d, shard)) as r:
+                        for blob in r.raw_records():
+                            w.write_raw(blob)
+                            n += 1
+            else:
+                for rec in records:
+                    w.write(rec)
+                    n += 1
         os.replace(tmp, self.target)
         self._discard_scratch()
         return n

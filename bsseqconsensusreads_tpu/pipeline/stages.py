@@ -24,7 +24,12 @@ import shlex
 import subprocess
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
-from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamWriter
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamWriter,
+    write_items,
+)
 from bsseqconsensusreads_tpu.io.fasta import FastaFile
 from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
 from bsseqconsensusreads_tpu.io.sam import read_sam
@@ -83,17 +88,26 @@ class PipelineBuilder:
         """Write a consensus batch stream: straight through, or via durable
         per-batch shards when intra-stage checkpointing is on (the batch
         stream is already offset by ck.batches_done). The 'self' mode's
-        coordinate sort is external-merge, never whole-file in RAM."""
+        coordinate sort is external-merge, never whole-file in RAM. Batch
+        items may be BamRecord objects or io.bam.RawRecords blocks (native
+        batch emit; never under 'self', which must sort records)."""
         if ck is not None:
             ck.write_batches(batches)
-            recs = ck.iter_records()
-            ck.finalize(self._sorted(recs, header) if mode == "self" else recs)
+            ck.finalize(
+                self._sorted(ck.iter_records(), header)
+                if mode == "self" else None  # None = raw shard concatenation
+            )
             return
-        recs = (rec for batch in batches for rec in batch)
         if mode == "self":
-            recs = self._sorted(recs, header)
+            recs = self._sorted(
+                (rec for batch in batches for rec in batch), header
+            )
+            with BamWriter(out_path, header) as writer:
+                writer.write_all(recs)
+            return
         with BamWriter(out_path, header) as writer:
-            writer.write_all(recs)
+            for batch in batches:
+                write_items(writer, batch)
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
         """Arm intra-stage checkpointing for one stage target, fingerprinted
@@ -176,6 +190,7 @@ class PipelineBuilder:
                 stats=stats,
                 skip_batches=ck.batches_done if ck else 0,
                 indel_policy=self.cfg.indel_policy,
+                emit=self.cfg.emit,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 
@@ -203,6 +218,7 @@ class PipelineBuilder:
                 stats=stats,
                 skip_batches=ck.batches_done if ck else 0,
                 passthrough=self.cfg.duplex_passthrough,
+                emit=self.cfg.emit,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 

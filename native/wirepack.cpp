@@ -19,10 +19,72 @@
 
 namespace {
 
+// ---- BAM record serialization constants (mirror io/bam.py) ----
+
+// framework base code (A=0 C=1 G=2 T=3 N=4) -> SAM nt16 nibble
+constexpr uint8_t kNt16[5] = {1, 2, 4, 8, 15};
+// complement in framework code space (A<->T, C<->G, N->N)
+constexpr uint8_t kComp[5] = {3, 2, 1, 0, 4};
+
+constexpr uint16_t kPaired = 0x1, kProperPair = 0x2, kUnmap = 0x4,
+                   kMUnmap = 0x8, kReverse = 0x10, kMReverse = 0x20,
+                   kRead1 = 0x40, kRead2 = 0x80;
+
+// BAI binning, SAM spec section 5.3 (identical to io/bam.py reg2bin)
+inline uint16_t reg2bin(int64_t beg, int64_t end) {
+  --end;
+  if (end < 0) end = 0;
+  if (beg < 0) beg = 0;
+  if (beg >> 14 == end >> 14) return uint16_t(((1 << 15) - 1) / 7 + (beg >> 14));
+  if (beg >> 17 == end >> 17) return uint16_t(((1 << 12) - 1) / 7 + (beg >> 17));
+  if (beg >> 20 == end >> 20) return uint16_t(((1 << 9) - 1) / 7 + (beg >> 20));
+  if (beg >> 23 == end >> 23) return uint16_t(((1 << 6) - 1) / 7 + (beg >> 23));
+  if (beg >> 26 == end >> 26) return uint16_t(((1 << 3) - 1) / 7 + (beg >> 26));
+  return 0;
+}
+
+struct Cursor {
+  uint8_t* p;
+  const uint8_t* end;
+  bool overflow = false;
+
+  inline void need(int64_t n) {
+    if (p + n > end) overflow = true;
+  }
+  inline void put_bytes(const void* src, int64_t n) {
+    need(n);
+    if (!overflow) std::memcpy(p, src, size_t(n));
+    p += n;
+  }
+  inline void put_u8(uint8_t v) { put_bytes(&v, 1); }
+  inline void put_u16(uint16_t v) { put_bytes(&v, 2); }
+  inline void put_i32(int32_t v) { put_bytes(&v, 4); }
+  inline void put_u32(uint32_t v) { put_bytes(&v, 4); }
+  inline void put_f32(float v) { put_bytes(&v, 4); }
+};
+
+inline void put_int_tag(Cursor& c, const char* key, int32_t v) {
+  c.put_bytes(key, 2);
+  c.put_u8('i');
+  c.put_i32(v);
+}
+
+// B:S (uint16) array tag from int16/int8 sources
+template <typename T>
+inline void put_arr_tag(Cursor& c, const char* key, const T* vals,
+                        const int32_t* idx, int64_t n) {
+  c.put_bytes(key, 2);
+  c.put_u8('B');
+  c.put_u8('S');
+  c.put_u32(uint32_t(n));
+  for (int64_t i = 0; i < n; ++i) c.put_u16(uint16_t(vals[idx[i]]));
+}
+
 // Error codes mirrored by the Python wrapper (io/wirepack.py).
 constexpr int kErrTooManyLevels = -2;  // explicit mode, levels overflow book
 constexpr int kErrQualTooHigh = -3;    // covered qual > 93 (BAM printable max)
 constexpr int kErrBadMode = -4;
+constexpr int kErrQnameTooLong = -5;   // BAM l_read_name is a uint8
 
 inline int resolve_auto(int nlevels, bool has_255, int max_level) {
   if (nlevels > 16 || has_255 || max_level > 93) return 8;
@@ -162,6 +224,216 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
   while (nbytes & 3) dst[nbytes++] = 0;
   *qual_len_out = book + nbytes;
   return bits;
+}
+
+// Emit one consensus batch as ready-to-write BAM record bytes.
+//
+// The per-record Python path (pipeline.calling._emit_* + io.bam
+// encode_record) costs ~50-100 us/record — the production wall once the
+// kernel runs on TPU. This is the whole batch in one sweep, byte-identical
+// to the Python records (tests/test_recordemit.py diffs them).
+//
+// Per-column planes, C-contiguous [f, 2, w]:
+//   base int8 (framework codes), qual uint8, depth int16, errors int16,
+//   a_depth/b_depth int8 or NULL (duplex per-strand tags when present).
+// Per-family meta:
+//   ref_id int32, window_start int64, n_reads int32 (min_reads filter
+//   operand), role_reverse uint8 [f, 2],
+//   mi/rx string blobs with per-family (offset, len) — rx len 0 = absent.
+// mode_self: 1 = aligned self-mode records, 0 = unaligned records.
+//
+// Returns 0; -1 when out_cap is too small (nothing useful in out); -5 when
+// a qname would overflow BAM's uint8 l_read_name (the Python encoder
+// raises for the same input — silent truncation would corrupt the record
+// stream). n_records/n_skipped report emitted records and
+// min_reads-skipped families for StageStats.
+int wirepack_emit_consensus_records(
+    const int8_t* base, const uint8_t* qual, const int16_t* depth,
+    const int16_t* errors, const int8_t* a_depth, const int8_t* b_depth,
+    int64_t f, int64_t w, const int32_t* ref_id, const int64_t* window_start,
+    const int32_t* n_reads, const uint8_t* role_reverse,
+    const uint8_t* mi_blob, const int32_t* mi_off, const int32_t* mi_len,
+    const uint8_t* rx_blob, const int32_t* rx_off, const int32_t* rx_len,
+    int min_reads, int mode_self, uint8_t* out, int64_t out_cap,
+    int64_t* out_len, int64_t* n_records, int64_t* n_skipped) {
+  for (int64_t fi = 0; fi < f; ++fi)
+    if (mi_len[fi] + 1 > 255) return kErrQnameTooLong;
+  Cursor c{out, out + out_cap};
+  int64_t records = 0, skipped = 0;
+  // scratch (static cap: w is the bucketed window, <= a few thousand)
+  int32_t* cov = new int32_t[2 * w];
+  uint8_t* codes = new uint8_t[w];
+  uint8_t* rqual = new uint8_t[w];
+
+  for (int64_t fi = 0; fi < f; ++fi) {
+    if (n_reads[fi] < min_reads) {
+      ++skipped;
+      continue;
+    }
+    int32_t* covs[2] = {cov, cov + w};
+    int64_t ncov[2];
+    int64_t starts[2];
+    for (int role = 0; role < 2; ++role) {
+      const int16_t* d = depth + (fi * 2 + role) * w;
+      int64_t n = 0;
+      for (int64_t i = 0; i < w; ++i)
+        if (d[i] > 0) covs[role][n++] = int32_t(i);
+      ncov[role] = n;
+      starts[role] = n ? window_start[fi] + covs[role][0] : -1;
+    }
+    for (int role = 0; role < 2; ++role) {
+      const int64_t n = ncov[role];
+      if (n == 0) continue;
+      const int64_t row = (fi * 2 + role) * w;
+      const int32_t* cv = covs[role];
+      // tlen (same expression as the Python emitters)
+      int32_t tlen = 0;
+      if (starts[0] >= 0 && starts[1] >= 0) {
+        const int64_t lo = starts[0] < starts[1] ? starts[0] : starts[1];
+        int64_t hi = 0;
+        for (int r2 = 0; r2 < 2; ++r2) {
+          const int64_t h = window_start[fi] + covs[r2][ncov[r2] - 1] + 1;
+          if (h > hi) hi = h;
+        }
+        tlen = int32_t(starts[role] == lo ? hi - lo : lo - hi);
+      }
+      const bool reverse = role_reverse[fi * 2 + role] != 0;
+      const bool mate_reverse = role_reverse[fi * 2 + (1 - role)] != 0;
+      const int64_t mate_pos = starts[1 - role];
+
+      uint16_t flag;
+      int32_t rec_ref, rec_pos, rec_next_ref, rec_next_pos, rec_tlen;
+      uint8_t mapq;
+      uint16_t n_cigar;
+      if (mode_self) {
+        flag = kPaired | (role ? kRead2 : kRead1);
+        if (mate_pos >= 0) {
+          flag |= kProperPair;
+          if (mate_reverse) flag |= kMReverse;
+        } else {
+          flag |= kMUnmap;
+        }
+        if (reverse) flag |= kReverse;
+        rec_ref = ref_id[fi];
+        rec_pos = int32_t(starts[role]);
+        mapq = 60;
+        n_cigar = 1;
+        rec_next_ref = mate_pos >= 0 ? ref_id[fi] : -1;
+        rec_next_pos = int32_t(mate_pos >= 0 ? mate_pos : -1);
+        rec_tlen = tlen;
+      } else {
+        flag = kPaired | kUnmap | kMUnmap | (role ? kRead2 : kRead1);
+        rec_ref = -1;
+        rec_pos = -1;
+        mapq = 0;
+        n_cigar = 0;
+        rec_next_ref = -1;
+        rec_next_pos = -1;
+        rec_tlen = 0;
+      }
+
+      // base codes + quals in emission orientation
+      const bool flip = !mode_self && reverse;
+      for (int64_t i = 0; i < n; ++i) {
+        const int64_t src = flip ? n - 1 - i : i;
+        uint8_t code = uint8_t(base[row + cv[src]]);
+        if (code > 4) code = 4;
+        codes[i] = flip ? kComp[code] : code;
+        rqual[i] = qual[row + cv[src]];
+      }
+
+      const int32_t l_qname = mi_len[fi] + 1;  // + NUL
+      const int64_t body_start_needed =
+          4 + 32 + l_qname + 4 * n_cigar + (n + 1) / 2 + n;
+      c.need(body_start_needed);  // early bail keeps memcpy ranges valid
+      if (c.overflow) break;
+
+      uint8_t* block_size_at = c.p;
+      c.p += 4;  // block_size backpatched below
+      const int64_t ref_end = mode_self ? starts[role] + n : 1;
+      c.put_i32(rec_ref);
+      c.put_i32(rec_pos);
+      c.put_u8(uint8_t(l_qname));
+      c.put_u8(mapq);
+      c.put_u16(reg2bin(mode_self ? starts[role] : 0, ref_end));
+      c.put_u16(n_cigar);
+      c.put_u16(flag);
+      c.put_u32(uint32_t(n));
+      c.put_i32(rec_next_ref);
+      c.put_i32(rec_next_pos);
+      c.put_i32(rec_tlen);
+      c.put_bytes(mi_blob + mi_off[fi], mi_len[fi]);
+      c.put_u8(0);
+      if (n_cigar) c.put_u32(uint32_t(n) << 4);  // one M run
+      for (int64_t i = 0; i + 1 < n; i += 2)
+        c.put_u8(uint8_t((kNt16[codes[i]] << 4) | kNt16[codes[i + 1]]));
+      if (n & 1) c.put_u8(uint8_t(kNt16[codes[n - 1]] << 4));
+      c.put_bytes(rqual, n);
+
+      // tags, in the Python emitters' dict order:
+      // MI cD cM cE cd ce [RX] [aD bD aM bM ad bd]
+      c.put_bytes("MI", 2);
+      c.put_u8('Z');
+      c.put_bytes(mi_blob + mi_off[fi], mi_len[fi]);
+      c.put_u8(0);
+      const int16_t* drow = depth + row;
+      const int16_t* erow = errors + row;
+      int32_t dmax = 0, dmin = INT32_MAX;
+      int64_t dtot = 0, etot = 0;
+      for (int64_t i = 0; i < n; ++i) {
+        const int32_t dv = drow[cv[i]];
+        if (dv > dmax) dmax = dv;
+        if (dv < dmin) dmin = dv;
+        dtot += dv;
+        etot += erow[cv[i]];
+      }
+      put_int_tag(c, "cD", dmax);
+      put_int_tag(c, "cM", dmin);
+      c.put_bytes("cE", 2);
+      c.put_u8('f');
+      c.put_f32(dtot ? float(double(etot) / double(dtot)) : 0.0f);
+      put_arr_tag(c, "cd", drow, cv, n);
+      put_arr_tag(c, "ce", erow, cv, n);
+      if (rx_len[fi] > 0) {
+        c.put_bytes("RX", 2);
+        c.put_u8('Z');
+        c.put_bytes(rx_blob + rx_off[fi], rx_len[fi]);
+        c.put_u8(0);
+      }
+      if (a_depth != nullptr) {
+        const int8_t* arow = a_depth + row;
+        const int8_t* brow = b_depth + row;
+        int32_t amax = INT32_MIN, amin = INT32_MAX;
+        int32_t bmax = INT32_MIN, bmin = INT32_MAX;
+        for (int64_t i = 0; i < n; ++i) {
+          const int32_t av = arow[cv[i]], bv = brow[cv[i]];
+          if (av > amax) amax = av;
+          if (av < amin) amin = av;
+          if (bv > bmax) bmax = bv;
+          if (bv < bmin) bmin = bv;
+        }
+        put_int_tag(c, "aD", amax);
+        put_int_tag(c, "bD", bmax);
+        put_int_tag(c, "aM", amin);
+        put_int_tag(c, "bM", bmin);
+        put_arr_tag(c, "ad", arow, cv, n);
+        put_arr_tag(c, "bd", brow, cv, n);
+      }
+      if (c.overflow) break;
+      const int32_t block_size = int32_t(c.p - block_size_at - 4);
+      std::memcpy(block_size_at, &block_size, 4);
+      ++records;
+    }
+    if (c.overflow) break;
+  }
+  delete[] cov;
+  delete[] codes;
+  delete[] rqual;
+  if (c.overflow) return -1;
+  *out_len = c.p - out;
+  *n_records = records;
+  *n_skipped = skipped;
+  return 0;
 }
 
 // Unpack the family-major planar duplex output wire
