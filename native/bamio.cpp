@@ -14,7 +14,12 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 #include <zlib.h>
 
@@ -38,6 +43,147 @@ struct Writer {
   int level = 6;
   std::string err;
 };
+
+bool compress_block(const uint8_t* data, size_t n, int level,
+                    std::vector<uint8_t>& out, std::string& err);
+
+// Shared BGZF payload chunking: fill `buf` to exactly 65280 bytes, then
+// hand off via flush() (which must leave buf ready for refill). One source
+// of truth for the block-boundary invariant both writers' byte-identical
+// guarantee rests on.
+template <typename FlushFn>
+int buffered_write(std::vector<uint8_t>& buf, const uint8_t* data, int64_t n,
+                   FlushFn flush) {
+  int64_t off = 0;
+  while (off < n) {
+    size_t room = 65280 - buf.size();
+    size_t take = size_t(n - off) < room ? size_t(n - off) : room;
+    buf.insert(buf.end(), data + off, data + off + take);
+    off += take;
+    if (buf.size() == 65280) {
+      if (!flush()) return -1;
+    }
+  }
+  return 0;
+}
+
+// ---- multi-threaded BGZF writer ----
+//
+// BGZF parallelizes trivially: each 64 KB block compresses independently
+// and the file is their in-order concatenation, so a worker pool behind
+// the same 65280-byte chunking produces BYTE-IDENTICAL output to the
+// single-threaded writer (tests/test_native.py asserts it). The submitting
+// thread drains completed jobs from the queue front in submission order;
+// a bounded queue applies backpressure so memory stays O(threads) blocks.
+
+struct MtJob {
+  std::vector<uint8_t> raw;    // uncompressed payload
+  std::vector<uint8_t> block;  // finished on-disk block
+  bool claimed = false;
+  bool done = false;
+  bool failed = false;
+  std::string err;
+};
+
+struct MtWriter {
+  FILE* fh = nullptr;
+  int level = 6;
+  std::string err;
+  std::vector<uint8_t> buf;
+  std::deque<std::unique_ptr<MtJob>> queue;  // submission order
+  std::mutex mu;
+  std::condition_variable cv_work;  // workers wait: unclaimed job / stop
+  std::condition_variable cv_done;  // submitter waits: front done / room
+  std::vector<std::thread> workers;
+  bool stop = false;
+  size_t max_queue = 16;
+
+  ~MtWriter() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_work.notify_all();
+    for (auto& t : workers) t.join();
+  }
+};
+
+void mt_worker(MtWriter* w) {
+  for (;;) {
+    MtJob* job = nullptr;
+    {
+      std::unique_lock<std::mutex> lk(w->mu);
+      w->cv_work.wait(lk, [&] {
+        if (w->stop) return true;
+        for (auto& j : w->queue)
+          if (!j->claimed) return true;
+        return false;
+      });
+      if (w->stop) return;
+      for (auto& j : w->queue)
+        if (!j->claimed) {
+          j->claimed = true;
+          job = j.get();
+          break;
+        }
+    }
+    if (!job) continue;
+    std::string err;
+    const bool ok =
+        compress_block(job->raw.data(), job->raw.size(), w->level, job->block, err);
+    {
+      std::lock_guard<std::mutex> lk(w->mu);
+      job->done = true;
+      job->failed = !ok;
+      job->err = err;
+    }
+    w->cv_done.notify_all();
+  }
+}
+
+// Write out every completed job at the queue front; when `all`, wait for
+// the whole queue to drain. Returns false (setting w->err) on any failure.
+bool mt_drain(MtWriter* w, bool all) {
+  std::unique_lock<std::mutex> lk(w->mu);
+  for (;;) {
+    while (!w->queue.empty() && w->queue.front()->done) {
+      std::unique_ptr<MtJob> job = std::move(w->queue.front());
+      w->queue.pop_front();
+      if (job->failed) {
+        w->err = job->err;
+        return false;
+      }
+      lk.unlock();  // fwrite outside the lock: workers keep compressing
+      const bool ok =
+          fwrite(job->block.data(), 1, job->block.size(), w->fh) ==
+          job->block.size();
+      lk.lock();
+      if (!ok) {
+        w->err = "write failed";
+        return false;
+      }
+    }
+    const bool blocked =
+        all ? !w->queue.empty()
+            : (w->queue.size() >= w->max_queue && !w->queue.front()->done);
+    if (!blocked) return true;
+    w->cv_done.wait(lk, [&] {
+      return !w->queue.empty() && w->queue.front()->done;
+    });
+  }
+}
+
+bool mt_submit(MtWriter* w, std::vector<uint8_t>&& payload) {
+  if (!mt_drain(w, false)) return false;  // backpressure + in-order writes
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    auto job = std::make_unique<MtJob>();
+    job->raw = std::move(payload);
+    w->queue.push_back(std::move(job));
+  }
+  w->cv_work.notify_one();
+  return true;
+}
 
 const uint8_t kEofBlock[28] = {0x1f, 0x8b, 0x08, 0x04, 0,    0,    0,    0,
                                0,    0xff, 0x06, 0x00, 0x42, 0x43, 0x02, 0x00,
@@ -143,14 +289,18 @@ bool ensure(Reader* r, size_t n) {
   return true;
 }
 
-bool flush_block(Writer* w, const uint8_t* data, size_t n) {
+// Compress one payload into a complete on-disk BGZF block (header +
+// deflate stream + crc/isize tail). Pure function of (data, level) — the
+// single-threaded and multi-threaded writers produce identical bytes.
+bool compress_block(const uint8_t* data, size_t n, int level,
+                    std::vector<uint8_t>& out, std::string& err) {
   std::vector<uint8_t> cdata(kMaxBlock);
-  for (int attempt_level = w->level;; attempt_level = 0) {
+  for (int attempt_level = level;; attempt_level = 0) {
     z_stream zs;
     memset(&zs, 0, sizeof(zs));
     if (deflateInit2(&zs, attempt_level, Z_DEFLATED, -15, 8,
                      Z_DEFAULT_STRATEGY) != Z_OK) {
-      w->err = "deflateInit failed";
+      err = "deflateInit failed";
       return false;
     }
     zs.next_in = const_cast<uint8_t*>(data);
@@ -162,13 +312,13 @@ bool flush_block(Writer* w, const uint8_t* data, size_t n) {
     deflateEnd(&zs);
     if (rc != Z_STREAM_END) {
       if (attempt_level != 0) continue;  // retry stored
-      w->err = "deflate failed";
+      err = "deflate failed";
       return false;
     }
     size_t bsize = clen + 12 + 6 + 8;
     if (bsize > 65536) {
       if (attempt_level != 0) continue;
-      w->err = "block too large even stored";
+      err = "block too large even stored";
       return false;
     }
     uint8_t head[18] = {0x1f, 0x8b, 8,    4,    0, 0, 0, 0, 0,
@@ -180,14 +330,23 @@ bool flush_block(Writer* w, const uint8_t* data, size_t n) {
     uint8_t tail[8] = {uint8_t(crc), uint8_t(crc >> 8), uint8_t(crc >> 16),
                        uint8_t(crc >> 24), uint8_t(n), uint8_t(n >> 8),
                        uint8_t(n >> 16), uint8_t(n >> 24)};
-    if (fwrite(head, 1, 18, w->fh) != 18 ||
-        fwrite(cdata.data(), 1, clen, w->fh) != clen ||
-        fwrite(tail, 1, 8, w->fh) != 8) {
-      w->err = "write failed";
-      return false;
-    }
+    out.clear();
+    out.reserve(18 + clen + 8);
+    out.insert(out.end(), head, head + 18);
+    out.insert(out.end(), cdata.data(), cdata.data() + clen);
+    out.insert(out.end(), tail, tail + 8);
     return true;
   }
+}
+
+bool flush_block(Writer* w, const uint8_t* data, size_t n) {
+  std::vector<uint8_t> block;
+  if (!compress_block(data, n, w->level, block, w->err)) return false;
+  if (fwrite(block.data(), 1, block.size(), w->fh) != block.size()) {
+    w->err = "write failed";
+    return false;
+  }
+  return true;
 }
 
 inline int32_t rd_i32(const uint8_t* p) {
@@ -392,18 +551,11 @@ Writer* bamio_create(const char* path, int level, char* err, int errlen) {
 }
 
 int bamio_write(Writer* w, const uint8_t* data, int64_t n) {
-  int64_t off = 0;
-  while (off < n) {
-    size_t room = 65280 - w->buf.size();
-    size_t take = size_t(n - off) < room ? size_t(n - off) : room;
-    w->buf.insert(w->buf.end(), data + off, data + off + take);
-    off += take;
-    if (w->buf.size() == 65280) {
-      if (!flush_block(w, w->buf.data(), w->buf.size())) return -1;
-      w->buf.clear();
-    }
-  }
-  return 0;
+  return buffered_write(w->buf, data, n, [&] {
+    if (!flush_block(w, w->buf.data(), w->buf.size())) return false;
+    w->buf.clear();
+    return true;
+  });
 }
 
 const char* bamio_writer_error(Writer* w) { return w->err.c_str(); }
@@ -418,6 +570,56 @@ int bamio_finish(Writer* w) {
   if (fclose(w->fh) != 0) rc = -1;
   w->fh = nullptr;
   delete w;
+  return rc;
+}
+
+// ---- multi-threaded writer ABI (byte-identical output to the above) ----
+
+MtWriter* bamio_create_mt(const char* path, int level, int threads, char* err,
+                          int errlen) {
+  if (threads < 1) threads = 1;
+  if (threads > 64) threads = 64;
+  MtWriter* w = new MtWriter();
+  w->fh = fopen(path, "wb");
+  w->level = level;
+  if (!w->fh) {
+    snprintf(err, errlen, "cannot create %s", path);
+    delete w;
+    return nullptr;
+  }
+  w->buf.reserve(65280);
+  w->max_queue = size_t(threads) * 4;
+  for (int i = 0; i < threads; ++i)
+    w->workers.emplace_back(mt_worker, w);
+  return w;
+}
+
+int bamio_write_mt(MtWriter* w, const uint8_t* data, int64_t n) {
+  if (!w->err.empty()) return -1;
+  return buffered_write(w->buf, data, n, [&] {
+    std::vector<uint8_t> payload;
+    payload.reserve(65280);
+    payload.swap(w->buf);
+    w->buf.reserve(65280);
+    return mt_submit(w, std::move(payload));
+  });
+}
+
+const char* bamio_writer_error_mt(MtWriter* w) { return w->err.c_str(); }
+
+int bamio_finish_mt(MtWriter* w) {
+  // a recorded write/compress failure must fail the finish too — appending
+  // the EOF marker to a truncated stream would make corruption look like a
+  // validly terminated file
+  int rc = w->err.empty() ? 0 : -1;
+  if (rc == 0 && !w->buf.empty()) {
+    if (!mt_submit(w, std::move(w->buf))) rc = -1;
+  }
+  if (rc == 0 && !mt_drain(w, true)) rc = -1;
+  if (rc == 0 && fwrite(kEofBlock, 1, 28, w->fh) != 28) rc = -1;
+  if (fclose(w->fh) != 0) rc = -1;
+  w->fh = nullptr;
+  delete w;  // joins workers
   return rc;
 }
 
