@@ -288,3 +288,15 @@ class TestEmitIntegration:
             with BamReader(target) as r:
                 outs[emit] = list(r.raw_records())
         assert outs["python"] == outs["native"] and len(outs["python"]) > 0
+
+
+def test_native_emit_rejects_overlong_qname():
+    # BAM l_read_name is uint8: the Python encoder raises struct.error for
+    # a 255+ char qname; the native emitter must refuse too, not truncate
+    f, w = 2, 16
+    out = _random_outputs(f, w, 13, duplex=False)
+    metas = _metas(f, 13)
+    metas[1].mi = "M" * 300
+    batch = _Batch(metas, np.ones((f, 2, 2, w), np.int8))
+    with pytest.raises(ValueError, match="254"):
+        _native_blob(batch, out, ConsensusParams(min_reads=0), "self", False)
