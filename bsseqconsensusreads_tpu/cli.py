@@ -44,8 +44,7 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
         choices=("auto", "native", "python"),
         default="auto",
         help="record emission: native C++ batch serializer vs per-record "
-        "Python objects (auto = native when built; 'self' mode always "
-        "uses python, its output is coordinate-sorted)",
+        "Python objects (auto = native when built)",
     )
 
 
@@ -93,24 +92,6 @@ def cmd_run(args) -> int:
     return 0
 
 
-def _write_batches(batches, out_path: str, header, mode: str) -> None:
-    """Stream consensus batches to the output BAM: straight through
-    (handles RawRecords blocks from the native emitter), or via an
-    external-merge coordinate sort in 'self' mode — never the whole
-    output in RAM."""
-    from bsseqconsensusreads_tpu.io.bam import BamWriter, write_items
-    from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
-    from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
-
-    with BamWriter(out_path, header) as writer:
-        if mode == "self":
-            recs = (rec for batch in batches for rec in batch)
-            writer.write_all(external_sort(recs, coordinate_key, header))
-        else:
-            for batch in batches:
-                write_items(writer, batch)
-
-
 def cmd_molecular(args) -> int:
     from bsseqconsensusreads_tpu.io.bam import BamReader
     from bsseqconsensusreads_tpu.pipeline.calling import (
@@ -128,9 +109,11 @@ def cmd_molecular(args) -> int:
             max_window=args.max_window,
             grouping=args.grouping,
             stats=stats,
-            emit="python" if args.mode == "self" else args.emit,
+            emit=args.emit,
         )
-        _write_batches(batches, args.output, reader.header, args.mode)
+        from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
+
+        write_batch_stream(batches, args.output, reader.header, args.mode)
     print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 
@@ -157,9 +140,11 @@ def cmd_duplex(args) -> int:
             max_window=args.max_window,
             grouping=args.grouping,
             stats=stats,
-            emit="python" if args.mode == "self" else args.emit,
+            emit=args.emit,
         )
-        _write_batches(batches, args.output, reader.header, args.mode)
+        from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
+
+        write_batch_stream(batches, args.output, reader.header, args.mode)
     print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 

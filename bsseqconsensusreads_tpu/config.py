@@ -60,8 +60,8 @@ class FrameworkConfig:
     #: batches to BAM bytes in C++ (io.wirepack.emit_consensus_records —
     #: byte-identical to the Python path, skips per-record object building
     #: and encode), 'python' builds BamRecord objects, 'auto' picks native
-    #: when built and the stage output is order-preserving (the 'self'
-    #: aligner mode coordinate-sorts downstream, which needs objects).
+    #: when built. The 'self' aligner mode coordinate-sorts the blobs
+    #: directly (pipeline.extsort.external_sort_raw).
     emit: str = "auto"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)

@@ -426,10 +426,11 @@ def _emit_read(
 
 
 def _resolve_emit(emit: str, mode: str) -> str:
-    """'auto' -> the native batch emitter when built AND the stage output
-    is order-preserving (the 'self' modes coordinate-sort downstream, which
-    needs record objects); 'native' demands it; 'python' forces the
-    object path."""
+    """'auto' -> the native batch emitter when built; 'native' demands it;
+    'python' forces the object path. Downstream writers handle RawRecords
+    in every mode (the 'self' coordinate sort runs on raw blobs,
+    pipeline.extsort.external_sort_raw)."""
+    del mode  # every mode supports raw emission
     if emit not in ("auto", "native", "python"):
         raise ValueError(f"unknown emit {emit!r}; use auto|native|python")
     if emit == "python":
@@ -437,19 +438,12 @@ def _resolve_emit(emit: str, mode: str) -> str:
     from bsseqconsensusreads_tpu.io import wirepack
 
     if emit == "native":
-        if mode == "self":
-            raise ValueError(
-                "emit 'native' requires an order-preserving mode; the "
-                "'self' stage output is coordinate-sorted downstream"
-            )
         if not wirepack.available():
             raise OSError(
                 f"native emit unavailable: {wirepack.load_error()}"
             )
         return "native"
-    if mode != "self" and wirepack.available():
-        return "native"
-    return "python"
+    return "native" if wirepack.available() else "python"
 
 
 def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,

@@ -147,11 +147,21 @@ class BatchCheckpoint:
             with BamReader(os.path.join(d, shard)) as r:
                 yield from r
 
-    def finalize(self, records: Iterable[BamRecord] | None = None) -> int:
+    def iter_raw_records(self) -> Iterator[bytes]:
+        """Stream every durable record as its encoded blob, in batch order
+        — feeds the raw coordinate sort (pipeline.extsort.external_sort_raw)
+        without a decode/re-encode round trip."""
+        d = os.path.dirname(self.target)
+        for shard in self.manifest.shards:
+            with BamReader(os.path.join(d, shard)) as r:
+                yield from r.raw_records()
+
+    def finalize(self, records: Iterable | None = None) -> int:
         """Concatenate shards into the target BAM and remove scratch files.
 
         records: optionally a transformed stream (e.g. coordinate-sorted
-        iter_records()) to write instead of the raw shard order.
+        iter_records(), or encoded blobs from a raw sort over
+        iter_raw_records()) to write instead of the raw shard order.
         Returns the record count.
 
         The target appears atomically (tmp + rename): a crash mid-finalize
@@ -173,7 +183,10 @@ class BatchCheckpoint:
                             n += 1
             else:
                 for rec in records:
-                    w.write(rec)
+                    if isinstance(rec, (bytes, memoryview)):
+                        w.write_raw(rec)
+                    else:
+                        w.write(rec)
                     n += 1
         os.replace(tmp, self.target)
         self._discard_scratch()

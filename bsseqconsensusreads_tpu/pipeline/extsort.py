@@ -17,10 +17,19 @@ from __future__ import annotations
 
 import heapq
 import os
+import struct
 import tempfile
 from typing import Callable, Iterable, Iterator
 
-from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamRecord, BamWriter
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    RawRecords,
+    encode_record,
+    write_items,
+)
 
 #: Default spill threshold. ~100k BamRecords of a 150 bp library is a few
 #: hundred MB of Python objects — far under the <16 GB budget while keeping
@@ -35,24 +44,30 @@ DEFAULT_BUFFER_RECORDS = 100_000
 MERGE_FANIN = 64
 
 
-def external_sort(
-    records: Iterable[BamRecord],
-    key: Callable[[BamRecord], tuple],
+def _external_sort_core(
+    items: Iterable,
+    key: Callable,
     header: BamHeader,
-    workdir: str | None = None,
-    buffer_records: int = DEFAULT_BUFFER_RECORDS,
-) -> Iterator[BamRecord]:
-    """Yield `records` in `key` order using bounded host memory.
+    workdir: str | None,
+    buffer_records: int,
+    write_item: Callable,
+    read_run: Callable,
+) -> Iterator:
+    """Shared spill/merge machinery behind external_sort (BamRecord
+    objects) and external_sort_raw (encoded blobs): runs of
+    `buffer_records` are sorted in RAM and spilled as BGZF BAM shards
+    under `workdir` (a private temp dir when None); merges hold one item
+    per run, collapsing runs in MERGE_FANIN groups first (multi-pass) so
+    open descriptors stay bounded. If the input fits one buffer no file
+    is ever written. Shards are deleted as the merge finishes; the temp
+    dir is cleaned up even if the consumer abandons the iterator.
 
-    Runs of `buffer_records` are sorted in RAM and spilled as BGZF BAM
-    shards under `workdir` (a private temp dir when None); the merge phase
-    holds one record per run. If the input fits in a single buffer no file
-    is ever written. Shards are deleted as soon as the merge finishes;
-    the temp dir is cleaned up even if the consumer abandons the iterator.
+    write_item(writer, item) appends one item to a run; read_run(reader)
+    yields a run's items back in order.
     """
     if buffer_records < 1:
         raise ValueError(f"buffer_records must be >= 1, got {buffer_records}")
-    buf: list[BamRecord] = []
+    buf: list = []
     run_paths: list[str] = []
     tmpdir: tempfile.TemporaryDirectory | None = None
 
@@ -65,12 +80,13 @@ def external_sort(
             )
         path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
         with BamWriter(path, header) as w:
-            w.write_all(buf)
+            for item in buf:
+                write_item(w, item)
         run_paths.append(path)
         buf.clear()
 
-    for rec in records:
-        buf.append(rec)
+    for item in items:
+        buf.append(item)
         if len(buf) >= buffer_records:
             spill()
 
@@ -82,8 +98,14 @@ def external_sort(
     if buf:
         spill()
 
-    # multi-pass merge: collapse runs in MERGE_FANIN groups until one
-    # level fits, bounding simultaneously open descriptors
+    def open_runs(paths: list[str], readers: list):
+        streams = []
+        for p in paths:
+            r = BamReader(p)
+            readers.append(r)
+            streams.append(read_run(r))
+        return streams
+
     pass_index = 0
     while len(run_paths) > MERGE_FANIN:
         merged_paths: list[str] = []
@@ -92,10 +114,11 @@ def external_sort(
             out = os.path.join(
                 tmpdir.name, f"pass{pass_index:02d}_{len(merged_paths):05d}.bam"
             )
-            readers = [BamReader(p) for p in group]
+            readers: list = []
             try:
                 with BamWriter(out, header) as w:
-                    w.write_all(heapq.merge(*readers, key=key))
+                    for item in heapq.merge(*open_runs(group, readers), key=key):
+                        write_item(w, item)
             finally:
                 for r in readers:
                     r.close()
@@ -105,13 +128,104 @@ def external_sort(
         run_paths = merged_paths
         pass_index += 1
 
-    readers = [BamReader(p) for p in run_paths]
+    readers = []
     try:
-        yield from heapq.merge(*readers, key=key)
+        yield from heapq.merge(*open_runs(run_paths, readers), key=key)
     finally:
         for r in readers:
             r.close()
         tmpdir.cleanup()
+
+
+def external_sort(
+    records: Iterable[BamRecord],
+    key: Callable[[BamRecord], tuple],
+    header: BamHeader,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+) -> Iterator[BamRecord]:
+    """Yield `records` in `key` order using bounded host memory
+    (_external_sort_core over BamRecord objects)."""
+    return _external_sort_core(
+        records, key, header, workdir, buffer_records,
+        write_item=lambda w, rec: w.write(rec),
+        read_run=iter,
+    )
+
+
+def raw_coordinate_key(blob: bytes) -> tuple:
+    """record_ops.coordinate_key read at the fixed offsets of an encoded
+    record blob (block_size +0, then ref_id +4, pos +8, l_qname +12,
+    flag +18, qname +36) — no decode needed."""
+    ref_id, pos = struct.unpack_from("<ii", blob, 4)
+    (flag,) = struct.unpack_from("<H", blob, 18)
+    qname = blob[36 : 36 + blob[12] - 1].decode("ascii")
+    return (
+        ref_id if ref_id >= 0 else 1 << 30,
+        pos if pos >= 0 else 1 << 30,
+        qname,
+        flag,
+    )
+
+
+def iter_record_blobs(items: Iterable) -> Iterator[bytes]:
+    """Normalize a mixed BamRecord / RawRecords stream to per-record
+    encoded blobs (RawRecords blocks split at their block_size prefixes)."""
+    for item in items:
+        if isinstance(item, RawRecords):
+            blob = item.blob
+            off = 0
+            n = len(blob)
+            while off < n:
+                (size,) = struct.unpack_from("<i", blob, off)
+                yield blob[off : off + 4 + size]
+                off += 4 + size
+        else:
+            yield encode_record(item)
+
+
+def external_sort_raw(
+    blobs: Iterable[bytes],
+    header: BamHeader,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+    key: Callable[[bytes], tuple] = raw_coordinate_key,
+) -> Iterator[bytes]:
+    """external_sort over encoded record blobs: same spill/merge core, but
+    records never decode — keys read at fixed offsets (raw_coordinate_key)
+    and runs write via write_raw. Byte-for-byte the ordering of
+    external_sort with the matching object key (both sorts are stable)."""
+    return _external_sort_core(
+        blobs, key, header, workdir, buffer_records,
+        write_item=lambda w, blob: w.write_raw(blob),
+        read_run=lambda r: r.raw_records(),
+    )
+
+
+def write_batch_stream(
+    batches: Iterable,
+    out_path: str,
+    header: BamHeader,
+    mode: str,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+) -> None:
+    """Write a consensus batch stream (lists of BamRecord / RawRecords) to
+    a BAM: straight through when order-preserving, or via the raw-blob
+    external coordinate sort in 'self' mode — never the whole output in
+    RAM. Shared by the pipeline stage runner and the CLI subcommands."""
+    with BamWriter(out_path, header) as writer:
+        if mode == "self":
+            blobs = iter_record_blobs(
+                item for batch in batches for item in batch
+            )
+            for blob in external_sort_raw(
+                blobs, header, workdir=workdir, buffer_records=buffer_records
+            ):
+                writer.write_raw(blob)
+        else:
+            for batch in batches:
+                write_items(writer, batch)
 
 
 def sorted_write(

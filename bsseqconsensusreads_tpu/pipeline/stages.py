@@ -39,9 +39,11 @@ from bsseqconsensusreads_tpu.pipeline.calling import (
     call_molecular_batches,
 )
 from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
-from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
+from bsseqconsensusreads_tpu.pipeline.extsort import (
+    external_sort_raw,
+    write_batch_stream,
+)
 from bsseqconsensusreads_tpu.pipeline.record_ops import (
-    coordinate_key,
     filter_mapped,
     zipper_bams_stream,
 )
@@ -75,10 +77,12 @@ class PipelineBuilder:
             h.text = "@HD\tVN:1.6\tSO:unsorted\n" + h.text
         return h
 
-    def _sorted(self, records, header):
-        """Bounded-memory coordinate sort (external merge over BGZF runs)."""
-        return external_sort(
-            records, coordinate_key, header,
+    def _sorted_raw(self, blobs, header):
+        """Bounded-memory coordinate sort over encoded record blobs (same
+        ordering as the object-key external_sort; keys read at fixed
+        offsets, no decode/re-encode round trip)."""
+        return external_sort_raw(
+            blobs, header,
             workdir=self.cfg.tmp or None,
             buffer_records=self.cfg.sort_buffer_records,
         )
@@ -90,24 +94,19 @@ class PipelineBuilder:
         stream is already offset by ck.batches_done). The 'self' mode's
         coordinate sort is external-merge, never whole-file in RAM. Batch
         items may be BamRecord objects or io.bam.RawRecords blocks (native
-        batch emit; never under 'self', which must sort records)."""
+        batch emit); the 'self' coordinate sort runs on encoded blobs."""
         if ck is not None:
             ck.write_batches(batches)
             ck.finalize(
-                self._sorted(ck.iter_records(), header)
+                self._sorted_raw(ck.iter_raw_records(), header)
                 if mode == "self" else None  # None = raw shard concatenation
             )
             return
-        if mode == "self":
-            recs = self._sorted(
-                (rec for batch in batches for rec in batch), header
-            )
-            with BamWriter(out_path, header) as writer:
-                writer.write_all(recs)
-            return
-        with BamWriter(out_path, header) as writer:
-            for batch in batches:
-                write_items(writer, batch)
+        write_batch_stream(
+            batches, out_path, header, mode,
+            workdir=self.cfg.tmp or None,
+            buffer_records=self.cfg.sort_buffer_records,
+        )
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
         """Arm intra-stage checkpointing for one stage target, fingerprinted

@@ -214,3 +214,80 @@ def test_deep_threshold_above_encode_cap_not_skipped():
     ]
     assert stats.skipped_families == 0 and stats.families == 1
     assert len(out) == 1 and out[0].get_tag("cD") == depth
+
+
+class TestRawExternalSort:
+    """external_sort_raw must order encoded blobs exactly as external_sort
+    orders the same records under coordinate_key (both stable)."""
+
+    def _records(self, n, seed):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+
+        rng = np.random.default_rng(seed)
+        recs = []
+        for i in range(n):
+            length = int(rng.integers(5, 30))
+            unmapped = rng.random() < 0.1
+            recs.append(
+                BamRecord(
+                    qname=f"q{int(rng.integers(0, 40))}",
+                    flag=int(rng.choice([99, 147, 83, 163, 4])),
+                    ref_id=-1 if unmapped else int(rng.integers(0, 3)),
+                    pos=-1 if unmapped else int(rng.integers(0, 1000)),
+                    mapq=60,
+                    cigar=[] if unmapped else [(CMATCH, length)],
+                    next_ref_id=-1,
+                    next_pos=-1,
+                    tlen=0,
+                    seq="".join(
+                        "ACGT"[b] for b in rng.integers(0, 4, size=length)
+                    ),
+                    qual=bytes(rng.integers(2, 40, size=length).astype("u1")),
+                )
+            )
+        return recs
+
+    def test_matches_object_sort(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader, encode_record
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort,
+            external_sort_raw,
+            iter_record_blobs,
+        )
+        from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+        header = BamHeader("@HD\tVN:1.6\n", [("c0", 5000), ("c1", 5000), ("c2", 5000)])
+        recs = self._records(700, seed=4)
+        want = [
+            encode_record(r)
+            for r in external_sort(
+                iter(recs), coordinate_key, header,
+                workdir=str(tmp_path), buffer_records=100,
+            )
+        ]
+        got = list(
+            external_sort_raw(
+                iter_record_blobs(iter(recs)), header,
+                workdir=str(tmp_path), buffer_records=100,
+            )
+        )
+        assert got == want
+
+    def test_single_buffer_no_spill(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader, encode_record
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort,
+            external_sort_raw,
+            iter_record_blobs,
+        )
+        from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+        header = BamHeader("@HD\tVN:1.6\n", [("c0", 5000), ("c1", 5000), ("c2", 5000)])
+        recs = self._records(40, seed=5)
+        want = [
+            encode_record(r)
+            for r in external_sort(iter(recs), coordinate_key, header)
+        ]
+        assert list(external_sort_raw(iter_record_blobs(iter(recs)), header)) == want

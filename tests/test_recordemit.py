@@ -300,3 +300,39 @@ def test_native_emit_rejects_overlong_qname():
     batch = _Batch(metas, np.ones((f, 2, 2, w), np.int8))
     with pytest.raises(ValueError, match="254"):
         _native_blob(batch, out, ConsensusParams(min_reads=0), "self", False)
+
+
+def test_self_mode_native_pipeline_matches_python(tmp_path):
+    """Full self-aligned run_pipeline with emit native vs python: the final
+    coordinate-sorted BAMs must be byte-identical (native emit + raw-blob
+    external sort vs object emit + object sort)."""
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.io.bam import BamWriter
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_grouped_bam_records,
+        random_genome,
+        write_fasta,
+    )
+
+    rng = np.random.default_rng(51)
+    name, genome = random_genome(rng, 8000)
+    header, records = make_grouped_bam_records(rng, name, genome, n_families=10)
+    inbam = str(tmp_path / "in.bam")
+    with BamWriter(inbam, header) as w:
+        for r in records:
+            w.write(r)
+    fa = str(tmp_path / "g.fa")
+    write_fasta(fa, name, genome)
+    outs = {}
+    for emit in ("python", "native"):
+        cfg = FrameworkConfig(
+            genome_dir=str(tmp_path), genome_fasta_file_name="g.fa",
+            aligner="self", emit=emit,
+        )
+        outdir = str(tmp_path / f"out_{emit}")
+        target, _, _ = run_pipeline(cfg, inbam, outdir=outdir)
+        outs[emit] = open(target, "rb").read()
+    assert outs["python"] == outs["native"] and len(outs["python"]) > 100
