@@ -647,7 +647,8 @@ def call_molecular_batches(
         with stats.metrics.timed("fetch"):
             out = unpack_molecular_outputs(jax.device_get(wire), f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
-        main = emit_fn(batch, out, params, mode, stats)
+        with stats.metrics.timed("emit"):
+            main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
             return [main] + deep_emitted
         return main + deep_emitted
@@ -724,9 +725,10 @@ def call_molecular_batches(
                 stats.used_cells += dused
                 with stats.metrics.timed("kernel"):
                     dout = run_deep_kernel(dbatch)
-                deep_emitted.extend(
-                    _emit_molecular_batch(dbatch, dout, params, mode, stats)
-                )
+                with stats.metrics.timed("emit"):
+                    deep_emitted.extend(
+                        _emit_molecular_batch(dbatch, dout, params, mode, stats)
+                    )
             if not batch.meta:
                 yield "now", deep_emitted
                 continue
@@ -905,7 +907,8 @@ def call_duplex_batches(
         with stats.metrics.timed("fetch"):
             out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
-        main = emit_fn(batch, out, params, mode, stats)
+        with stats.metrics.timed("emit"):
+            main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
             return [main] + passed
         return main + passed
