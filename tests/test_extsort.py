@@ -291,3 +291,41 @@ class TestRawExternalSort:
             for r in external_sort(iter(recs), coordinate_key, header)
         ]
         assert list(external_sort_raw(iter_record_blobs(iter(recs)), header)) == want
+
+
+class TestWriteBatchStream:
+    """write_batch_stream: the shared stage/CLI batch writer."""
+
+    def test_mixed_items_and_self_sort(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bam import (
+            BamHeader,
+            BamReader,
+            RawRecords,
+            encode_record,
+        )
+        from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
+
+        header = BamHeader("@HD\tVN:1.6\n", [("c0", 5000)])
+        rng = np.random.default_rng(77)
+        recs = TestRawExternalSort()._records(30, seed=7)
+        blob = RawRecords(
+            b"".join(encode_record(r) for r in recs[:10]), 10
+        )
+        batches = [[blob], recs[10:20], [], recs[20:]]
+
+        # order-preserving mode: straight-through, counts intact
+        out1 = str(tmp_path / "stream.bam")
+        write_batch_stream(iter(batches), out1, header, mode="unaligned")
+        with BamReader(out1) as r:
+            got = [x.qname for x in r]
+        assert got == [r_.qname for r_ in recs]
+
+        # self mode: coordinate-sorted over the mixed items
+        from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+        out2 = str(tmp_path / "sorted.bam")
+        write_batch_stream(iter(batches), out2, header, mode="self")
+        with BamReader(out2) as r:
+            got_keys = [coordinate_key(x) for x in r]
+        assert got_keys == sorted(got_keys)
+        assert len(got_keys) == len(recs)
