@@ -109,3 +109,14 @@ def test_two_process_packed_molecular_matches_single(tmp_path):
     quals = rng.integers(2, 41, size=bases.shape).astype(np.uint8)
     want = np.asarray(packed_molecular_kernel()(bases, quals, ConsensusParams()))
     np.testing.assert_array_equal(got, want)
+
+
+def test_local_rows_count_mismatch_raises():
+    from bsseqconsensusreads_tpu.parallel import multihost
+
+    rng = np.random.default_rng(9)
+    a = rng.integers(0, 50, size=(16, 2)).astype(np.int8)
+    mesh = multihost.multihost_family_mesh()
+    (ga,) = multihost.global_family_batch((a,), 16, mesh)
+    with pytest.raises(ValueError, match="local rows"):
+        multihost.local_rows(ga, 12)
