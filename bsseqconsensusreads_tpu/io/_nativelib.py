@@ -19,34 +19,66 @@ REPO_ROOT = os.path.dirname(
 NATIVE_DIR = os.path.join(REPO_ROOT, "native")
 
 
+def _build(so_name: str) -> str | None:
+    try:
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR, so_name],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return None
+    except Exception as e:  # no compiler / make failure
+        return f"native build failed: {e}"
+
+
 def load_library(
     so_name: str,
     source_name: str,
     env_flag: str | None = None,
+    required_symbols: tuple[str, ...] = (),
 ) -> tuple[C.CDLL | None, str | None]:
     """Load native/<so_name>, building `make <so_name>` on first use.
 
     Returns (lib, None) on success or (None, reason) on any failure —
     callers cache both outcomes. env_flag names an environment variable
-    that disables the library when set to "0".
+    that disables the library when set to "0". required_symbols guards
+    against a stale pre-upgrade .so (the .so is gitignored, so an existing
+    checkout can hold one missing newly added entry points): when any
+    symbol is absent the .so is rebuilt once and reloaded, and a still-
+    incomplete library loads as unavailable instead of raising
+    AttributeError out of the caller's binding code.
     """
     if env_flag and os.environ.get(env_flag, "1") == "0":
         return None, f"disabled via {env_flag}=0"
     so_path = os.path.join(NATIVE_DIR, so_name)
+    have_source = os.path.exists(os.path.join(NATIVE_DIR, source_name))
     if not os.path.exists(so_path):
-        if os.path.exists(os.path.join(NATIVE_DIR, source_name)):
-            try:
-                subprocess.run(
-                    ["make", "-C", NATIVE_DIR, so_name],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception as e:  # no compiler / make failure
-                return None, f"native build failed: {e}"
-        else:
+        if not have_source:
             return None, "native sources not found"
+        err = _build(so_name)
+        if err:
+            return None, err
     try:
-        return C.CDLL(so_path), None
+        lib = C.CDLL(so_path)
     except OSError as e:
         return None, f"cannot load {so_path}: {e}"
+    missing = [s for s in required_symbols if not hasattr(lib, s)]
+    if missing and have_source:
+        # stale build: force a rebuild (make alone may consider the .so
+        # fresh if checkout mtimes are skewed) and reload
+        try:
+            os.unlink(so_path)
+        except OSError:
+            pass
+        err = _build(so_name)
+        if err:
+            return None, f"stale {so_name} missing {missing[0]}; {err}"
+        try:
+            lib = C.CDLL(so_path)
+        except OSError as e:
+            return None, f"cannot load rebuilt {so_path}: {e}"
+        missing = [s for s in required_symbols if not hasattr(lib, s)]
+    if missing:
+        return None, f"{so_name} lacks required symbols: {', '.join(missing)}"
+    return lib, None

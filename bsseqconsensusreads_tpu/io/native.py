@@ -23,7 +23,17 @@ def _try_load():
     global _lib, _load_error
     if _lib is not None or _load_error is not None:
         return
-    lib, _load_error = load_library("libbamio.so", "bamio.cpp")
+    lib, _load_error = load_library(
+        "libbamio.so",
+        "bamio.cpp",
+        required_symbols=(
+            "bamio_open", "bamio_read", "bamio_error", "bamio_close",
+            "bamio_create", "bamio_write", "bamio_writer_error",
+            "bamio_finish", "bamio_create_mt", "bamio_write_mt",
+            "bamio_writer_error_mt", "bamio_finish_mt",
+            "bamio_parse_records",
+        ),
+    )
     if lib is None:
         return
     lib.bamio_open.restype = C.c_void_p

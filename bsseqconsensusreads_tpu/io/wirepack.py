@@ -32,7 +32,14 @@ def _try_load():
     if _lib is not None or _load_error is not None:
         return
     lib, _load_error = load_library(
-        "libwirepack.so", "wirepack.cpp", env_flag="BSSEQ_TPU_NATIVE_WIRE"
+        "libwirepack.so",
+        "wirepack.cpp",
+        env_flag="BSSEQ_TPU_NATIVE_WIRE",
+        required_symbols=(
+            "wirepack_pack_duplex",
+            "wirepack_unpack_duplex_outputs",
+            "wirepack_emit_consensus_records",
+        ),
     )
     if lib is None:
         return
@@ -85,6 +92,11 @@ def pack_duplex(bases, quals, cover, convert_mask, eligible, qual_mode):
         raise OSError(_load_error or "native wirepack unavailable")
     f, r, w = bases.shape
     cells = f * r * w
+    if cells % 2:
+        # the C nibble loop reads bases[i+1]; an odd cell count would read
+        # one byte past the buffer (ops.wire guards w%2 for its callers,
+        # direct callers are guarded here)
+        raise ValueError(f"duplex wire pack needs an even f*r*w, got {cells}")
     bases = np.ascontiguousarray(bases, dtype=np.int8)
     quals = np.ascontiguousarray(quals, dtype=np.uint8)
     cover = np.ascontiguousarray(cover, dtype=np.uint8)

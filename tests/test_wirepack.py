@@ -127,3 +127,20 @@ def test_native_unpack_matches_numpy():
     for k in want:
         np.testing.assert_array_equal(got[k], want[k], err_msg=k)
         assert got[k].dtype == want[k].dtype, k
+
+
+def test_native_pack_rejects_odd_cell_count():
+    """f*r*w must be even: the C nibble loop reads bases[i+1] (round-2
+    advisor finding — direct callers bypass ops.wire's w%2 guard)."""
+    from bsseqconsensusreads_tpu.io import wirepack
+
+    if not wirepack.available():
+        pytest.skip(f"native wirepack unavailable: {wirepack.load_error()}")
+    f, r, w = 1, 3, 5  # odd cells
+    bases = np.zeros((f, r, w), dtype=np.int8)
+    quals = np.zeros((f, r, w), dtype=np.uint8)
+    cover = np.ones((f, r, w), dtype=bool)
+    cmask = np.zeros((f, r), dtype=bool)
+    elig = np.ones(f, dtype=bool)
+    with pytest.raises(ValueError, match="even"):
+        wirepack.pack_duplex(bases, quals, cover, cmask, elig, "q8")
