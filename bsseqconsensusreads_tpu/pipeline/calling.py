@@ -94,7 +94,12 @@ def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     Counts distinct qnames of records the encoder would keep — hardclipped
     reads never encode, indel reads don't under indel_policy='drop'
     (ops.encode.trim_softclips_keep_indels) — so a family padded with
-    droppable reads isn't misrouted onto the one-family deep path."""
+    droppable reads isn't misrouted onto the one-family deep path.
+    Families with <= threshold records skip the CIGAR scan entirely (the
+    kept-qname count can't exceed the record count), so a normal-depth
+    stream pays O(1) per family for this rarity check.
+
+    Deep entries carry the kept-qname count: (mi, records, depth)."""
     from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CDEL, CINS
 
     drop_ops = (
@@ -102,16 +107,40 @@ def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     )
     normal, deep = [], []
     for mi, records in chunk:
+        if len(records) <= threshold:
+            normal.append((mi, records))
+            continue
         qnames = {
             r.qname
             for r in records
             if not any(op in drop_ops for op, _ in r.cigar)
         }
         if len(qnames) > threshold:
-            deep.append((mi, records))
+            deep.append((mi, records, len(qnames)))
         else:
             normal.append((mi, records))
     return normal, deep
+
+
+def _bucket_deep(deep):
+    """Group deep families into shared kernel dispatches by padded template
+    bucket (ops.encode.bucket_templates): families landing in the same
+    bucket dispatch as one [K, T, 2, W] batch — one kernel call for K
+    families instead of K calls — while families of very different depth
+    never pad each other (the bucket bounds pad waste). Each dispatch is
+    capped at DEEP_TEMPLATE_CAP total padded templates (K * bucket), so a
+    deep-heavy chunk can never build an unbounded [K, T, 2, W] allocation.
+    Buckets yield in first-appearance order; families keep input order
+    within a bucket."""
+    from bsseqconsensusreads_tpu.ops.encode import bucket_templates
+
+    buckets: dict[int, list] = {}
+    for mi, records, depth in deep:
+        buckets.setdefault(bucket_templates(depth), []).append((mi, records))
+    for bucket, group in buckets.items():
+        max_k = max(1, DEEP_TEMPLATE_CAP // bucket)
+        for i in range(0, len(group), max_k):
+            yield group[i : i + max_k]
 
 
 def _pipelined(events):
@@ -706,11 +735,11 @@ def call_molecular_batches(
             stats.skipped_families += len(skipped)
             stats.indel_aligned += batch.indel_aligned
             stats.indel_dropped += batch.indel_dropped
-            deep_emitted: list[BamRecord] = []
-            for mi, deep_records in deep:
+            deep_emitted: list = []
+            for deep_group in _bucket_deep(deep):
                 with stats.metrics.timed("encode"):
                     dbatch, dskipped = encode_molecular_families(
-                        [(mi, deep_records)], max_window=max_window,
+                        deep_group, max_window=max_window,
                         max_templates=DEEP_TEMPLATE_CAP,
                         indel_policy=indel_policy,
                     )
@@ -726,9 +755,11 @@ def call_molecular_batches(
                 with stats.metrics.timed("kernel"):
                     dout = run_deep_kernel(dbatch)
                 with stats.metrics.timed("emit"):
-                    deep_emitted.extend(
-                        _emit_molecular_batch(dbatch, dout, params, mode, stats)
-                    )
+                    demit = emit_fn(dbatch, dout, params, mode, stats)
+                if isinstance(demit, RawRecords):
+                    deep_emitted.append(demit)
+                else:
+                    deep_emitted.extend(demit)
             if not batch.meta:
                 yield "now", deep_emitted
                 continue
