@@ -1,0 +1,320 @@
+"""Consensus math vs fgbio's published caller model (round-2 VERDICT item 4).
+
+The reference's output contract is "equivalent to fgbio
+CallDuplexConsensusReads" (reference README.md:9) with the flag surface of
+main.snake.py:54,163. fgbio's JVM is not in this environment, but its caller
+math is published source (fulcrumgenomics/fgbio,
+VanillaUmiConsensusCaller.scala / ConsensusCaller.scala /
+DuplexConsensusCaller.scala). This suite transcribes that math — NOT the
+framework's own ops.phred / utils.oracle — into plain float64 Python below,
+and checks the production kernels against it on hand-sized inputs.
+
+Transcribed model (fgbio ConsensusCaller semantics):
+  1. per-observation error  p_adj = P2(phred2p(q), phred2p(postUmi))
+     where P2(p1, p2) = p1(1-p2) + (1-p1)p2 + (2/3)p1p2
+     (ConsensusCaller.probabilityOfErrorTwoTrials: exactly one process errs,
+     or both err and the second doesn't revert — 2/3 under uniform subs)
+  2. per-column, per candidate b: LL(b) = sum_obs log(1-p_adj) if obs==b
+     else log(p_adj/3)
+  3. consensus = argmax LL; with a uniform prior its error probability is
+     p_cons = 1 - exp(LL_max) / sum_b exp(LL(b))
+  4. final error  p_final = P2(p_cons, phred2p(preUmi)); qual = -10log10,
+     clamped to printable Phred
+  5. observations with raw q < minInputBaseQuality are excluded (no depth,
+     no vote); consensus columns with qual < minConsensusBaseQuality are
+     no-called (N, qual 2)
+  6. consensus tags: cD = max per-column depth, cM = min, cE = total
+     disagreeing observations / total observations, cd/ce = the per-column
+     arrays themselves (fgbio CallMolecularConsensusReads tag docs)
+
+Knowing deviations of this framework from fgbio (each deliberate, each
+documented where implemented):
+  * The vote runs in genome-window space over softclip-trimmed reads;
+    indel/hardclip reads are dropped — mirroring what the reference pipeline
+    itself feeds fgbio after tools/1+2 (models/molecular.py module doc).
+  * The duplex merge is the same likelihood vote at depth 2 over the two
+    single-strand consensi (models/duplex.py), not fgbio's
+    sum/difference-of-quals special case; strand disagreement still
+    no-calls on equal evidence (both reduce to "agreement strengthens,
+    conflict cancels"), but agreeing-qual arithmetic differs:
+    fgbio adds Phreds, the vote multiplies error posteriors. Covered by
+    test_duplex_agreement_strengthens / disagreement_cancels.
+  * Device arithmetic is float32 (TPU VPU) vs fgbio's float64 — asserted
+    here to ±1 Phred after rounding.
+  * fgbio's per-read filters this pipeline never enables
+    (--min-reads>0 family filter is host-side; --max-reads downsampling is
+    not used by the reference invocation) are out of scope.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.molecular import column_vote, overlap_cocall
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+# ---------------------------------------------------------------------------
+# Independent float64 transcription of fgbio's math (no package imports).
+
+A, C, G, T = 0, 1, 2, 3
+
+
+def phred2p(q: float) -> float:
+    return 10.0 ** (-q / 10.0)
+
+
+def p2phred(p: float, lo: float = 2.0, hi: float = 93.0) -> float:
+    return min(hi, max(lo, -10.0 * math.log10(max(p, 1e-300))))
+
+
+def two_trials(p1: float, p2: float) -> float:
+    """fgbio ConsensusCaller.probabilityOfErrorTwoTrials."""
+    return p1 * (1.0 - p2) + (1.0 - p1) * p2 + (2.0 / 3.0) * p1 * p2
+
+
+def column_lls(kept: list[tuple[int, float]], post_umi: float) -> list[float]:
+    ll = [0.0, 0.0, 0.0, 0.0]
+    for b, q in kept:
+        p_adj = two_trials(phred2p(q), phred2p(post_umi))
+        for cand in (A, C, G, T):
+            ll[cand] += math.log1p(-p_adj) if cand == b else math.log(p_adj / 3.0)
+    return ll
+
+
+def fgbio_column(obs: list[tuple[int, float]], *, pre_umi: float = 45.0,
+                 post_umi: float = 30.0, min_input_q: float = 0.0,
+                 min_consensus_q: float = 0.0):
+    """One consensus column from [(base, raw_qual), ...] observations.
+
+    Returns (base, qual_int, depth, errors) with base=N for no-call,
+    matching the documented fgbio caller flow (steps 1-5 above).
+    """
+    kept = [(b, q) for b, q in obs if b != NBASE and q >= min_input_q]
+    depth = len(kept)
+    if depth == 0:
+        return NBASE, 2, 0, 0
+    ll = column_lls(kept, post_umi)
+    best = max(range(4), key=lambda cand: ll[cand])
+    mx = max(ll)
+    total = sum(math.exp(v - mx) for v in ll)
+    p_cons = 1.0 - math.exp(ll[best] - mx) / total
+    p_final = two_trials(p_cons, phred2p(pre_umi))
+    qual = p2phred(p_final)
+    if qual < min_consensus_q:
+        return NBASE, 2, depth, 0
+    errors = sum(1 for b, _ in kept if b != best)
+    return best, int(round(qual)), depth, errors
+
+
+def run_kernel_column(obs: list[tuple[int, float]], **kw) -> tuple:
+    """The production kernel on the same single column (reads x 1 window)."""
+    params = ConsensusParams(
+        error_rate_pre_umi=kw.get("pre_umi", 45.0),
+        error_rate_post_umi=kw.get("post_umi", 30.0),
+        min_input_base_quality=kw.get("min_input_q", 0.0),
+        min_consensus_base_quality=kw.get("min_consensus_q", 0.0),
+    )
+    bases = np.array([[b] for b, _ in obs], dtype=np.int8)
+    quals = np.array([[q] for _, q in obs], dtype=np.float32)
+    out = column_vote(bases, quals, params)
+    return (
+        int(out["base"][0]),
+        int(out["qual"][0]),
+        int(out["depth"][0]),
+        int(out["errors"][0]),
+    )
+
+
+def assert_matches_fgbio(obs, **kw):
+    want = fgbio_column(obs, **kw)
+    got = run_kernel_column(obs, **kw)
+    if got[0] != want[0] and want[0] != NBASE:
+        # exact log-likelihood tie: the argmax is genuinely ambiguous (equal
+        # posterior — fgbio's own pick is an implementation detail there) and
+        # float32-vs-float64 summation order may break it differently. Accept
+        # any tied-best base and recompute errors against that pick.
+        kept = [
+            (b, q) for b, q in obs
+            if b != NBASE and q >= kw.get("min_input_q", 0.0)
+        ]
+        ll = column_lls(kept, kw.get("post_umi", 30.0))
+        best = max(ll)
+        tied = {cand for cand in (A, C, G, T) if abs(ll[cand] - best) < 1e-9}
+        assert got[0] in tied, f"base: got {got} want {want} for {obs}"
+        want = (got[0], want[1], want[2], sum(1 for b, _ in kept if b != got[0]))
+    assert got[0] == want[0], f"base: got {got} want {want} for {obs}"
+    assert abs(got[1] - want[1]) <= 1, f"qual: got {got} want {want} for {obs}"
+    assert got[2:] == want[2:], f"depth/errors: got {got} want {want} for {obs}"
+
+
+# ---------------------------------------------------------------------------
+# Closed-form anchor values (checked against the transcription itself, so a
+# transcription typo can't silently pass: these are derived by hand).
+
+
+def test_single_read_posterior_closed_form():
+    """One observation: the posterior error equals p_adj exactly
+    (1-p vs three p/3 candidates), then the pre-UMI fold applies."""
+    q, pre, post = 30.0, 45.0, 30.0
+    p_adj = two_trials(phred2p(q), phred2p(post))
+    # posterior error = 3*(p/3 / ((1-p) + p)) -- denominator is 1
+    p_cons_closed = p_adj
+    base, qual, depth, errors = fgbio_column([(A, q)], pre_umi=pre, post_umi=post)
+    assert base == A and depth == 1 and errors == 0
+    assert qual == int(round(p2phred(two_trials(p_cons_closed, phred2p(pre)))))
+    # and the hand number: p_adj ~ 1.9987e-3 -> p_final ~ 2.0303e-3 -> Q27
+    assert qual == 27
+
+
+def test_two_agreeing_reads_strengthen():
+    """Agreement multiplies likelihood ratios: quality rises, bounded by the
+    pre-UMI prior (fgbio's reason for the pre/post split: consensus can't
+    beat the source molecule's own error floor)."""
+    pre = 45.0
+    floor = p2phred(two_trials(0.0, phred2p(pre)))  # 45.0
+    # Q20 reads: evidence accumulates visibly before the floor saturates
+    # (Q30 reads already hit the pre-UMI floor at two observations)
+    q1 = fgbio_column([(C, 20.0)])[1]
+    q2 = fgbio_column([(C, 20.0), (C, 20.0)])[1]
+    q3 = fgbio_column([(C, 20.0)] * 3)[1]
+    assert q1 < q2 < q3 <= int(round(floor)) + 1
+    for obs in ([(C, 20.0)], [(C, 20.0)] * 2, [(C, 20.0)] * 3):
+        assert_matches_fgbio(obs)
+
+
+def test_disagreement_cancels():
+    """Two equal-quality disagreeing reads: posterior ~ 1/2 between the two
+    observed bases (the unobserved two are negligible), so the consensus
+    qual collapses to ~Q3."""
+    base, qual, depth, errors = fgbio_column([(A, 30.0), (G, 30.0)])
+    assert depth == 2 and errors == 1
+    assert qual <= 4
+    assert_matches_fgbio([(A, 30.0), (G, 30.0)])
+
+
+def test_higher_quality_base_wins():
+    obs = [(A, 35.0), (G, 20.0)]
+    base, qual, *_ = fgbio_column(obs)
+    assert base == A
+    assert_matches_fgbio(obs)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_columns_match_transcription(seed):
+    """Randomized columns (mixed bases, RTA3-binned and arbitrary quals,
+    no-calls) against the float64 transcription."""
+    rng = np.random.default_rng(400 + seed)
+    for _ in range(40):
+        n = int(rng.integers(1, 12))
+        obs = []
+        for _ in range(n):
+            b = int(rng.integers(0, 5))
+            q = float(rng.choice([2, 12, 23, 30, 37, 40]))
+            obs.append((b, q))
+        assert_matches_fgbio(obs)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        {"pre_umi": 45.0, "post_umi": 30.0},  # the reference's exact flags
+        {"pre_umi": 20.0, "post_umi": 10.0},
+        {"min_input_q": 20.0},
+        {"min_consensus_q": 25.0},
+    ],
+)
+def test_flag_surface_semantics(kw):
+    """The main.snake.py:54,163 flag surface: error-rate priors, input-qual
+    exclusion (no depth, no vote), consensus-qual no-call masking."""
+    rng = np.random.default_rng(99)
+    for _ in range(30):
+        n = int(rng.integers(1, 8))
+        obs = [
+            (int(rng.integers(0, 4)), float(rng.integers(2, 41)))
+            for _ in range(n)
+        ]
+        assert_matches_fgbio(obs, **kw)
+
+
+def test_min_input_quality_excludes_from_depth():
+    obs = [(A, 30.0), (G, 10.0)]
+    base, qual, depth, errors = fgbio_column(obs, min_input_q=20.0)
+    assert depth == 1 and errors == 0 and base == A
+    assert_matches_fgbio(obs, min_input_q=20.0)
+
+
+def test_error_floor_is_pre_umi_rate():
+    """No amount of agreeing evidence can push consensus quality past the
+    pre-UMI error rate: the source molecule itself may be wrong."""
+    deep = [(T, 40.0)] * 50
+    _, qual, _, _ = fgbio_column(deep)
+    assert qual == 45  # exactly the --error-rate-pre-umi=45 prior
+    assert_matches_fgbio(deep)
+
+
+# ---------------------------------------------------------------------------
+# Overlap co-call (--consensus-call-overlapping-bases=true): fgbio's
+# documented R1/R2 pre-combination.
+
+
+def test_overlap_cocall_agreement_sums_quals():
+    bases = np.array([[[A], [A]]], dtype=np.int8)  # [T=1, 2 roles, W=1]
+    quals = np.array([[[30.0], [20.0]]], dtype=np.float32)
+    b, q = overlap_cocall(bases, quals)
+    assert int(b[0, 0, 0]) == A and int(b[0, 1, 0]) == A
+    assert float(q[0, 0, 0]) == 50.0 and float(q[0, 1, 0]) == 50.0
+
+
+def test_overlap_cocall_disagreement_keeps_winner_with_diff():
+    bases = np.array([[[A], [G]]], dtype=np.int8)
+    quals = np.array([[[35.0], [20.0]]], dtype=np.float32)
+    b, q = overlap_cocall(bases, quals)
+    assert int(b[0, 0, 0]) == A and int(b[0, 1, 0]) == A
+    assert float(q[0, 0, 0]) == 15.0
+
+
+def test_overlap_cocall_tie_masks_both():
+    bases = np.array([[[A], [G]]], dtype=np.int8)
+    quals = np.array([[[30.0], [30.0]]], dtype=np.float32)
+    b, _ = overlap_cocall(bases, quals)
+    assert int(b[0, 0, 0]) == NBASE and int(b[0, 1, 0]) == NBASE
+
+
+# ---------------------------------------------------------------------------
+# Duplex: documented deviation, but the structural guarantees fgbio's
+# combiner provides must hold in the vote formulation too.
+
+
+def _duplex_pair(b1, q1, b2, q2):
+    from bsseqconsensusreads_tpu.models.duplex import duplex_consensus
+
+    bases = np.full((1, 4, 1), NBASE, dtype=np.int8)
+    quals = np.zeros((1, 4, 1), dtype=np.float32)
+    bases[0, 0, 0], quals[0, 0, 0] = b1, q1  # strand A, R1 role
+    bases[0, 1, 0], quals[0, 1, 0] = b2, q2  # strand B, R1 role
+    out = duplex_consensus(bases, quals, ConsensusParams(min_reads=0))
+    return int(out["base"][0, 0, 0]), int(out["qual"][0, 0, 0])
+
+
+def test_duplex_agreement_strengthens():
+    """Strand agreement must yield a higher qual than either single strand
+    (fgbio: q1+q2 capped; here: posterior product — same direction)."""
+    single = fgbio_column([(A, 30.0)], post_umi=30.0)[1]
+    b, q = _duplex_pair(A, 30.0, A, 30.0)
+    assert b == A and q > single
+
+
+def test_duplex_equal_disagreement_no_calls():
+    """Equal-evidence strand conflict cannot produce a confident call
+    (fgbio emits N; the vote emits the tied argmax at floor quality)."""
+    b, q = _duplex_pair(A, 30.0, G, 30.0)
+    assert q <= 4
+
+
+def test_duplex_unequal_disagreement_keeps_stronger_strand():
+    b, q = _duplex_pair(A, 38.0, G, 15.0)
+    assert b == A
