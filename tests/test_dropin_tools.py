@@ -1,0 +1,148 @@
+"""Drop-in tools exercised exactly as the Snakemake rule bodies would.
+
+The north-star contract (BASELINE.json; reference main.snake.py:121-164) is
+that `tools/call_molecular_consensus_tpu.py` / `call_duplex_consensus_tpu.py`
+slot into the reference's rule shapes as `shell:` subprocesses. These tests
+invoke them that way — fresh interpreter, documented arguments, reference-
+style config.yaml for the `run` entry — and assert the output BAMs are
+byte-identical to the in-process pipeline (round-2 VERDICT item 7).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamWriter
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_tool(script: str, argv: list[str]) -> subprocess.CompletedProcess:
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        BSSEQ_TPU_BACKEND="cpu",  # subprocesses must never grab the tunnel
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", script), *argv],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+
+
+@pytest.fixture(scope="module")
+def molecular_input(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dropin_mol")
+    rng = np.random.default_rng(77)
+    name, genome = random_genome(rng, 6000)
+    header, records = make_grouped_bam_records(rng, name, genome, n_families=8)
+    inp = str(tmp / "grouped.bam")
+    with BamWriter(inp, header) as w:
+        w.write_all(records)
+    return tmp, inp
+
+
+@pytest.fixture(scope="module")
+def duplex_input(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dropin_dup")
+    rng = np.random.default_rng(78)
+    name, genome = random_genome(rng, 4000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [(name, len(genome))])
+    records = []
+    for gi in range(5):
+        records += make_aligned_duplex_group(
+            rng, name, genome, gi, 100 + 300 * gi, 60
+        )
+    inp = str(tmp / "aligned.bam")
+    with BamWriter(inp, header) as w:
+        w.write_all(records)
+    return tmp, inp, fasta
+
+
+def test_molecular_dropin_subprocess_matches_inprocess(molecular_input):
+    """The rule-shape invocation (main.snake.py:46-55's replacement):
+    `python3 tools/call_molecular_consensus_tpu.py -i IN -o OUT`."""
+    tmp, inp = molecular_input
+    sub_out = str(tmp / "sub.bam")
+    cp = _run_tool("call_molecular_consensus_tpu.py",
+                   ["-i", inp, "-o", sub_out])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    # stderr carries the stage stats JSON (observability contract)
+    assert '"families"' in cp.stderr
+
+    from bsseqconsensusreads_tpu.cli import main as cli_main
+
+    in_out = str(tmp / "inproc.bam")
+    assert cli_main(["molecular", "-i", inp, "-o", in_out]) == 0
+    sub_bytes = open(sub_out, "rb").read()
+    assert sub_bytes == open(in_out, "rb").read()
+    n = sum(1 for _ in BamReader(sub_out))
+    assert n > 0
+
+
+def test_duplex_dropin_subprocess_matches_inprocess(duplex_input):
+    """The four-rule-chain replacement (main.snake.py:121-164):
+    `python3 tools/call_duplex_consensus_tpu.py -i IN -o OUT --reference REF`.
+    """
+    tmp, inp, fasta = duplex_input
+    sub_out = str(tmp / "sub.bam")
+    cp = _run_tool("call_duplex_consensus_tpu.py",
+                   ["-i", inp, "-o", sub_out, "--reference", fasta])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+
+    from bsseqconsensusreads_tpu.cli import main as cli_main
+
+    in_out = str(tmp / "inproc.bam")
+    assert cli_main(
+        ["duplex", "-i", inp, "-o", in_out, "--reference", fasta]
+    ) == 0
+    assert open(sub_out, "rb").read() == open(in_out, "rb").read()
+    recs = list(BamReader(sub_out))
+    assert len(recs) == 10  # 5 groups x R1+R2
+    for rec in recs:
+        tags = dict(rec.tags)
+        assert "MI" in tags and "RX" in tags
+
+
+def test_run_entry_with_reference_style_config(tmp_path):
+    """`python -m bsseqconsensusreads_tpu run --config config.yaml --bam …`
+    — the snakemake-invocation equivalent (README.md:62) driven by a
+    reference-style config.yaml (config.yaml:1-11 keys + promoted knobs)."""
+    rng = np.random.default_rng(79)
+    name, genome = random_genome(rng, 6000)
+    write_fasta(str(tmp_path / "genome.fa"), name, genome)
+    header, records = make_grouped_bam_records(rng, name, genome, n_families=6)
+    inp = str(tmp_path / "sample.bam")
+    with BamWriter(inp, header) as w:
+        w.write_all(records)
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"genome_dir: {tmp_path}\n"
+        "genome_fasta_file_name: genome.fa\n"
+        f"tmp: {tmp_path}\n"
+        "backend: cpu\n"
+        "aligner: self\n"
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu")
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu", "run",
+         "--config", str(cfg), "--bam", inp,
+         "--outdir", str(tmp_path / "output")],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    outs = os.listdir(tmp_path / "output")
+    finals = [f for f in outs if f.endswith(".bam")]
+    assert finals, outs
