@@ -157,3 +157,65 @@ def make_aligned_duplex_group(
         rec.set_tag("RX", "ACGTACGT-TGCATGCA", "Z")
         recs.append(rec)
     return recs
+
+
+def stream_duplex_families(
+    codes: np.ndarray,
+    n_families: int,
+    *,
+    read_len: int = 100,
+    frag_extra: int = 30,
+    templates_for=None,
+    qual_for=None,
+    mutate=None,
+    rx: str = "ACGTACGT-TGCATGCA",
+):
+    """Stream a coordinate-sorted synthetic grouped-duplex record stream.
+
+    One MI family per `fam` index: A/B strands x both mates (flags
+    99/147/163/83), `templates_for(fam)` read pairs per strand (default 1).
+    Family start positions are MONOTONE NON-DECREASING —
+    ``10 + (fam * span) // n_families`` — so the stream satisfies the
+    'coordinate' grouping contract (pipeline.calling.stream_mi_groups) for
+    ANY family count; a stride-modulo scheme would wrap and silently break
+    the sort once n_families * stride exceeds the genome span.
+
+    Memory is O(1 family): records are built lazily. Shared by
+    tests/memhelper.py (peak-RSS tests) and tools/scale_rehearsal.py so the
+    generation scheme has one source of truth.
+
+    qual_for(fam, ti, flag) -> bytes[read_len]; mutate(seq, fam, ti, flag)
+    -> str lets callers inject sequencing errors without paying per-record
+    rng costs here.
+    """
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+
+    genome_len = len(codes)
+    frag_len = read_len + frag_extra
+    span = genome_len - frag_len - 30
+    if span <= 0:
+        raise ValueError(f"genome too short: {genome_len} for {frag_len}-bp fragments")
+    default_qual = bytes([35] * read_len)
+    for fam in range(n_families):
+        start = 10 + (fam * span) // n_families
+        r2 = start + frag_len - read_len
+        left = codes_to_seq(codes[start : start + read_len])
+        right = codes_to_seq(codes[r2 : r2 + read_len])
+        t = templates_for(fam) if templates_for else 1
+        for strand, (lf, rf) in (("A", (99, 147)), ("B", (163, 83))):
+            for ti in range(t):
+                for flag, pos, mate, seq, tl in (
+                    (lf, start, r2, left, frag_len),
+                    (rf, r2, start, right, -frag_len),
+                ):
+                    if mutate is not None:
+                        seq = mutate(seq, fam, ti, flag)
+                    rec = BamRecord(
+                        qname=f"f{fam}:{strand}:{ti}", flag=flag, ref_id=0,
+                        pos=pos, mapq=60, cigar=[(CMATCH, read_len)],
+                        next_ref_id=0, next_pos=mate, tlen=tl, seq=seq,
+                        qual=qual_for(fam, ti, flag) if qual_for else default_qual,
+                    )
+                    rec.set_tag("RX", rx, "Z")
+                    rec.set_tag("MI", f"{fam}/{strand}", "Z")
+                    yield rec

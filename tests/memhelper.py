@@ -39,36 +39,12 @@ def _genome(rng):
     return codes, codes_to_seq(codes)
 
 
-def _family_records(codes, fam: int, qual: bytes):
-    """One 4-record duplex family (A/B strands, both mates), exact-genome
-    reads at monotonically increasing positions so the stream is
-    coordinate-ordered for the 'coordinate' grouping mode."""
-    start = 10 + (fam * 37) % (GENOME_LEN - 3 * READ_LEN - 20)
-    frag_len = READ_LEN + 30
-    r2 = start + frag_len - READ_LEN
-    left_seq = codes_to_seq(codes[start : start + READ_LEN])
-    right_seq = codes_to_seq(codes[r2 : r2 + READ_LEN])
-    out = []
-    for strand, (lf, rf) in (("A", (99, 147)), ("B", (163, 83))):
-        for flag, pos, mate, seq, tl in (
-            (lf, start, r2, left_seq, frag_len),
-            (rf, r2, start, right_seq, -frag_len),
-        ):
-            rec = BamRecord(
-                qname=f"fam{fam}:{strand}", flag=flag, ref_id=0, pos=pos,
-                mapq=60, cigar=[(CMATCH, READ_LEN)], next_ref_id=0,
-                next_pos=mate, tlen=tl, seq=seq, qual=qual,
-            )
-            rec.set_tag("RX", "ACGTACGT-TGCATGCA", "Z")
-            rec.set_tag("MI", f"{fam}/{strand}", "Z")
-            out.append(rec)
-    return out
-
-
 def _stream_families(codes, n_families: int):
-    qual = bytes([35] * READ_LEN)
-    for fam in range(n_families):
-        yield from _family_records(codes, fam, qual)
+    """Coordinate-sorted 4-record duplex families (one template per strand)
+    via the shared monotone-position generator."""
+    from bsseqconsensusreads_tpu.utils.testing import stream_duplex_families
+
+    yield from stream_duplex_families(codes, n_families, read_len=READ_LEN)
 
 
 def _rss_mb() -> float:

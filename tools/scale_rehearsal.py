@@ -1,0 +1,231 @@
+#!/usr/bin/env python3
+"""Scale rehearsal toward the 100M-read config (round-2 VERDICT item 5).
+
+Generates a multi-GB coordinate-sorted grouped BAM with the BASELINE
+configs 3/5 family mixture — a 1-2-read cfDNA tail, normal paired families,
+and deep families past the template cap — then runs the full self-aligned
+pipeline (molecular -> fused duplex, native ingest+emit,
+grouping='coordinate', external-merge sorts) in a CHILD process and asserts
+its peak RSS stays bounded. The reference's envelope for this workload is
+>=100 GB host RAM (reference README.md:83, -Xmx100g heaps at
+main.snake.py:54,106,152,163); the framework's contract is <16 GB
+(BASELINE.md), enforced here with margin.
+
+Writes a JSON artifact: per-stage families/sec, phase metrics
+(StageStats.metrics: ingest/encode/kernel/fetch/emit splits), peak RSS, and
+the generation/pipeline wall clocks.
+
+Usage: python tools/scale_rehearsal.py [--families 2000000]
+       [--out SCALE_r03.json] [--workdir DIR] [--rss-limit-gb 12]
+       (--child <workdir> <families> is the subprocess entry)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+READ_LEN = 150
+GENOME_LEN = 2_000_000
+FRAG_LEN = READ_LEN + 30
+#: family mixture (BASELINE configs 3/5): fractions of the family count
+CFDNA_FRACTION = 0.7  # 1 template/strand  -> 4 records ("1-2-read" tail)
+NORMAL_TEMPLATES = 2  # per strand         -> 8 records
+DEEP_FAMILIES = 3  # families beyond MAX_TEMPLATES (deep-family path)
+DEEP_TEMPLATES = 4200  # > ops.encode.MAX_TEMPLATES = 4096
+
+
+def _records_for(n_families: int) -> int:
+    n_cfdna = int(n_families * CFDNA_FRACTION)
+    n_normal = n_families - n_cfdna - DEEP_FAMILIES
+    return (
+        n_cfdna * 4
+        + n_normal * NORMAL_TEMPLATES * 4
+        + DEEP_FAMILIES * DEEP_TEMPLATES * 4
+    )
+
+
+def _child(workdir: str, n_families: int) -> None:
+    """Generate + run; prints one JSON line with stats."""
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import resource
+
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import (
+        stream_duplex_families,
+        write_fasta,
+    )
+
+    rng = np.random.default_rng(9)
+    codes = rng.integers(0, 4, size=GENOME_LEN).astype(np.int8)
+    genome = codes_to_seq(codes)
+    fasta = os.path.join(workdir, "genome.fa")
+    write_fasta(fasta, "chr1", genome)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", GENOME_LEN)])
+
+    deep_every = max(1, n_families // (DEEP_FAMILIES + 1))
+    # entropy pools: RTA3-binned random quals and error positions/bases,
+    # pre-generated so per-record cost stays O(string copy). Real inputs
+    # are not constant-qual/error-free; this also keeps the BAM from
+    # compressing into triviality and makes the vote actually correct
+    # sequencing errors at scale.
+    qual_pool = [
+        bytes(np.random.default_rng(100 + i).choice(
+            np.array([2, 12, 23, 37], np.uint8), size=READ_LEN
+        )) for i in range(64)
+    ]
+    err_pos = rng.integers(2, READ_LEN - 2, size=4096)
+    err_base = rng.integers(0, 4, size=4096)
+
+    def templates_for(fam: int) -> int:
+        if fam and fam % deep_every == 0 and fam // deep_every <= DEEP_FAMILIES:
+            return DEEP_TEMPLATES
+        if fam % 10 < 10 * CFDNA_FRACTION:
+            return 1
+        return NORMAL_TEMPLATES
+
+    def mutate(seq: str, fam: int, ti: int, flag: int) -> str:
+        # ~1.3% substitution error rate: 2 positions per read
+        h = (fam * 31 + ti * 7 + flag) & 4095
+        for k in (h, (h * 2654435761) & 4095):
+            i = int(err_pos[k])
+            seq = seq[:i] + "ACGT"[err_base[k]] + seq[i + 1 :]
+        return seq
+
+    def qual_for(fam: int, ti: int, flag: int) -> bytes:
+        return qual_pool[(fam + ti * 13 + flag) & 63]
+
+    bam = os.path.join(workdir, "input", "scale.bam")
+    os.makedirs(os.path.dirname(bam), exist_ok=True)
+    t0 = time.monotonic()
+    n_records = 0
+    with BamWriter(bam, header) as w:
+        for rec in stream_duplex_families(
+            codes, n_families, read_len=READ_LEN,
+            frag_extra=FRAG_LEN - READ_LEN,
+            templates_for=templates_for, qual_for=qual_for, mutate=mutate,
+        ):
+            w.write(rec)
+            n_records += 1
+    gen_s = time.monotonic() - t0
+    gen_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+    cfg = FrameworkConfig(
+        genome_dir=workdir,
+        genome_fasta_file_name="genome.fa",
+        tmp=workdir,
+        aligner="self",
+        grouping="coordinate",
+        sort_buffer_records=100_000,
+        batch_families=2048,
+    )
+    t0 = time.monotonic()
+    target, _, stats = run_pipeline(cfg, bam, outdir=os.path.join(workdir, "output"))
+    pipe_s = time.monotonic() - t0
+    out = {
+        "n_families": n_families,
+        "n_records": n_records,
+        "input_bytes": os.path.getsize(bam),
+        "gen_s": round(gen_s, 1),
+        "gen_rss_mb": round(gen_rss, 1),
+        "pipeline_s": round(pipe_s, 1),
+        "rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        ),
+        "output_bytes": os.path.getsize(target),
+        "stages": {
+            name: st.as_dict() for name, st in stats.items()
+        },
+    }
+    print(json.dumps(out))
+
+
+def main() -> int:
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        _child(sys.argv[2], int(sys.argv[3]))
+        return 0
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--families", type=int, default=2_000_000)
+    ap.add_argument("--out", default="SCALE_r03.json")
+    ap.add_argument("--workdir", default="")
+    ap.add_argument("--rss-limit-gb", type=float, default=12.0)
+    ap.add_argument("--timeout", type=int, default=14_400)
+    args = ap.parse_args()
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="bsseq_scale_")
+    os.makedirs(workdir, exist_ok=True)
+    report = {
+        "config": {
+            "families": args.families,
+            "expected_records_approx": _records_for(args.families),
+            "cfdna_fraction": CFDNA_FRACTION,
+            "deep_families": DEEP_FAMILIES,
+            "deep_templates": DEEP_TEMPLATES,
+            "read_len": READ_LEN,
+            "rss_limit_gb": args.rss_limit_gb,
+        },
+        "ok": False,
+    }
+    t0 = time.monotonic()
+    try:
+        cp = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", workdir,
+             str(args.families)],
+            stdout=subprocess.PIPE, text=True, timeout=args.timeout,
+            env=dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu"),
+        )
+        report["wall_s"] = round(time.monotonic() - t0, 1)
+        if cp.returncode != 0:
+            report["error"] = f"child rc={cp.returncode}"
+        else:
+            child = json.loads(cp.stdout.strip().splitlines()[-1])
+            report["result"] = child
+            rss_gb = child["rss_mb"] / 1024.0
+            report["rss_ok"] = rss_gb < args.rss_limit_gb
+            dup = child["stages"].get("duplex", {})
+            mol = child["stages"].get("molecular", {})
+            for name, st in (("molecular", mol), ("duplex", dup)):
+                if st.get("wall_seconds"):
+                    report[f"{name}_families_per_s"] = round(
+                        st.get("families", 0) / st["wall_seconds"], 1
+                    )
+            report["records_per_s_end_to_end"] = round(
+                child["n_records"] / child["pipeline_s"], 1
+            )
+            report["ok"] = bool(report["rss_ok"])
+    except subprocess.TimeoutExpired:
+        report["error"] = f"child timed out after {args.timeout}s"
+        report["wall_s"] = round(time.monotonic() - t0, 1)
+    except Exception as exc:  # malformed child output must still produce
+        # a clean artifact, not a traceback after an hours-long run
+        report["error"] = f"{type(exc).__name__}: {exc}"
+        report["wall_s"] = round(time.monotonic() - t0, 1)
+    finally:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+        if not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps({k: report.get(k) for k in
+                      ("ok", "rss_ok", "wall_s", "error")}))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
