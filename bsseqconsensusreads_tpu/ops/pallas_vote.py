@@ -77,11 +77,19 @@ def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
         ).astype(jnp.float32)
         p_err = phred.adjust_quals_post_umi(quals, params.error_rate_post_umi)
         log_ok, log_err = phred.log_likelihoods(p_err)
+        # Factor the candidate-independent miss term out of the 4-way loop:
+        #   LL(b) = sum w*(hit_b*log_ok + (1-hit_b)*log_err)
+        #         = sum w*hit_b*(log_ok-log_err)  +  sum w*log_err
+        # The shared sum is computed once per chunk instead of four times —
+        # same float adds in the same order per term, so numerics match the
+        # unfactored form up to the usual summation-order ulps the tie
+        # comparison already absorbs.
+        log_diff = (log_ok - log_err) * w_obs
+        shared = jnp.sum(log_err * w_obs, axis=0, keepdims=True)  # [1, W]
         for b in range(NUM_BASES):
             hit = (bases == float(b)).astype(jnp.float32)
-            contrib = (hit * log_ok + (1.0 - hit) * log_err) * w_obs
             row = slice(g * NUM_BASES + b, g * NUM_BASES + b + 1)
-            ll_acc[row, :] += jnp.sum(contrib, axis=0, keepdims=True)
+            ll_acc[row, :] += jnp.sum(hit * log_diff, axis=0, keepdims=True) + shared
             cnt_acc[row, :] += jnp.sum(hit * w_obs, axis=0, keepdims=True)
 
     @pl.when(t == num_t - 1)
