@@ -40,6 +40,13 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
         help="MI-group streaming strategy (coordinate = bounded memory on sorted input)",
     )
     p.add_argument(
+        "--batching",
+        choices=("bucketed", "sequential"),
+        default="bucketed",
+        help="molecular chunk composition: depth-homogeneous buckets "
+        "(bounded pad waste) vs input order",
+    )
+    p.add_argument(
         "--emit",
         choices=("auto", "native", "python"),
         default="auto",
@@ -110,6 +117,7 @@ def cmd_molecular(args) -> int:
             grouping=args.grouping,
             stats=stats,
             emit=args.emit,
+            batching=args.batching,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 

@@ -35,6 +35,11 @@ class FrameworkConfig:
     #: coordinate-sorted input; 'adjacent' for MI-grouped input; 'gather'
     #: holds everything (any order). See pipeline.calling.stream_mi_groups.
     grouping: str = "coordinate"
+    #: molecular-stage chunk composition: 'bucketed' groups families into
+    #: depth-homogeneous kernel batches (bounded pad waste, stable shapes —
+    #: pipeline.calling._group_batches_bucketed); 'sequential' chunks in
+    #: input order (pre-bucketing behavior / output order).
+    batching: str = "bucketed"
     #: intra-stage checkpoint interval in kernel batches (0 = rule-boundary
     #: checkpoints only, the reference's granularity). When > 0, consensus
     #: stages write durable shards every N batches and resume mid-stage

@@ -102,24 +102,34 @@ def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     Deep entries carry the kept-qname count: (mi, records, depth)."""
     from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CDEL, CINS
 
-    drop_ops = (
-        (CINS, CDEL, CHARD_CLIP) if indel_policy == "drop" else (CHARD_CLIP,)
-    )
     normal, deep = [], []
     for mi, records in chunk:
         if len(records) <= threshold:
             normal.append((mi, records))
             continue
-        qnames = {
-            r.qname
-            for r in records
-            if not any(op in drop_ops for op, _ in r.cigar)
-        }
-        if len(qnames) > threshold:
-            deep.append((mi, records, len(qnames)))
+        n = _kept_template_count(records, indel_policy)
+        if n > threshold:
+            deep.append((mi, records, n))
         else:
             normal.append((mi, records))
     return normal, deep
+
+
+def _kept_template_count(records, indel_policy: str = "drop") -> int:
+    """Distinct qnames among records the encoder would keep (hardclipped
+    reads never encode; indel reads don't under indel_policy='drop') — the
+    template-depth estimate shared by the deep-family splitter and the
+    bucketed batcher so both agree with what encode actually materializes."""
+    from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CDEL, CINS
+
+    drop_ops = (
+        (CINS, CDEL, CHARD_CLIP) if indel_policy == "drop" else (CHARD_CLIP,)
+    )
+    return len({
+        r.qname
+        for r in records
+        if not any(op in drop_ops for op, _ in r.cigar)
+    })
 
 
 def _bucket_deep(deep):
@@ -370,6 +380,48 @@ def _group_batches(
         yield buf
 
 
+def _group_batches_bucketed(
+    groups: Iterator[tuple[str, list[BamRecord]]],
+    size: int,
+    indel_policy: str = "drop",
+) -> Iterator[list[tuple[str, list[BamRecord]]]]:
+    """Depth-homogeneous chunking for the molecular stage: families
+    accumulate per template bucket (ops.encode.bucket_templates of the
+    distinct-qname count) and a chunk is emitted when its bucket fills.
+
+    Sequential chunking pads every family in a chunk to the chunk's deepest
+    bucket — on a cfDNA-heavy mixture (1-template tail plus multi-template
+    families, BASELINE config 5) that wasted ~45% of encoded cells, and
+    padded cells ride the H2D wire and the kernel. Bucketed chunks pad only
+    within one bucket (<2x by construction) and keep kernel shapes stable
+    (one (size, bucket, 2, W) compile per bucket) instead of re-compiling
+    per chunk-max depth. Deep families accumulate like any bucket — same-
+    bucket deep families share one deep-path dispatch downstream
+    (_bucket_deep) — and the record-count flush bounds what any bucket can
+    hold (a single very deep family flushes its chunk immediately).
+
+    A bucket flushes at `size` families or size*8 records. Deterministic
+    given the input order — the checkpoint skip_batches replay contract."""
+    from bsseqconsensusreads_tpu.ops.encode import bucket_templates
+
+    pending: dict[int, list[tuple[str, list[BamRecord]]]] = {}
+    counts: dict[int, int] = {}
+    max_records = size * 8
+    for mi, records in groups:
+        # the indel-filtered distinct-qname count is what encode actually
+        # materializes (a raw record count would put every R1+R2 cfDNA
+        # family one bucket too high)
+        b = bucket_templates(_kept_template_count(records, indel_policy))
+        lst = pending.setdefault(b, [])
+        lst.append((mi, records))
+        counts[b] = counts.get(b, 0) + len(records)
+        if len(lst) >= size or counts[b] >= max_records:
+            yield pending.pop(b)
+            counts.pop(b)
+    for b in sorted(pending):
+        yield pending[b]
+
+
 def _consensus_tags(depth_arr, err_arr, mi, rx):
     """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce.
 
@@ -596,12 +648,17 @@ def call_molecular_batches(
     mesh="auto",
     deep_threshold: int | None = None,
     emit: str = "python",
+    batching: str = "bucketed",
 ) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
     (pipeline.checkpoint): batching is deterministic given identical input
     and parameters, so skip_batches replays the stream past already-
     checkpointed batches without re-running encode or the TPU kernel.
+
+    batching: 'bucketed' (default) groups families into depth-homogeneous
+    chunks per template bucket — bounded pad waste, stable kernel shapes
+    (_group_batches_bucketed); 'sequential' chunks in input order.
 
     emit: 'python' yields lists of BamRecord; 'native'/'auto' yield lists
     whose first element may be an io.bam.RawRecords block (the C++ batch
@@ -714,10 +771,18 @@ def call_molecular_batches(
         stream_mi_groups(records, grouping=grouping, stats=stats),
         stats.metrics,
     )
+    if batching == "bucketed":
+        chunks = _group_batches_bucketed(groups, batch_families, indel_policy)
+    elif batching == "sequential":
+        chunks = _group_batches(groups, batch_families)
+    else:
+        raise ValueError(
+            f"unknown batching {batching!r} (want 'bucketed'|'sequential')"
+        )
 
     def events():
         batch_index = 0
-        for chunk in _group_batches(groups, batch_families):
+        for chunk in chunks:
             batch_index += 1
             if batch_index <= skip_batches:
                 continue

@@ -122,6 +122,9 @@ class PipelineBuilder:
             "batch_families": self.cfg.batch_families,
             "max_window": self.cfg.max_window,
             "grouping": self.cfg.grouping,
+            # chunk composition differs between batching modes: shards
+            # resumed across a mode change would splice wrong families
+            "batching": self.cfg.batching,
             "indel_policy": self.cfg.indel_policy,
             "params": repr(getattr(self.cfg, stage)),
             # kernel choice changes tie-break behavior; resuming shards
@@ -190,6 +193,7 @@ class PipelineBuilder:
                 skip_batches=ck.batches_done if ck else 0,
                 indel_policy=self.cfg.indel_policy,
                 emit=self.cfg.emit,
+                batching=self.cfg.batching,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 
