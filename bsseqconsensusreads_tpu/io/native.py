@@ -31,7 +31,7 @@ def _try_load():
             "bamio_create", "bamio_write", "bamio_writer_error",
             "bamio_finish", "bamio_create_mt", "bamio_write_mt",
             "bamio_writer_error_mt", "bamio_finish_mt",
-            "bamio_parse_records",
+            "bamio_parse_records2",
         ),
     )
     if lib is None:
@@ -61,8 +61,8 @@ def _try_load():
     lib.bamio_writer_error_mt.argtypes = [C.c_void_p]
     lib.bamio_finish_mt.restype = C.c_int
     lib.bamio_finish_mt.argtypes = [C.c_void_p]
-    lib.bamio_parse_records.restype = C.c_int64
-    lib.bamio_parse_records.argtypes = [
+    lib.bamio_parse_records2.restype = C.c_int64
+    lib.bamio_parse_records2.argtypes = [
         C.c_void_p, C.c_int64,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
@@ -70,6 +70,7 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_int64, C.c_void_p,
         C.c_void_p, C.c_int64, C.c_void_p,
         C.c_char_p, C.c_int, C.c_char_p, C.c_int, C.c_char_p, C.c_int,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
     ]
     _lib = lib
 
@@ -235,6 +236,7 @@ class ColumnarBatch:
         "n", "ref_id", "pos", "flag", "mapq", "l_seq", "next_ref",
         "next_pos", "tlen", "n_cigar", "seq", "qual", "var_off",
         "cigar", "cigar_off", "qname", "mi", "rx",
+        "ref_span", "left_clip", "right_clip", "cigar_flags",
     )
 
     def __init__(self, n, **arrays):
@@ -295,7 +297,11 @@ def read_columnar(
             qname = np.zeros(n * qname_width, np.uint8)
             mi = np.zeros(n * tag_width, np.uint8)
             rx = np.zeros(n * tag_width, np.uint8)
-            got = _lib.bamio_parse_records(
+            ref_span = np.empty(n, np.int32)
+            left_clip = np.empty(n, np.int32)
+            right_clip = np.empty(n, np.int32)
+            cigar_flags = np.empty(n, np.uint8)
+            got = _lib.bamio_parse_records2(
                 r._h, n,
                 *(a.ctypes.data_as(C.c_void_p) for a in (
                     fixed["ref_id"], fixed["pos"], fixed["flag"], fixed["mapq"],
@@ -312,6 +318,10 @@ def read_columnar(
                 qname.ctypes.data_as(C.c_char_p), qname_width,
                 mi.ctypes.data_as(C.c_char_p), tag_width,
                 rx.ctypes.data_as(C.c_char_p), tag_width,
+                ref_span.ctypes.data_as(C.c_void_p),
+                left_clip.ctypes.data_as(C.c_void_p),
+                right_clip.ctypes.data_as(C.c_void_p),
+                cigar_flags.ctypes.data_as(C.c_void_p),
             )
             if got < 0:
                 raise IOError(_lib.bamio_error(r._h).decode())
@@ -331,6 +341,10 @@ def read_columnar(
                 qname=qn,
                 mi=mis,
                 rx=rxs,
+                ref_span=ref_span[:got],
+                left_clip=left_clip[:got],
+                right_clip=right_clip[:got],
+                cigar_flags=cigar_flags[:got],
             )
             # a short batch means either EOF or a capacity stop with a
             # pending record; the next parse call distinguishes (got==0 ends)

@@ -70,13 +70,21 @@ def trim_softclips_keep_indels(
     pos, has_indel). Hardclipped reads still return None (their bases are
     physically absent from the record). Used by indel_policy='align'
     (ops.banded — above-parity recovery of reads the reference drops)."""
+    # columnar ingest fast path (pipeline.ingest.ColumnarRecordView): the C
+    # parser pre-digested the CIGAR (clips/indel/hardclip) and the base
+    # codes/quals are buffer views — no cigar list, no string round-trip
+    info = getattr(rec, "clip_info", None)
+    if info is not None:
+        start, rclip, has_indel, has_hard = info
+        if has_hard:
+            return None
+        codes, quals = rec.codes_quals
+        end = len(codes) - rclip
+        return codes[start:end], quals[start:end], rec.pos, has_indel
     cigar = rec.cigar
     if any(op == CHARD_CLIP for op, _ in cigar):
         return None
     has_indel = any(op in (CINS, CDEL) for op, _ in cigar)
-    # columnar ingest fast path (pipeline.ingest.ColumnarRecordView): base
-    # codes and quals come straight from the native parser's buffers, no
-    # string round-trip
     precoded = getattr(rec, "codes_quals", None)
     if precoded is not None:
         codes, quals = precoded

@@ -125,11 +125,16 @@ def _kept_template_count(records, indel_policy: str = "drop") -> int:
     drop_ops = (
         (CINS, CDEL, CHARD_CLIP) if indel_policy == "drop" else (CHARD_CLIP,)
     )
-    return len({
-        r.qname
-        for r in records
-        if not any(op in drop_ops for op, _ in r.cigar)
-    })
+    drop_indels = indel_policy == "drop"
+
+    def kept(r) -> bool:
+        info = getattr(r, "clip_info", None)
+        if info is not None:  # columnar view: C-side CIGAR digest
+            _, _, has_indel, has_hard = info
+            return not (has_hard or (drop_indels and has_indel))
+        return not any(op in drop_ops for op, _ in r.cigar)
+
+    return len({r.qname for r in records if kept(r)})
 
 
 def _bucket_deep(deep):

@@ -452,17 +452,25 @@ void bamio_close(Reader* r) {
 // Fixed per-record: ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
 // n_cigar. Variable: seq codes + quals at var_off[i] (l_seq[i] bytes each,
 // capacity var_cap), cigar ops at cigar_off[i] (n_cigar u32), qname/mi/rx
-// fixed-width NUL-terminated strings. Returns records parsed, -1 on error.
-// Stops early (returning fewer) when a capacity would be exceeded; the
-// blocking record is buffered internally and returned by the next call.
-int64_t bamio_parse_records(
+// fixed-width NUL-terminated strings. Also emits the per-record CIGAR
+// digest the Python hot loops otherwise recompute per record: ref_span
+// (reference bases consumed: M/D/N/=/X), left_clip/right_clip (terminal
+// softclip lengths), cigar_flags (bit0 = has I/D, bit1 = has hardclip).
+// Returns records parsed, -1 on error. Stops early (returning fewer) when
+// a capacity would be exceeded; the blocking record is buffered internally
+// and returned by the next call. (The "2" suffix versions the signature:
+// loading a stale pre-digest .so fails symbol lookup and triggers a
+// rebuild instead of corrupting memory through a mismatched call.)
+int64_t bamio_parse_records2(
     Reader* r, int64_t max_records,
     int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
     int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
     uint16_t* n_cigar,
     uint8_t* seq_codes, uint8_t* quals, int64_t var_cap, int64_t* var_off,
     uint32_t* cigar, int64_t cigar_cap, int64_t* cigar_off,
-    char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w) {
+    char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w,
+    int32_t* ref_span, int32_t* left_clip, int32_t* right_clip,
+    uint8_t* cigar_flags) {
   int64_t nrec = 0;
   int64_t vused = 0, cused = 0;
   std::vector<uint8_t> body;
@@ -517,6 +525,33 @@ int64_t bamio_parse_records(
     off += l_qname;
     memcpy(cigar + cused, p + off, size_t(ncig) * 4);
     cigar_off[nrec] = cused;
+    {
+      int32_t rspan = 0;
+      uint8_t cf = 0;
+      const uint32_t* cg = cigar + cused;
+      for (uint16_t k = 0; k < ncig; k++) {
+        uint32_t op = cg[k] & 0xF, len = cg[k] >> 4;
+        switch (op) {
+          case 0: case 7: case 8: rspan += int32_t(len); break;  // M,=,X
+          case 2: rspan += int32_t(len); cf |= 1; break;         // D
+          case 3: rspan += int32_t(len); break;                  // N
+          case 1: cf |= 1; break;                                // I
+          case 5: cf |= 2; break;                                // H
+          default: break;                                        // S,P
+        }
+      }
+      // terminal softclips exactly as the Python trim reads them: first
+      // and last op independently (a single all-S op sets both)
+      int32_t lcl = 0, rcl = 0;
+      if (ncig) {
+        if ((cg[0] & 0xF) == 4) lcl = int32_t(cg[0] >> 4);
+        if ((cg[ncig - 1] & 0xF) == 4) rcl = int32_t(cg[ncig - 1] >> 4);
+      }
+      ref_span[nrec] = rspan;
+      left_clip[nrec] = lcl;
+      right_clip[nrec] = rcl;
+      cigar_flags[nrec] = cf;
+    }
     cused += ncig;
     off += size_t(ncig) * 4;
     var_off[nrec] = vused;
