@@ -207,11 +207,15 @@ def encode_molecular_families(
                 continue
             ref_id = rec.ref_id
             role = 1 if rec.flag & FREAD2 else 0
-            templates[rec.qname][role] = (
+            # qname_key (columnar views): raw bytes, no per-record decode —
+            # only template identity matters here
+            templates[getattr(rec, "qname_key", None) or rec.qname][role] = (
                 codes, quals, pos, bool(rec.flag & FREVERSE), has_indel
             )
-            if rec.has_tag("RX"):
+            try:  # one tag parse, not a has_tag/get_tag pair
                 rx_counts[rec.get_tag("RX")] += 1
+            except KeyError:
+                pass
             lo = pos if lo is None else min(lo, pos)
             e = pos + len(codes) + (INDEL_BAND if has_indel else 0)
             hi = e if hi is None else max(hi, e)

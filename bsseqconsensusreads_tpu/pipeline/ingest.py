@@ -76,6 +76,13 @@ class ColumnarRecordView:
         raw = self._b.qname[self._i]
         return raw.rstrip(b"\x00").decode("ascii", "replace")
 
+    @property
+    def qname_key(self):
+        """Raw fixed-width qname bytes — a hashable template key without
+        the per-record rstrip+decode (encode pairs R1/R2 by qname; only
+        uniqueness matters there, and NUL padding is stable per name)."""
+        return self._b.qname[self._i]
+
     # --- cigar -------------------------------------------------------------
 
     @property
