@@ -79,7 +79,9 @@ def _external_sort_core(
                 prefix="bsseq_extsort_", dir=workdir
             )
         path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
-        with BamWriter(path, header) as w:
+        # spill shards are deleted after the merge: fast compression (the
+        # BGZF container is identical, only the deflate effort drops)
+        with BamWriter(path, header, level=1) as w:
             for item in buf:
                 write_item(w, item)
         run_paths.append(path)
@@ -116,7 +118,7 @@ def _external_sort_core(
             )
             readers: list = []
             try:
-                with BamWriter(out, header) as w:
+                with BamWriter(out, header, level=1) as w:
                     for item in heapq.merge(*open_runs(group, readers), key=key):
                         write_item(w, item)
             finally:
