@@ -366,7 +366,12 @@ def encode_duplex_families(
         lo, hi = None, None
         group_size = 0
         for rec in records:
-            if any(op == CHARD_CLIP for op, _ in rec.cigar):
+            info = getattr(rec, "clip_info", None)  # columnar CIGAR digest
+            if (
+                info[3]
+                if info is not None
+                else any(op == CHARD_CLIP for op, _ in rec.cigar)
+            ):
                 continue  # reference drops hardclipped reads (2.extend_gap.py:160)
             group_size += 1
             row = DUPLEX_ROW_OF_FLAG.get(rec.flag)
@@ -377,8 +382,11 @@ def encode_duplex_families(
             codes, quals, pos = trimmed
             rows[row] = (codes, quals, pos)
             ref_id = rec.ref_id
-            if not rx and rec.has_tag("RX"):
-                rx = rec.get_tag("RX")
+            if not rx:
+                try:  # one tag parse, not a has_tag/get_tag pair
+                    rx = rec.get_tag("RX")
+                except KeyError:
+                    pass
             lo = pos if lo is None else min(lo, pos)
             e = pos + len(codes)
             hi = e if hi is None else max(hi, e)
