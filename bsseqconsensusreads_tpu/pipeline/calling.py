@@ -279,9 +279,11 @@ def stream_mi_groups(
     """
 
     def mi_of(rec: BamRecord) -> str:
-        if not rec.has_tag("MI"):
-            raise ValueError(f"{rec.qname} does not have MI tag.")
-        mi = str(rec.get_tag("MI"))
+        try:  # one tag parse per record, not a has_tag/get_tag pair
+            mi = rec.get_tag("MI")
+        except KeyError:
+            raise ValueError(f"{rec.qname} does not have MI tag.") from None
+        mi = str(mi)
         return mi.split("/")[0] if strip_suffix else mi
 
     if grouping == "gather":
