@@ -318,3 +318,123 @@ def test_duplex_equal_disagreement_no_calls():
 def test_duplex_unequal_disagreement_keeps_stronger_strand():
     b, q = _duplex_pair(A, 38.0, G, 15.0)
     assert b == A
+
+
+# ---------------------------------------------------------------------------
+# Emitted tag surface: the cD/cM/cE/cd/ce (and duplex aD/bD/aM/bM/ad/bd)
+# values on actual output records, against this file's independent
+# transcription (fgbio CallMolecularConsensusReads /
+# CallDuplexConsensusReads tag documentation; reference flag surface
+# main.snake.py:54,163).
+
+
+def test_emitted_molecular_tags_match_transcription():
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+    from bsseqconsensusreads_tpu.pipeline.calling import call_molecular
+
+    # R1 and R2 cover DISJOINT windows so the overlap co-call (on by
+    # default, --consensus-call-overlapping-bases=true) is a no-op and the
+    # per-role transcription applies directly
+    genome = "ACGTACGTACGTACGTACGT" * 2
+    L = 20
+    depth = 3
+    recs = []
+    rng = np.random.default_rng(5)
+    quals = rng.integers(10, 41, size=(depth, 2, L))
+    base_err = {(1, 0, 4): "T", (2, 1, 7): "A"}  # (template, role, col) -> base
+    for d in range(depth):
+        for role, flag, pos in ((0, 99, 0), (1, 147, L)):
+            frag = genome[pos : pos + L]
+            seq = list(frag)
+            for (td, rr, col), b in base_err.items():
+                if td == d and rr == role:
+                    seq[col] = b
+            r = BamRecord(
+                qname=f"t{d}", flag=flag, ref_id=0, pos=pos, mapq=60,
+                cigar=[(CMATCH, L)], next_ref_id=0, next_pos=0,
+                seq="".join(seq),
+                qual=bytes(quals[d, role].tolist()),
+            )
+            r.set_tag("MI", "7/A", "Z")
+            r.set_tag("RX", "AA-CC", "Z")
+            recs.append(r)
+    out = list(call_molecular(iter(recs), mode="self", grouping="adjacent"))
+    assert len(out) == 2  # R1 + R2
+    for role, rec in enumerate(out):
+        # expected per-column values from the independent transcription
+        frag = genome[role * L : role * L + L]
+        exp = []
+        for col in range(L):
+            obs = []
+            for d in range(depth):
+                b = frag[col]
+                if (d, role, col) in base_err:
+                    b = base_err[(d, role, col)]
+                obs.append(("ACGT".index(b), float(quals[d, role, col])))
+            exp.append(fgbio_column(obs))
+        depths = [e[2] for e in exp]
+        errs = [e[3] for e in exp]
+        tags = dict(rec.tags)
+        assert tags["cD"][1] == max(depths)
+        assert tags["cM"][1] == min(depths)
+        assert abs(tags["cE"][1] - sum(errs) / sum(depths)) < 1e-6
+        assert list(tags["cd"][1][1]) == depths
+        assert list(tags["ce"][1][1]) == errs
+        # consensus bases and quals per column, too
+        for col, (b, q, _, _) in enumerate(exp):
+            assert "ACGTN".index(rec.seq[col]) == b, (role, col)
+            assert abs(rec.qual[col] - q) <= 1, (role, col)
+
+
+def test_emitted_duplex_strand_tags():
+    """Duplex per-strand depth tags aD/bD/aM/bM and per-base ad/bd reflect
+    which strand covered each column (fgbio DuplexConsensusCaller tag
+    surface)."""
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamRecord, BamWriter, CMATCH
+    from bsseqconsensusreads_tpu.pipeline.calling import call_duplex
+    from bsseqconsensusreads_tpu.utils.testing import (
+        bisulfite_convert,
+        random_genome,
+        write_fasta,
+    )
+    import inspect
+    from bsseqconsensusreads_tpu.pipeline import calling as calling_mod
+
+    rng = np.random.default_rng(6)
+    name, genome = random_genome(rng, 300)
+    frag = genome[50:110]
+    a_seq = bisulfite_convert(frag, genome, 50, "A")
+    b_seq = bisulfite_convert(frag, genome, 50, "B")
+    recs = []
+    for flag, strand, seq in (
+        (99, "A", a_seq), (163, "B", b_seq), (83, "B", b_seq), (147, "A", a_seq)
+    ):
+        r = BamRecord(
+            qname=f"q:{flag}", flag=flag, ref_id=0, pos=50, mapq=60,
+            cigar=[(CMATCH, 60)], next_ref_id=0, next_pos=50,
+            seq=seq, qual=bytes([35] * 60),
+        )
+        r.set_tag("MI", f"9/{strand}", "Z")
+        r.set_tag("RX", "AA-CC", "Z")
+        recs.append(r)
+
+    def fetch(nm, s, e):
+        return genome[s:e]
+
+    out = list(call_duplex(iter(recs), fetch, [name], mode="self",
+                           grouping="adjacent"))
+    assert len(out) == 2
+    for rec in out:
+        tags = dict(rec.tags)
+        for k in ("aD", "bD", "aM", "bM"):
+            assert k in tags, tags.keys()
+        ad = list(tags["ad"][1][1])
+        bd = list(tags["bd"][1][1])
+        # every consensus column here is covered by both strands once
+        assert set(ad) == {1} and set(bd) == {1}
+        assert tags["aD"][1] == 1 and tags["bD"][1] == 1
+        assert tags["aM"][1] == 1 and tags["bM"][1] == 1
