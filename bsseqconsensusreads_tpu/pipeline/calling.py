@@ -610,10 +610,16 @@ def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             cov = spans[role]
             if len(cov) == 0:
                 continue
-            seq_fwd = codes_to_seq(base[fi, role, cov])
-            quals_fwd = qual[fi, role, cov].astype(np.uint8, copy=False).tobytes()
+            # CONTIGUOUS span [first, last] covered column: interior
+            # no-call columns (possible at depth 1-2 when a tie masks an
+            # overlap column) emit as N/qual-2 like fgbio's consensus
+            # reads — compacting them out would shift every downstream
+            # base against the M-run CIGAR
+            sl = slice(int(cov[0]), int(cov[-1]) + 1)
+            seq_fwd = codes_to_seq(base[fi, role, sl])
+            quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
-                depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx
             )
             other = 1 - role
             tlen = 0
@@ -1077,10 +1083,13 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             cov = spans[role]
             if len(cov) == 0:
                 continue
-            seq_fwd = codes_to_seq(base[fi, role, cov])
-            quals_fwd = qual[fi, role, cov].astype(np.uint8, copy=False).tobytes()
+            # contiguous span, interior no-calls as N (see
+            # _emit_molecular_batch)
+            sl = slice(int(cov[0]), int(cov[-1]) + 1)
+            seq_fwd = codes_to_seq(base[fi, role, sl])
+            quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
-                depth[fi, role, cov], errors[fi, role, cov], meta.mi, meta.rx
+                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx
             )
             # fgbio duplex per-strand tag surface (README.md:9 contract;
             # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
@@ -1088,8 +1097,8 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             # strand contributes its single-strand consensus read, so
             # per-column strand depth is presence (0/1); the raw-read
             # depths live in the molecular stage's cD/cd tags upstream.
-            a_cov = a_depth[fi, role, cov]
-            b_cov = b_depth[fi, role, cov]
+            a_cov = a_depth[fi, role, sl]
+            b_cov = b_depth[fi, role, sl]
             tags["aD"] = ("i", int(a_cov.max()))
             tags["bD"] = ("i", int(b_cov.max()))
             tags["aM"] = ("i", int(a_cov.min()))

@@ -169,6 +169,7 @@ def stream_duplex_families(
     qual_for=None,
     mutate=None,
     rx: str = "ACGTACGT-TGCATGCA",
+    bisulfite: bool = False,
 ):
     """Stream a coordinate-sorted synthetic grouped-duplex record stream.
 
@@ -187,6 +188,12 @@ def stream_duplex_families(
     qual_for(fam, ti, flag) -> bytes[read_len]; mutate(seq, fam, ti, flag)
     -> str lets callers inject sequencing errors without paying per-record
     rng costs here.
+
+    bisulfite=True emits each strand's reads in that strand's bisulfite
+    space (bisulfite_convert A/B, CpGs methylated) — the chemistry the
+    duplex convert stage is built for (reference tools/1 semantics); raw
+    genome reads fed through the convert stage would trip its
+    content-dependent rewrite rules pseudo-randomly.
     """
     from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
 
@@ -195,14 +202,23 @@ def stream_duplex_families(
     span = genome_len - frag_len - 30
     if span <= 0:
         raise ValueError(f"genome too short: {genome_len} for {frag_len}-bp fragments")
+    genome_str = codes_to_seq(codes) if bisulfite else None
     default_qual = bytes([35] * read_len)
     for fam in range(n_families):
         start = 10 + (fam * span) // n_families
         r2 = start + frag_len - read_len
-        left = codes_to_seq(codes[start : start + read_len])
-        right = codes_to_seq(codes[r2 : r2 + read_len])
+        if not bisulfite:
+            left = codes_to_seq(codes[start : start + read_len])
+            right = codes_to_seq(codes[r2 : r2 + read_len])
         t = templates_for(fam) if templates_for else 1
         for strand, (lf, rf) in (("A", (99, 147)), ("B", (163, 83))):
+            if bisulfite:
+                left = bisulfite_convert(
+                    genome_str[start : start + read_len], genome_str, start, strand
+                )
+                right = bisulfite_convert(
+                    genome_str[r2 : r2 + read_len], genome_str, r2, strand
+                )
             for ti in range(t):
                 for flag, pos, mate, seq, tl in (
                     (lf, start, r2, left, frag_len),

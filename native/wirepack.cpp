@@ -72,12 +72,12 @@ inline void put_int_tag(Cursor& c, const char* key, int32_t v) {
 // B:S (uint16) array tag from int16/int8 sources
 template <typename T>
 inline void put_arr_tag(Cursor& c, const char* key, const T* vals,
-                        const int32_t* idx, int64_t n) {
+                        int64_t n) {
   c.put_bytes(key, 2);
   c.put_u8('B');
   c.put_u8('S');
   c.put_u32(uint32_t(n));
-  for (int64_t i = 0; i < n; ++i) c.put_u16(uint16_t(vals[idx[i]]));
+  for (int64_t i = 0; i < n; ++i) c.put_u16(uint16_t(vals[i]));
 }
 
 // Error codes mirrored by the Python wrapper (io/wirepack.py).
@@ -261,7 +261,6 @@ int wirepack_emit_consensus_records(
   Cursor c{out, out + out_cap};
   int64_t records = 0, skipped = 0;
   // scratch (static cap: w is the bucketed window, <= a few thousand)
-  int32_t* cov = new int32_t[2 * w];
   uint8_t* codes = new uint8_t[w];
   uint8_t* rqual = new uint8_t[w];
 
@@ -270,29 +269,36 @@ int wirepack_emit_consensus_records(
       ++skipped;
       continue;
     }
-    int32_t* covs[2] = {cov, cov + w};
-    int64_t ncov[2];
+    // CONTIGUOUS covered span per role, mirroring the Python emitters:
+    // interior depth-0 columns emit as N/qual-2 (fgbio no-call semantics)
+    // instead of being compacted out, which would shift downstream bases
+    // against the single-M-run CIGAR.
+    int64_t lo_[2], n_[2];
     int64_t starts[2];
     for (int role = 0; role < 2; ++role) {
       const int16_t* d = depth + (fi * 2 + role) * w;
-      int64_t n = 0;
+      int64_t lo = -1, hi = -1;
       for (int64_t i = 0; i < w; ++i)
-        if (d[i] > 0) covs[role][n++] = int32_t(i);
-      ncov[role] = n;
-      starts[role] = n ? window_start[fi] + covs[role][0] : -1;
+        if (d[i] > 0) {
+          if (lo < 0) lo = i;
+          hi = i;
+        }
+      lo_[role] = lo;
+      n_[role] = lo < 0 ? 0 : hi - lo + 1;
+      starts[role] = lo < 0 ? -1 : window_start[fi] + lo;
     }
     for (int role = 0; role < 2; ++role) {
-      const int64_t n = ncov[role];
+      const int64_t n = n_[role];
       if (n == 0) continue;
       const int64_t row = (fi * 2 + role) * w;
-      const int32_t* cv = covs[role];
+      const int64_t lo0 = lo_[role];
       // tlen (same expression as the Python emitters)
       int32_t tlen = 0;
       if (starts[0] >= 0 && starts[1] >= 0) {
         const int64_t lo = starts[0] < starts[1] ? starts[0] : starts[1];
         int64_t hi = 0;
         for (int r2 = 0; r2 < 2; ++r2) {
-          const int64_t h = window_start[fi] + covs[r2][ncov[r2] - 1] + 1;
+          const int64_t h = window_start[fi] + lo_[r2] + n_[r2];
           if (h > hi) hi = h;
         }
         tlen = int32_t(starts[role] == lo ? hi - lo : lo - hi);
@@ -336,10 +342,10 @@ int wirepack_emit_consensus_records(
       const bool flip = !mode_self && reverse;
       for (int64_t i = 0; i < n; ++i) {
         const int64_t src = flip ? n - 1 - i : i;
-        uint8_t code = uint8_t(base[row + cv[src]]);
+        uint8_t code = uint8_t(base[row + lo0 + src]);
         if (code > 4) code = 4;
         codes[i] = flip ? kComp[code] : code;
-        rqual[i] = qual[row + cv[src]];
+        rqual[i] = qual[row + lo0 + src];
       }
 
       const int32_t l_qname = mi_len[fi] + 1;  // + NUL
@@ -376,24 +382,24 @@ int wirepack_emit_consensus_records(
       c.put_u8('Z');
       c.put_bytes(mi_blob + mi_off[fi], mi_len[fi]);
       c.put_u8(0);
-      const int16_t* drow = depth + row;
-      const int16_t* erow = errors + row;
+      const int16_t* drow = depth + row + lo0;
+      const int16_t* erow = errors + row + lo0;
       int32_t dmax = 0, dmin = INT32_MAX;
       int64_t dtot = 0, etot = 0;
       for (int64_t i = 0; i < n; ++i) {
-        const int32_t dv = drow[cv[i]];
+        const int32_t dv = drow[i];
         if (dv > dmax) dmax = dv;
         if (dv < dmin) dmin = dv;
         dtot += dv;
-        etot += erow[cv[i]];
+        etot += erow[i];
       }
       put_int_tag(c, "cD", dmax);
       put_int_tag(c, "cM", dmin);
       c.put_bytes("cE", 2);
       c.put_u8('f');
       c.put_f32(dtot ? float(double(etot) / double(dtot)) : 0.0f);
-      put_arr_tag(c, "cd", drow, cv, n);
-      put_arr_tag(c, "ce", erow, cv, n);
+      put_arr_tag(c, "cd", drow, n);
+      put_arr_tag(c, "ce", erow, n);
       if (rx_len[fi] > 0) {
         c.put_bytes("RX", 2);
         c.put_u8('Z');
@@ -401,12 +407,12 @@ int wirepack_emit_consensus_records(
         c.put_u8(0);
       }
       if (a_depth != nullptr) {
-        const int8_t* arow = a_depth + row;
-        const int8_t* brow = b_depth + row;
+        const int8_t* arow = a_depth + row + lo0;
+        const int8_t* brow = b_depth + row + lo0;
         int32_t amax = INT32_MIN, amin = INT32_MAX;
         int32_t bmax = INT32_MIN, bmin = INT32_MAX;
         for (int64_t i = 0; i < n; ++i) {
-          const int32_t av = arow[cv[i]], bv = brow[cv[i]];
+          const int32_t av = arow[i], bv = brow[i];
           if (av > amax) amax = av;
           if (av < amin) amin = av;
           if (bv > bmax) bmax = bv;
@@ -416,8 +422,8 @@ int wirepack_emit_consensus_records(
         put_int_tag(c, "bD", bmax);
         put_int_tag(c, "aM", amin);
         put_int_tag(c, "bM", bmin);
-        put_arr_tag(c, "ad", arow, cv, n);
-        put_arr_tag(c, "bd", brow, cv, n);
+        put_arr_tag(c, "ad", arow, n);
+        put_arr_tag(c, "bd", brow, n);
       }
       if (c.overflow) break;
       const int32_t block_size = int32_t(c.p - block_size_at - 4);
@@ -426,7 +432,6 @@ int wirepack_emit_consensus_records(
     }
     if (c.overflow) break;
   }
-  delete[] cov;
   delete[] codes;
   delete[] rqual;
   if (c.overflow) return -1;

@@ -222,3 +222,39 @@ def test_bucketed_batching_cuts_pad_waste_same_output():
         )
     assert results["bucketed"][0] < results["sequential"][0] - 0.05
     assert results["bucketed"][1] == results["sequential"][1]
+
+
+def test_interior_nocall_emits_contiguous_N_not_compacted():
+    """A depth-0 column INSIDE a consensus read's span (tie-masked overlap
+    co-call at depth 1) must emit as N/qual-2 with the span contiguous —
+    compacting it would shift every downstream base against the M-run
+    CIGAR (round-3 accuracy-eval finding; fgbio emits no-call N bases)."""
+    from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+    from bsseqconsensusreads_tpu.pipeline.calling import call_molecular
+
+    L = 30
+    genome = ("ACGT" * 10)[:L]
+    # one template whose R1/R2 fully overlap; disagree at column 7 with
+    # EQUAL quals -> overlap co-call masks both observations there
+    seq1 = list(genome)
+    seq2 = list(genome)
+    seq1[7] = "A" if genome[7] != "A" else "C"
+    recs = []
+    for role, flag, seq in ((0, 99, seq1), (1, 147, seq2)):
+        r = BamRecord(
+            qname="t0", flag=flag, ref_id=0, pos=0, mapq=60,
+            cigar=[(CMATCH, L)], next_ref_id=0, next_pos=0,
+            seq="".join(seq), qual=bytes([30] * L),
+        )
+        r.set_tag("MI", "5/A", "Z")
+        recs.append(r)
+    out = list(call_molecular(iter(recs), mode="self", grouping="adjacent"))
+    assert len(out) == 2
+    for rec in out:
+        assert len(rec.seq) == L  # contiguous: the hole is not compacted
+        assert rec.seq[7] == "N"
+        assert rec.qual[7] == 2
+        assert rec.seq[:7] == genome[:7] and rec.seq[8:] == genome[8:]
+        tags = dict(rec.tags)
+        assert tags["cd"][1][1][7] == 0  # per-base depth records the hole
+        assert tags["cM"][1] == 0

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Error-suppression accuracy evaluation (the point of duplex consensus).
+
+The reference pipeline exists to suppress sequencing errors by combining
+reads that share a UMI (duplex consensus calling; reference README.md:1-9).
+This harness measures that suppression end-to-end through THIS framework's
+full self-aligned pipeline (molecular -> duplex stages):
+
+  for each per-strand family depth d in --depths:
+    * generate N coordinate-sorted UMI families (shared generator,
+      utils.testing.stream_duplex_families) at depth d with RTA3-binned
+      quals, each read carrying independent substitution errors at the
+      per-base rate implied by its qualities;
+    * run the pipeline on the ERROR-FREE twin of the same dataset -> truth
+      consensus;
+    * run it on the error-injected dataset; align output records by
+      (qname, flag) and count per-base consensus mismatches vs truth and
+      no-calls (N).
+
+Reported per depth: measured raw per-base error rate, consensus per-base
+error rate, suppression factor (raw/consensus), and no-call fraction.
+Writes one JSON artifact (default ACCURACY_r03.json).
+
+Usage: python tools/accuracy_eval.py [--families 20000]
+       [--depths 1,2,3,5] [--out ACCURACY_r03.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("BSSEQ_TPU_BACKEND", "cpu")
+
+READ_LEN = 150
+GENOME_LEN = 400_000
+
+
+def _run_pipeline(workdir: str, codes, n_families: int, depth: int,
+                  inject_errors: bool, rng_seed: int):
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamWriter
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import (
+        stream_duplex_families,
+        write_fasta,
+    )
+
+    tag = "err" if inject_errors else "truth"
+    d = os.path.join(workdir, f"{tag}_d{depth}")
+    os.makedirs(os.path.join(d, "input"), exist_ok=True)
+    fasta = os.path.join(d, "genome.fa")
+    write_fasta(fasta, "chr1", codes_to_seq(codes))
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", GENOME_LEN)])
+
+    rng = np.random.default_rng(rng_seed)
+    # RTA3 qual pool; per-base error probability follows the Phred value of
+    # the qual byte at that position, so the injected noise is exactly what
+    # the quality string claims
+    qual_pool = [
+        bytes(rng.choice(np.array([12, 23, 37], np.uint8), size=READ_LEN))
+        for _ in range(64)
+    ]
+    err_draws = rng.random(1 << 20)
+    err_bases = rng.integers(1, 4, size=1 << 20)  # offset, never the same base
+    counter = [0, 0]  # [errors injected, bases emitted]
+
+    def qual_for(fam, ti, flag):
+        return qual_pool[(fam * 7 + ti * 13 + flag) & 63]
+
+    def mutate(seq, fam, ti, flag):
+        if not inject_errors:
+            return seq
+        q = qual_for(fam, ti, flag)
+        h = (fam * 2654435761 + ti * 40503 + flag * 97) & ((1 << 20) - 1)
+        out = list(seq)
+        for i in range(len(out)):
+            j = (h + i * 31) & ((1 << 20) - 1)
+            if err_draws[j] < 10.0 ** (-q[i] / 10.0):
+                out[i] = "ACGT"[("ACGT".index(out[i]) + err_bases[j]) % 4]
+                counter[0] += 1
+        counter[1] += len(out)
+        return "".join(out)
+
+    bam = os.path.join(d, "input", "acc.bam")
+    with BamWriter(bam, header) as w:
+        for rec in stream_duplex_families(
+            codes, n_families, read_len=READ_LEN,
+            templates_for=lambda f: depth,
+            qual_for=qual_for, mutate=mutate, bisulfite=True,
+        ):
+            w.write(rec)
+    cfg = FrameworkConfig(
+        genome_dir=d, genome_fasta_file_name="genome.fa", tmp=d,
+        aligner="self", grouping="coordinate",
+    )
+    target, _, _ = run_pipeline(cfg, bam, outdir=os.path.join(d, "output"))
+    out = {}
+    with BamReader(target) as r:
+        for rec in r:
+            out[(rec.qname, rec.flag)] = (rec.pos, rec.seq)
+    raw_rate = counter[0] / counter[1] if counter[1] else 0.0
+    return out, raw_rate
+
+
+def main() -> int:
+    import numpy as np
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--families", type=int, default=20_000)
+    ap.add_argument("--depths", default="1,2,3,5")
+    ap.add_argument("--out", default="ACCURACY_r03.json")
+    args = ap.parse_args()
+    depths = [int(x) for x in args.depths.split(",")]
+
+    rng = np.random.default_rng(77)
+    codes = rng.integers(0, 4, size=GENOME_LEN).astype(np.int8)
+    report = {
+        "families_per_depth": args.families,
+        "read_len": READ_LEN,
+        "qual_levels": [12, 23, 37],
+        "depths": {},
+        "ok": False,
+    }
+    with tempfile.TemporaryDirectory(prefix="bsseq_acc_") as wd:
+        for depth in depths:
+            t0 = time.time()
+            truth, _ = _run_pipeline(wd, codes, args.families, depth,
+                                     inject_errors=False, rng_seed=1)
+            got, raw_rate = _run_pipeline(wd, codes, args.families, depth,
+                                          inject_errors=True, rng_seed=1)
+            assert set(got) == set(truth), "consensus record sets diverged"
+            mismatch = nocall = total = 0
+            for key, (want_pos, want_seq) in truth.items():
+                have_pos, have_seq = got[key]
+                # compare on the coordinate-aligned overlap: an error at a
+                # read edge can legitimately shift the conversion stage's
+                # edge trims (LA/RD, reference tools/1+2 semantics) by a
+                # base, so record lengths may differ by 1
+                lo = max(want_pos, have_pos)
+                hi = min(want_pos + len(want_seq), have_pos + len(have_seq))
+                for w in range(lo, hi):
+                    a = have_seq[w - have_pos]
+                    b = want_seq[w - want_pos]
+                    total += 1
+                    if a == "N":
+                        nocall += 1
+                    elif a != b:
+                        mismatch += 1
+            cons_rate = mismatch / total if total else 0.0
+            report["depths"][str(depth)] = {
+                "raw_error_rate": round(raw_rate, 6),
+                "consensus_error_rate": round(cons_rate, 9),
+                "consensus_errors": mismatch,
+                "consensus_bases": total,
+                "no_call_fraction": round(nocall / total if total else 0.0, 6),
+                "suppression_factor": round(raw_rate / cons_rate, 1)
+                if cons_rate else None,  # None = no surviving errors
+                "wall_s": round(time.time() - t0, 1),
+            }
+            print(f"depth {depth}: {json.dumps(report['depths'][str(depth)])}",
+                  file=sys.stderr)
+    report["ok"] = True
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps({k: v for k, v in report.items() if k != "depths"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
