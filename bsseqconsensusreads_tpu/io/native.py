@@ -31,7 +31,9 @@ def _try_load():
             "bamio_create", "bamio_write", "bamio_writer_error",
             "bamio_finish", "bamio_create_mt", "bamio_write_mt",
             "bamio_writer_error_mt", "bamio_finish_mt",
-            "bamio_parse_records2",
+            "bamio_parse_records2", "bamio_parse_grouped",
+            "bamio_group_start", "bamio_group_error",
+            "bamio_group_refragmented", "bamio_group_free",
         ),
     )
     if lib is None:
@@ -72,6 +74,19 @@ def _try_load():
         C.c_char_p, C.c_int, C.c_char_p, C.c_int, C.c_char_p, C.c_int,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
     ]
+    lib.bamio_group_start.restype = C.c_void_p
+    lib.bamio_group_start.argtypes = [C.c_int64, C.c_int]
+    lib.bamio_group_error.restype = C.c_char_p
+    lib.bamio_group_error.argtypes = [C.c_void_p]
+    lib.bamio_group_refragmented.restype = C.c_int64
+    lib.bamio_group_refragmented.argtypes = [C.c_void_p]
+    lib.bamio_group_free.argtypes = [C.c_void_p]
+    lib.bamio_parse_grouped.restype = C.c_int64
+    lib.bamio_parse_grouped.argtypes = (
+        [C.c_void_p, C.c_void_p, C.c_int64]  # Reader*, Grouper*, max_records
+        + lib.bamio_parse_records2.argtypes[2:]
+        + [C.c_char_p, C.c_int, C.c_void_p, C.c_int64, C.c_void_p]
+    )
     _lib = lib
 
 
@@ -245,6 +260,85 @@ class ColumnarBatch:
             setattr(self, k, v)
 
 
+def _skip_header(r: "NativeBgzfReader", path: str) -> None:
+    import struct
+
+    magic = r.read_unbuffered(4)
+    if magic != b"BAM\x01":
+        raise IOError(f"{path}: not a BAM file")
+    (l_text,) = struct.unpack("<i", r.read_unbuffered(4))
+    r.read_unbuffered(l_text)
+    (n_ref,) = struct.unpack("<i", r.read_unbuffered(4))
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack("<i", r.read_unbuffered(4))
+        r.read_unbuffered(l_name + 4)
+
+
+def _alloc_batch(n: int, var_bytes: int, qname_width: int, tag_width: int):
+    """Batch buffers + the ctypes argument list bamio_parse_records2 /
+    bamio_parse_grouped share (from max_records onward)."""
+    bufs = {
+        "ref_id": np.empty(n, np.int32),
+        "pos": np.empty(n, np.int32),
+        "flag": np.empty(n, np.uint16),
+        "mapq": np.empty(n, np.uint8),
+        "l_seq": np.empty(n, np.int32),
+        "next_ref": np.empty(n, np.int32),
+        "next_pos": np.empty(n, np.int32),
+        "tlen": np.empty(n, np.int32),
+        "n_cigar": np.empty(n, np.uint16),
+        "seq": np.empty(var_bytes, np.uint8),
+        "qual": np.empty(var_bytes, np.uint8),
+        "var_off": np.empty(n, np.int64),
+        "cigar": np.empty(var_bytes // 16, np.uint32),
+        "cigar_off": np.empty(n, np.int64),
+        # calloc-backed numpy buffers: create_string_buffer would memset
+        # ~20 MB per batch eagerly, dominating small files
+        "qname": np.zeros(n * qname_width, np.uint8),
+        "mi": np.zeros(n * tag_width, np.uint8),
+        "rx": np.zeros(n * tag_width, np.uint8),
+        "ref_span": np.empty(n, np.int32),
+        "left_clip": np.empty(n, np.int32),
+        "right_clip": np.empty(n, np.int32),
+        "cigar_flags": np.empty(n, np.uint8),
+    }
+    p = lambda k: bufs[k].ctypes.data_as(C.c_void_p)  # noqa: E731
+    args = [
+        p("ref_id"), p("pos"), p("flag"), p("mapq"), p("l_seq"),
+        p("next_ref"), p("next_pos"), p("tlen"), p("n_cigar"),
+        p("seq"), p("qual"), var_bytes, p("var_off"),
+        p("cigar"), var_bytes // 16, p("cigar_off"),
+        bufs["qname"].ctypes.data_as(C.c_char_p), qname_width,
+        bufs["mi"].ctypes.data_as(C.c_char_p), tag_width,
+        bufs["rx"].ctypes.data_as(C.c_char_p), tag_width,
+        p("ref_span"), p("left_clip"), p("right_clip"), p("cigar_flags"),
+    ]
+    return bufs, args
+
+
+def _batch_from(bufs, got: int, qname_width: int, tag_width: int):
+    fixed_keys = (
+        "ref_id", "pos", "flag", "mapq", "l_seq", "next_ref", "next_pos",
+        "tlen", "n_cigar",
+    )
+    return ColumnarBatch(
+        int(got),
+        **{k: bufs[k][:got] for k in fixed_keys},
+        seq=bufs["seq"],
+        qual=bufs["qual"],
+        var_off=bufs["var_off"][:got],
+        cigar=bufs["cigar"],
+        cigar_off=bufs["cigar_off"][:got],
+        qname=bufs["qname"].view(f"S{qname_width}")[:got],
+        mi=bufs["mi"].view(f"S{tag_width}")[:got],
+        rx=bufs["rx"].view(f"S{tag_width}")[:got],
+        ref_span=bufs["ref_span"][:got],
+        left_clip=bufs["left_clip"][:got],
+        right_clip=bufs["right_clip"][:got],
+        cigar_flags=bufs["cigar_flags"][:got],
+    )
+
+
 def read_columnar(
     path: str,
     batch_records: int = 1 << 16,
@@ -258,95 +352,87 @@ def read_columnar(
     # share a prefix (encode pairs R1/R2 by qname).
     """Stream a BAM file as ColumnarBatches (header is parsed separately by
     BamReader — this starts from a fresh native stream and skips the header).
-
-    Yields (header_bytes_consumed_only_first) ColumnarBatch objects.
     """
-    import struct
-
     r = NativeBgzfReader(path)
     try:
-        magic = r.read_unbuffered(4)
-        if magic != b"BAM\x01":
-            raise IOError(f"{path}: not a BAM file")
-        (l_text,) = struct.unpack("<i", r.read_unbuffered(4))
-        r.read_unbuffered(l_text)
-        (n_ref,) = struct.unpack("<i", r.read_unbuffered(4))
-        for _ in range(n_ref):
-            (l_name,) = struct.unpack("<i", r.read_unbuffered(4))
-            r.read_unbuffered(l_name + 4)
+        _skip_header(r, path)
         while True:
-            n = batch_records
-            fixed = {
-                "ref_id": np.empty(n, np.int32),
-                "pos": np.empty(n, np.int32),
-                "flag": np.empty(n, np.uint16),
-                "mapq": np.empty(n, np.uint8),
-                "l_seq": np.empty(n, np.int32),
-                "next_ref": np.empty(n, np.int32),
-                "next_pos": np.empty(n, np.int32),
-                "tlen": np.empty(n, np.int32),
-                "n_cigar": np.empty(n, np.uint16),
-            }
-            seq = np.empty(var_bytes, np.uint8)
-            qual = np.empty(var_bytes, np.uint8)
-            var_off = np.empty(n, np.int64)
-            cigar = np.empty(var_bytes // 16, np.uint32)
-            cigar_off = np.empty(n, np.int64)
-            # calloc-backed numpy buffers: create_string_buffer would memset
-            # ~20 MB per batch eagerly, dominating small files
-            qname = np.zeros(n * qname_width, np.uint8)
-            mi = np.zeros(n * tag_width, np.uint8)
-            rx = np.zeros(n * tag_width, np.uint8)
-            ref_span = np.empty(n, np.int32)
-            left_clip = np.empty(n, np.int32)
-            right_clip = np.empty(n, np.int32)
-            cigar_flags = np.empty(n, np.uint8)
-            got = _lib.bamio_parse_records2(
-                r._h, n,
-                *(a.ctypes.data_as(C.c_void_p) for a in (
-                    fixed["ref_id"], fixed["pos"], fixed["flag"], fixed["mapq"],
-                    fixed["l_seq"], fixed["next_ref"], fixed["next_pos"],
-                    fixed["tlen"], fixed["n_cigar"],
-                )),
-                seq.ctypes.data_as(C.c_void_p),
-                qual.ctypes.data_as(C.c_void_p),
-                var_bytes,
-                var_off.ctypes.data_as(C.c_void_p),
-                cigar.ctypes.data_as(C.c_void_p),
-                var_bytes // 16,
-                cigar_off.ctypes.data_as(C.c_void_p),
-                qname.ctypes.data_as(C.c_char_p), qname_width,
-                mi.ctypes.data_as(C.c_char_p), tag_width,
-                rx.ctypes.data_as(C.c_char_p), tag_width,
-                ref_span.ctypes.data_as(C.c_void_p),
-                left_clip.ctypes.data_as(C.c_void_p),
-                right_clip.ctypes.data_as(C.c_void_p),
-                cigar_flags.ctypes.data_as(C.c_void_p),
+            bufs, args = _alloc_batch(
+                batch_records, var_bytes, qname_width, tag_width
             )
+            got = _lib.bamio_parse_records2(r._h, batch_records, *args)
             if got < 0:
                 raise IOError(_lib.bamio_error(r._h).decode())
             if got == 0:
                 return
-            qn = qname.view(f"S{qname_width}")[:got]
-            mis = mi.view(f"S{tag_width}")[:got]
-            rxs = rx.view(f"S{tag_width}")[:got]
-            yield ColumnarBatch(
-                int(got),
-                **{k: v[:got] for k, v in fixed.items()},
-                seq=seq,
-                qual=qual,
-                var_off=var_off[:got],
-                cigar=cigar,
-                cigar_off=cigar_off[:got],
-                qname=qn,
-                mi=mis,
-                rx=rxs,
-                ref_span=ref_span[:got],
-                left_clip=left_clip[:got],
-                right_clip=right_clip[:got],
-                cigar_flags=cigar_flags[:got],
-            )
+            yield _batch_from(bufs, got, qname_width, tag_width)
             # a short batch means either EOF or a capacity stop with a
             # pending record; the next parse call distinguishes (got==0 ends)
     finally:
+        r.close()
+
+
+def read_grouped_columnar(
+    path: str,
+    flush_margin: int = 10_000,
+    strip_suffix: bool = False,
+    batch_records: int = 1 << 16,
+    var_bytes: int = 1 << 25,
+    qname_width: int = 256,
+    tag_width: int = 48,
+):
+    """Stream ColumnarBatches whose records are reordered into CONTIGUOUS
+    whole-MI-family runs by the C-side coordinate grouper
+    (bamio_parse_grouped — the native equivalent of
+    pipeline.calling.stream_mi_groups grouping='coordinate').
+
+    Yields (batch, fam_mi bytes array [nf], fam_nrec int32 [nf],
+    refragmented_delta). Raises ValueError on a record without an MI tag
+    (reference parity: tools/2.extend_gap.py:180). A single family larger
+    than the buffers grows them and retries.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native codec unavailable")
+    r = NativeBgzfReader(path)
+    g = _lib.bamio_group_start(flush_margin, int(strip_suffix))
+    refrag_prev = 0
+    try:
+        _skip_header(r, path)
+        while True:
+            bufs, args = _alloc_batch(
+                batch_records, var_bytes, qname_width, tag_width
+            )
+            fam_cap = batch_records
+            fam_mi = np.zeros(fam_cap * tag_width, np.uint8)
+            fam_nrec = np.empty(fam_cap, np.int32)
+            n_fams = C.c_int64(0)
+            got = _lib.bamio_parse_grouped(
+                r._h, g, batch_records, *args,
+                fam_mi.ctypes.data_as(C.c_char_p), tag_width,
+                fam_nrec.ctypes.data_as(C.c_void_p), fam_cap,
+                C.byref(n_fams),
+            )
+            if got == -1:
+                raise IOError(_lib.bamio_error(r._h).decode())
+            if got == -2:
+                qn = _lib.bamio_group_error(g).decode()
+                raise ValueError(f"{qn} does not have MI tag.")
+            if got == -3:  # one family exceeds the buffers: grow and retry
+                batch_records *= 2
+                var_bytes *= 2
+                continue
+            if got == 0:
+                return
+            nf = n_fams.value
+            refrag = int(_lib.bamio_group_refragmented(g))
+            delta, refrag_prev = refrag - refrag_prev, refrag
+            yield (
+                _batch_from(bufs, got, qname_width, tag_width),
+                fam_mi.view(f"S{tag_width}")[:nf],
+                fam_nrec[:nf],
+                delta,
+            )
+    finally:
+        _lib.bamio_group_free(g)
         r.close()

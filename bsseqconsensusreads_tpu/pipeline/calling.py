@@ -276,7 +276,29 @@ def stream_mi_groups(
 
     Records without an MI tag raise, matching the reference
     (tools/2.extend_gap.py:180).
+
+    A pipeline.ingest.GroupedColumnarStream (records pre-grouped by the
+    C-side coordinate grouper, identical groups and order to this
+    function's 'coordinate' mode) delegates straight through; its
+    construction parameters must match this call's.
     """
+    iter_groups = getattr(records, "iter_groups", None)
+    if iter_groups is not None:
+        if grouping != "coordinate":
+            raise ValueError(
+                f"pre-grouped stream requires grouping='coordinate', got {grouping!r}"
+            )
+        if (records.strip_suffix, records.flush_margin) != (
+            strip_suffix, flush_margin,
+        ):
+            raise ValueError(
+                "pre-grouped stream was built with "
+                f"(strip_suffix={records.strip_suffix}, "
+                f"flush_margin={records.flush_margin}); caller wants "
+                f"({strip_suffix}, {flush_margin})"
+            )
+        yield from iter_groups(stats)
+        return
 
     def mi_of(rec: BamRecord) -> str:
         try:  # one tag parse per record, not a has_tag/get_tag pair

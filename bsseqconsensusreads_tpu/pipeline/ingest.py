@@ -193,3 +193,34 @@ def columnar_records(path: str, batch_records: int = 1 << 16) -> Iterator[Column
 def available() -> bool:
     """True when the native decoder is built and loadable."""
     return native.available()
+
+
+class GroupedColumnarStream:
+    """Pre-grouped record stream: the C-side coordinate MI-grouper
+    (io.native.read_grouped_columnar) hands whole families back as
+    contiguous columnar runs, so the Python layer does no per-record
+    grouping work. pipeline.calling.stream_mi_groups delegates to
+    iter_groups() when it receives one of these (the config echo lets it
+    verify the stream was built with the semantics the caller expects)."""
+
+    def __init__(self, path: str, flush_margin: int = 10_000,
+                 strip_suffix: bool = False):
+        self.path = path
+        self.flush_margin = flush_margin
+        self.strip_suffix = strip_suffix
+
+    def iter_groups(self, stats=None):
+        for batch, fam_mi, fam_nrec, refrag in native.read_grouped_columnar(
+            self.path, self.flush_margin, self.strip_suffix
+        ):
+            if stats is not None:
+                stats.records_in += batch.n
+                stats.refragmented_families += refrag
+            off = 0
+            for k in range(len(fam_mi)):
+                n = int(fam_nrec[k])
+                yield (
+                    fam_mi[k].rstrip(b"\x00").decode("ascii", "replace"),
+                    [ColumnarRecordView(batch, i) for i in range(off, off + n)],
+                )
+                off += n

@@ -141,11 +141,15 @@ class PipelineBuilder:
         )
 
     def _ingest_records(self, path: str, reader, stats: StageStats,
-                        allow_native: bool = True):
+                        allow_native: bool = True,
+                        strip_suffix: bool = False):
         """Record stream for a consensus stage: the native columnar decoder
-        (pipeline.ingest) when configured+built, else the BamReader. The
-        chosen engine lands in stats.metrics ('ingest_native' counter) so
-        the ingest-phase records/sec (records_in / ingest_seconds) is
+        (pipeline.ingest) when configured+built, else the BamReader. With
+        grouping='coordinate' the native path also pre-groups families in
+        C (ingest.GroupedColumnarStream; disable via
+        BSSEQ_TPU_NATIVE_GROUPING=0). The chosen engine lands in
+        stats.metrics ('ingest_native'/'group_native' counters) so the
+        ingest-phase records/sec (records_in / ingest_seconds) is
         attributable."""
         from bsseqconsensusreads_tpu.pipeline import ingest
 
@@ -165,6 +169,16 @@ class PipelineBuilder:
                 "built (make -C native)"
             )
         stats.metrics.count("ingest_native", int(use_native))
+        use_grouped = (
+            use_native
+            and self.cfg.grouping == "coordinate"
+            and os.environ.get("BSSEQ_TPU_NATIVE_GROUPING", "1") != "0"
+        )
+        stats.metrics.count("group_native", int(use_grouped))
+        if use_grouped:
+            return ingest.GroupedColumnarStream(
+                path, strip_suffix=strip_suffix
+            )
         return ingest.columnar_records(path) if use_native else reader
 
     def _pg(self, header: BamHeader, stage: str) -> BamHeader:
@@ -210,6 +224,7 @@ class PipelineBuilder:
                     # leftovers written through must keep their full tag
                     # set; native views carry only MI/RX
                     allow_native=not self.cfg.duplex_passthrough,
+                    strip_suffix=True,  # duplex groups by base MI
                 ),
                 fasta.fetch,
                 names,

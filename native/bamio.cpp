@@ -19,6 +19,8 @@
 #include <memory>
 #include <mutex>
 #include <string>
+#include <unordered_map>
+#include <unordered_set>
 #include <thread>
 #include <vector>
 #include <zlib.h>
@@ -408,6 +410,316 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
   }
 }
 
+// ---- shared columnar record emission --------------------------------------
+
+}  // namespace (reopened below: the stream reader is part of the C ABI)
+
+extern "C" int64_t bamio_read(Reader* r, uint8_t* buf, int64_t n);
+
+namespace {
+
+// Output arrays + cursors for one columnar batch (the bamio_parse_records2
+// surface). emit_record_body decodes one raw record body into the next slot.
+struct ColumnarOut {
+  int32_t* ref_id;
+  int32_t* pos;
+  uint16_t* flag;
+  uint8_t* mapq;
+  int32_t* l_seq;
+  int32_t* next_ref;
+  int32_t* next_pos;
+  int32_t* tlen;
+  uint16_t* n_cigar;
+  uint8_t* seq_codes;
+  uint8_t* quals;
+  int64_t var_cap;
+  int64_t* var_off;
+  uint32_t* cigar;
+  int64_t cigar_cap;
+  int64_t* cigar_off;
+  char* qname;
+  int qname_w;
+  char* mi;
+  int mi_w;
+  char* rx;
+  int rx_w;
+  int64_t max_records;
+  int64_t vused = 0, cused = 0, nrec = 0;
+  int32_t* ref_span;
+  int32_t* left_clip;
+  int32_t* right_clip;
+  uint8_t* cigar_flags;
+};
+
+bool record_fits(const uint8_t* p, ColumnarOut& o) {
+  int32_t lseq = rd_i32(p + 16);
+  uint16_t ncig = rd_u16(p + 12);
+  return o.nrec < o.max_records && o.vused + lseq <= o.var_cap &&
+         o.cused + ncig <= o.cigar_cap;
+}
+
+void emit_record_body(const uint8_t* p, size_t bs, ColumnarOut& o) {
+  const int64_t nrec = o.nrec;
+  int32_t lseq = rd_i32(p + 16);
+  uint16_t ncig = rd_u16(p + 12);
+  uint8_t l_qname = p[8];
+  o.ref_id[nrec] = rd_i32(p + 0);
+  o.pos[nrec] = rd_i32(p + 4);
+  o.mapq[nrec] = p[9];
+  o.n_cigar[nrec] = ncig;
+  o.flag[nrec] = rd_u16(p + 14);
+  o.l_seq[nrec] = lseq;
+  o.next_ref[nrec] = rd_i32(p + 20);
+  o.next_pos[nrec] = rd_i32(p + 24);
+  o.tlen[nrec] = rd_i32(p + 28);
+  size_t off = 32;
+  {
+    size_t cnt = l_qname - 1;
+    if (cnt > size_t(o.qname_w - 1)) cnt = o.qname_w - 1;
+    memcpy(o.qname + nrec * o.qname_w, p + off, cnt);
+    o.qname[nrec * o.qname_w + cnt] = '\0';
+  }
+  off += l_qname;
+  memcpy(o.cigar + o.cused, p + off, size_t(ncig) * 4);
+  o.cigar_off[nrec] = o.cused;
+  {
+    int32_t rspan = 0;
+    uint8_t cf = 0;
+    const uint32_t* cg = o.cigar + o.cused;
+    for (uint16_t k = 0; k < ncig; k++) {
+      uint32_t op = cg[k] & 0xF, len = cg[k] >> 4;
+      switch (op) {
+        case 0: case 7: case 8: rspan += int32_t(len); break;  // M,=,X
+        case 2: rspan += int32_t(len); cf |= 1; break;         // D
+        case 3: rspan += int32_t(len); break;                  // N
+        case 1: cf |= 1; break;                                // I
+        case 5: cf |= 2; break;                                // H
+        default: break;                                        // S,P
+      }
+    }
+    int32_t lcl = 0, rcl = 0;
+    if (ncig) {
+      if ((cg[0] & 0xF) == 4) lcl = int32_t(cg[0] >> 4);
+      if ((cg[ncig - 1] & 0xF) == 4) rcl = int32_t(cg[ncig - 1] >> 4);
+    }
+    o.ref_span[nrec] = rspan;
+    o.left_clip[nrec] = lcl;
+    o.right_clip[nrec] = rcl;
+    o.cigar_flags[nrec] = cf;
+  }
+  o.cused += ncig;
+  off += size_t(ncig) * 4;
+  o.var_off[nrec] = o.vused;
+  const uint8_t* sp = p + off;
+  for (int32_t i = 0; i < lseq; i++) {
+    uint8_t b = sp[i >> 1];
+    uint8_t code = (i & 1) ? (b & 0xf) : (b >> 4);
+    o.seq_codes[o.vused + i] = uint8_t(kNt16ToCode[code]);
+  }
+  off += (lseq + 1) / 2;
+  memcpy(o.quals + o.vused, p + off, lseq);
+  off += lseq;
+  o.vused += lseq;
+  find_z_tag(p + off, bs - off, "MI", o.mi + nrec * o.mi_w, o.mi_w);
+  find_z_tag(p + off, bs - off, "RX", o.rx + nrec * o.rx_w, o.rx_w);
+  o.nrec++;
+}
+
+// Read one raw record body (sans block_size) from the stream.
+// Returns 1 ok, 0 clean EOF, -1 error (r->err set).
+int read_record_body(Reader* r, std::vector<uint8_t>& body) {
+  uint8_t szbuf[4];
+  int64_t got = bamio_read(r, szbuf, 4);
+  if (got == 0) return 0;
+  if (got != 4) {
+    r->err = r->err.empty() ? "truncated record size" : r->err;
+    return -1;
+  }
+  int32_t bs = rd_i32(szbuf);
+  if (bs < 32 || bs > (1 << 28)) {
+    r->err = "corrupt record size";
+    return -1;
+  }
+  body.resize(bs);
+  if (bamio_read(r, body.data(), bs) != bs) {
+    r->err = r->err.empty() ? "truncated record body" : r->err;
+    return -1;
+  }
+  return 1;
+}
+
+// ---- streaming coordinate MI-grouper --------------------------------------
+//
+// C-side equivalent of pipeline.calling.stream_mi_groups grouping
+// 'coordinate' (flush a family once the sweep passes margin bases beyond
+// its last read; insertion-ordered open set exactly like a Python dict;
+// refragmented families counted, missing MI is an error). Families come
+// back as CONTIGUOUS record runs inside otherwise-normal columnar batches,
+// so the Python layer does no per-record grouping work at all.
+
+struct OpenGroup {
+  std::vector<std::vector<uint8_t>> bodies;
+  int32_t ref_id = -1;
+  int64_t max_end = -1;
+  std::string key;
+  bool live = true;
+};
+
+struct Grouper {
+  int64_t margin = 10000;
+  int64_t stride = 2500;
+  bool strip = false;
+  // insertion-ordered open set: slots + key->slot map; dead slots are
+  // compacted during sweeps (mirrors Python dict iteration order)
+  std::vector<OpenGroup> open;
+  std::unordered_map<std::string, size_t> index;
+  std::deque<OpenGroup> ready;
+  std::unordered_set<std::string> flushed;
+  int64_t refragmented = 0;
+  int32_t last_ref = -1;
+  int64_t last_pos = -(int64_t(1) << 62);
+  bool source_done = false;
+  std::string err;
+};
+
+int64_t ref_end_of_body(const uint8_t* p) {
+  int64_t pos = rd_i32(p + 4);
+  uint16_t ncig = rd_u16(p + 12);
+  uint8_t l_qname = p[8];
+  const uint8_t* cg = p + 32 + l_qname;
+  int64_t span = 0;
+  for (uint16_t k = 0; k < ncig; k++) {
+    uint32_t v = rd_u32(cg + 4 * k);
+    uint32_t op = v & 0xF;
+    if (op == 0 || op == 2 || op == 3 || op == 7 || op == 8) span += v >> 4;
+  }
+  return pos + span;
+}
+
+// Full-length Z-tag lookup with a found flag (find_z_tag cannot
+// distinguish an absent tag from an empty value, and its fixed-width
+// output would truncate long grouping keys into silent merges).
+bool z_tag_find(const uint8_t* tags, size_t n, const char* key,
+                std::string& out) {
+  size_t off = 0;
+  while (off + 3 <= n) {
+    char t0 = char(tags[off]), t1 = char(tags[off + 1]);
+    char tc = char(tags[off + 2]);
+    off += 3;
+    size_t len = 0;
+    switch (tc) {
+      case 'A': case 'c': case 'C': len = 1; break;
+      case 's': case 'S': len = 2; break;
+      case 'i': case 'I': case 'f': len = 4; break;
+      case 'Z': case 'H': {
+        size_t e = off;
+        while (e < n && tags[e] != 0) e++;
+        if (t0 == key[0] && t1 == key[1]) {
+          out.assign(reinterpret_cast<const char*>(tags + off), e - off);
+          return true;
+        }
+        off = e + 1;
+        continue;
+      }
+      case 'B': {
+        if (off + 5 > n) return false;
+        char sub = char(tags[off]);
+        uint32_t cnt = rd_u32(tags + off + 1);
+        size_t esz = (sub == 'c' || sub == 'C') ? 1
+                     : (sub == 's' || sub == 'S') ? 2 : 4;
+        off += 5 + size_t(cnt) * esz;
+        continue;
+      }
+      default:
+        return false;  // unknown tag type: stop scanning
+    }
+    off += len;
+  }
+  return false;
+}
+
+// MI key of one record body; returns false when the tag is ABSENT (an
+// empty value is a legal key, matching the Python streamer).
+bool mi_key_of_body(const uint8_t* p, size_t bs, bool strip,
+                    std::string& key) {
+  uint16_t ncig = rd_u16(p + 12);
+  int32_t lseq = rd_i32(p + 16);
+  uint8_t l_qname = p[8];
+  size_t off = 32 + l_qname + size_t(ncig) * 4 + (lseq + 1) / 2 + lseq;
+  if (off >= bs) return false;
+  if (!z_tag_find(p + off, bs - off, "MI", key)) return false;
+  if (strip) {
+    size_t slash = key.find('/');
+    if (slash != std::string::npos) key.resize(slash);
+  }
+  return true;
+}
+
+void grouper_sweep(Grouper& g, int32_t ref_id, int64_t pos) {
+  // flush done groups in insertion order, then compact dead slots
+  bool any_dead = false;
+  for (auto& og : g.open) {
+    if (!og.live) continue;
+    if (og.ref_id != ref_id || og.max_end + g.margin < pos) {
+      g.flushed.insert(og.key);
+      g.index.erase(og.key);
+      og.live = false;
+      g.ready.push_back(std::move(og));
+      any_dead = true;
+    }
+  }
+  if (any_dead) {
+    std::vector<OpenGroup> kept;
+    kept.reserve(g.open.size());
+    for (auto& og : g.open)
+      if (og.live) {
+        g.index[og.key] = kept.size();
+        kept.push_back(std::move(og));
+      }
+    g.open.swap(kept);
+  }
+  g.last_ref = ref_id;
+  g.last_pos = pos;
+}
+
+// Feed one record; returns false on missing MI (g.err set to the qname).
+bool grouper_feed(Grouper& g, std::vector<uint8_t>&& body) {
+  const uint8_t* p = body.data();
+  std::string key;
+  if (!mi_key_of_body(p, body.size(), g.strip, key)) {
+    uint8_t l_qname = p[8];
+    g.err.assign(reinterpret_cast<const char*>(p + 32),
+                 l_qname ? l_qname - 1 : 0);
+    return false;
+  }
+  int32_t ref_id = rd_i32(p + 0);
+  int64_t pos = rd_i32(p + 4);
+  if (pos >= 0 && !g.open.empty() &&
+      (ref_id != g.last_ref || pos - g.last_pos >= g.stride)) {
+    grouper_sweep(g, ref_id, pos);
+  }
+  auto it = g.index.find(key);
+  if (it == g.index.end()) {
+    if (g.flushed.count(key)) g.refragmented++;
+    g.index[key] = g.open.size();
+    g.open.emplace_back();
+    g.open.back().key = key;
+    it = g.index.find(key);
+  }
+  OpenGroup& og = g.open[it->second];
+  if (pos >= 0) {
+    int64_t end = ref_end_of_body(p);
+    if (og.max_end < 0 || og.ref_id != ref_id) {
+      og.ref_id = ref_id;
+      og.max_end = end;
+    } else if (end > og.max_end) {
+      og.max_end = end;
+    }
+  }
+  og.bodies.push_back(std::move(body));
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -471,105 +783,28 @@ int64_t bamio_parse_records2(
     char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w,
     int32_t* ref_span, int32_t* left_clip, int32_t* right_clip,
     uint8_t* cigar_flags) {
-  int64_t nrec = 0;
-  int64_t vused = 0, cused = 0;
+  ColumnarOut o{ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
+                n_cigar, seq_codes, quals, var_cap, var_off, cigar,
+                cigar_cap, cigar_off, qname, qname_w, mi, mi_w, rx, rx_w,
+                max_records, 0, 0, 0,
+                ref_span, left_clip, right_clip, cigar_flags};
   std::vector<uint8_t> body;
-  while (nrec < max_records) {
+  while (o.nrec < max_records) {
     if (!r->pending.empty()) {
       body.swap(r->pending);
       r->pending.clear();
     } else {
-      uint8_t szbuf[4];
-      int64_t got = bamio_read(r, szbuf, 4);
-      if (got == 0) break;
-      if (got != 4) {
-        r->err = r->err.empty() ? "truncated record size" : r->err;
-        return -1;
-      }
-      int32_t bs = rd_i32(szbuf);
-      if (bs < 32 || bs > (1 << 28)) {
-        r->err = "corrupt record size";
-        return -1;
-      }
-      body.resize(bs);
-      if (bamio_read(r, body.data(), bs) != bs) {
-        r->err = r->err.empty() ? "truncated record body" : r->err;
-        return -1;
-      }
+      int rc = read_record_body(r, body);
+      if (rc == 0) break;
+      if (rc < 0) return -1;
     }
-    const uint8_t* p = body.data();
-    size_t bs = body.size();
-    int32_t lseq = rd_i32(p + 16);
-    uint16_t ncig = rd_u16(p + 12);
-    if (vused + lseq > var_cap || cused + ncig > cigar_cap) {
+    if (!record_fits(body.data(), o)) {
       r->pending.swap(body);  // doesn't fit: hand back next call
       break;
     }
-    uint8_t l_qname = p[8];
-    ref_id[nrec] = rd_i32(p + 0);
-    pos[nrec] = rd_i32(p + 4);
-    mapq[nrec] = p[9];
-    n_cigar[nrec] = ncig;
-    flag[nrec] = rd_u16(p + 14);
-    l_seq[nrec] = lseq;
-    next_ref[nrec] = rd_i32(p + 20);
-    next_pos[nrec] = rd_i32(p + 24);
-    tlen[nrec] = rd_i32(p + 28);
-    size_t off = 32;
-    {
-      size_t cnt = l_qname - 1;
-      if (cnt > size_t(qname_w - 1)) cnt = qname_w - 1;
-      memcpy(qname + nrec * qname_w, p + off, cnt);
-      qname[nrec * qname_w + cnt] = '\0';
-    }
-    off += l_qname;
-    memcpy(cigar + cused, p + off, size_t(ncig) * 4);
-    cigar_off[nrec] = cused;
-    {
-      int32_t rspan = 0;
-      uint8_t cf = 0;
-      const uint32_t* cg = cigar + cused;
-      for (uint16_t k = 0; k < ncig; k++) {
-        uint32_t op = cg[k] & 0xF, len = cg[k] >> 4;
-        switch (op) {
-          case 0: case 7: case 8: rspan += int32_t(len); break;  // M,=,X
-          case 2: rspan += int32_t(len); cf |= 1; break;         // D
-          case 3: rspan += int32_t(len); break;                  // N
-          case 1: cf |= 1; break;                                // I
-          case 5: cf |= 2; break;                                // H
-          default: break;                                        // S,P
-        }
-      }
-      // terminal softclips exactly as the Python trim reads them: first
-      // and last op independently (a single all-S op sets both)
-      int32_t lcl = 0, rcl = 0;
-      if (ncig) {
-        if ((cg[0] & 0xF) == 4) lcl = int32_t(cg[0] >> 4);
-        if ((cg[ncig - 1] & 0xF) == 4) rcl = int32_t(cg[ncig - 1] >> 4);
-      }
-      ref_span[nrec] = rspan;
-      left_clip[nrec] = lcl;
-      right_clip[nrec] = rcl;
-      cigar_flags[nrec] = cf;
-    }
-    cused += ncig;
-    off += size_t(ncig) * 4;
-    var_off[nrec] = vused;
-    const uint8_t* sp = p + off;
-    for (int32_t i = 0; i < lseq; i++) {
-      uint8_t b = sp[i >> 1];
-      uint8_t code = (i & 1) ? (b & 0xf) : (b >> 4);
-      seq_codes[vused + i] = uint8_t(kNt16ToCode[code]);
-    }
-    off += (lseq + 1) / 2;
-    memcpy(quals + vused, p + off, lseq);
-    off += lseq;
-    vused += lseq;
-    find_z_tag(p + off, bs - off, "MI", mi + nrec * mi_w, mi_w);
-    find_z_tag(p + off, bs - off, "RX", rx + nrec * rx_w, rx_w);
-    nrec++;
+    emit_record_body(body.data(), body.size(), o);
   }
-  return nrec;
+  return o.nrec;
 }
 
 Writer* bamio_create(const char* path, int level, char* err, int errlen) {
@@ -656,6 +891,98 @@ int bamio_finish_mt(MtWriter* w) {
   w->fh = nullptr;
   delete w;  // joins workers
   return rc;
+}
+
+// ---- streaming coordinate MI-grouping (C ABI) -----------------------------
+
+Grouper* bamio_group_start(int64_t margin, int strip) {
+  Grouper* g = new Grouper();
+  g->margin = margin;
+  g->stride = margin / 4 > 0 ? margin / 4 : 1;
+  g->strip = strip != 0;
+  return g;
+}
+
+const char* bamio_group_error(Grouper* g) { return g->err.c_str(); }
+
+int64_t bamio_group_refragmented(Grouper* g) { return g->refragmented; }
+
+void bamio_group_free(Grouper* g) { delete g; }
+
+// Grouped columnar parse: the bamio_parse_records2 output surface with
+// records reordered into CONTIGUOUS whole-family runs (coordinate-sorted
+// input; flush-margin semantics of pipeline.calling.stream_mi_groups
+// 'coordinate', including insertion-order flushing and refragmentation
+// counting). fam_nrec[i] records of family i are adjacent; fam_mi holds
+// each family's (optionally /-stripped) MI key. Returns records emitted
+// (0 = stream complete), -1 stream error (bamio_error), -2 record without
+// an MI tag (bamio_group_error -> offending qname), -3 the next family
+// alone exceeds a capacity (retry with larger buffers).
+int64_t bamio_parse_grouped(
+    Reader* r, Grouper* g, int64_t max_records,
+    int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
+    int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
+    uint16_t* n_cigar,
+    uint8_t* seq_codes, uint8_t* quals, int64_t var_cap, int64_t* var_off,
+    uint32_t* cigar, int64_t cigar_cap, int64_t* cigar_off,
+    char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w,
+    int32_t* ref_span, int32_t* left_clip, int32_t* right_clip,
+    uint8_t* cigar_flags,
+    char* fam_mi, int fam_mi_w, int32_t* fam_nrec, int64_t fam_cap,
+    int64_t* n_fams) {
+  ColumnarOut o{ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
+                n_cigar, seq_codes, quals, var_cap, var_off, cigar,
+                cigar_cap, cigar_off, qname, qname_w, mi, mi_w, rx, rx_w,
+                max_records, 0, 0, 0,
+                ref_span, left_clip, right_clip, cigar_flags};
+  std::vector<uint8_t> body;
+  int64_t fams = 0;
+  bool batch_full = false;
+  while (!batch_full) {
+    while (!g->ready.empty() && fams < fam_cap) {
+      OpenGroup& og = g->ready.front();
+      int64_t need_v = 0, need_c = 0;
+      for (auto& b : og.bodies) {
+        need_v += rd_i32(b.data() + 16);
+        need_c += rd_u16(b.data() + 12);
+      }
+      if (o.nrec + int64_t(og.bodies.size()) > max_records ||
+          o.vused + need_v > var_cap || o.cused + need_c > cigar_cap) {
+        if (o.nrec == 0) return -3;  // one family bigger than the buffers
+        batch_full = true;
+        break;  // family stays queued for the next call
+      }
+      for (auto& b : og.bodies) emit_record_body(b.data(), b.size(), o);
+      size_t cnt = og.key.size();
+      if (cnt > size_t(fam_mi_w - 1)) cnt = size_t(fam_mi_w - 1);
+      memcpy(fam_mi + fams * fam_mi_w, og.key.data(), cnt);
+      fam_mi[fams * fam_mi_w + cnt] = '\0';
+      fam_nrec[fams] = int32_t(og.bodies.size());
+      fams++;
+      g->ready.pop_front();
+    }
+    if (batch_full || o.nrec >= max_records || fams >= fam_cap) break;
+    if (g->source_done && g->ready.empty()) break;
+    if (g->source_done) continue;
+    int rc = read_record_body(r, body);
+    if (rc < 0) return -1;
+    if (rc == 0) {
+      g->source_done = true;
+      // final flush: remaining open groups in insertion order
+      for (auto& og : g->open)
+        if (og.live) {
+          og.live = false;
+          g->ready.push_back(std::move(og));
+        }
+      g->open.clear();
+      g->index.clear();
+      continue;
+    }
+    if (!grouper_feed(*g, std::move(body))) return -2;
+    body = std::vector<uint8_t>();  // reset the moved-from buffer
+  }
+  *n_fams = fams;
+  return o.nrec;
 }
 
 }  // extern "C"

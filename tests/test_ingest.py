@@ -111,8 +111,23 @@ class TestColumnarIngest:
         b = PipelineBuilder(cfg, ingest_bam["path"], str(tmp_path))
         stats = StageStats()
         src = b._ingest_records(ingest_bam["path"], None, stats)
-        assert isinstance(next(iter(src)), ingest.ColumnarRecordView)
+        # coordinate + native -> the C-side pre-grouped stream
+        assert isinstance(src, ingest.GroupedColumnarStream)
+        mi, recs = next(src.iter_groups())
+        assert isinstance(recs[0], ingest.ColumnarRecordView)
         assert stats.metrics.counters["ingest_native"] == 1
+        assert stats.metrics.counters["group_native"] == 1
+        # grouping disabled by env -> plain columnar views
+        import os as _os
+
+        _os.environ["BSSEQ_TPU_NATIVE_GROUPING"] = "0"
+        try:
+            stats15 = StageStats()
+            src15 = b._ingest_records(ingest_bam["path"], None, stats15)
+            assert isinstance(next(iter(src15)), ingest.ColumnarRecordView)
+            assert stats15.metrics.counters["group_native"] == 0
+        finally:
+            del _os.environ["BSSEQ_TPU_NATIVE_GROUPING"]
         # gather grouping forces the python reader (buffer pinning)
         cfg2 = FrameworkConfig(ingest="native", grouping="gather")
         b2 = PipelineBuilder(cfg2, ingest_bam["path"], str(tmp_path))
@@ -333,3 +348,195 @@ def test_messy_cigar_pipeline_parity_columnar_vs_python(tmp_path):
                 write_items(w, b)
         outs[engine] = open(out, "rb").read()
     assert outs["columnar"] == outs["python"] and len(outs["columnar"]) > 100
+
+
+class TestNativeGrouper:
+    """C-side coordinate MI-grouping (io.native.read_grouped_columnar /
+    ingest.GroupedColumnarStream) vs the Python streamer: identical groups
+    in identical order, same refragmentation accounting, same missing-MI
+    error, bounded buffers growing for monster families."""
+
+    def _write(self, tmp_path, records, name="g.bam", refs=(("chr1", 100000),)):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+
+        path = str(tmp_path / name)
+        with BamWriter(path, BamHeader("@HD\tVN:1.6\n", list(refs))) as w:
+            w.write_all(records)
+        return path
+
+    def _records(self, rng, n_fams=200, dup_every=0):
+        from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+
+        recs = []
+        for fam in range(n_fams):
+            start = 10 + fam * 13
+            for flag, pos in ((99, start), (147, start + 30)):
+                r = BamRecord(
+                    qname=f"f{fam}", flag=flag, ref_id=0, pos=pos, mapq=60,
+                    cigar=[(CMATCH, 25)], next_ref_id=0, next_pos=start,
+                    seq="A" * 25, qual=bytes([30] * 25),
+                )
+                r.set_tag("MI", f"{fam % dup_every if dup_every else fam}/A", "Z")
+                recs.append(r)
+        return recs
+
+    def test_groups_match_python_streamer(self, tmp_path):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.pipeline import ingest
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            StageStats,
+            stream_mi_groups,
+        )
+
+        if not ingest.available():
+            pytest.skip("native decoder unavailable")
+        rng = np.random.default_rng(3)
+        path = self._write(tmp_path, self._records(rng))
+        py = [
+            (mi, [(r.qname, r.flag, r.pos) for r in recs])
+            for mi, recs in stream_mi_groups(
+                ingest.columnar_records(path), grouping="coordinate"
+            )
+        ]
+        stats = StageStats()
+        nat = [
+            (mi, [(r.qname, r.flag, r.pos) for r in recs])
+            for mi, recs in stream_mi_groups(
+                ingest.GroupedColumnarStream(path),
+                grouping="coordinate", stats=stats,
+            )
+        ]
+        assert nat == py  # content AND order
+        assert stats.records_in == 400
+
+    def test_refragmentation_counted_like_python(self, tmp_path):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+        from bsseqconsensusreads_tpu.pipeline import ingest
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            StageStats,
+            stream_mi_groups,
+        )
+
+        if not ingest.available():
+            pytest.skip("native decoder unavailable")
+        # same MI at two loci far beyond the flush margin -> refragmented
+        recs = []
+        for pos in (100, 60_000):
+            r = BamRecord(
+                qname=f"q{pos}", flag=0, ref_id=0, pos=pos, mapq=60,
+                cigar=[(CMATCH, 20)], next_ref_id=-1, next_pos=-1,
+                seq="C" * 20, qual=bytes([30] * 20),
+            )
+            r.set_tag("MI", "77/A", "Z")
+            recs.append(r)
+        # spacer families so the sweep advances
+        for i, pos in enumerate(range(200, 50_000, 400)):
+            r = BamRecord(
+                qname=f"s{i}", flag=0, ref_id=0, pos=pos, mapq=60,
+                cigar=[(CMATCH, 20)], next_ref_id=-1, next_pos=-1,
+                seq="G" * 20, qual=bytes([30] * 20),
+            )
+            r.set_tag("MI", f"s{i}/A", "Z")
+            recs.append(r)
+        recs.sort(key=lambda r: r.pos)
+        path = self._write(tmp_path, recs)
+        want_stats = StageStats()
+        list(stream_mi_groups(ingest.columnar_records(path),
+                              grouping="coordinate", stats=want_stats))
+        got_stats = StageStats()
+        list(stream_mi_groups(ingest.GroupedColumnarStream(path),
+                              grouping="coordinate", stats=got_stats))
+        assert want_stats.refragmented_families == 1
+        assert got_stats.refragmented_families == 1
+
+    def test_missing_mi_raises(self, tmp_path):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+        from bsseqconsensusreads_tpu.pipeline import ingest
+        from bsseqconsensusreads_tpu.pipeline.calling import stream_mi_groups
+
+        if not ingest.available():
+            pytest.skip("native decoder unavailable")
+        r = BamRecord(
+            qname="nomi", flag=0, ref_id=0, pos=5, mapq=60,
+            cigar=[(CMATCH, 10)], next_ref_id=-1, next_pos=-1,
+            seq="A" * 10, qual=bytes([30] * 10),
+        )
+        path = self._write(tmp_path, [r])
+        with pytest.raises(ValueError, match="nomi does not have MI tag"):
+            list(stream_mi_groups(ingest.GroupedColumnarStream(path),
+                                  grouping="coordinate"))
+
+    def test_monster_family_grows_buffers(self, tmp_path):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.io import native
+        from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+
+        if not native.available():
+            pytest.skip("native decoder unavailable")
+        # one family whose record count exceeds the initial batch cap
+        recs = []
+        for d in range(300):
+            r = BamRecord(
+                qname=f"t{d}", flag=0, ref_id=0, pos=50, mapq=60,
+                cigar=[(CMATCH, 30)], next_ref_id=-1, next_pos=-1,
+                seq="T" * 30, qual=bytes([30] * 30),
+            )
+            r.set_tag("MI", "0/A", "Z")
+            recs.append(r)
+        path = self._write(tmp_path, recs)
+        out = list(native.read_grouped_columnar(path, batch_records=64))
+        total = sum(int(fn.sum()) for _, _, fn, _ in out)
+        assert total == 300
+        assert all(len(fm) >= 1 for _, fm, _, _ in out)
+
+    def test_config_mismatch_rejected(self, tmp_path):
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.pipeline import ingest
+        from bsseqconsensusreads_tpu.pipeline.calling import stream_mi_groups
+
+        if not ingest.available():
+            pytest.skip("native decoder unavailable")
+        rng = np.random.default_rng(4)
+        path = self._write(tmp_path, self._records(rng, n_fams=3))
+        with pytest.raises(ValueError, match="pre-grouped"):
+            list(stream_mi_groups(ingest.GroupedColumnarStream(path),
+                                  grouping="adjacent"))
+        with pytest.raises(ValueError, match="strip_suffix"):
+            list(stream_mi_groups(
+                ingest.GroupedColumnarStream(path, strip_suffix=True),
+                grouping="coordinate",
+            ))
+
+
+def test_grouper_empty_mi_after_strip_groups_not_errors(tmp_path):
+    """MI '/A' strips to the empty key: the Python streamer groups under ''
+    — the native grouper must too, not abort as missing-MI (round-3 review
+    finding: absent tag vs empty value)."""
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamRecord, BamWriter, CMATCH
+    from bsseqconsensusreads_tpu.pipeline import ingest
+    from bsseqconsensusreads_tpu.pipeline.calling import stream_mi_groups
+
+    if not ingest.available():
+        pytest.skip("native decoder unavailable")
+    r = BamRecord(
+        qname="edge", flag=0, ref_id=0, pos=5, mapq=60,
+        cigar=[(CMATCH, 10)], next_ref_id=-1, next_pos=-1,
+        seq="A" * 10, qual=bytes([30] * 10),
+    )
+    r.set_tag("MI", "/A", "Z")
+    path = str(tmp_path / "e.bam")
+    with BamWriter(path, BamHeader("@HD\tVN:1.6\n", [("chr1", 1000)])) as w:
+        w.write(r)
+    groups = list(stream_mi_groups(
+        ingest.GroupedColumnarStream(path, strip_suffix=True),
+        grouping="coordinate", strip_suffix=True,
+    ))
+    assert len(groups) == 1 and groups[0][0] == ""
+    assert len(groups[0][1]) == 1
