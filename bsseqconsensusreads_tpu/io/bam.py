@@ -506,6 +506,24 @@ class BamWriter:
         these."""
         self._bgzf.write(blob)
 
+    def write_raw_many(self, blobs: Iterable[bytes], chunk: int = 1 << 20) -> int:
+        """Append a stream of pre-encoded record blobs, coalesced into
+        ~`chunk`-byte writes. The external sort and final-output paths move
+        millions of small blobs; per-blob write calls (each a ctypes hop
+        into the native codec) dominated their wall clock. Returns the
+        number of blobs written."""
+        buf = bytearray()
+        n = 0
+        for blob in blobs:
+            buf += blob
+            n += 1
+            if len(buf) >= chunk:
+                self._bgzf.write(bytes(buf))
+                buf.clear()
+        if buf:
+            self._bgzf.write(bytes(buf))
+        return n
+
     def write_all(self, recs: Iterable[BamRecord]) -> None:
         for rec in recs:
             self.write(rec)

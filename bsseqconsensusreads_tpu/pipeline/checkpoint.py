@@ -174,13 +174,11 @@ class BatchCheckpoint:
         with BamWriter(tmp, self.header) as w:
             if records is None:
                 # raw-order concatenation: copy each shard's record bytes
-                # verbatim (no decode/re-encode round trip)
+                # verbatim (no decode/re-encode round trip), coalesced
                 d = os.path.dirname(self.target)
                 for shard in self.manifest.shards:
                     with BamReader(os.path.join(d, shard)) as r:
-                        for blob in r.raw_records():
-                            w.write_raw(blob)
-                            n += 1
+                        n += w.write_raw_many(r.raw_records())
             else:
                 for rec in records:
                     if isinstance(rec, (bytes, memoryview)):

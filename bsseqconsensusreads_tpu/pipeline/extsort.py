@@ -52,6 +52,7 @@ def _external_sort_core(
     buffer_records: int,
     write_item: Callable,
     read_run: Callable,
+    write_run: Callable | None = None,
 ) -> Iterator:
     """Shared spill/merge machinery behind external_sort (BamRecord
     objects) and external_sort_raw (encoded blobs): runs of
@@ -82,8 +83,11 @@ def _external_sort_core(
         # spill shards are deleted after the merge: fast compression (the
         # BGZF container is identical, only the deflate effort drops)
         with BamWriter(path, header, level=1) as w:
-            for item in buf:
-                write_item(w, item)
+            if write_run is not None:  # coalesced (raw-blob) writes
+                write_run(w, buf)
+            else:
+                for item in buf:
+                    write_item(w, item)
         run_paths.append(path)
         buf.clear()
 
@@ -119,8 +123,12 @@ def _external_sort_core(
             readers: list = []
             try:
                 with BamWriter(out, header, level=1) as w:
-                    for item in heapq.merge(*open_runs(group, readers), key=key):
-                        write_item(w, item)
+                    merged = heapq.merge(*open_runs(group, readers), key=key)
+                    if write_run is not None:
+                        write_run(w, merged)
+                    else:
+                        for item in merged:
+                            write_item(w, item)
             finally:
                 for r in readers:
                     r.close()
@@ -201,6 +209,7 @@ def external_sort_raw(
         blobs, key, header, workdir, buffer_records,
         write_item=lambda w, blob: w.write_raw(blob),
         read_run=lambda r: r.raw_records(),
+        write_run=lambda w, items: w.write_raw_many(items),
     )
 
 
@@ -221,10 +230,12 @@ def write_batch_stream(
             blobs = iter_record_blobs(
                 item for batch in batches for item in batch
             )
-            for blob in external_sort_raw(
-                blobs, header, workdir=workdir, buffer_records=buffer_records
-            ):
-                writer.write_raw(blob)
+            writer.write_raw_many(
+                external_sort_raw(
+                    blobs, header, workdir=workdir,
+                    buffer_records=buffer_records,
+                )
+            )
         else:
             for batch in batches:
                 write_items(writer, batch)
