@@ -166,14 +166,16 @@ def external_sort(
 def raw_coordinate_key(blob: bytes) -> tuple:
     """record_ops.coordinate_key read at the fixed offsets of an encoded
     record blob (block_size +0, then ref_id +4, pos +8, l_qname +12,
-    flag +18, qname +36) — no decode needed."""
+    flag +18, qname +36) — no decode needed. The qname stays raw bytes:
+    BAM qnames are ASCII, and bytes compare in the same lexicographic
+    order as the object key's str — decoding 2x per record across
+    sort + merge was measurable at the 100M-read scale."""
     ref_id, pos = struct.unpack_from("<ii", blob, 4)
     (flag,) = struct.unpack_from("<H", blob, 18)
-    qname = blob[36 : 36 + blob[12] - 1].decode("ascii")
     return (
         ref_id if ref_id >= 0 else 1 << 30,
         pos if pos >= 0 else 1 << 30,
-        qname,
+        blob[36 : 36 + blob[12] - 1],
         flag,
     )
 
