@@ -1,0 +1,109 @@
+"""Full-pipeline crash/resume under the round-3 machinery.
+
+A REAL subprocess runs the self-aligned pipeline with intra-stage
+checkpoints over the current default engines (C-grouped columnar ingest,
+depth-bucketed batching, native batch emit) and hard-crashes (os._exit)
+mid-molecular-stage; a fresh process resumes from the durable shards. The
+final BAM must be byte-identical to an uninterrupted run — the combined
+determinism contract of skip_batches replay across the grouped stream,
+bucketed chunk composition, and raw-blob sort finalize (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+from bsseqconsensusreads_tpu.utils.testing import (
+    random_genome,
+    stream_duplex_families,
+    write_fasta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, sys
+os.environ["BSSEQ_TPU_BACKEND"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from bsseqconsensusreads_tpu.pipeline import stages, calling
+
+crash_after = int(os.environ.get("CRASH_AFTER", "0"))
+if crash_after:
+    orig = calling.call_molecular_batches
+    def dying(*a, **k):
+        for i, b in enumerate(orig(*a, **k)):
+            if i >= crash_after:
+                os._exit(9)  # hard crash: no cleanup, no atexit
+            yield b
+    stages.call_molecular_batches = dying
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+wd, bam, outdir = sys.argv[1:4]
+cfg = FrameworkConfig(
+    genome_dir=wd, genome_fasta_file_name="genome.fa", tmp=wd,
+    aligner="self", grouping="coordinate", batch_families=8,
+    checkpoint_every=2,
+)
+target, _, _ = run_pipeline(cfg, bam, outdir=outdir)
+print(target)
+"""
+
+
+@pytest.mark.slow
+def test_subprocess_crash_resume_byte_identical(tmp_path):
+    rng = np.random.default_rng(88)
+    codes = rng.integers(0, 4, size=40_000).astype(np.int8)
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+
+    write_fasta(str(tmp_path / "genome.fa"), "chr1", codes_to_seq(codes))
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 40_000)])
+    bam = str(tmp_path / "input" / "in.bam")
+    os.makedirs(os.path.dirname(bam))
+    with BamWriter(bam, header) as w:
+        for rec in stream_duplex_families(
+            codes, 120, read_len=60, bisulfite=True,
+            templates_for=lambda f: 1 if f % 3 else 2,
+        ):
+            w.write(rec)
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu")
+
+    def run(outdir, crash_after=0):
+        e = dict(env, CRASH_AFTER=str(crash_after))
+        return subprocess.run(
+            [sys.executable, str(worker), str(tmp_path), bam, outdir],
+            env=e, capture_output=True, text=True, timeout=600,
+        )
+
+    # uninterrupted reference
+    cp = run(str(tmp_path / "out_plain"))
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    plain_target = cp.stdout.strip().splitlines()[-1]
+
+    # crash after 3 chunks (checkpoint_every=2 -> 2 durable batches)
+    out_crash = str(tmp_path / "out_crash")
+    cp = run(out_crash, crash_after=3)
+    assert cp.returncode == 9
+    # durable evidence of the partial run
+    scraps = [f for f in os.listdir(out_crash) if ".ckpt" in f or ".part" in f]
+    assert scraps, os.listdir(out_crash)
+
+    # resume in a fresh process
+    cp = run(out_crash)
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    resumed_target = cp.stdout.strip().splitlines()[-1]
+
+    assert open(resumed_target, "rb").read() == open(plain_target, "rb").read()
+    # scratch cleaned up after finalize
+    scraps = [f for f in os.listdir(out_crash) if ".ckpt" in f or ".part" in f]
+    assert scraps == []
