@@ -34,6 +34,8 @@ def _try_load():
             "bamio_parse_records2", "bamio_parse_grouped",
             "bamio_group_start", "bamio_group_error",
             "bamio_group_refragmented", "bamio_group_free",
+            "bamio_encode_scan", "bamio_encode_fill",
+            "bamio_duplex_scan", "bamio_duplex_fill",
         ),
     )
     if lib is None:
@@ -86,6 +88,31 @@ def _try_load():
         [C.c_void_p, C.c_void_p, C.c_int64]  # Reader*, Grouper*, max_records
         + lib.bamio_parse_records2.argtypes[2:]
         + [C.c_char_p, C.c_int, C.c_void_p, C.c_int64, C.c_void_p]
+    )
+    lib.bamio_encode_scan.restype = C.c_int64
+    lib.bamio_encode_scan.argtypes = (
+        [C.c_int64, C.c_void_p, C.c_void_p]        # n_fam, fam_start, fam_nrec
+        + [C.c_void_p] * 8                          # flag..cigar_flags
+        + [C.c_void_p, C.c_int32, C.c_void_p, C.c_int32]  # qname/w, rx/w
+        + [C.c_int32, C.c_int64]                    # indel_policy, band
+        + [C.c_void_p] * 10                         # outputs
+    )
+    lib.bamio_encode_fill.restype = C.c_int64
+    lib.bamio_encode_fill.argtypes = (
+        [C.c_int64] + [C.c_void_p] * 14 + [C.c_int64, C.c_int64]
+        + [C.c_void_p, C.c_void_p]
+    )
+    lib.bamio_duplex_scan.restype = C.c_int64
+    lib.bamio_duplex_scan.argtypes = (
+        [C.c_int64, C.c_void_p, C.c_void_p]  # n_fam, fam_start, fam_nrec
+        + [C.c_void_p] * 7                    # flag..cigar_flags
+        + [C.c_void_p, C.c_int32]             # rx, rx_w
+        + [C.c_void_p] * 8                    # outputs
+    )
+    lib.bamio_duplex_fill.restype = C.c_int64
+    lib.bamio_duplex_fill.argtypes = (
+        [C.c_int64] + [C.c_void_p] * 12 + [C.c_int64]
+        + [C.c_void_p] * 3
     )
     _lib = lib
 
@@ -436,3 +463,133 @@ def read_grouped_columnar(
     finally:
         _lib.bamio_group_free(g)
         r.close()
+
+
+def _vp(a: np.ndarray) -> C.c_void_p:
+    return a.ctypes.data_as(C.c_void_p)
+
+
+def encode_scan(
+    batch, fam_start: np.ndarray, fam_nrec: np.ndarray,
+    indel_policy: str, indel_band: int,
+) -> dict[str, np.ndarray]:
+    """Run the C molecular-encode scan (bamio_encode_scan) over contiguous
+    family runs of one ColumnarBatch. Returns the per-family digest and
+    per-record placement arrays ops.encode consumes; semantics mirror
+    encode_molecular_families pass 1 exactly (see native/bamio.cpp)."""
+    nf = len(fam_start)
+    n = batch.n
+    out = {
+        "lo": np.empty(nf, np.int64),
+        "window": np.empty(nf, np.int64),
+        "ntpl": np.empty(nf, np.int32),
+        "ntpl_est": np.empty(nf, np.int32),
+        "rolerev": np.empty(nf, np.uint8),
+        "refid": np.empty(nf, np.int32),
+        "rx_rec": np.empty(nf, np.int64),
+        "ti": np.empty(n, np.int32),
+        "role": np.empty(n, np.uint8),
+        "keep": np.empty(n, np.uint8),
+    }
+    qname_w = batch.qname.dtype.itemsize
+    rx_w = batch.rx.dtype.itemsize
+    rc = _lib.bamio_encode_scan(
+        nf, _vp(fam_start), _vp(fam_nrec),
+        _vp(batch.flag), _vp(batch.pos), _vp(batch.ref_id),
+        _vp(batch.l_seq), _vp(batch.var_off),
+        _vp(batch.left_clip), _vp(batch.right_clip), _vp(batch.cigar_flags),
+        _vp(batch.qname.view(np.uint8)), qname_w,
+        _vp(batch.rx.view(np.uint8)), rx_w,
+        0 if indel_policy == "drop" else 1, indel_band,
+        _vp(out["lo"]), _vp(out["window"]),
+        _vp(out["ntpl"]), _vp(out["ntpl_est"]),
+        _vp(out["rolerev"]), _vp(out["refid"]), _vp(out["rx_rec"]),
+        _vp(out["ti"]), _vp(out["role"]), _vp(out["keep"]),
+    )
+    if rc != 0:
+        raise RuntimeError(f"bamio_encode_scan failed: rc={rc}")
+    return out
+
+
+def encode_fill(
+    batch, scan: dict[str, np.ndarray],
+    fam_start: np.ndarray, fam_nrec: np.ndarray,
+    rows: np.ndarray, lo: np.ndarray,
+    bases: np.ndarray, quals: np.ndarray,
+) -> int:
+    """Write one segment's direct-placed reads into the [*, T, 2, W] batch
+    tensors via bamio_encode_fill. Returns records written."""
+    t_pad, _, w_pad = bases.shape[1:]
+    got = _lib.bamio_encode_fill(
+        len(fam_start), _vp(fam_start), _vp(fam_nrec),
+        _vp(rows), _vp(lo),
+        _vp(batch.pos), _vp(batch.l_seq), _vp(batch.var_off),
+        _vp(batch.left_clip), _vp(batch.right_clip),
+        _vp(batch.seq), _vp(batch.qual),
+        _vp(scan["ti"]), _vp(scan["role"]), _vp(scan["keep"]),
+        t_pad, w_pad, _vp(bases), _vp(quals),
+    )
+    if got < 0:
+        raise RuntimeError(
+            "bamio_encode_fill: read outside its family window "
+            "(scan/fill mismatch)"
+        )
+    return int(got)
+
+
+def duplex_scan(
+    batch, fam_start: np.ndarray, fam_nrec: np.ndarray
+) -> dict[str, np.ndarray]:
+    """Run the C duplex-encode scan (bamio_duplex_scan) over contiguous
+    family runs of one ColumnarBatch; mirrors encode_duplex_families
+    pass 1 (see native/bamio.cpp)."""
+    nf = len(fam_start)
+    out = {
+        "start": np.empty(nf, np.int64),
+        "window": np.empty(nf, np.int64),
+        "rowmask": np.empty(nf, np.uint8),
+        "gsize": np.empty(nf, np.int32),
+        "refid": np.empty(nf, np.int32),
+        "rx_rec": np.empty(nf, np.int64),
+        "nleft": np.empty(nf, np.int32),
+        "row": np.empty(batch.n, np.int8),
+    }
+    rc = _lib.bamio_duplex_scan(
+        nf, _vp(fam_start), _vp(fam_nrec),
+        _vp(batch.flag), _vp(batch.pos), _vp(batch.ref_id),
+        _vp(batch.l_seq), _vp(batch.left_clip), _vp(batch.right_clip),
+        _vp(batch.cigar_flags),
+        _vp(batch.rx.view(np.uint8)), batch.rx.dtype.itemsize,
+        _vp(out["start"]), _vp(out["window"]), _vp(out["rowmask"]),
+        _vp(out["gsize"]), _vp(out["refid"]), _vp(out["rx_rec"]),
+        _vp(out["nleft"]), _vp(out["row"]),
+    )
+    if rc != 0:
+        raise RuntimeError(f"bamio_duplex_scan failed: rc={rc}")
+    return out
+
+
+def duplex_fill(
+    batch, scan: dict[str, np.ndarray],
+    fam_start: np.ndarray, fam_nrec: np.ndarray,
+    rows: np.ndarray, starts: np.ndarray,
+    bases: np.ndarray, quals: np.ndarray, cover: np.ndarray,
+) -> int:
+    """Write one segment's placed duplex reads into the [*, 4, W] batch
+    tensors via bamio_duplex_fill. Returns records written."""
+    w_pad = bases.shape[-1]
+    got = _lib.bamio_duplex_fill(
+        len(fam_start), _vp(fam_start), _vp(fam_nrec),
+        _vp(rows), _vp(starts),
+        _vp(batch.pos), _vp(batch.l_seq), _vp(batch.var_off),
+        _vp(batch.left_clip), _vp(batch.right_clip),
+        _vp(batch.seq), _vp(batch.qual),
+        _vp(scan["row"]), w_pad,
+        _vp(bases), _vp(quals), _vp(cover),
+    )
+    if got < 0:
+        raise RuntimeError(
+            "bamio_duplex_fill: read outside its family window "
+            "(scan/fill mismatch)"
+        )
+    return int(got)

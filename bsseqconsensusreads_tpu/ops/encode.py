@@ -166,6 +166,44 @@ MAX_TEMPLATES = 4096
 INDEL_BAND = 8
 
 
+def scan_matches(group, policy: str) -> bool:
+    """True when `group` is a pipeline.ingest.FamilyRun carrying a C encode
+    digest computed under `policy` — the single gate for every native fast
+    path (the bucketed batcher, the deep-family splitter, and the encoders
+    must classify a group identically or families silently fall onto the
+    per-record path)."""
+    return (
+        getattr(group, "scan", None) is not None
+        and getattr(group, "scan_policy", None) == policy
+    )
+
+
+def _iter_batch_segments(fams: list):
+    """(i, j) index ranges of maximal same-ColumnarBatch runs — one native
+    fill call each (fill pointers are per batch)."""
+    i, n = 0, len(fams)
+    while i < n:
+        j = i
+        b = fams[i].batch
+        while j < n and fams[j].batch is b:
+            j += 1
+        yield i, j
+        i = j
+
+
+def _segment_runs(fams: list, i: int, j: int) -> tuple[np.ndarray, np.ndarray]:
+    """(fam_start, fam_nrec) arrays for one same-batch segment."""
+    return (
+        np.fromiter((g.start for g in fams[i:j]), np.int64, j - i),
+        np.fromiter((g.n for g in fams[i:j]), np.int32, j - i),
+    )
+
+
+def _decode_fixed(raw: bytes) -> str:
+    """Decode a NUL-padded fixed-width field (ColumnarBatch qname/mi/rx)."""
+    return raw.rstrip(b"\x00").decode("ascii", "replace")
+
+
 def encode_molecular_families(
     families: Sequence[tuple[str, Sequence[BamRecord]]],
     max_window: int = 4096,
@@ -186,6 +224,12 @@ def encode_molecular_families(
     """
     if indel_policy not in ("drop", "align"):
         raise ValueError(f"indel_policy must be 'drop'|'align', got {indel_policy!r}")
+    fams = families if isinstance(families, list) else list(families)
+    if fams and all(scan_matches(f, indel_policy) for f in fams):
+        return _encode_molecular_native(
+            fams, max_window, max_templates, indel_policy
+        )
+    families = fams
     placed = []
     skipped: list[str] = []
     indel_dropped = 0
@@ -301,6 +345,91 @@ def _align_pending(bases, quals, pending) -> tuple[int, int]:
     return aligned, n - aligned
 
 
+def _encode_molecular_native(
+    fams: list,
+    max_window: int,
+    max_templates: int,
+    indel_policy: str,
+) -> tuple[MolecularBatch, list[str]]:
+    """encode_molecular_families over pipeline.ingest.FamilyRun inputs: the
+    per-record pass already ran in C at ingest time (io.native.encode_scan,
+    semantics documented at native/bamio.cpp bamio_encode_scan), so this
+    reads per-family digests and fills the tensors with one C call per
+    contiguous batch segment (io.native.encode_fill). Output is identical
+    to the Python path — tests/test_native_encode.py fuzzes the parity."""
+    from bsseqconsensusreads_tpu.io import native
+
+    skipped: list[str] = []
+    placed: list = []
+    rows = np.empty(len(fams), np.int64)
+    max_t, max_w = 1, LANE
+    for i, fam in enumerate(fams):
+        s, k = fam.scan, fam.fidx
+        ntpl = int(s["ntpl"][k])
+        window = int(s["window"][k])
+        if ntpl == 0 or window > max_window or ntpl > max_templates:
+            skipped.append(fam.mi)
+            rows[i] = -1
+            continue
+        rows[i] = len(placed)
+        placed.append(fam)
+        if ntpl > max_t:
+            max_t = ntpl
+        if window > max_w:
+            max_w = window
+
+    f = len(placed)
+    t_pad = bucket_templates(max_t)
+    w_pad = bucket_window(max_w)
+    bases = np.full((f, t_pad, 2, w_pad), NBASE, dtype=np.int8)
+    quals = np.zeros((f, t_pad, 2, w_pad), dtype=np.uint8)
+    for i, j in _iter_batch_segments(fams):
+        scan = fams[i].scan
+        fam_start, fam_nrec = _segment_runs(fams, i, j)
+        native.encode_fill(
+            fams[i].batch, scan, fam_start, fam_nrec, rows[i:j],
+            np.ascontiguousarray(scan["lo"][[g.fidx for g in fams[i:j]]]),
+            bases, quals,
+        )
+
+    meta: list[FamilyMeta] = []
+    pending: list[tuple[int, int, int, np.ndarray, np.ndarray, int]] = []
+    for row, fam in enumerate(placed):
+        s, k, b = fam.scan, fam.fidx, fam.batch
+        rx = ""
+        rxr = int(s["rx_rec"][k])
+        if rxr >= 0:
+            rx = _decode_fixed(b.rx[rxr])
+        rr = int(s["rolerev"][k])
+        meta.append(FamilyMeta(
+            fam.mi, int(s["refid"][k]), int(s["lo"][k]), int(s["ntpl"][k]),
+            rx, role_reverse=(bool(rr & 1), bool(rr & 2)),
+        ))
+        if indel_policy != "align":
+            continue
+        keep = s["keep"][fam.start : fam.start + fam.n]
+        for dj in np.nonzero(keep == 2)[0]:
+            j2 = fam.start + int(dj)
+            lc, rc = int(b.left_clip[j2]), int(b.right_clip[j2])
+            vo = int(b.var_off[j2])
+            length = int(b.l_seq[j2]) - lc - rc
+            codes = b.seq[vo + lc : vo + lc + length].view(np.int8)
+            q = b.qual[vo + lc : vo + lc + length]
+            if b.qual[vo] == 0xFF:
+                q = np.zeros(length, np.uint8)
+            pending.append((
+                row, int(s["ti"][j2]), int(s["role"][j2]), codes, q,
+                int(b.pos[j2]) - int(s["lo"][k]),
+            ))
+    indel_aligned = indel_dropped = 0
+    if pending:
+        indel_aligned, indel_dropped = _align_pending(bases, quals, pending)
+    return (
+        MolecularBatch(bases, quals, meta, indel_aligned, indel_dropped),
+        skipped,
+    )
+
+
 #: Flags the duplex stage accepts, and their row in the family tensor —
 #: derived from the single flag vocabulary in utils.flags (GROUP_ORDER is the
 #: reference's output order, tools/2.extend_gap.py:136). The conversion tool
@@ -355,6 +484,10 @@ def encode_duplex_families(
     hardclip drop, like the reference's grouping pass; the resulting
     per-family extend_eligible flag gates extend_gap downstream.
     """
+    fams = families if isinstance(families, list) else list(families)
+    if fams and all(scan_matches(f, "duplex") for f in fams):
+        return _encode_duplex_native(fams, ref_fetch, ref_names, max_window)
+    families = fams
     placed = []
     leftovers: list[BamRecord] = []
     skipped: list[str] = []
@@ -430,6 +563,94 @@ def encode_duplex_families(
             codes = seq_to_codes(ref_str)
             ref[fi, : len(codes)] = codes
         meta.append(FamilyMeta(mi, ref_id, start, len(rows), rx))
+    return (
+        DuplexBatch(bases, quals, cover, ref, convert_mask, eligible, meta),
+        leftovers,
+        skipped,
+    )
+
+
+def _encode_duplex_native(
+    fams: list, ref_fetch, ref_names: Sequence[str], max_window: int
+) -> tuple["DuplexBatch", list, list[str]]:
+    """encode_duplex_families over pipeline.ingest.FamilyRun inputs carrying
+    the C duplex-scan digest (io.native.duplex_scan): per-family start/
+    window/rowmask and per-record row placement were computed at ingest
+    time, so only leftover records (row == -1) ever materialize per-record
+    views, and the tensors fill with one C call per contiguous batch
+    segment. Output identical to the Python path (tests/test_native_encode
+    fuzzes the parity); reference fetching stays host-side per family."""
+    from bsseqconsensusreads_tpu.io import native
+    from bsseqconsensusreads_tpu.pipeline.ingest import ColumnarRecordView
+
+    skipped: list[str] = []
+    leftovers: list = []
+    placed: list = []
+    rows = np.empty(len(fams), np.int64)
+    max_w = LANE
+    for i, fam in enumerate(fams):
+        s, k = fam.scan, fam.fidx
+        window = int(s["window"][k])
+        # leftovers accumulate from every family, skipped or not (the
+        # Python pass appends them before the family-level gates); the
+        # scan's per-family count keeps the common zero case index-scan-free
+        if int(s["nleft"][k]):
+            row_of = s["row"][fam.start : fam.start + fam.n]
+            for dj in np.nonzero(row_of == -1)[0]:
+                leftovers.append(
+                    ColumnarRecordView(fam.batch, fam.start + int(dj))
+                )
+        if window < 0 or window > max_window:
+            skipped.append(fam.mi)
+            rows[i] = -1
+            continue
+        rows[i] = len(placed)
+        placed.append(fam)
+        if window > max_w:
+            max_w = window
+
+    f = len(placed)
+    w_pad = bucket_window(max_w)
+    bases = np.full((f, 4, w_pad), NBASE, dtype=np.int8)
+    quals = np.zeros((f, 4, w_pad), dtype=np.float32)
+    cover = np.zeros((f, 4, w_pad), dtype=bool)
+    ref = np.full((f, w_pad + 1), NBASE, dtype=np.int8)
+    convert_mask = np.zeros((f, 4), dtype=bool)
+    eligible = np.zeros(f, dtype=bool)
+    for i, j in _iter_batch_segments(fams):
+        scan = fams[i].scan
+        fam_start, fam_nrec = _segment_runs(fams, i, j)
+        native.duplex_fill(
+            fams[i].batch, scan, fam_start, fam_nrec, rows[i:j],
+            np.ascontiguousarray(scan["start"][[g.fidx for g in fams[i:j]]]),
+            bases, quals, cover.view(np.uint8),
+        )
+
+    meta: list[FamilyMeta] = []
+    for row, fam in enumerate(placed):
+        s, k, b = fam.scan, fam.fidx, fam.batch
+        mask = int(s["rowmask"][k])
+        eligible[row] = int(s["gsize"][k]) == 4
+        for r in CONVERT_ROWS:
+            convert_mask[row, r] = bool(mask & (1 << r))
+        rx = ""
+        rxr = int(s["rx_rec"][k])
+        if rxr >= 0:
+            rx = _decode_fixed(b.rx[rxr])
+        ref_id = int(s["refid"][k])
+        start = int(s["start"][k])
+        window = int(s["window"][k])
+        name = ref_names[ref_id] if 0 <= ref_id < len(ref_names) else None
+        if name is not None:
+            try:
+                ref_str = ref_fetch(name, start, start + window + 1)
+            except Exception:
+                ref_str = ""
+            codes = seq_to_codes(ref_str)
+            ref[row, : len(codes)] = codes
+        meta.append(
+            FamilyMeta(fam.mi, ref_id, start, bin(mask).count("1"), rx)
+        )
     return (
         DuplexBatch(bases, quals, cover, ref, convert_mask, eligible, meta),
         leftovers,

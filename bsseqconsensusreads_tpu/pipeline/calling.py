@@ -59,6 +59,7 @@ from bsseqconsensusreads_tpu.ops.encode import (
     codes_to_seq,
     encode_duplex_families,
     encode_molecular_families,
+    scan_matches,
 )
 from bsseqconsensusreads_tpu.utils import observe
 
@@ -99,19 +100,30 @@ def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     kept-qname count can't exceed the record count), so a normal-depth
     stream pays O(1) per family for this rarity check.
 
-    Deep entries carry the kept-qname count: (mi, records, depth)."""
+    Deep entries carry the kept-qname count: (group, depth)."""
     from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CDEL, CINS
 
     normal, deep = [], []
-    for mi, records in chunk:
+    for g in chunk:
+        # ingest.FamilyRun: record count and kept-template count were
+        # computed by the C encode-scan — no record walk at all. Groups are
+        # passed through unchanged either way so the encoder's native fill
+        # path sees the original objects.
+        if scan_matches(g, indel_policy):
+            if g.ntpl_est <= threshold:
+                normal.append(g)
+            else:
+                deep.append((g, g.ntpl_est))
+            continue
+        mi, records = g
         if len(records) <= threshold:
-            normal.append((mi, records))
+            normal.append(g)
             continue
         n = _kept_template_count(records, indel_policy)
         if n > threshold:
-            deep.append((mi, records, n))
+            deep.append((g, n))
         else:
-            normal.append((mi, records))
+            normal.append(g)
     return normal, deep
 
 
@@ -150,8 +162,8 @@ def _bucket_deep(deep):
     from bsseqconsensusreads_tpu.ops.encode import bucket_templates
 
     buckets: dict[int, list] = {}
-    for mi, records, depth in deep:
-        buckets.setdefault(bucket_templates(depth), []).append((mi, records))
+    for g, depth in deep:
+        buckets.setdefault(bucket_templates(depth), []).append(g)
     for bucket, group in buckets.items():
         max_k = max(1, DEEP_TEMPLATE_CAP // bucket)
         for i in range(0, len(group), max_k):
@@ -436,14 +448,21 @@ def _group_batches_bucketed(
     pending: dict[int, list[tuple[str, list[BamRecord]]]] = {}
     counts: dict[int, int] = {}
     max_records = size * 8
-    for mi, records in groups:
+    for g in groups:
         # the indel-filtered distinct-qname count is what encode actually
         # materializes (a raw record count would put every R1+R2 cfDNA
-        # family one bucket too high)
-        b = bucket_templates(_kept_template_count(records, indel_policy))
+        # family one bucket too high); an ingest.FamilyRun carries it
+        # precomputed by the C encode-scan
+        if scan_matches(g, indel_policy):
+            n_tpl, n_rec = g.ntpl_est, g.n
+        else:
+            _, records = g
+            n_tpl = _kept_template_count(records, indel_policy)
+            n_rec = len(records)
+        b = bucket_templates(n_tpl)
         lst = pending.setdefault(b, [])
-        lst.append((mi, records))
-        counts[b] = counts.get(b, 0) + len(records)
+        lst.append(g)
+        counts[b] = counts.get(b, 0) + n_rec
         if len(lst) >= size or counts[b] >= max_records:
             yield pending.pop(b)
             counts.pop(b)

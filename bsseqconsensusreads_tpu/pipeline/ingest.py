@@ -22,7 +22,7 @@ from typing import Iterator
 import numpy as np
 
 from bsseqconsensusreads_tpu.io import native
-from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+from bsseqconsensusreads_tpu.ops.encode import _decode_fixed, codes_to_seq
 
 _CIGAR_CACHE_MAX = 1 << 4  # ops per record before falling back to a list
 
@@ -73,8 +73,7 @@ class ColumnarRecordView:
 
     @property
     def qname(self) -> str:
-        raw = self._b.qname[self._i]
-        return raw.rstrip(b"\x00").decode("ascii", "replace")
+        return _decode_fixed(self._b.qname[self._i])
 
     @property
     def qname_key(self):
@@ -158,8 +157,8 @@ class ColumnarRecordView:
             raw = self._b.rx[self._i]
         else:
             return None
-        s = raw.rstrip(b"\x00")
-        return s.decode("ascii", "replace") if s else None
+        s = _decode_fixed(raw)
+        return s if s else None
 
     def has_tag(self, name: str) -> bool:
         return self._tag(name) is not None
@@ -195,32 +194,108 @@ def available() -> bool:
     return native.available()
 
 
+class FamilyRun:
+    """One MI family as a contiguous run of a ColumnarBatch, carrying the
+    C encode-scan digest (io.native.encode_scan). Tuple-compatible with the
+    (mi, records) pairs the group streamers yield — `mi, records = fam`
+    works — but consumers that understand the digest (the bucketed batcher,
+    the deep-family splitter, ops.encode's native fill path) read the
+    per-family arrays instead of materializing per-record views, which is
+    what removes the per-record Python cost from the encode phase."""
+
+    __slots__ = ("batch", "scan", "scan_policy", "fidx", "start", "n",
+                 "mi", "_records")
+
+    def __init__(self, batch, scan, scan_policy, fidx, start, n, mi):
+        self.batch = batch
+        self.scan = scan
+        self.scan_policy = scan_policy
+        self.fidx = fidx
+        self.start = start
+        self.n = n
+        self.mi = mi
+        self._records = None
+
+    @property
+    def records(self) -> list[ColumnarRecordView]:
+        if self._records is None:
+            self._records = [
+                ColumnarRecordView(self.batch, i)
+                for i in range(self.start, self.start + self.n)
+            ]
+        return self._records
+
+    def __iter__(self):
+        yield self.mi
+        yield self.records
+
+    @property
+    def ntpl(self) -> int:
+        """Templates the encoder will materialize (placed, len > 0)."""
+        return int(self.scan["ntpl"][self.fidx])
+
+    @property
+    def ntpl_est(self) -> int:
+        """Distinct kept qnames — pipeline.calling._kept_template_count."""
+        return int(self.scan["ntpl_est"][self.fidx])
+
+
 class GroupedColumnarStream:
     """Pre-grouped record stream: the C-side coordinate MI-grouper
     (io.native.read_grouped_columnar) hands whole families back as
     contiguous columnar runs, so the Python layer does no per-record
     grouping work. pipeline.calling.stream_mi_groups delegates to
     iter_groups() when it receives one of these (the config echo lets it
-    verify the stream was built with the semantics the caller expects)."""
+    verify the stream was built with the semantics the caller expects).
+
+    scan_policy 'drop' | 'align' additionally runs the C molecular-encode
+    scan (one call per batch) and yields FamilyRun objects instead of
+    (mi, records) tuples; 'duplex' runs the duplex-shaped scan
+    (io.native.duplex_scan, rows keyed by flag); None keeps the tuple
+    form."""
 
     def __init__(self, path: str, flush_margin: int = 10_000,
-                 strip_suffix: bool = False):
+                 strip_suffix: bool = False,
+                 scan_policy: str | None = None):
+        if scan_policy not in (None, "drop", "align", "duplex"):
+            raise ValueError(f"unknown scan_policy {scan_policy!r}")
         self.path = path
         self.flush_margin = flush_margin
         self.strip_suffix = strip_suffix
+        self.scan_policy = scan_policy
 
     def iter_groups(self, stats=None):
+        from bsseqconsensusreads_tpu.ops.encode import INDEL_BAND
+
         for batch, fam_mi, fam_nrec, refrag in native.read_grouped_columnar(
             self.path, self.flush_margin, self.strip_suffix
         ):
             if stats is not None:
                 stats.records_in += batch.n
                 stats.refragmented_families += refrag
+            if self.scan_policy is not None:
+                fam_start = np.zeros(len(fam_nrec), np.int64)
+                fam_start[1:] = np.cumsum(fam_nrec[:-1], dtype=np.int64)
+                nrec_c = np.ascontiguousarray(fam_nrec)
+                if self.scan_policy == "duplex":
+                    scan = native.duplex_scan(batch, fam_start, nrec_c)
+                else:
+                    scan = native.encode_scan(
+                        batch, fam_start, nrec_c,
+                        self.scan_policy, INDEL_BAND,
+                    )
+                for k in range(len(fam_mi)):
+                    yield FamilyRun(
+                        batch, scan, self.scan_policy, k,
+                        int(fam_start[k]), int(fam_nrec[k]),
+                        _decode_fixed(fam_mi[k]),
+                    )
+                continue
             off = 0
             for k in range(len(fam_mi)):
                 n = int(fam_nrec[k])
                 yield (
-                    fam_mi[k].rstrip(b"\x00").decode("ascii", "replace"),
+                    _decode_fixed(fam_mi[k]),
                     [ColumnarRecordView(batch, i) for i in range(off, off + n)],
                 )
                 off += n

@@ -142,7 +142,8 @@ class PipelineBuilder:
 
     def _ingest_records(self, path: str, reader, stats: StageStats,
                         allow_native: bool = True,
-                        strip_suffix: bool = False):
+                        strip_suffix: bool = False,
+                        scan_policy: str | None = None):
         """Record stream for a consensus stage: the native columnar decoder
         (pipeline.ingest) when configured+built, else the BamReader. With
         grouping='coordinate' the native path also pre-groups families in
@@ -177,7 +178,7 @@ class PipelineBuilder:
         stats.metrics.count("group_native", int(use_grouped))
         if use_grouped:
             return ingest.GroupedColumnarStream(
-                path, strip_suffix=strip_suffix
+                path, strip_suffix=strip_suffix, scan_policy=scan_policy,
             )
         return ingest.columnar_records(path) if use_native else reader
 
@@ -197,7 +198,12 @@ class PipelineBuilder:
             header = self._pg(reader.header, "molecular")
             ck = self._checkpointed("molecular", rule, header)
             batches = call_molecular_batches(
-                self._ingest_records(rule.inputs[0], reader, stats),
+                self._ingest_records(
+                    rule.inputs[0], reader, stats,
+                    # C-side per-family encode digest rides along with the
+                    # grouped stream (ops.encode native fill path)
+                    scan_policy=self.cfg.indel_policy,
+                ),
                 params=self.cfg.molecular,
                 mode=mode,
                 batch_families=self.cfg.batch_families,
@@ -225,6 +231,7 @@ class PipelineBuilder:
                     # set; native views carry only MI/RX
                     allow_native=not self.cfg.duplex_passthrough,
                     strip_suffix=True,  # duplex groups by base MI
+                    scan_policy="duplex",
                 ),
                 fasta.fetch,
                 names,

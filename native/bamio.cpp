@@ -986,3 +986,349 @@ int64_t bamio_parse_grouped(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Molecular-encode digest: the C twin of the per-record pass in
+// ops.encode.encode_molecular_families. The grouper above already hands
+// families back as contiguous columnar runs; the scan below walks each run
+// once, replicating the Python pass-1 semantics exactly (template pairing by
+// fixed-width qname bytes with last-record-wins (qname, role) slots, RX
+// majority with first-insertion tie-break, per-slot orientation votes,
+// lo/hi window over every kept record), so the Python layer never touches
+// individual records on the hot path. Fill then writes the [F, T, 2, W]
+// tensors with straight memcpys.
+
+namespace {
+
+inline uint64_t enc_hash(const uint8_t* p, size_t n) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Fixed-width fields are NUL-padded from NUL-terminated values, so hashing
+// and comparing strnlen+1 bytes is equivalent to the full width (the
+// included NUL stops a prefix from matching a longer name) at a fraction
+// of the byte work — qname_width is 256 for ~35-char names.
+inline size_t enc_keylen(const uint8_t* p, size_t width) {
+  size_t n = strnlen(reinterpret_cast<const char*>(p), width);
+  return n < width ? n + 1 : width;
+}
+
+// Generation-stamped open-addressing scratch reused across families: reset()
+// is O(1) except when capacity grows, so a 64k-record batch of small
+// families pays no per-family clearing.
+struct EncScratch {
+  std::vector<int64_t> tbl_key;   // record index whose qname defines the entry
+  std::vector<int32_t> tbl_ti;    // template row, -1 while est-only
+  std::vector<uint32_t> tbl_gen;
+  std::vector<int64_t> rtbl_key;  // record index whose RX defines the entry
+  std::vector<int32_t> rtbl_idx;  // index into rx_* insertion-ordered lists
+  std::vector<uint32_t> rtbl_gen;
+  std::vector<int64_t> rx_count;
+  std::vector<int64_t> rx_first;  // first record carrying this RX
+  std::vector<int64_t> slot_rec;   // (ti, role) -> last record, -1 empty
+  std::vector<uint8_t> slot_state;  // bit0 present, bit1 reverse-strand
+  uint32_t gen = 0;
+  size_t mask = 0;
+
+  void reset(size_t nrec) {
+    size_t cap = 16;
+    while (cap < nrec * 2) cap <<= 1;
+    if (cap > tbl_key.size()) {
+      tbl_key.assign(cap, 0);
+      tbl_ti.assign(cap, 0);
+      tbl_gen.assign(cap, 0);
+      rtbl_key.assign(cap, 0);
+      rtbl_idx.assign(cap, 0);
+      rtbl_gen.assign(cap, 0);
+      gen = 0;
+    }
+    mask = tbl_key.size() - 1;
+    gen++;
+    rx_count.clear();
+    rx_first.clear();
+    slot_rec.clear();
+    slot_state.clear();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1 over contiguous family runs [fam_start[f], fam_start[f]+fam_nrec[f]).
+// Per record j: out_keep[j] 0 = dropped, 1 = direct-placed, 2 = pending
+// indel (indel_policy 1 = 'align'); out_ti/out_role give the template slot.
+// Per family f: out_lo/out_window (-1 when no record places), out_ntpl
+// (distinct templates with a placed record — what encode materializes),
+// out_ntpl_est (distinct qnames among hardclip/indel-kept records — the
+// _kept_template_count the bucketed batcher and deep splitter use),
+// out_rolerev (bit0/bit1 = majority reverse-orientation of role 0/1 slots),
+// out_refid (last kept record's ref id), out_rx_rec (a record index whose RX
+// is the family majority, -1 when none tagged). Returns 0.
+int64_t bamio_encode_scan(
+    int64_t n_fam, const int64_t* fam_start, const int32_t* fam_nrec,
+    const uint16_t* flag, const int32_t* pos, const int32_t* ref_id,
+    const int32_t* l_seq, const int64_t* var_off,
+    const int32_t* left_clip, const int32_t* right_clip,
+    const uint8_t* cigar_flags,
+    const uint8_t* qname, int32_t qname_w,
+    const uint8_t* rx, int32_t rx_w,
+    int32_t indel_policy, int64_t indel_band,
+    int64_t* out_lo, int64_t* out_window,
+    int32_t* out_ntpl, int32_t* out_ntpl_est,
+    uint8_t* out_rolerev, int32_t* out_refid, int64_t* out_rx_rec,
+    int32_t* out_ti, uint8_t* out_role, uint8_t* out_keep) {
+  (void)var_off;
+  static thread_local EncScratch s;
+  const bool drop_indels = indel_policy == 0;
+  for (int64_t f = 0; f < n_fam; f++) {
+    const int64_t start = fam_start[f];
+    const int64_t nrec = fam_nrec[f];
+    s.reset(size_t(nrec));
+    int64_t lo = INT64_MAX, hi = INT64_MIN;
+    int32_t refid = -1, ntpl = 0, est = 0;
+    bool any = false;
+    for (int64_t j = start; j < start + nrec; j++) {
+      out_keep[j] = 0;
+      out_ti[j] = -1;
+      out_role[j] = 0;
+      const uint8_t cf = cigar_flags[j];
+      if (cf & 2) continue;  // hardclip: never encodes
+      const bool has_indel = (cf & 1) != 0;
+      if (has_indel && drop_indels) continue;
+      // template entry (est counts it even when the read trims to nothing)
+      const uint8_t* qn = qname + j * int64_t(qname_w);
+      const size_t qlen = enc_keylen(qn, size_t(qname_w));
+      size_t h = size_t(enc_hash(qn, qlen)) & s.mask;
+      while (true) {
+        if (s.tbl_gen[h] != s.gen) {
+          s.tbl_gen[h] = s.gen;
+          s.tbl_key[h] = j;
+          s.tbl_ti[h] = -1;
+          est++;
+          break;
+        }
+        if (memcmp(qname + s.tbl_key[h] * int64_t(qname_w), qn, qlen) == 0)
+          break;
+        h = (h + 1) & s.mask;
+      }
+      const int64_t L =
+          int64_t(l_seq[j]) - left_clip[j] - right_clip[j];
+      if (L <= 0) continue;
+      any = true;
+      refid = ref_id[j];
+      if (s.tbl_ti[h] < 0) {
+        s.tbl_ti[h] = ntpl++;
+        s.slot_rec.push_back(-1);
+        s.slot_rec.push_back(-1);
+        s.slot_state.push_back(0);
+        s.slot_state.push_back(0);
+      }
+      const int32_t ti = s.tbl_ti[h];
+      const int role = (flag[j] & 0x80) ? 1 : 0;  // FREAD2
+      const size_t slot = size_t(ti) * 2 + size_t(role);
+      if (s.slot_rec[slot] >= 0) out_keep[s.slot_rec[slot]] = 0;  // overwrite
+      s.slot_rec[slot] = j;
+      s.slot_state[slot] =
+          uint8_t(1 | (((flag[j] >> 4) & 1) << 1));  // present | FREVERSE
+      out_keep[j] = has_indel ? 2 : 1;
+      out_ti[j] = ti;
+      out_role[j] = uint8_t(role);
+      // RX vote: absent/empty tag (NUL-led fixed-width field) not counted
+      const uint8_t* rxp = rx + j * int64_t(rx_w);
+      if (rxp[0] != 0) {
+        const size_t rlen = enc_keylen(rxp, size_t(rx_w));
+        size_t rh = size_t(enc_hash(rxp, rlen)) & s.mask;
+        while (true) {
+          if (s.rtbl_gen[rh] != s.gen) {
+            s.rtbl_gen[rh] = s.gen;
+            s.rtbl_key[rh] = j;
+            s.rtbl_idx[rh] = int32_t(s.rx_count.size());
+            s.rx_count.push_back(0);
+            s.rx_first.push_back(j);
+            break;
+          }
+          if (memcmp(rx + s.rtbl_key[rh] * int64_t(rx_w), rxp, rlen) == 0)
+            break;
+          rh = (rh + 1) & s.mask;
+        }
+        s.rx_count[size_t(s.rtbl_idx[rh])]++;
+      }
+      const int64_t p = pos[j];
+      if (p < lo) lo = p;
+      const int64_t e = p + L + (has_indel ? indel_band : 0);
+      if (e > hi) hi = e;
+    }
+    out_lo[f] = any ? lo : -1;
+    out_window[f] = any ? hi - lo : -1;
+    out_ntpl[f] = ntpl;
+    out_ntpl_est[f] = est;
+    out_refid[f] = refid;
+    // majority RX, ties to first inserted (Python max() over dict order)
+    int64_t best = -1, best_n = 0;
+    for (size_t k = 0; k < s.rx_count.size(); k++)
+      if (s.rx_count[k] > best_n) {
+        best_n = s.rx_count[k];
+        best = s.rx_first[k];
+      }
+    out_rx_rec[f] = best;
+    // per-role orientation vote over surviving (template, role) slots
+    int votes[2][2] = {{0, 0}, {0, 0}};
+    for (size_t k = 0; k < s.slot_state.size(); k++)
+      if (s.slot_state[k] & 1) votes[k & 1][(s.slot_state[k] >> 1) & 1]++;
+    out_rolerev[f] = uint8_t((votes[0][1] > votes[0][0] ? 1 : 0) |
+                             (votes[1][1] > votes[1][0] ? 2 : 0));
+  }
+  return 0;
+}
+
+// Duplex-encode digest: the C twin of ops.encode.encode_duplex_families
+// pass 1. Rows are keyed by exact flag value (the reference's 4-read group
+// vocabulary, tools/2.extend_gap.py:117-131): 99->0, 163->1, 83->2, 147->3.
+// Per record j: out_row[j] = 0..3 placed, -1 leftover (unknown flag,
+// duplicate row, indel, or empty after trim), -2 hardclip-dropped (the
+// reference silently drops these, never passes them through). Per family:
+// out_start = max(lo-1, 0) (one margin column for the conversion prepend),
+// out_window = hi-start (-1 when nothing places), out_rowmask (bit r =
+// row r placed), out_gsize (non-hardclip record count; ==4 gates
+// extend_eligible), out_refid, out_rx_rec (first placed record with a
+// non-empty RX, -1 if none), out_nleft (leftover count — lets the Python
+// side skip the per-family index scan for the common zero case).
+int64_t bamio_duplex_scan(
+    int64_t n_fam, const int64_t* fam_start, const int32_t* fam_nrec,
+    const uint16_t* flag, const int32_t* pos, const int32_t* ref_id,
+    const int32_t* l_seq,
+    const int32_t* left_clip, const int32_t* right_clip,
+    const uint8_t* cigar_flags,
+    const uint8_t* rx, int32_t rx_w,
+    int64_t* out_start, int64_t* out_window,
+    uint8_t* out_rowmask, int32_t* out_gsize,
+    int32_t* out_refid, int64_t* out_rx_rec, int32_t* out_nleft,
+    int8_t* out_row) {
+  for (int64_t f = 0; f < n_fam; f++) {
+    const int64_t start = fam_start[f];
+    const int64_t nrec = fam_nrec[f];
+    int64_t lo = INT64_MAX, hi = INT64_MIN, rx_rec = -1;
+    int32_t refid = -1, gsize = 0, nleft = 0;
+    uint8_t mask = 0;
+    bool any = false;
+    for (int64_t j = start; j < start + nrec; j++) {
+      const uint8_t cf = cigar_flags[j];
+      if (cf & 2) {  // hardclip: dropped, not a leftover
+        out_row[j] = -2;
+        continue;
+      }
+      gsize++;
+      int row;
+      switch (flag[j]) {
+        case 99: row = 0; break;
+        case 163: row = 1; break;
+        case 83: row = 2; break;
+        case 147: row = 3; break;
+        default: row = -1;
+      }
+      const int64_t L = int64_t(l_seq[j]) - left_clip[j] - right_clip[j];
+      if (row < 0 || (mask & (1 << row)) || (cf & 1) || L <= 0) {
+        out_row[j] = -1;  // leftover (first record wins a duplicate row)
+        nleft++;
+        continue;
+      }
+      mask |= uint8_t(1 << row);
+      out_row[j] = int8_t(row);
+      any = true;
+      refid = ref_id[j];
+      if (rx_rec < 0 && rx[j * int64_t(rx_w)] != 0) rx_rec = j;
+      const int64_t p = pos[j];
+      if (p < lo) lo = p;
+      if (p + L > hi) hi = p + L;
+    }
+    const int64_t st = any ? (lo > 0 ? lo - 1 : 0) : -1;
+    out_start[f] = st;
+    out_window[f] = any ? hi - st : -1;
+    out_rowmask[f] = mask;
+    out_gsize[f] = gsize;
+    out_refid[f] = refid;
+    out_rx_rec[f] = rx_rec;
+    out_nleft[f] = nleft;
+  }
+  return 0;
+}
+
+// Duplex pass 2: write placed reads (out_row >= 0) of families with
+// rows[f] >= 0 into bases int8 / quals float32 / cover uint8(bool)
+// [*, 4, w_pad]. Missing qualities (0xFF lead) stay zero. Returns records
+// written, -1 on a window violation (scan/fill mismatch).
+int64_t bamio_duplex_fill(
+    int64_t n_fam, const int64_t* fam_start, const int32_t* fam_nrec,
+    const int64_t* rows, const int64_t* starts,
+    const int32_t* pos, const int32_t* l_seq, const int64_t* var_off,
+    const int32_t* left_clip, const int32_t* right_clip,
+    const uint8_t* seq, const uint8_t* qual,
+    const int8_t* row_of, int64_t w_pad,
+    int8_t* bases, float* quals, uint8_t* cover) {
+  int64_t written = 0;
+  for (int64_t f = 0; f < n_fam; f++) {
+    const int64_t row = rows[f];
+    if (row < 0) continue;
+    const int64_t start = fam_start[f];
+    for (int64_t j = start; j < start + fam_nrec[f]; j++) {
+      if (row_of[j] < 0) continue;
+      const int64_t L = int64_t(l_seq[j]) - left_clip[j] - right_clip[j];
+      const int64_t off = int64_t(pos[j]) - starts[f];
+      if (off < 0 || off + L > w_pad) return -1;
+      const int64_t dst = (row * 4 + row_of[j]) * w_pad + off;
+      const int64_t src = var_off[j] + left_clip[j];
+      memcpy(bases + dst, seq + src, size_t(L));
+      memset(cover + dst, 1, size_t(L));
+      if (qual[var_off[j]] != 0xFF)
+        for (int64_t i = 0; i < L; i++)
+          quals[dst + i] = float(qual[src + i]);
+      written++;
+    }
+  }
+  return written;
+}
+
+// Pass 2: write direct-placed reads (keep==1) of families with rows[f] >= 0
+// into bases/quals [*, t_pad, 2, w_pad] (bases pre-filled NBASE, quals
+// zero). Missing qualities (0xFF lead byte, the BAM '*' fill) stay zero,
+// matching ColumnarRecordView.codes_quals. Returns records written, or -1
+// if any read falls outside its family window (scan/fill mismatch — a bug,
+// not an input condition).
+int64_t bamio_encode_fill(
+    int64_t n_fam, const int64_t* fam_start, const int32_t* fam_nrec,
+    const int64_t* rows, const int64_t* lo,
+    const int32_t* pos, const int32_t* l_seq, const int64_t* var_off,
+    const int32_t* left_clip, const int32_t* right_clip,
+    const uint8_t* seq, const uint8_t* qual,
+    const int32_t* ti, const uint8_t* role, const uint8_t* keep,
+    int64_t t_pad, int64_t w_pad,
+    int8_t* bases, uint8_t* quals) {
+  int64_t written = 0;
+  for (int64_t f = 0; f < n_fam; f++) {
+    const int64_t row = rows[f];
+    if (row < 0) continue;
+    const int64_t start = fam_start[f];
+    for (int64_t j = start; j < start + fam_nrec[f]; j++) {
+      if (keep[j] != 1) continue;
+      const int64_t L = int64_t(l_seq[j]) - left_clip[j] - right_clip[j];
+      const int64_t off = int64_t(pos[j]) - lo[f];
+      if (ti[j] < 0 || ti[j] >= t_pad || off < 0 || off + L > w_pad)
+        return -1;
+      const int64_t dst =
+          ((row * t_pad + ti[j]) * 2 + role[j]) * w_pad + off;
+      const int64_t src = var_off[j] + left_clip[j];
+      memcpy(bases + dst, seq + src, size_t(L));
+      if (qual[var_off[j]] != 0xFF) memcpy(quals + dst, qual + src, size_t(L));
+      written++;
+    }
+  }
+  return written;
+}
+
+}  // extern "C"

@@ -1,0 +1,419 @@
+"""Native molecular-encode digest (io.native.encode_scan/encode_fill +
+ops.encode._encode_molecular_native) vs the per-record Python encoder:
+identical tensors, meta, skip lists, and stage output bytes.
+
+The C scan replicates encode_molecular_families pass 1 (template pairing by
+qname with last-record-wins (qname, role) slots, RX majority with
+first-insertion tie-break, per-slot orientation votes, lo/hi window over
+every kept record) — this suite fuzzes exactly those semantics: softclips,
+hardclips, indels under both policies, missing quals, duplicate slots, RX
+ties and absences, all-softclip reads (est vs placed template-count
+divergence), window/template-cap skips.
+"""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    CDEL,
+    CHARD_CLIP,
+    CINS,
+    CMATCH,
+    CSOFT_CLIP,
+    write_items,
+)
+from bsseqconsensusreads_tpu.ops.encode import encode_molecular_families
+from bsseqconsensusreads_tpu.pipeline import ingest
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    _kept_template_count,
+    call_molecular_batches,
+)
+
+pytestmark = pytest.mark.skipif(
+    not ingest.available(), reason="native decoder not built"
+)
+
+
+def _messy_records(rng, n_families=60, base_start=20):
+    """Families exercising every scan branch; coordinate-sorted on return."""
+    records = []
+    for fam in range(n_families):
+        start = base_start + fam * 70
+        kind = fam % 10
+        mi = f"{fam}/A"
+        depth = int(rng.integers(1, 5))
+        for d in range(depth):
+            for flag, pos in ((99, start), (147, start + 25)):
+                cig = [(CMATCH, 30)]
+                roll = int(rng.integers(0, 8))
+                if roll == 0:
+                    cig = [(CSOFT_CLIP, 4), (CMATCH, 26)]
+                elif roll == 1:
+                    cig = [(CMATCH, 26), (CSOFT_CLIP, 4)]
+                elif roll == 2:
+                    cig = [(CMATCH, 12), (CINS, 2), (CMATCH, 16)]
+                elif roll == 3:
+                    cig = [(CMATCH, 14), (CDEL, 3), (CMATCH, 13)]
+                elif roll == 4:
+                    cig = [(CHARD_CLIP, 3), (CMATCH, 30)]
+                elif roll == 5 and d > 0:
+                    cig = [(CSOFT_CLIP, 30)]  # trims to nothing: est-only
+                read_len = sum(
+                    n for op, n in cig if op in (CMATCH, CINS, CSOFT_CLIP)
+                )
+                seq = "".join(
+                    "ACGT"[b] for b in rng.integers(0, 4, size=read_len)
+                )
+                qual = bytes(rng.integers(2, 41, size=read_len).tolist())
+                if kind == 1 and d == 0:
+                    qual = None  # missing quals (BAM '*' / 0xFF fill)
+                rec = BamRecord(
+                    qname=f"f{fam}d{d}", flag=flag, ref_id=0, pos=pos,
+                    mapq=60, cigar=cig, next_ref_id=0,
+                    next_pos=start + 25 if flag == 99 else start,
+                    seq=seq, qual=qual,
+                )
+                rec.set_tag("MI", mi, "Z")
+                if kind == 2:
+                    pass  # no RX anywhere in the family
+                elif kind == 3:
+                    # two RX values, counts tied when depth is even: the
+                    # majority must tie-break to the first-seen value
+                    rec.set_tag("RX", "AA-CC" if d % 2 == 0 else "GG-TT", "Z")
+                elif kind == 4 and d == 0:
+                    pass  # one untagged read among tagged ones
+                else:
+                    rec.set_tag("RX", "AC-GT", "Z")
+                records.append(rec)
+        if kind == 5:
+            # duplicate (qname, role) slot: a second flag-99 record for an
+            # existing qname — last record must win the slot
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=30))
+            rec = BamRecord(
+                qname=f"f{fam}d0", flag=99, ref_id=0, pos=start + 2,
+                mapq=60, cigar=[(CMATCH, 30)], next_ref_id=0,
+                next_pos=start + 25, seq=seq,
+                qual=bytes(rng.integers(2, 41, size=30).tolist()),
+            )
+            rec.set_tag("MI", mi, "Z")
+            rec.set_tag("RX", "AC-GT", "Z")
+            records.append(rec)
+        if kind == 6:
+            # hardclip-only family: every read drops -> skipped (empty)
+            for rec in records[:]:
+                pass
+            only = BamRecord(
+                qname=f"f{fam}hc", flag=0, ref_id=0, pos=start + 40000,
+                mapq=60, cigar=[(CHARD_CLIP, 2), (CMATCH, 20)],
+                next_ref_id=-1, next_pos=-1,
+                seq="A" * 20, qual=bytes([30] * 20),
+            )
+            only.set_tag("MI", f"{fam}hc/A", "Z")
+            records.append(only)
+        if kind == 7:
+            # window overflow: mate 600 bases away busts max_window=512
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=30))
+            far = BamRecord(
+                qname=f"f{fam}d0", flag=147, ref_id=0, pos=start + 600,
+                mapq=60, cigar=[(CMATCH, 30)], next_ref_id=0, next_pos=start,
+                seq=seq, qual=bytes(rng.integers(2, 41, size=30).tolist()),
+            )
+            far.set_tag("MI", mi, "Z")
+            far.set_tag("RX", "AC-GT", "Z")
+            records.append(far)
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return records
+
+
+@pytest.fixture(scope="module", params=[0, 1, 2])
+def messy_bam(request, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp(f"natenc{request.param}")
+    rng = np.random.default_rng(1000 + request.param)
+    records = _messy_records(rng)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 200000)])
+    path = str(tmp / "in.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    return {"path": path, "header": header}
+
+
+def _families(path, scan_policy):
+    return list(
+        ingest.GroupedColumnarStream(
+            path, scan_policy=scan_policy
+        ).iter_groups()
+    )
+
+
+def _assert_batches_equal(a, b):
+    batch_a, skip_a = a
+    batch_b, skip_b = b
+    assert skip_a == skip_b
+    assert batch_a.bases.shape == batch_b.bases.shape
+    assert np.array_equal(batch_a.bases, batch_b.bases)
+    assert np.array_equal(batch_a.quals, batch_b.quals)
+    assert batch_a.indel_aligned == batch_b.indel_aligned
+    assert batch_a.indel_dropped == batch_b.indel_dropped
+    assert len(batch_a.meta) == len(batch_b.meta)
+    for ma, mb in zip(batch_a.meta, batch_b.meta):
+        assert (ma.mi, ma.ref_id, ma.window_start, ma.n_templates,
+                ma.rx, tuple(ma.role_reverse)) == (
+            mb.mi, mb.ref_id, mb.window_start, mb.n_templates,
+            mb.rx, tuple(mb.role_reverse)
+        )
+
+
+class TestNativeEncodeParity:
+    @pytest.mark.parametrize("policy", ["drop", "align"])
+    def test_encode_parity(self, messy_bam, policy, monkeypatch):
+        from bsseqconsensusreads_tpu.io import native
+
+        fills = []
+        real_fill = native.encode_fill
+        monkeypatch.setattr(
+            native, "encode_fill",
+            lambda *a, **k: fills.append(1) or real_fill(*a, **k),
+        )
+        fams_scan = _families(messy_bam["path"], policy)
+        fams_py = _families(messy_bam["path"], None)
+        assert [f.mi for f in fams_scan] == [mi for mi, _ in fams_py]
+        got = encode_molecular_families(
+            fams_scan, max_window=512, indel_policy=policy
+        )
+        want = encode_molecular_families(
+            fams_py, max_window=512, indel_policy=policy
+        )
+        assert fills, "native fill path was not exercised"
+        _assert_batches_equal(got, want)
+
+    def test_template_cap_skip_parity(self, messy_bam):
+        got = encode_molecular_families(
+            _families(messy_bam["path"], "drop"), max_window=512,
+            max_templates=2,
+        )
+        want = encode_molecular_families(
+            _families(messy_bam["path"], None), max_window=512,
+            max_templates=2,
+        )
+        _assert_batches_equal(got, want)
+
+    def test_ntpl_est_matches_kept_template_count(self, messy_bam):
+        for policy in ("drop", "align"):
+            fams_scan = _families(messy_bam["path"], policy)
+            fams_py = _families(messy_bam["path"], None)
+            for run, (mi, records) in zip(fams_scan, fams_py):
+                assert run.mi == mi
+                assert run.ntpl_est == _kept_template_count(records, policy), mi
+                assert run.n == len(records)
+
+    def test_scan_policy_mismatch_falls_back(self, messy_bam):
+        """A stream scanned under one policy encoding under the other must
+        take the per-record Python path (the digest would be wrong)."""
+        fams = _families(messy_bam["path"], "drop")
+        got = encode_molecular_families(
+            fams, max_window=512, indel_policy="align"
+        )
+        want = encode_molecular_families(
+            _families(messy_bam["path"], None), max_window=512,
+            indel_policy="align",
+        )
+        _assert_batches_equal(got, want)
+
+
+def test_stage_output_identical_with_scan(messy_bam, tmp_path):
+    """Full molecular stage: scan-carrying stream vs tuple stream must be
+    byte-identical (same chunks, same order, same consensus records)."""
+    outs = {}
+    for policy in ("drop", None):
+        stats = StageStats()
+        stream = ingest.GroupedColumnarStream(
+            messy_bam["path"], scan_policy=policy
+        )
+        batches = call_molecular_batches(
+            stream, mode="self", grouping="coordinate", stats=stats,
+            mesh=None,
+        )
+        out = str(tmp_path / f"out_{policy}.bam")
+        with BamWriter(out, messy_bam["header"], engine="python") as w:
+            for b in batches:
+                write_items(w, b)
+        outs[policy] = open(out, "rb").read()
+    assert outs["drop"] == outs[None] and len(outs["drop"]) > 100
+
+
+def _duplex_records(rng, n_families=50, base_start=30):
+    """Duplex-shaped families: 4-read groups plus every leftover class —
+    unknown flags, duplicate rows, indels, hardclips, empty-after-trim."""
+    records = []
+    for fam in range(n_families):
+        start = base_start + fam * 80
+        kind = fam % 8
+        mi = f"{fam}"
+        for i, (flag, pos) in enumerate(
+            ((99, start), (163, start), (83, start + 20), (147, start + 20))
+        ):
+            cig = [(CMATCH, 40)]
+            roll = int(rng.integers(0, 6))
+            if roll == 0:
+                cig = [(CSOFT_CLIP, 5), (CMATCH, 35)]
+            elif roll == 1:
+                cig = [(CMATCH, 35), (CSOFT_CLIP, 5)]
+            elif kind == 1 and roll == 2:
+                cig = [(CMATCH, 18), (CINS, 2), (CMATCH, 20)]  # leftover
+            elif kind == 2 and roll == 3:
+                cig = [(CHARD_CLIP, 2), (CMATCH, 40)]  # dropped
+            elif kind == 3 and roll == 4:
+                cig = [(CSOFT_CLIP, 40)]  # empty after trim -> leftover
+            read_len = sum(
+                n for op, n in cig if op in (CMATCH, CINS, CSOFT_CLIP)
+            )
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=read_len))
+            qual = bytes(rng.integers(2, 41, size=read_len).tolist())
+            if kind == 4 and i == 0:
+                qual = None
+            rec = BamRecord(
+                qname=f"q{fam}:{i}", flag=flag, ref_id=0, pos=pos, mapq=60,
+                cigar=cig, next_ref_id=0, next_pos=start, seq=seq, qual=qual,
+            )
+            rec.set_tag("MI", f"{mi}/{'AB'[i % 2]}", "Z")
+            if not (kind == 5 and i < 2):  # first reads untagged: rx from
+                rec.set_tag("RX", f"RX{fam % 3}", "Z")  # a later placed read
+            records.append(rec)
+        if kind == 6:  # duplicate row: second flag-99 record -> leftover
+            rec = BamRecord(
+                qname=f"q{fam}:dup", flag=99, ref_id=0, pos=start + 1,
+                mapq=60, cigar=[(CMATCH, 40)], next_ref_id=0, next_pos=start,
+                seq="A" * 40, qual=bytes([30] * 40),
+            )
+            rec.set_tag("MI", f"{mi}/A", "Z")
+            records.append(rec)
+        if kind == 7:  # unknown flag -> leftover
+            rec = BamRecord(
+                qname=f"q{fam}:odd", flag=0, ref_id=0, pos=start + 2,
+                mapq=60, cigar=[(CMATCH, 40)], next_ref_id=0, next_pos=-1,
+                seq="C" * 40, qual=bytes([30] * 40),
+            )
+            rec.set_tag("MI", f"{mi}/A", "Z")
+            records.append(rec)
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return records
+
+
+class TestNativeDuplexEncodeParity:
+    @pytest.fixture(scope="class", params=[0, 1])
+    def duplex_bam(self, request, tmp_path_factory):
+        tmp = tmp_path_factory.mktemp(f"natdup{request.param}")
+        rng = np.random.default_rng(500 + request.param)
+        records = _duplex_records(rng)
+        header = BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 200000)]
+        )
+        path = str(tmp / "dup.bam")
+        with BamWriter(path, header) as w:
+            w.write_all(records)
+        genome = "".join(
+            "ACGT"[b] for b in np.random.default_rng(9).integers(
+                0, 4, size=10000
+            )
+        )
+        return {"path": path, "header": header, "genome": genome}
+
+    def _encode(self, bam, scan_policy, **kw):
+        from bsseqconsensusreads_tpu.ops.encode import encode_duplex_families
+
+        fams = list(
+            ingest.GroupedColumnarStream(
+                bam["path"], strip_suffix=True, scan_policy=scan_policy
+            ).iter_groups()
+        )
+        genome = bam["genome"]
+        return encode_duplex_families(
+            fams, lambda name, s, e: genome[s:e], ["chr1"], **kw
+        )
+
+    @pytest.mark.parametrize("max_window", [4096, 64])
+    def test_duplex_encode_parity(self, duplex_bam, max_window):
+        got_b, got_l, got_s = self._encode(
+            duplex_bam, "duplex", max_window=max_window
+        )
+        want_b, want_l, want_s = self._encode(
+            duplex_bam, None, max_window=max_window
+        )
+        assert got_s == want_s
+        assert [(r.qname, r.flag, r.pos) for r in got_l] == [
+            (r.qname, r.flag, r.pos) for r in want_l
+        ]
+        assert np.array_equal(got_b.bases, want_b.bases)
+        assert np.array_equal(got_b.quals, want_b.quals)
+        assert np.array_equal(got_b.cover, want_b.cover)
+        assert np.array_equal(got_b.ref, want_b.ref)
+        assert np.array_equal(got_b.convert_mask, want_b.convert_mask)
+        assert np.array_equal(got_b.extend_eligible, want_b.extend_eligible)
+        for ma, mb in zip(got_b.meta, want_b.meta):
+            assert (ma.mi, ma.ref_id, ma.window_start, ma.n_templates,
+                    ma.rx) == (
+                mb.mi, mb.ref_id, mb.window_start, mb.n_templates, mb.rx
+            )
+
+    def test_duplex_stage_output_identical(self, duplex_bam, tmp_path):
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            call_duplex_batches,
+        )
+
+        genome = duplex_bam["genome"]
+        outs = {}
+        for policy in ("duplex", None):
+            stream = ingest.GroupedColumnarStream(
+                duplex_bam["path"], strip_suffix=True, scan_policy=policy
+            )
+            batches = call_duplex_batches(
+                stream, lambda name, s, e: genome[s:e], ["chr1"],
+                mode="self", grouping="coordinate", stats=StageStats(),
+                mesh=None,
+            )
+            out = str(tmp_path / f"dup_{policy}.bam")
+            with BamWriter(out, duplex_bam["header"], engine="python") as w:
+                for b in batches:
+                    write_items(w, b)
+            outs[policy] = open(out, "rb").read()
+        assert outs["duplex"] == outs[None] and len(outs["duplex"]) > 100
+
+
+def test_deep_family_scan_parity(tmp_path):
+    """A deep family (template count past the deep threshold) must route
+    and encode identically with and without the scan digest."""
+    rng = np.random.default_rng(77)
+    records = []
+    for t in range(40):
+        seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=30))
+        rec = BamRecord(
+            qname=f"t{t}", flag=99, ref_id=0, pos=100 + (t % 3),
+            mapq=60, cigar=[(CMATCH, 30)], next_ref_id=0, next_pos=100,
+            seq=seq, qual=bytes(rng.integers(2, 41, size=30).tolist()),
+        )
+        rec.set_tag("MI", "0/A", "Z")
+        rec.set_tag("RX", "AC-GT", "Z")
+        records.append(rec)
+    records.sort(key=lambda r: r.pos)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 10000)])
+    path = str(tmp_path / "deep.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    outs = {}
+    for policy in ("drop", None):
+        stream = ingest.GroupedColumnarStream(path, scan_policy=policy)
+        batches = call_molecular_batches(
+            stream, mode="self", grouping="coordinate",
+            stats=StageStats(), mesh=None, deep_threshold=8,
+        )
+        out = str(tmp_path / f"deep_{policy}.bam")
+        with BamWriter(out, header, engine="python") as w:
+            for b in batches:
+                write_items(w, b)
+        outs[policy] = open(out, "rb").read()
+    assert outs["drop"] == outs[None] and len(outs["drop"]) > 100
