@@ -149,6 +149,8 @@ def cmd_duplex(args) -> int:
             grouping=args.grouping,
             stats=stats,
             emit=args.emit,
+            refstore=args.reference,  # FASTA path; loaded only if wire engages
+            transport=args.transport,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -182,6 +184,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--reference", required=True, help="genome FASTA")
     p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    p.add_argument(
+        "--transport", choices=("auto", "wire", "unpacked"), default="auto",
+        help="device transport: packed u32 wire + device-resident genome, "
+        "or plain tensors (byte-identical output)",
+    )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)
 

@@ -464,6 +464,7 @@ def encode_duplex_families(
     ref_fetch,
     ref_names: Sequence[str],
     max_window: int = 4096,
+    fetch_ref: bool = True,
 ) -> tuple[DuplexBatch, list[BamRecord], list[str]]:
     """Encode duplex MI groups (strand suffix already stripped) for the fused
     convert+extend+duplex TPU stage.
@@ -483,10 +484,16 @@ def encode_duplex_families(
     (tools/2.extend_gap.py:114-115). Group size counts reads surviving the
     hardclip drop, like the reference's grouping pass; the resulting
     per-family extend_eligible flag gates extend_gap downstream.
+
+    fetch_ref=False leaves batch.ref all-N — for the wire transport, whose
+    kernel gathers the windows from the device-resident genome
+    (ops.refstore) instead of shipping them from the host.
     """
     fams = families if isinstance(families, list) else list(families)
     if fams and all(scan_matches(f, "duplex") for f in fams):
-        return _encode_duplex_native(fams, ref_fetch, ref_names, max_window)
+        return _encode_duplex_native(
+            fams, ref_fetch, ref_names, max_window, fetch_ref
+        )
     families = fams
     placed = []
     leftovers: list[BamRecord] = []
@@ -552,7 +559,11 @@ def encode_duplex_families(
             cover[fi, row, off : off + len(codes)] = True
             if row in CONVERT_ROWS:
                 convert_mask[fi, row] = True
-        name = ref_names[ref_id] if 0 <= ref_id < len(ref_names) else None
+        name = (
+            ref_names[ref_id]
+            if fetch_ref and 0 <= ref_id < len(ref_names)
+            else None
+        )
         if name is not None:
             try:
                 # Only window+1 columns are ever read by the kernels (the
@@ -571,7 +582,8 @@ def encode_duplex_families(
 
 
 def _encode_duplex_native(
-    fams: list, ref_fetch, ref_names: Sequence[str], max_window: int
+    fams: list, ref_fetch, ref_names: Sequence[str], max_window: int,
+    fetch_ref: bool = True,
 ) -> tuple["DuplexBatch", list, list[str]]:
     """encode_duplex_families over pipeline.ingest.FamilyRun inputs carrying
     the C duplex-scan digest (io.native.duplex_scan): per-family start/
@@ -640,7 +652,11 @@ def _encode_duplex_native(
         ref_id = int(s["refid"][k])
         start = int(s["start"][k])
         window = int(s["window"][k])
-        name = ref_names[ref_id] if 0 <= ref_id < len(ref_names) else None
+        name = (
+            ref_names[ref_id]
+            if fetch_ref and 0 <= ref_id < len(ref_names)
+            else None
+        )
         if name is not None:
             try:
                 ref_str = ref_fetch(name, start, start + window + 1)

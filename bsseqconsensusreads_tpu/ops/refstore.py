@@ -90,6 +90,14 @@ class RefStore:
             self._device = jax.device_put(self.codes)
         return self._device
 
+    def contig_indices(self, names) -> np.ndarray:
+        """Map contig NAMES (e.g. a BAM header's reference order, which need
+        not match the FASTA's) to this store's contig indices; unknown names
+        map to -1 (-> NO_REF rows from window_offsets)."""
+        return np.asarray(
+            [self._index.get(n, -1) for n in names], dtype=np.int64
+        )
+
     def window_offsets(self, ref_ids, window_starts):
         """Vectorized (starts, limits) uint32 arrays for gather_windows.
 

@@ -986,11 +986,23 @@ def call_duplex_batches(
     passthrough: bool = False,
     vote_kernel: str | None = None,
     emit: str = "python",
+    refstore=None,
+    transport: str = "auto",
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
     unit — see call_molecular_batches for the skip_batches and `emit`
     contracts; passthrough records stay objects either way).
+
+    transport: 'wire' ships each batch as ONE packed u32 array and gathers
+    reference windows from the device-resident genome (`refstore`: an
+    ops.refstore.RefStore, or a FASTA path loaded lazily only when the
+    wire engages) — the tunnel-optimal path bench.py measures,
+    byte-identical output to 'unpacked' (the adaptive qual codebook is
+    lossless). 'auto' picks wire when a refstore is provided, the run is
+    single-device (the sharded path shards unpacked arrays), and the
+    backend is an accelerator (on CPU the pack/unpack is pure overhead);
+    'unpacked' forces the plain-tensor path.
 
     Input: the aligned, tag-zipped, mapped-only molecular consensus BAM
     (reference checkpoint `…_aunamerged_aligned.bam`) — or, in self-aligned
@@ -1029,24 +1041,91 @@ def call_duplex_batches(
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_duplex_packed(mesh, params, vote_kernel=kernel)
 
+    if transport not in ("auto", "wire", "unpacked"):
+        raise ValueError(
+            f"transport must be 'auto'|'wire'|'unpacked', got {transport!r}"
+        )
+    if transport == "wire" and refstore is None:
+        raise ValueError(
+            "transport 'wire' needs a refstore (a RefStore or a FASTA path)"
+        )
+    if transport == "wire" and mesh is not None:
+        # the sharded path shards unpacked tensors; an explicit 'wire' on a
+        # multi-device run degrades rather than dead-ends (no caller can
+        # reach in and clear the mesh)
+        import warnings
+
+        warnings.warn(
+            "transport 'wire' is single-device; falling back to the "
+            "unpacked transport on this mesh",
+            stacklevel=2,
+        )
+    # 'auto' engages the wire only on an accelerator: on the CPU backend
+    # there is no transfer to save and the pack/unpack sweeps are pure
+    # overhead (measured ~7% stage loss), while on tunneled TPU the stage
+    # is transfer-bound and the wire is ~4x fewer bytes each way.
+    use_wire = (
+        refstore is not None
+        and mesh is None
+        and (
+            transport == "wire"
+            or (transport == "auto" and jax.default_backend() != "cpu")
+        )
+    )
+    if use_wire and isinstance(refstore, str):
+        # lazy full-genome load: only paid when the wire actually engages
+        from bsseqconsensusreads_tpu.ops.refstore import RefStore
+
+        refstore = RefStore.from_fasta(refstore)
+    rid_map = refstore.contig_indices(ref_names) if use_wire else None
+
     def dispatch_kernel(batch):
         """Submit one batch; returns (device wire array, padded f). The D2H
         copy is requested immediately so it streams while the host encodes
         the next chunk / emits the previous one (depth-1 software pipeline —
         on tunneled TPU hosts the transfer, not compute, bounds the stage)."""
         f = batch.bases.shape[0]
-        arrays = (
-            batch.bases, batch.quals, batch.cover, batch.ref,
-            batch.convert_mask, batch.extend_eligible,
-        )
-        if sharded_fn is None:
-            packed, _la, _rd = duplex_call_pipeline_packed(
-                *arrays, params=params, vote_kernel=kernel
+        if use_wire:
+            # one packed u32 array up; windows gathered from the
+            # device-resident genome (models.duplex.duplex_call_wire_fused
+            # — the path bench.py measures, lossless by construction)
+            from bsseqconsensusreads_tpu.models.duplex import (
+                duplex_call_wire_fused,
+            )
+            from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
+
+            w = batch.bases.shape[-1]
+            rids = np.fromiter((m.ref_id for m in batch.meta), np.int64, f)
+            valid = (rids >= 0) & (rids < len(rid_map))
+            # a plain rid_map[rids] would let -1 wrap to the last contig
+            mapped = np.where(valid, rid_map[np.where(valid, rids, 0)], -1)
+            starts, limits = refstore.window_offsets(
+                mapped,
+                np.fromiter((m.window_start for m in batch.meta), np.int64, f),
+            )
+            wire = pack_duplex_inputs(
+                batch.bases, batch.quals.astype(np.uint8), batch.cover,
+                batch.convert_mask, batch.extend_eligible, starts, limits,
+                qual_mode="auto",
+            )
+            packed = duplex_call_wire_fused(
+                wire.to_words(), refstore.device_codes, f, w,
+                params=params, qual_mode=wire.qual_mode, vote_kernel=kernel,
             )
             pf = f
         else:
-            padded, pf = pad_families(arrays, f, data_size)
-            packed, _la, _rd = sharded_fn(*padded)
+            arrays = (
+                batch.bases, batch.quals, batch.cover, batch.ref,
+                batch.convert_mask, batch.extend_eligible,
+            )
+            if sharded_fn is None:
+                packed, _la, _rd = duplex_call_pipeline_packed(
+                    *arrays, params=params, vote_kernel=kernel
+                )
+                pf = f
+            else:
+                padded, pf = pad_families(arrays, f, data_size)
+                packed, _la, _rd = sharded_fn(*padded)
         copy_async = getattr(packed, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()
@@ -1055,7 +1134,15 @@ def call_duplex_batches(
     def retire_and_emit(packed, pf, batch, passed):
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         with stats.metrics.timed("fetch"):
-            out = unpack_duplex_outputs(jax.device_get(packed), f=pf, w=w)
+            host = jax.device_get(packed)
+            if use_wire:
+                from bsseqconsensusreads_tpu.models.duplex import (
+                    unpack_duplex_wire_outputs,
+                )
+
+                out = unpack_duplex_wire_outputs(host, f=pf, w=w)
+            else:
+                out = unpack_duplex_outputs(host, f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
         with stats.metrics.timed("emit"):
             main = emit_fn(batch, out, params, mode, stats)
@@ -1077,8 +1164,12 @@ def call_duplex_batches(
             if batch_index <= skip_batches:
                 continue
             with stats.metrics.timed("encode"):
+                # wire transport: the kernel gathers reference windows from
+                # the device genome, so encode skips the per-family host
+                # fetch (batch.ref stays all-N and unused)
                 batch, leftovers, skipped = encode_duplex_families(
-                    chunk, ref_fetch, ref_names, max_window=max_window
+                    chunk, ref_fetch, ref_names, max_window=max_window,
+                    fetch_ref=not use_wire,
                 )
             stats.skipped_families += len(skipped)
             stats.leftover_records += len(leftovers)

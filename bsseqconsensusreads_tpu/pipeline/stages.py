@@ -244,6 +244,10 @@ class PipelineBuilder:
                 skip_batches=ck.batches_done if ck else 0,
                 passthrough=self.cfg.duplex_passthrough,
                 emit=self.cfg.emit,
+                # FASTA path, loaded into a device-resident genome only if
+                # the wire transport engages (call_duplex_batches decides)
+                refstore=self.cfg.genome_fasta,
+                transport=self.cfg.transport,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 

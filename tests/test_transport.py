@@ -1,0 +1,146 @@
+"""Duplex wire transport (call_duplex_batches transport='wire'): the packed
+u32 + device-resident-genome path must produce byte-identical output to the
+unpacked-tensor path — including BAM-header contig order differing from the
+FASTA's, unmapped families (all-N windows), and windows running past a
+contig end."""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamWriter,
+    write_items,
+)
+from bsseqconsensusreads_tpu.ops.refstore import RefStore
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    random_genome,
+)
+
+
+@pytest.fixture(scope="module")
+def duplex_setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("transport")
+    rng = np.random.default_rng(11)
+    _, g1 = random_genome(rng, 9000, name="chrA")
+    _, g2 = random_genome(rng, 7000, name="chrB")
+    genomes = {"chrA": g1, "chrB": g2}
+    # BAM header order chrA, chrB; the RefStore is built chrB-first to pin
+    # the name-based contig mapping (a raw ref_id indexed store would read
+    # the wrong contig)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n", [("chrA", 9000), ("chrB", 7000)]
+    )
+    records = []
+    for fam in range(40):
+        ref_id = fam % 2
+        gname = ("chrA", "chrB")[ref_id]
+        start = 50 + (fam // 2) * 150
+        if fam == 6:  # read ends at the contig end: the window's +1
+            # lookahead column must come back N on both paths
+            start = len(genomes[gname]) - 60
+        recs = make_aligned_duplex_group(
+            rng, gname, genomes[gname], fam, start, 60,
+            softclip=3 if fam % 5 == 0 else 0,
+        )
+        for r in recs:
+            r.ref_id = ref_id
+            if fam == 9:
+                r.ref_id = -1  # unmapped family: all-N reference row
+        records.extend(recs)
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    path = str(tmp / "dup_in.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    store = RefStore(["chrB", "chrA"], seqs=[g2, g1])
+    return {
+        "path": path, "header": header, "genomes": genomes, "store": store,
+        "tmp": tmp,
+    }
+
+
+def _run(setup, transport, refstore, out_name, **kw):
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+
+    genomes = setup["genomes"]
+
+    def fetch(name, s, e):
+        return genomes[name][s:e]
+
+    kw.setdefault("mesh", None)
+    with BamReader(setup["path"]) as reader:
+        names = [n for n, _ in reader.header.references]
+        batches = call_duplex_batches(
+            reader, fetch, names, mode="self", grouping="coordinate",
+            stats=StageStats(), transport=transport,
+            refstore=refstore, **kw,
+        )
+        out = str(setup["tmp"] / out_name)
+        with BamWriter(out, setup["header"], engine="python") as w:
+            for b in batches:
+                write_items(w, b)
+    return open(out, "rb").read()
+
+
+class TestWireTransport:
+    def test_wire_matches_unpacked(self, duplex_setup):
+        wire = _run(duplex_setup, "wire", duplex_setup["store"], "wire.bam")
+        plain = _run(duplex_setup, "unpacked", None, "plain.bam")
+        assert wire == plain and len(wire) > 200
+
+    def test_auto_matches_unpacked(self, duplex_setup):
+        """'auto' output is transport-independent by construction: on the
+        CPU backend it falls back to unpacked (no transfer to save); on an
+        accelerator it engages the wire — byte-identical either way."""
+        auto = _run(duplex_setup, "auto", duplex_setup["store"], "auto.bam")
+        plain = _run(duplex_setup, "unpacked", None, "plain2.bam")
+        assert auto == plain
+
+    def test_wire_without_store_raises(self, duplex_setup):
+        with pytest.raises(ValueError, match="needs a refstore"):
+            _run(duplex_setup, "wire", None, "err.bam")
+
+    def test_wire_accepts_fasta_path(self, duplex_setup):
+        """refstore may be a FASTA path, loaded lazily only when the wire
+        engages — the form the stage/CLI callers use."""
+        fasta = str(duplex_setup["tmp"] / "ref.fa")
+        with open(fasta, "w") as fh:  # FASTA order != BAM header order
+            for name in ("chrB", "chrA"):
+                fh.write(f">{name}\n{duplex_setup['genomes'][name]}\n")
+        wire = _run(duplex_setup, "wire", fasta, "wire_path.bam")
+        plain = _run(duplex_setup, "unpacked", None, "plain3.bam")
+        assert wire == plain
+
+    def test_wire_on_mesh_warns_and_falls_back(self, duplex_setup):
+        """An explicit 'wire' on a multi-device mesh must degrade to the
+        sharded unpacked path with a warning, not dead-end (no caller can
+        clear the mesh)."""
+        import jax
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >1 device")
+        from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=2, n_reads=1)
+        with pytest.warns(UserWarning, match="single-device"):
+            out = _run(
+                duplex_setup, "wire", duplex_setup["store"],
+                "wire_mesh.bam", mesh=mesh,
+            )
+        plain = _run(duplex_setup, "unpacked", None, "plain4.bam")
+        assert out == plain
+
+    def test_unknown_transport_raises(self, duplex_setup):
+        with pytest.raises(ValueError, match="transport"):
+            _run(duplex_setup, "bogus", None, "err2.bam")
+
+
+def test_contig_indices_maps_by_name(duplex_setup):
+    store = duplex_setup["store"]
+    idx = store.contig_indices(["chrA", "chrB", "chrMissing"])
+    assert idx.tolist() == [1, 0, -1]
