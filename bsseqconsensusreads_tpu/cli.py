@@ -34,6 +34,12 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
     p.add_argument("--batch-families", type=int, default=512)
     p.add_argument("--max-window", type=int, default=4096)
     p.add_argument(
+        "--transport", choices=("auto", "wire", "unpacked"), default="auto",
+        help="device transport: ONE packed u32 array per direction "
+        "(+ device-resident genome on duplex), or plain tensors — "
+        "byte-identical output either way",
+    )
+    p.add_argument(
         "--grouping",
         choices=("gather", "adjacent", "coordinate"),
         default="coordinate",
@@ -118,6 +124,7 @@ def cmd_molecular(args) -> int:
             stats=stats,
             emit=args.emit,
             batching=args.batching,
+            transport=args.transport,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -184,11 +191,6 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--reference", required=True, help="genome FASTA")
     p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
-    p.add_argument(
-        "--transport", choices=("auto", "wire", "unpacked"), default="auto",
-        help="device transport: packed u32 wire + device-resident genome, "
-        "or plain tensors (byte-identical output)",
-    )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)
 

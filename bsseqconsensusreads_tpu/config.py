@@ -68,13 +68,14 @@ class FrameworkConfig:
     #: when built. The 'self' aligner mode coordinate-sorts the blobs
     #: directly (pipeline.extsort.external_sort_raw).
     emit: str = "auto"
-    #: duplex-stage device transport: 'wire' packs each batch into ONE u32
-    #: array and gathers reference windows from the device-resident genome
-    #: (ops.refstore — the tunnel-optimal path bench.py measures; lossless,
-    #: byte-identical output); 'unpacked' ships plain tensors + host-fetched
-    #: ref windows; 'auto' picks wire on single-device accelerator runs
-    #: (on the CPU backend there is no transfer to save, and the sharded
-    #: path shards unpacked tensors).
+    #: consensus-stage device transport: 'wire' packs each batch into ONE
+    #: u32 array per direction (and, on the duplex stage, gathers reference
+    #: windows from the device-resident genome, ops.refstore — the
+    #: tunnel-optimal path bench.py measures; lossless, byte-identical
+    #: output); 'unpacked' ships plain tensors (+ host-fetched ref windows
+    #: on duplex); 'auto' picks wire on single-device accelerator runs (on
+    #: the CPU backend there is no transfer to save, and the sharded path
+    #: shards unpacked tensors).
     transport: str = "auto"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)

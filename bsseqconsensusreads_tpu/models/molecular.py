@@ -236,3 +236,42 @@ def packed_molecular_kernel(kernel_fn=None):
     molecular-consensus kernel (stock XLA vote or the Pallas one). Cached
     per kernel so repeated pipeline batches reuse one compiled program."""
     return _packed_kernel_cached(kernel_fn or molecular_consensus)
+
+
+@lru_cache(maxsize=64)
+def _wire_kernel_cached(kernel_fn):
+    @partial(jax.jit, static_argnames=("f", "t", "w", "params", "qual_mode"))
+    def fn(
+        words, f: int, t: int, w: int,
+        params: ConsensusParams = ConsensusParams(),
+        qual_mode: str = "q8",
+    ):
+        from bsseqconsensusreads_tpu.ops.wire import (
+            split_duplex_wire,
+            unpack_duplex_inputs,
+        )
+
+        r = t * 2
+        nib, qual, meta, _starts, _limits = split_duplex_wire(
+            words, f, w, r=r, qual_mode=qual_mode
+        )
+        bases, quals, _cover, _cm, _el = unpack_duplex_inputs(
+            nib, qual, meta, f, w, r=r, qual_mode=qual_mode
+        )
+        out = kernel_fn(
+            bases.reshape(f, t, 2, w), quals.reshape(f, t, 2, w), params
+        )
+        return pack_molecular_outputs(out)
+
+    return fn
+
+
+def molecular_wire_kernel(kernel_fn=None):
+    """Jitted `fn(words, f, t, w, params, qual_mode) -> packed u32 wire`:
+    the tunnel-optimal molecular stage — ONE u32 array each way. Input is
+    ops.wire.pack_molecular_inputs' 2T-row wire (4 bits/cell bases, the
+    adaptive qual codebook) split and unpacked on device; output is the
+    same planar wire packed_molecular_kernel emits. ~4x fewer H2D bytes
+    than the unpacked [F,T,2,W] int8+uint8 pair on a transfer-bound link,
+    bit-identical results (the codebook is lossless)."""
+    return _wire_kernel_cached(kernel_fn or molecular_consensus)

@@ -271,6 +271,35 @@ def pack_duplex_inputs(
     )
 
 
+def pack_molecular_inputs(
+    bases: np.ndarray, quals: np.ndarray, qual_mode: str = "auto"
+) -> DuplexWire:
+    """Pack a MolecularBatch's [F, T, 2, W] tensors as a 2T-row input wire.
+
+    Reuses the duplex wire format with r = 2T: NBASE rides the nibble's 3
+    base bits (cover = observed, derived from bases), and the duplex-only
+    meta/starts/limits sections carry zeros — a few bytes per family
+    against the MB-scale nib/qual planes, cheaper than a second format.
+    Unpack with unpack_duplex_inputs(r=2T) and reshape to [F, T, 2, W]
+    (models.molecular.molecular_wire_kernel does both on device).
+    """
+    f, t, two, w = bases.shape
+    r = t * two
+    b2 = np.ascontiguousarray(bases.reshape(f, r, w))
+    from bsseqconsensusreads_tpu.alphabet import NBASE
+
+    return pack_duplex_inputs(
+        b2,
+        np.ascontiguousarray(quals.reshape(f, r, w)),
+        b2 != NBASE,
+        np.zeros((f, r), dtype=bool),
+        np.zeros(f, dtype=bool),
+        np.zeros(f, dtype=np.uint32),
+        np.zeros(f, dtype=np.uint32),
+        qual_mode=qual_mode,
+    )
+
+
 def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4,
                          qual_mode: str = "q8"):
     """Device-side (jit-traceable) inverse of pack_duplex_inputs.

@@ -86,6 +86,33 @@ def _resolve_mesh(mesh):
 DEEP_TEMPLATE_CAP = 16_384
 
 
+def _resolve_transport(transport: str, mesh) -> bool:
+    """Shared transport policy of the consensus stages: validate the value
+    and decide whether the packed-wire path engages. 'wire' on a mesh
+    degrades to unpacked with a warning (the sharded path shards unpacked
+    tensors and no caller can clear the mesh); 'auto' engages the wire only
+    on single-device accelerator runs — on the CPU backend there is no
+    transfer to save and the pack/unpack sweeps are pure overhead
+    (measured ~7% stage loss), while on tunneled TPU the stage is
+    transfer-bound and the wire is ~4x fewer bytes each way."""
+    if transport not in ("auto", "wire", "unpacked"):
+        raise ValueError(
+            f"transport must be 'auto'|'wire'|'unpacked', got {transport!r}"
+        )
+    if transport == "wire" and mesh is not None:
+        import warnings
+
+        warnings.warn(
+            "transport 'wire' is single-device; falling back to the "
+            "unpacked transport on this mesh",
+            stacklevel=3,
+        )
+    return mesh is None and (
+        transport == "wire"
+        or (transport == "auto" and jax.default_backend() != "cpu")
+    )
+
+
 def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     """Partition (mi, records) groups by encodable template count: families
     whose count exceeds `threshold` go to the deep-family path (sharded
@@ -703,6 +730,7 @@ def call_molecular_batches(
     deep_threshold: int | None = None,
     emit: str = "python",
     batching: str = "bucketed",
+    transport: str = "auto",
 ) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -734,6 +762,11 @@ def call_molecular_batches(
     sharded across the mesh's devices with a psum segmented reduction
     (parallel.deep_family) — instead of being skipped; only beyond
     DEEP_TEMPLATE_CAP (int16 transport ceiling) are they skipped+reported.
+
+    transport: 'wire' packs each batch's input tensors into ONE u32 array
+    (ops.wire.pack_molecular_inputs — ~4x fewer H2D bytes, bit-identical
+    results); 'auto' engages it on single-device accelerator runs, like
+    call_duplex_batches; 'unpacked' forces plain tensors.
     """
     from bsseqconsensusreads_tpu.ops import encode as encode_mod
 
@@ -748,9 +781,17 @@ def call_molecular_batches(
         deep_threshold = encode_mod.MAX_TEMPLATES
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
+    use_wire = _resolve_transport(transport, mesh)
     sharded_fn = None
     deep_state: dict = {}
     if mesh is None:
+        if use_wire:
+            from bsseqconsensusreads_tpu.models.molecular import (
+                molecular_wire_kernel,
+            )
+            from bsseqconsensusreads_tpu.ops.wire import pack_molecular_inputs
+
+            wire_fn = molecular_wire_kernel(consensus_fn)
         packed_fn = packed_molecular_kernel(consensus_fn)
     else:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
@@ -770,7 +811,17 @@ def call_molecular_batches(
         as call_duplex_batches)."""
         f = batch.bases.shape[0]
         if sharded_fn is None:
-            wire = packed_fn(batch.bases, batch.quals, params)
+            if use_wire:
+                t, w = batch.bases.shape[1], batch.bases.shape[-1]
+                win = pack_molecular_inputs(
+                    batch.bases, batch.quals, qual_mode="auto"
+                )
+                wire = wire_fn(
+                    win.to_words(), f, t, w, params=params,
+                    qual_mode=win.qual_mode,
+                )
+            else:
+                wire = packed_fn(batch.bases, batch.quals, params)
             pf = f
         else:
             (pb, pq), pf = pad_families(
@@ -1041,37 +1092,11 @@ def call_duplex_batches(
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_duplex_packed(mesh, params, vote_kernel=kernel)
 
-    if transport not in ("auto", "wire", "unpacked"):
-        raise ValueError(
-            f"transport must be 'auto'|'wire'|'unpacked', got {transport!r}"
-        )
     if transport == "wire" and refstore is None:
         raise ValueError(
             "transport 'wire' needs a refstore (a RefStore or a FASTA path)"
         )
-    if transport == "wire" and mesh is not None:
-        # the sharded path shards unpacked tensors; an explicit 'wire' on a
-        # multi-device run degrades rather than dead-ends (no caller can
-        # reach in and clear the mesh)
-        import warnings
-
-        warnings.warn(
-            "transport 'wire' is single-device; falling back to the "
-            "unpacked transport on this mesh",
-            stacklevel=2,
-        )
-    # 'auto' engages the wire only on an accelerator: on the CPU backend
-    # there is no transfer to save and the pack/unpack sweeps are pure
-    # overhead (measured ~7% stage loss), while on tunneled TPU the stage
-    # is transfer-bound and the wire is ~4x fewer bytes each way.
-    use_wire = (
-        refstore is not None
-        and mesh is None
-        and (
-            transport == "wire"
-            or (transport == "auto" and jax.default_backend() != "cpu")
-        )
-    )
+    use_wire = _resolve_transport(transport, mesh) and refstore is not None
     if use_wire and isinstance(refstore, str):
         # lazy full-genome load: only paid when the wire actually engages
         from bsseqconsensusreads_tpu.ops.refstore import RefStore

@@ -213,6 +213,7 @@ class PipelineBuilder:
                 skip_batches=ck.batches_done if ck else 0,
                 indel_policy=self.cfg.indel_policy,
                 emit=self.cfg.emit,
+                transport=self.cfg.transport,
                 batching=self.cfg.batching,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)

@@ -140,6 +140,71 @@ class TestWireTransport:
             _run(duplex_setup, "bogus", None, "err2.bam")
 
 
+class TestMolecularWireTransport:
+    @pytest.fixture(scope="class")
+    def mol_bam(self, tmp_path_factory):
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+        )
+
+        tmp = tmp_path_factory.mktemp("moltransport")
+        rng = np.random.default_rng(33)
+        name, genome = random_genome(rng, 40000)
+        header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=150, read_len=80
+        )
+        records.sort(key=lambda r: (r.ref_id, r.pos))
+        path = str(tmp / "mol_in.bam")
+        with BamWriter(path, header) as w:
+            w.write_all(records)
+        return {"path": path, "header": header, "tmp": tmp}
+
+    def _run(self, setup, transport, out_name, **kw):
+        from bsseqconsensusreads_tpu.io.bam import BamReader
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            call_molecular_batches,
+        )
+
+        kw.setdefault("mesh", None)
+        with BamReader(setup["path"]) as reader:
+            batches = call_molecular_batches(
+                reader, mode="self", grouping="coordinate",
+                stats=StageStats(), transport=transport, **kw,
+            )
+            out = str(setup["tmp"] / out_name)
+            with BamWriter(out, setup["header"], engine="python") as w:
+                for b in batches:
+                    write_items(w, b)
+        return open(out, "rb").read()
+
+    def test_wire_matches_unpacked(self, mol_bam):
+        wire = self._run(mol_bam, "wire", "wire.bam")
+        plain = self._run(mol_bam, "unpacked", "plain.bam")
+        assert wire == plain and len(wire) > 200
+
+    def test_auto_matches_unpacked(self, mol_bam):
+        auto = self._run(mol_bam, "auto", "auto.bam")
+        plain = self._run(mol_bam, "unpacked", "plain2.bam")
+        assert auto == plain
+
+    def test_wire_on_mesh_warns_and_falls_back(self, mol_bam):
+        import jax
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >1 device")
+        from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=2, n_reads=1)
+        with pytest.warns(UserWarning, match="single-device"):
+            out = self._run(mol_bam, "wire", "wire_mesh.bam", mesh=mesh)
+        plain = self._run(mol_bam, "unpacked", "plain3.bam")
+        assert out == plain
+
+    def test_unknown_transport_raises(self, mol_bam):
+        with pytest.raises(ValueError, match="transport"):
+            self._run(mol_bam, "bogus", "err.bam")
+
+
 def test_contig_indices_maps_by_name(duplex_setup):
     store = duplex_setup["store"]
     idx = store.contig_indices(["chrA", "chrB", "chrMissing"])
