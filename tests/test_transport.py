@@ -139,6 +139,16 @@ class TestWireTransport:
         with pytest.raises(ValueError, match="transport"):
             _run(duplex_setup, "bogus", None, "err2.bam")
 
+    def test_wire_with_pallas_vote_matches_unpacked_xla(self, duplex_setup):
+        """Packed wire + device genome + Pallas duplex vote == unpacked XLA
+        (interpret mode on CPU; Mosaic parity is the on-chip tool's job)."""
+        wire = _run(
+            duplex_setup, "wire", duplex_setup["store"], "wire_pallas.bam",
+            vote_kernel="pallas",
+        )
+        plain = _run(duplex_setup, "unpacked", None, "plain_xla.bam")
+        assert wire == plain
+
 
 class TestMolecularWireTransport:
     @pytest.fixture(scope="class")
@@ -203,6 +213,16 @@ class TestMolecularWireTransport:
     def test_unknown_transport_raises(self, mol_bam):
         with pytest.raises(ValueError, match="transport"):
             self._run(mol_bam, "bogus", "err.bam")
+
+    def test_wire_with_pallas_vote_matches_unpacked_xla(self, mol_bam):
+        """The two flagship pieces composed: packed wire transport feeding
+        the Pallas vote kernel must equal the unpacked XLA path (interpret
+        mode on CPU; tools/pallas_tpu_parity.py covers Mosaic on chip)."""
+        wire = self._run(
+            mol_bam, "wire", "wire_pallas.bam", vote_kernel="pallas"
+        )
+        plain = self._run(mol_bam, "unpacked", "plain_xla.bam")
+        assert wire == plain
 
 
 def test_contig_indices_maps_by_name(duplex_setup):
