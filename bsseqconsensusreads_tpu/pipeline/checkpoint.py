@@ -77,12 +77,13 @@ class BatchCheckpoint:
     """
 
     def __init__(self, target: str, header: BamHeader, every: int = 16,
-                 fingerprint: dict | None = None):
+                 fingerprint: dict | None = None, level: int = 6):
         if every < 1:
             raise ValueError(f"checkpoint interval must be >= 1, got {every}")
         self.target = target
         self.header = header
         self.every = every
+        self.level = level  # deflate level of the finalized target
         self.manifest_path = target + ".ckpt.json"
         self.manifest = _Manifest.load(self.manifest_path)
         fingerprint = fingerprint or {}
@@ -129,7 +130,9 @@ class BatchCheckpoint:
 
     def _flush(self, items: list, n_batches: int) -> None:
         path = self._shard_path(len(self.manifest.shards))
-        with BamWriter(path, self.header) as w:
+        # shards are scratch (re-read once at finalize, then deleted):
+        # always deflate fast, like the external-sort spills
+        with BamWriter(path, self.header, level=1) as w:
             n = write_items(w, items)
         # the shard must hit disk BEFORE the manifest claims it durable
         with open(path, "rb") as fh:
@@ -171,7 +174,7 @@ class BatchCheckpoint:
         """
         n = 0
         tmp = self.target + ".finalize.tmp"
-        with BamWriter(tmp, self.header) as w:
+        with BamWriter(tmp, self.header, level=self.level) as w:
             if records is None:
                 # raw-order concatenation: copy each shard's record bytes
                 # verbatim (no decode/re-encode round trip), coalesced

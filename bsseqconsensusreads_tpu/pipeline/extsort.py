@@ -222,12 +222,15 @@ def write_batch_stream(
     mode: str,
     workdir: str | None = None,
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
+    level: int = 6,
 ) -> None:
     """Write a consensus batch stream (lists of BamRecord / RawRecords) to
     a BAM: straight through when order-preserving, or via the raw-blob
     external coordinate sort in 'self' mode — never the whole output in
-    RAM. Shared by the pipeline stage runner and the CLI subcommands."""
-    with BamWriter(out_path, header) as writer:
+    RAM. Shared by the pipeline stage runner and the CLI subcommands.
+    `level` is the BGZF deflate level (stage intermediates pass a fast
+    level; see FrameworkConfig.intermediate_level)."""
+    with BamWriter(out_path, header, level=level) as writer:
         if mode == "self":
             blobs = iter_record_blobs(
                 item for batch in batches for item in batch

@@ -65,9 +65,18 @@ class PipelineBuilder:
         self.sample = sample_name(bam_path)
         self.outdir = outdir
         self.stats: dict[str, StageStats] = {}
+        self.final_output: str | None = None  # set by build()
 
     def out(self, suffix: str) -> str:
         return os.path.join(self.outdir, f"{self.sample}{suffix}")
+
+    def _out_level(self, path: str) -> int:
+        """Deflate level for a stage output: intermediates — durable
+        rule-boundary checkpoints that the happy path re-reads exactly once
+        — write at cfg.intermediate_level (samtools' `-l1`-for-pipeline-
+        steps convention); the workflow's final target keeps the standard
+        level 6."""
+        return 6 if path == self.final_output else self.cfg.intermediate_level
 
     # ---- stage bodies -------------------------------------------------
 
@@ -106,6 +115,7 @@ class PipelineBuilder:
             batches, out_path, header, mode,
             workdir=self.cfg.tmp or None,
             buffer_records=self.cfg.sort_buffer_records,
+            level=self._out_level(out_path),
         )
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
@@ -138,6 +148,7 @@ class PipelineBuilder:
         return BatchCheckpoint(
             rule.outputs[0], header, every=self.cfg.checkpoint_every,
             fingerprint=fingerprint,
+            level=self._out_level(rule.outputs[0]),
         )
 
     def _ingest_records(self, path: str, reader, stats: StageStats,
@@ -270,7 +281,9 @@ class PipelineBuilder:
             cmd, shell=True, stdout=subprocess.PIPE, text=True
         )
         header, records = read_sam(proc.stdout)
-        with BamWriter(rule.outputs[0], header) as writer:
+        with BamWriter(
+            rule.outputs[0], header, level=self._out_level(rule.outputs[0])
+        ) as writer:
             writer.write_all(records)
         if proc.wait() != 0:
             raise WorkflowError(f"bwameth failed: {cmd}")
@@ -283,13 +296,17 @@ class PipelineBuilder:
                 workdir=self.cfg.tmp or None,
                 buffer_records=self.cfg.sort_buffer_records,
             )
-            with BamWriter(rule.outputs[0], header) as writer:
+            with BamWriter(
+                rule.outputs[0], header, level=self._out_level(rule.outputs[0])
+            ) as writer:
                 writer.write_all(merged)
 
     def run_filter_mapped(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:
             header = self._pg(reader.header, "filter-mapped")
-            with BamWriter(rule.outputs[0], header) as writer:
+            with BamWriter(
+                rule.outputs[0], header, level=self._out_level(rule.outputs[0])
+            ) as writer:
                 writer.write_all(filter_mapped(reader))
 
     # ---- pipeline assembly --------------------------------------------
@@ -312,6 +329,7 @@ class PipelineBuilder:
                 [target],
                 lambda r: self.run_duplex(r, mode="self"),
             )
+            self.final_output = target
             return wf, target
 
         molecular = self.out("_unalignedConsensus_molecular.bam")
@@ -325,6 +343,7 @@ class PipelineBuilder:
         fq2 = self.out("_unalignedConsensus_unfiltered_2.fq.gz")
         wf.rule("consensus_to_fq_unfiltered", [molecular], [fq1, fq2], self.run_sam_to_fastq)
         if cfg.aligner == "none":
+            self.final_output = fq1
             return wf, fq1
 
         aligned0 = self.out("_consensus_unfiltered.bam")
@@ -347,6 +366,7 @@ class PipelineBuilder:
         wf.rule("consensusduplex_to_fq", [duplex], [dfq1, dfq2], self.run_sam_to_fastq)
         target = self.out("_consensus_duplex_unfiltered_bwameth.bam")
         wf.rule("align_consensus_unfiltered_duplex", [dfq1, dfq2], [target], self.run_bwameth)
+        self.final_output = target
         return wf, target
 
 
