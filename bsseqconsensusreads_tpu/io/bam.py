@@ -296,11 +296,11 @@ def _select_bgzf(engine: str, native_factory, python_factory):
     return python_factory()
 
 
-def _open_bgzf(path: str, engine: str):
+def _open_bgzf(path: str, engine: str, threads: int | None = None):
     def native_factory():
         from bsseqconsensusreads_tpu.io.native import NativeBgzfReader
 
-        return NativeBgzfReader(path)
+        return NativeBgzfReader(path, threads=threads)
 
     return _select_bgzf(engine, native_factory, lambda: BgzfReader.open(path))
 
@@ -385,11 +385,14 @@ class BamReader:
 
     engine: 'auto' uses the native C++ BGZF codec when built (native/
     libbamio.so), falling back to the pure-Python codec; 'python'/'native'
-    force one.
+    force one. threads: BGZF inflate workers (native engine; None = the
+    shared io.native default) — pass 1 for readers opened in bulk, e.g.
+    external-merge fan-in.
     """
 
-    def __init__(self, path: str, engine: str = "auto"):
-        self._bgzf = _open_bgzf(path, engine)
+    def __init__(self, path: str, engine: str = "auto",
+                 threads: int | None = None):
+        self._bgzf = _open_bgzf(path, engine, threads=threads)
         try:
             magic = self._bgzf.read(4)
             if magic != BAM_MAGIC:

@@ -36,12 +36,15 @@ def _try_load():
             "bamio_group_refragmented", "bamio_group_free",
             "bamio_encode_scan", "bamio_encode_fill",
             "bamio_duplex_scan", "bamio_duplex_fill",
+            "bamio_open_mt",
         ),
     )
     if lib is None:
         return
     lib.bamio_open.restype = C.c_void_p
     lib.bamio_open.argtypes = [C.c_char_p, C.c_char_p, C.c_int]
+    lib.bamio_open_mt.restype = C.c_void_p
+    lib.bamio_open_mt.argtypes = [C.c_char_p, C.c_int, C.c_char_p, C.c_int]
     lib.bamio_read.restype = C.c_int64
     lib.bamio_read.argtypes = [C.c_void_p, C.c_void_p, C.c_int64]
     lib.bamio_error.restype = C.c_char_p
@@ -122,6 +125,18 @@ def available() -> bool:
     return _lib is not None
 
 
+def _bgzf_threads(threads: int | None) -> int:
+    """Shared reader/writer worker-count policy: explicit value wins, else
+    BSSEQ_TPU_BGZF_THREADS, else min(4, cpu count)."""
+    if threads is not None:
+        return threads
+    default = min(4, os.cpu_count() or 1)
+    try:
+        return int(os.environ.get("BSSEQ_TPU_BGZF_THREADS", str(default)))
+    except ValueError:
+        return default
+
+
 def load_error() -> str | None:
     _try_load()
     return _load_error
@@ -132,16 +147,24 @@ class NativeBgzfReader:
 
     Reads cross the ctypes boundary in 4 MiB chunks and are served from a
     Python-side buffer — per-record 4-byte reads would otherwise pay a
-    ctypes round trip each."""
+    ctypes round trip each.
+
+    threads > 1 inflates BGZF blocks on a worker pool with in-order
+    delivery (bamio_open_mt) — identical byte stream, the read-side twin
+    of the MT writer; inflate is the ingest wall on multi-core hosts.
+    Default: min(4, cpu count), overridable via BSSEQ_TPU_BGZF_THREADS
+    (shared with the writer)."""
 
     _CHUNK = 1 << 22
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, threads: int | None = None):
         _try_load()
         if _lib is None:
             raise OSError(_load_error or "native codec unavailable")
         err = C.create_string_buffer(256)
-        self._h = _lib.bamio_open(path.encode(), err, 256)
+        self._h = _lib.bamio_open_mt(
+            path.encode(), _bgzf_threads(threads), err, 256
+        )
         if not self._h:
             raise IOError(err.value.decode())
         self._buf = b""
@@ -220,14 +243,7 @@ class NativeBgzfWriter:
         _try_load()
         if _lib is None:
             raise OSError(_load_error or "native codec unavailable")
-        if threads is None:
-            default = min(4, os.cpu_count() or 1)
-            try:
-                threads = int(
-                    os.environ.get("BSSEQ_TPU_BGZF_THREADS", str(default))
-                )
-            except ValueError:
-                threads = default
+        threads = _bgzf_threads(threads)
         self._mt = threads > 1
         err = C.create_string_buffer(256)
         if self._mt:

@@ -107,7 +107,10 @@ def _external_sort_core(
     def open_runs(paths: list[str], readers: list):
         streams = []
         for p in paths:
-            r = BamReader(p)
+            # single-thread inflate: up to MERGE_FANIN of these are open at
+            # once, each consumed a record at a time — MT prefetch per
+            # reader would multiply threads and readahead by the fan-in
+            r = BamReader(p, threads=1)
             readers.append(r)
             streams.append(read_run(r))
         return streams

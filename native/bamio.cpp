@@ -29,6 +29,8 @@ namespace {
 
 constexpr size_t kMaxBlock = 65536;
 
+struct MtInflate;
+
 struct Reader {
   FILE* fh = nullptr;
   std::vector<uint8_t> carry;  // decompressed bytes not yet consumed
@@ -37,6 +39,7 @@ struct Reader {
   bool last_block_empty = false;
   bool eof = false;
   std::string err;
+  MtInflate* mt = nullptr;  // parallel-inflate pipeline (bamio_open_mt)
 };
 
 struct Writer {
@@ -195,27 +198,39 @@ const uint8_t kEofBlock[28] = {0x1f, 0x8b, 0x08, 0x04, 0,    0,    0,    0,
 // nt16 code -> framework base code (A=0 C=1 G=2 T=3 N/other=4)
 const int8_t kNt16ToCode[16] = {4, 0, 1, 4, 2, 4, 4, 4, 3, 4, 4, 4, 4, 4, 4, 4};
 
-bool read_block(Reader* r) {
+// One on-disk BGZF block, fetched but not yet inflated.
+struct RawBlock {
+  std::vector<uint8_t> cdata;
+  uint32_t crc = 0;
+  uint32_t isize = 0;
+};
+
+// Read the next block's compressed payload from the stream. Sequential —
+// one caller at a time owns the FILE*. `last_empty` is the EOF-marker
+// state (BGZF ends with an empty block): carried across calls, validated
+// when fread hits EOF. Returns 1 = block fetched, 0 = clean EOF,
+// -1 = error (err set).
+int fetch_raw_block(FILE* fh, RawBlock& b, bool& last_empty,
+                    std::string& err) {
   uint8_t head[12];
-  size_t got = fread(head, 1, 12, r->fh);
+  size_t got = fread(head, 1, 12, fh);
   if (got == 0) {
-    if (!r->last_block_empty) {
-      r->err = "BGZF EOF marker missing (file truncated?)";
-      return false;
+    if (!last_empty) {
+      err = "BGZF EOF marker missing (file truncated?)";
+      return -1;
     }
-    r->eof = true;
-    return true;
+    return 0;
   }
   if (got < 12 || head[0] != 0x1f || head[1] != 0x8b || head[2] != 8 ||
       !(head[3] & 4)) {
-    r->err = "not a BGZF stream";
-    return false;
+    err = "not a BGZF stream";
+    return -1;
   }
   uint16_t xlen = uint16_t(head[10]) | (uint16_t(head[11]) << 8);
   std::vector<uint8_t> extra(xlen);
-  if (fread(extra.data(), 1, xlen, r->fh) != xlen) {
-    r->err = "truncated BGZF extra field";
-    return false;
+  if (fread(extra.data(), 1, xlen, fh) != xlen) {
+    err = "truncated BGZF extra field";
+    return -1;
   }
   int bsize = -1;
   for (size_t off = 0; off + 4 <= extra.size();) {
@@ -228,57 +243,185 @@ bool read_block(Reader* r) {
     off += 4 + slen;
   }
   if (bsize < 0) {
-    r->err = "BGZF block missing BC subfield";
-    return false;
+    err = "BGZF block missing BC subfield";
+    return -1;
   }
   long cdata_len = long(bsize) - 12 - xlen - 8;
   if (cdata_len < 0) {
-    r->err = "corrupt BGZF BSIZE";
-    return false;
+    err = "corrupt BGZF BSIZE";
+    return -1;
   }
-  std::vector<uint8_t> cdata(cdata_len);
+  b.cdata.resize(cdata_len);
   uint8_t tail[8];
-  if (fread(cdata.data(), 1, cdata_len, r->fh) != size_t(cdata_len) ||
-      fread(tail, 1, 8, r->fh) != 8) {
-    r->err = "truncated BGZF block";
+  if (fread(b.cdata.data(), 1, cdata_len, fh) != size_t(cdata_len) ||
+      fread(tail, 1, 8, fh) != 8) {
+    err = "truncated BGZF block";
+    return -1;
+  }
+  b.crc = uint32_t(tail[0]) | (uint32_t(tail[1]) << 8) |
+          (uint32_t(tail[2]) << 16) | (uint32_t(tail[3]) << 24);
+  b.isize = uint32_t(tail[4]) | (uint32_t(tail[5]) << 8) |
+            (uint32_t(tail[6]) << 16) | (uint32_t(tail[7]) << 24);
+  if (b.isize > kMaxBlock) {
+    // untrusted 32-bit field: bounding it here keeps a corrupt block from
+    // driving huge allocations (fatal in a worker thread, where bad_alloc
+    // would escape to std::terminate instead of an IOError)
+    err = "corrupt BGZF ISIZE";
+    return -1;
+  }
+  last_empty = (b.isize == 0);
+  return 1;
+}
+
+// Inflate + CRC-check one fetched block into out[b.isize]. Pure function
+// of the block — safe from any thread.
+bool inflate_block(const RawBlock& b, uint8_t* out, std::string& err) {
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, -15) != Z_OK) {
+    err = "inflateInit failed";
     return false;
   }
-  uint32_t crc = uint32_t(tail[0]) | (uint32_t(tail[1]) << 8) |
-                 (uint32_t(tail[2]) << 16) | (uint32_t(tail[3]) << 24);
-  uint32_t isize = uint32_t(tail[4]) | (uint32_t(tail[5]) << 8) |
-                   (uint32_t(tail[6]) << 16) | (uint32_t(tail[7]) << 24);
-  size_t base = r->carry.size() - r->carry_off;
+  zs.next_in = const_cast<uint8_t*>(b.cdata.data());
+  zs.avail_in = uInt(b.cdata.size());
+  zs.next_out = out;
+  zs.avail_out = b.isize;
+  int rc = inflate(&zs, Z_FINISH);
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END || zs.total_out != b.isize) {
+    err = "BGZF inflate failed / ISIZE mismatch";
+    return false;
+  }
+  if (crc32(0L, out, b.isize) != b.crc) {
+    err = "BGZF CRC mismatch";
+    return false;
+  }
+  return true;
+}
+
+// --- multi-threaded inflate pipeline (the read-side twin of MtWriter) ----
+// The consumer thread fetches compressed blocks sequentially (cheap — page
+// cache memcpys) into a bounded in-order queue; workers inflate+CRC them
+// concurrently; delivery pops strictly in fetch order, so the decompressed
+// stream is byte-identical to the single-threaded path.
+
+struct InflJob {
+  RawBlock raw;
+  std::vector<uint8_t> out;
+  bool done = false;
+  std::string err;  // non-empty = this block failed
+};
+
+struct MtInflate {
+  std::mutex mu;
+  std::condition_variable cv_work;  // workers: todo became non-empty / stop
+  std::condition_variable cv_done;  // consumer: a job completed
+  std::deque<std::shared_ptr<InflJob>> order;  // delivery order, in flight
+  std::deque<std::shared_ptr<InflJob>> todo;   // not yet taken by a worker
+  std::vector<std::thread> workers;
+  bool stop = false;
+  bool fetch_eof = false;     // no more blocks will be fetched
+  std::string fetch_err;      // terminal fetch error (delivered last)
+  size_t window = 32;         // max blocks in flight (~4 MB ceiling)
+};
+
+void mt_inflate_worker(MtInflate* m) {
+  std::unique_lock<std::mutex> lk(m->mu);
+  while (true) {
+    m->cv_work.wait(lk, [&] { return m->stop || !m->todo.empty(); });
+    if (m->todo.empty()) return;  // stop && drained
+    std::shared_ptr<InflJob> job = m->todo.front();
+    m->todo.pop_front();
+    lk.unlock();
+    std::string err;
+    job->out.resize(job->raw.isize);
+    bool ok = job->raw.isize == 0 ||
+              inflate_block(job->raw, job->out.data(), err);
+    lk.lock();
+    if (!ok) job->err = err;
+    job->done = true;
+    m->cv_done.notify_all();
+  }
+}
+
+// Top the fetch window back up. Runs on the consumer thread (sole owner of
+// the FILE*); locks only around queue mutation, never around fread.
+void mt_fill(Reader* r) {
+  MtInflate* m = r->mt;
+  while (true) {
+    {
+      std::lock_guard<std::mutex> lk(m->mu);
+      if (m->fetch_eof || m->order.size() >= m->window) return;
+    }
+    auto job = std::make_shared<InflJob>();
+    std::string err;
+    int rc = fetch_raw_block(r->fh, job->raw, r->last_block_empty, err);
+    std::lock_guard<std::mutex> lk(m->mu);
+    if (rc <= 0) {
+      m->fetch_eof = true;
+      if (rc < 0) m->fetch_err = err;
+      return;
+    }
+    m->order.push_back(job);
+    m->todo.push_back(job);
+    m->cv_work.notify_one();
+  }
+}
+
+// MT replacement for the synchronous block append below: deliver the next
+// inflated block, in fetch order, into the carry.
+bool mt_next_block(Reader* r) {
+  MtInflate* m = r->mt;
+  mt_fill(r);
+  std::shared_ptr<InflJob> job;
+  {
+    std::unique_lock<std::mutex> lk(m->mu);
+    if (m->order.empty()) {
+      if (!m->fetch_err.empty()) {
+        r->err = m->fetch_err;
+        return false;
+      }
+      r->eof = true;
+      return true;
+    }
+    job = m->order.front();
+    m->cv_done.wait(lk, [&] { return job->done; });
+    m->order.pop_front();
+  }
+  if (!job->err.empty()) {
+    r->err = job->err;
+    return false;
+  }
+  if (r->carry_off > 0) {  // compact the carry before appending
+    r->carry.erase(r->carry.begin(), r->carry.begin() + r->carry_off);
+    r->carry_off = 0;
+  }
+  size_t old = r->carry.size();
+  r->carry.resize(old + job->out.size());
+  if (!job->out.empty())
+    memcpy(r->carry.data() + old, job->out.data(), job->out.size());
+  mt_fill(r);  // keep workers busy while the parser chews this block
+  return true;
+}
+
+bool read_block(Reader* r) {
+  if (r->mt) return mt_next_block(r);
+  RawBlock b;
+  int rc = fetch_raw_block(r->fh, b, r->last_block_empty, r->err);
+  if (rc < 0) return false;
+  if (rc == 0) {
+    r->eof = true;
+    return true;
+  }
   // compact the carry before appending
   if (r->carry_off > 0) {
     r->carry.erase(r->carry.begin(), r->carry.begin() + r->carry_off);
     r->carry_off = 0;
   }
   size_t old = r->carry.size();
-  r->carry.resize(old + isize);
-  (void)base;
-  if (isize > 0) {
-    z_stream zs;
-    memset(&zs, 0, sizeof(zs));
-    if (inflateInit2(&zs, -15) != Z_OK) {
-      r->err = "inflateInit failed";
-      return false;
-    }
-    zs.next_in = cdata.data();
-    zs.avail_in = uInt(cdata.size());
-    zs.next_out = r->carry.data() + old;
-    zs.avail_out = isize;
-    int rc = inflate(&zs, Z_FINISH);
-    inflateEnd(&zs);
-    if (rc != Z_STREAM_END || zs.total_out != isize) {
-      r->err = "BGZF inflate failed / ISIZE mismatch";
-      return false;
-    }
-    if (crc32(0L, r->carry.data() + old, isize) != crc) {
-      r->err = "BGZF CRC mismatch";
-      return false;
-    }
-  }
-  r->last_block_empty = (isize == 0);
+  r->carry.resize(old + b.isize);
+  if (b.isize > 0 && !inflate_block(b, r->carry.data() + old, r->err))
+    return false;
   return true;
 }
 
@@ -735,6 +878,19 @@ Reader* bamio_open(const char* path, char* err, int errlen) {
   return r;
 }
 
+// Open with `threads` parallel inflate workers (<=1 = plain bamio_open).
+// The handle is interchangeable with bamio_open's everywhere (bamio_read,
+// the columnar parsers, the grouper): only block decompression changes,
+// the delivered byte stream is identical.
+Reader* bamio_open_mt(const char* path, int threads, char* err, int errlen) {
+  Reader* r = bamio_open(path, err, errlen);
+  if (!r || threads <= 1) return r;
+  r->mt = new MtInflate();
+  for (int i = 0; i < threads; i++)
+    r->mt->workers.emplace_back(mt_inflate_worker, r->mt);
+  return r;
+}
+
 // Read up to n decompressed bytes. Returns bytes read (0 at EOF), -1 error.
 int64_t bamio_read(Reader* r, uint8_t* buf, int64_t n) {
   int64_t total = 0;
@@ -756,6 +912,16 @@ int64_t bamio_read(Reader* r, uint8_t* buf, int64_t n) {
 const char* bamio_error(Reader* r) { return r->err.c_str(); }
 
 void bamio_close(Reader* r) {
+  if (r->mt) {
+    {
+      std::lock_guard<std::mutex> lk(r->mt->mu);
+      r->mt->stop = true;
+      r->mt->todo.clear();  // abandoned work: nothing will be delivered
+    }
+    r->mt->cv_work.notify_all();
+    for (auto& t : r->mt->workers) t.join();
+    delete r->mt;
+  }
   if (r->fh) fclose(r->fh);
   delete r;
 }
