@@ -36,8 +36,9 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
     p.add_argument(
         "--transport", choices=("auto", "wire", "unpacked"), default="auto",
         help="device transport: ONE packed u32 array per direction "
-        "(+ device-resident genome on duplex), or plain tensors — "
-        "byte-identical output either way",
+        "(+ device-resident genome on duplex; round-robin across devices "
+        "on multi-device runs), or plain tensors — byte-identical output "
+        "either way",
     )
     p.add_argument(
         "--grouping",

@@ -81,10 +81,12 @@ class FrameworkConfig:
     #: u32 array per direction (and, on the duplex stage, gathers reference
     #: windows from the device-resident genome, ops.refstore — the
     #: tunnel-optimal path bench.py measures; lossless, byte-identical
-    #: output); 'unpacked' ships plain tensors (+ host-fetched ref windows
+    #: output); on multi-device runs 'wire' round-robins whole batches
+    #: across the devices (zero collectives, genome uploaded once per
+    #: device). 'unpacked' ships plain tensors (+ host-fetched ref windows
     #: on duplex); 'auto' picks wire on single-device accelerator runs (on
-    #: the CPU backend there is no transfer to save, and the sharded path
-    #: shards unpacked tensors).
+    #: the CPU backend there is no transfer to save, and the default
+    #: sharded path shards unpacked tensors).
     transport: str = "auto"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)

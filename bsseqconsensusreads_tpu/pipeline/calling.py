@@ -88,29 +88,55 @@ DEEP_TEMPLATE_CAP = 16_384
 
 def _resolve_transport(transport: str, mesh) -> bool:
     """Shared transport policy of the consensus stages: validate the value
-    and decide whether the packed-wire path engages. 'wire' on a mesh
-    degrades to unpacked with a warning (the sharded path shards unpacked
-    tensors and no caller can clear the mesh); 'auto' engages the wire only
-    on single-device accelerator runs — on the CPU backend there is no
-    transfer to save and the pack/unpack sweeps are pure overhead
-    (measured ~7% stage loss), while on tunneled TPU the stage is
-    transfer-bound and the wire is ~4x fewer bytes each way."""
+    and decide whether the SINGLE-DEVICE packed-wire path engages. An
+    explicit 'wire' on a mesh takes the multi-device wire path instead
+    (round-robin whole-batch dispatch — see the batch callers); 'auto'
+    engages the wire only on single-device accelerator runs — on the CPU
+    backend there is no transfer to save and the pack/unpack sweeps are
+    pure overhead (measured ~7% stage loss), while on tunneled TPU the
+    stage is transfer-bound and the wire is ~4x fewer bytes each way."""
     if transport not in ("auto", "wire", "unpacked"):
         raise ValueError(
             f"transport must be 'auto'|'wire'|'unpacked', got {transport!r}"
-        )
-    if transport == "wire" and mesh is not None:
-        import warnings
-
-        warnings.warn(
-            "transport 'wire' is single-device; falling back to the "
-            "unpacked transport on this mesh",
-            stacklevel=3,
         )
     return mesh is None and (
         transport == "wire"
         or (transport == "auto" and jax.default_backend() != "cpu")
     )
+
+
+class _WireRoundRobin:
+    """Round-robin whole-batch device placement for the multi-device wire
+    transport, shared by both consensus stages. Restricted to THIS
+    process's addressable devices: on a multi-host mesh each process
+    dispatches its own batches locally (device_put to another host's
+    device is not addressable; cross-host distribution is the multihost
+    layer's per-process batch assembly, parallel.multihost)."""
+
+    def __init__(self, mesh):
+        me = jax.process_index()
+        self.devices = [
+            d for d in mesh.devices.flat if d.process_index == me
+        ]
+        if not self.devices:
+            raise ValueError(
+                "transport 'wire' on a mesh with no devices addressable "
+                "from this process"
+            )
+        self._i = 0
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def next_device(self):
+        d = self.devices[self._i % len(self.devices)]
+        self._i += 1
+        return d
+
+
+def _pipeline_depth(rr: "_WireRoundRobin | None") -> int:
+    """Retire-pipeline depth: one batch in flight per round-robin device."""
+    return len(rr) if rr is not None else 1
 
 
 def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
@@ -197,29 +223,37 @@ def _bucket_deep(deep):
             yield group[i : i + max_k]
 
 
-def _pipelined(events):
-    """Depth-1 dispatch/retire software pipeline shared by the batch callers.
+def _pipelined(events, depth: int = 1):
+    """Dispatch/retire software pipeline shared by the batch callers.
 
     `events` yields one ("now", records) or ("deferred", retire_fn) item per
     input chunk. "now" results pass straight through; a "deferred" retire
     (the blocking device fetch + record emit of an already-dispatched
-    kernel batch) is held until the NEXT event arrives, so its D2H transfer
-    streams while the host encodes the following chunk. Exactly one yield
-    per event, in event order — the invariant checkpoint resume's
-    skip_batches counting depends on (pipeline.checkpoint), kept in this
-    one place for both the molecular and duplex stages.
+    kernel batch) is held until `depth` newer dispatches are in flight, so
+    its D2H transfer streams while the host encodes following chunks.
+    depth 1 is the classic double-buffer; the multi-device wire transport
+    passes depth = device count so every device holds one batch. Exactly
+    one yield per event, in event order — the invariant checkpoint
+    resume's skip_batches counting depends on (pipeline.checkpoint), kept
+    in this one place for both the molecular and duplex stages.
     """
-    pending = None
+    from collections import deque
+
+    depth = max(depth, 1)
+    pending: deque = deque()
     for kind, payload in events:
-        if pending is not None:
-            yield pending()
-            pending = None
         if kind == "deferred":
-            pending = payload
+            while len(pending) >= depth:
+                yield pending.popleft()()
+            pending.append(payload)
         else:
+            # "now" results must still appear in event order: drain the
+            # older in-flight retires first
+            while pending:
+                yield pending.popleft()()
             yield payload
-    if pending is not None:
-        yield pending()
+    while pending:
+        yield pending.popleft()()
 
 
 def _molecular_kernel(vote_kernel: str | None):
@@ -765,8 +799,10 @@ def call_molecular_batches(
 
     transport: 'wire' packs each batch's input tensors into ONE u32 array
     (ops.wire.pack_molecular_inputs — ~4x fewer H2D bytes, bit-identical
-    results); 'auto' engages it on single-device accelerator runs, like
-    call_duplex_batches; 'unpacked' forces plain tensors.
+    results); on a mesh it round-robins whole batches across the devices
+    (zero collectives, pipeline depth = device count). 'auto' engages the
+    single-device wire on accelerator runs, like call_duplex_batches;
+    'unpacked' forces plain tensors.
     """
     from bsseqconsensusreads_tpu.ops import encode as encode_mod
 
@@ -781,19 +817,23 @@ def call_molecular_batches(
         deep_threshold = encode_mod.MAX_TEMPLATES
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
-    use_wire = _resolve_transport(transport, mesh)
+    # explicit 'wire' on a mesh: round-robin whole batches across devices
+    # (see call_duplex_batches — zero collectives, zero pad_families)
+    wire_mc = transport == "wire" and mesh is not None
+    use_wire = _resolve_transport(transport, mesh) or wire_mc
     sharded_fn = None
     deep_state: dict = {}
-    if mesh is None:
-        if use_wire:
-            from bsseqconsensusreads_tpu.models.molecular import (
-                molecular_wire_kernel,
-            )
-            from bsseqconsensusreads_tpu.ops.wire import pack_molecular_inputs
+    wire_rr = _WireRoundRobin(mesh) if wire_mc else None
+    if use_wire:
+        from bsseqconsensusreads_tpu.models.molecular import (
+            molecular_wire_kernel,
+        )
+        from bsseqconsensusreads_tpu.ops.wire import pack_molecular_inputs
 
-            wire_fn = molecular_wire_kernel(consensus_fn)
+        wire_fn = molecular_wire_kernel(consensus_fn)
+    if mesh is None:
         packed_fn = packed_molecular_kernel(consensus_fn)
-    else:
+    elif not wire_mc:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
         from bsseqconsensusreads_tpu.parallel.sharding import (
             sharded_molecular_packed,
@@ -816,8 +856,11 @@ def call_molecular_batches(
                 win = pack_molecular_inputs(
                     batch.bases, batch.quals, qual_mode="auto"
                 )
+                words = win.to_words()
+                if wire_rr is not None:  # round-robin device placement
+                    words = jax.device_put(words, wire_rr.next_device())
                 wire = wire_fn(
-                    win.to_words(), f, t, w, params=params,
+                    words, f, t, w, params=params,
                     qual_mode=win.qual_mode,
                 )
             else:
@@ -943,7 +986,7 @@ def call_molecular_batches(
                 retire_and_emit, out_dev, trim, batch, deep_emitted
             )
 
-    yield from _pipelined(events())
+    yield from _pipelined(events(), depth=_pipeline_depth(wire_rr))
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -1050,10 +1093,12 @@ def call_duplex_batches(
     ops.refstore.RefStore, or a FASTA path loaded lazily only when the
     wire engages) — the tunnel-optimal path bench.py measures,
     byte-identical output to 'unpacked' (the adaptive qual codebook is
-    lossless). 'auto' picks wire when a refstore is provided, the run is
-    single-device (the sharded path shards unpacked arrays), and the
-    backend is an accelerator (on CPU the pack/unpack is pure overhead);
-    'unpacked' forces the plain-tensor path.
+    lossless). On a mesh, an explicit 'wire' round-robins whole batches
+    across the devices (genome uploaded once per device, zero collectives,
+    pipeline depth = device count). 'auto' picks the single-device wire
+    when a refstore is provided and the backend is an accelerator (on CPU
+    the pack/unpack is pure overhead; the sharded path shards unpacked
+    arrays); 'unpacked' forces the plain-tensor path.
 
     Input: the aligned, tag-zipped, mapped-only molecular consensus BAM
     (reference checkpoint `…_aunamerged_aligned.bam`) — or, in self-aligned
@@ -1084,8 +1129,13 @@ def call_duplex_batches(
     )
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
+    # explicit 'wire' on a mesh: round-robin WHOLE batches across the
+    # devices (each runs the single-device wire program; batches are
+    # independent, so this is data parallelism across batches with zero
+    # collectives, zero pad_families, and the per-device wire byte savings)
+    wire_mc = transport == "wire" and mesh is not None
     sharded_fn = None
-    if mesh is not None:
+    if mesh is not None and not wire_mc:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
         from bsseqconsensusreads_tpu.parallel.sharding import sharded_duplex_packed
 
@@ -1096,19 +1146,36 @@ def call_duplex_batches(
         raise ValueError(
             "transport 'wire' needs a refstore (a RefStore or a FASTA path)"
         )
-    use_wire = _resolve_transport(transport, mesh) and refstore is not None
+    use_wire = (
+        _resolve_transport(transport, mesh) and refstore is not None
+    ) or wire_mc
     if use_wire and isinstance(refstore, str):
         # lazy full-genome load: only paid when the wire actually engages
         from bsseqconsensusreads_tpu.ops.refstore import RefStore
 
         refstore = RefStore.from_fasta(refstore)
     rid_map = refstore.contig_indices(ref_names) if use_wire else None
+    wire_rr = _WireRoundRobin(mesh) if wire_mc else None
+    genome_per_dev: dict = {}
+
+    def _wire_device_args(words):
+        """(words, genome) placed on this dispatch's device: the default
+        device for single-device wire, else the round-robin target (the
+        genome is uploaded once per device and cached)."""
+        if wire_rr is None:
+            return words, refstore.device_codes
+        dev = wire_rr.next_device()
+        g = genome_per_dev.get(dev.id)
+        if g is None:
+            g = genome_per_dev[dev.id] = jax.device_put(refstore.codes, dev)
+        return jax.device_put(words, dev), g
 
     def dispatch_kernel(batch):
         """Submit one batch; returns (device wire array, padded f). The D2H
         copy is requested immediately so it streams while the host encodes
-        the next chunk / emits the previous one (depth-1 software pipeline —
-        on tunneled TPU hosts the transfer, not compute, bounds the stage)."""
+        the next chunk / emits the previous one (software pipeline, depth =
+        in-flight devices — on tunneled TPU hosts the transfer, not
+        compute, bounds the stage)."""
         f = batch.bases.shape[0]
         if use_wire:
             # one packed u32 array up; windows gathered from the
@@ -1133,8 +1200,9 @@ def call_duplex_batches(
                 batch.convert_mask, batch.extend_eligible, starts, limits,
                 qual_mode="auto",
             )
+            words, genome = _wire_device_args(wire.to_words())
             packed = duplex_call_wire_fused(
-                wire.to_words(), refstore.device_codes, f, w,
+                words, genome, f, w,
                 params=params, qual_mode=wire.qual_mode, vote_kernel=kernel,
             )
             pf = f
@@ -1212,7 +1280,7 @@ def call_duplex_batches(
                 packed, pf = dispatch_kernel(batch)
             yield "deferred", partial(retire_and_emit, packed, pf, batch, passed)
 
-    yield from _pipelined(events())
+    yield from _pipelined(events(), depth=_pipeline_depth(wire_rr))
     stats.wall_seconds += time.monotonic() - t0
 
 

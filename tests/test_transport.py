@@ -116,23 +116,24 @@ class TestWireTransport:
         plain = _run(duplex_setup, "unpacked", None, "plain3.bam")
         assert wire == plain
 
-    def test_wire_on_mesh_warns_and_falls_back(self, duplex_setup):
-        """An explicit 'wire' on a multi-device mesh must degrade to the
-        sharded unpacked path with a warning, not dead-end (no caller can
-        clear the mesh)."""
+    def test_wire_on_mesh_round_robins(self, duplex_setup):
+        """An explicit 'wire' on a multi-device mesh round-robins whole
+        batches across the devices — byte-identical output, batch order
+        preserved by the deepened retire pipeline."""
         import jax
 
         if jax.device_count() < 2:
             pytest.skip("needs >1 device")
         from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(n_data=2, n_reads=1)
-        with pytest.warns(UserWarning, match="single-device"):
-            out = _run(
-                duplex_setup, "wire", duplex_setup["store"],
-                "wire_mesh.bam", mesh=mesh,
-            )
-        plain = _run(duplex_setup, "unpacked", None, "plain4.bam")
+        mesh = make_mesh(n_data=min(4, jax.device_count()), n_reads=1)
+        out = _run(
+            duplex_setup, "wire", duplex_setup["store"],
+            "wire_mesh.bam", mesh=mesh, batch_families=8,
+        )
+        plain = _run(
+            duplex_setup, "unpacked", None, "plain4.bam", batch_families=8
+        )
         assert out == plain
 
     def test_unknown_transport_raises(self, duplex_setup):
@@ -197,17 +198,23 @@ class TestMolecularWireTransport:
         plain = self._run(mol_bam, "unpacked", "plain2.bam")
         assert auto == plain
 
-    def test_wire_on_mesh_warns_and_falls_back(self, mol_bam):
+    def test_wire_on_mesh_round_robins(self, mol_bam):
+        """Multi-device molecular wire: whole batches round-robin across
+        devices, output byte-identical and order-preserved (small
+        batch_families so several batches are in flight at once)."""
         import jax
 
         if jax.device_count() < 2:
             pytest.skip("needs >1 device")
         from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(n_data=2, n_reads=1)
-        with pytest.warns(UserWarning, match="single-device"):
-            out = self._run(mol_bam, "wire", "wire_mesh.bam", mesh=mesh)
-        plain = self._run(mol_bam, "unpacked", "plain3.bam")
+        mesh = make_mesh(n_data=min(4, jax.device_count()), n_reads=1)
+        out = self._run(
+            mol_bam, "wire", "wire_mesh.bam", mesh=mesh, batch_families=16
+        )
+        plain = self._run(
+            mol_bam, "unpacked", "plain3.bam", batch_families=16
+        )
         assert out == plain
 
     def test_unknown_transport_raises(self, mol_bam):
