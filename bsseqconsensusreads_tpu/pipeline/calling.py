@@ -86,23 +86,32 @@ def _resolve_mesh(mesh):
 DEEP_TEMPLATE_CAP = 16_384
 
 
-def _resolve_transport(transport: str, mesh) -> bool:
-    """Shared transport policy of the consensus stages: validate the value
-    and decide whether the SINGLE-DEVICE packed-wire path engages. An
-    explicit 'wire' on a mesh takes the multi-device wire path instead
-    (round-robin whole-batch dispatch — see the batch callers); 'auto'
-    engages the wire only on single-device accelerator runs — on the CPU
-    backend there is no transfer to save and the pack/unpack sweeps are
-    pure overhead (measured ~7% stage loss), while on tunneled TPU the
-    stage is transfer-bound and the wire is ~4x fewer bytes each way."""
+def _resolve_transport(transport: str, mesh) -> str:
+    """The ONE transport policy of the consensus stages. Returns the
+    resolved mode:
+
+    * 'wire'    — single-device packed wire: an explicit 'wire' off-mesh,
+                  or 'auto' on a single-device accelerator run (on the CPU
+                  backend there is no transfer to save and the pack/unpack
+                  sweeps are pure overhead, measured ~7% stage loss; on
+                  tunneled TPU the stage is transfer-bound and the wire is
+                  ~4x fewer bytes each way).
+    * 'wire-mc' — explicit 'wire' on a mesh: round-robin whole-batch
+                  dispatch across the mesh's addressable devices (see
+                  _WireRoundRobin and the batch callers).
+    * 'off'     — plain unpacked tensors.
+    """
     if transport not in ("auto", "wire", "unpacked"):
         raise ValueError(
             f"transport must be 'auto'|'wire'|'unpacked', got {transport!r}"
         )
-    return mesh is None and (
-        transport == "wire"
-        or (transport == "auto" and jax.default_backend() != "cpu")
-    )
+    if mesh is not None:
+        return "wire-mc" if transport == "wire" else "off"
+    if transport == "wire" or (
+        transport == "auto" and jax.default_backend() != "cpu"
+    ):
+        return "wire"
+    return "off"
 
 
 class _WireRoundRobin:
@@ -817,10 +826,9 @@ def call_molecular_batches(
         deep_threshold = encode_mod.MAX_TEMPLATES
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
-    # explicit 'wire' on a mesh: round-robin whole batches across devices
-    # (see call_duplex_batches — zero collectives, zero pad_families)
-    wire_mc = transport == "wire" and mesh is not None
-    use_wire = _resolve_transport(transport, mesh) or wire_mc
+    wire_mode = _resolve_transport(transport, mesh)
+    wire_mc = wire_mode == "wire-mc"
+    use_wire = wire_mode != "off"
     sharded_fn = None
     deep_state: dict = {}
     wire_rr = _WireRoundRobin(mesh) if wire_mc else None
@@ -1129,11 +1137,8 @@ def call_duplex_batches(
     )
     t0 = time.monotonic()
     mesh = _resolve_mesh(mesh)
-    # explicit 'wire' on a mesh: round-robin WHOLE batches across the
-    # devices (each runs the single-device wire program; batches are
-    # independent, so this is data parallelism across batches with zero
-    # collectives, zero pad_families, and the per-device wire byte savings)
-    wire_mc = transport == "wire" and mesh is not None
+    wire_mode = _resolve_transport(transport, mesh)
+    wire_mc = wire_mode == "wire-mc"
     sharded_fn = None
     if mesh is not None and not wire_mc:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
@@ -1146,9 +1151,9 @@ def call_duplex_batches(
         raise ValueError(
             "transport 'wire' needs a refstore (a RefStore or a FASTA path)"
         )
-    use_wire = (
-        _resolve_transport(transport, mesh) and refstore is not None
-    ) or wire_mc
+    # 'auto' without a refstore falls back to unpacked (wire-mc is always
+    # explicit, so its missing-refstore case raised above)
+    use_wire = wire_mode != "off" and refstore is not None
     if use_wire and isinstance(refstore, str):
         # lazy full-genome load: only paid when the wire actually engages
         from bsseqconsensusreads_tpu.ops.refstore import RefStore
