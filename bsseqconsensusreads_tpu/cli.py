@@ -159,6 +159,7 @@ def cmd_duplex(args) -> int:
             emit=args.emit,
             refstore=args.reference,  # FASTA path; loaded only if wire engages
             transport=args.transport,
+            passthrough=args.passthrough,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -192,6 +193,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--reference", required=True, help="genome FASTA")
     p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    p.add_argument(
+        "--passthrough", action="store_true",
+        help="reference-parity emission of off-vocabulary records (the "
+        "convert-stage treatment of tools/1.convert_AG_to_CT.py applied "
+        "to leftovers; default drops them, counted in stats)",
+    )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)
 

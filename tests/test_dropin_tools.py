@@ -116,6 +116,43 @@ def test_duplex_dropin_subprocess_matches_inprocess(duplex_input):
         assert "MI" in tags and "RX" in tags
 
 
+def test_duplex_dropin_passthrough_flag(duplex_input):
+    """--passthrough writes off-vocabulary leftovers through with the
+    reference's convert-stage treatment (flag 0 passes verbatim,
+    tools/1.convert_AG_to_CT.py:70-72); the default drops them."""
+    from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+
+    tmp, inp, fasta = duplex_input
+    # input + one unpaired flag-0 record (off the 99/163/83/147 vocabulary)
+    with BamReader(inp) as r:
+        header, records = r.header, list(r)
+    odd = BamRecord(
+        qname="odd0", flag=0, ref_id=0, pos=150, mapq=60,
+        cigar=[(CMATCH, 30)], next_ref_id=-1, next_pos=-1,
+        seq="A" * 30, qual=bytes([30] * 30),
+    )
+    odd.set_tag("MI", "999", "Z")
+    records.append(odd)
+    records.sort(key=lambda rec: (rec.ref_id, rec.pos))
+    inp2 = str(tmp / "with_odd.bam")
+    with BamWriter(inp2, header) as w:
+        w.write_all(records)
+
+    outs = {}
+    for label, extra in (("pass", ["--passthrough"]), ("drop", [])):
+        out = str(tmp / f"odd_{label}.bam")
+        cp = _run_tool(
+            "call_duplex_consensus_tpu.py",
+            ["-i", inp2, "-o", out, "--reference", fasta, *extra],
+        )
+        assert cp.returncode == 0, cp.stderr[-2000:]
+        with BamReader(out) as r:
+            outs[label] = [rec.qname for rec in r]
+    assert "odd0" in outs["pass"]
+    assert "odd0" not in outs["drop"]
+    assert len(outs["pass"]) == len(outs["drop"]) + 1
+
+
 def test_run_entry_with_reference_style_config(tmp_path):
     """`python -m bsseqconsensusreads_tpu run --config config.yaml --bam …`
     — the snakemake-invocation equivalent (README.md:62) driven by a
