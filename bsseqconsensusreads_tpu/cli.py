@@ -34,6 +34,12 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
     p.add_argument("--batch-families", type=int, default=512)
     p.add_argument("--max-window", type=int, default=4096)
     p.add_argument(
+        "--ingest", choices=("auto", "native", "python"), default="auto",
+        help="record ingest engine: the C++ columnar decoder (with C-side "
+        "grouping + encode digest on coordinate input) or pure-Python "
+        "BamReader — byte-identical output either way",
+    )
+    p.add_argument(
         "--transport", choices=("auto", "wire", "unpacked"), default="auto",
         help="device transport: ONE packed u32 array per direction "
         "(+ device-resident genome on duplex; round-robin across devices "
@@ -112,11 +118,17 @@ def cmd_molecular(args) -> int:
         StageStats,
         call_molecular_batches,
     )
+    from bsseqconsensusreads_tpu.pipeline.stages import ingest_records
 
     stats = StageStats()
     with BamReader(args.input) as reader:
         batches = call_molecular_batches(
-            reader,
+            ingest_records(
+                args.input, reader, stats,
+                ingest_choice=args.ingest, grouping=args.grouping,
+                # the C grouper carries the per-family encode digest
+                scan_policy="drop",
+            ),
             params=_params(args),
             mode=args.mode,
             batch_families=args.batch_families,
@@ -142,12 +154,22 @@ def cmd_duplex(args) -> int:
         call_duplex_batches,
     )
 
+    from bsseqconsensusreads_tpu.pipeline.stages import ingest_records
+
     stats = StageStats()
     fasta = FastaFile(args.reference)
     with BamReader(args.input) as reader:
         names = [n for n, _ in reader.header.references]
         batches = call_duplex_batches(
-            reader,
+            ingest_records(
+                args.input, reader, stats,
+                ingest_choice=args.ingest, grouping=args.grouping,
+                # passthrough leftovers keep their full tag set only on
+                # the Python record path (native views carry MI/RX)
+                allow_native=not args.passthrough,
+                strip_suffix=True,  # duplex groups by base MI
+                scan_policy="duplex",
+            ),
             fasta.fetch,
             names,
             params=_params(args),

@@ -56,6 +56,51 @@ def sample_name(bam_path: str) -> str:
     return os.path.basename(bam_path).replace(".bam", "")
 
 
+def ingest_records(path: str, reader, stats: StageStats,
+                   ingest_choice: str = "auto",
+                   grouping: str = "coordinate",
+                   allow_native: bool = True,
+                   strip_suffix: bool = False,
+                   scan_policy: str | None = None):
+    """Record stream for a consensus stage: the native columnar decoder
+    (pipeline.ingest) when configured+built, else the BamReader. With
+    grouping='coordinate' the native path also pre-groups families in
+    C (ingest.GroupedColumnarStream; disable via
+    BSSEQ_TPU_NATIVE_GROUPING=0) and runs the per-family encode scan
+    (scan_policy). The chosen engine lands in stats.metrics
+    ('ingest_native'/'group_native' counters) so the ingest-phase
+    records/sec (records_in / ingest_seconds) is attributable. Shared by
+    the pipeline stage runner and the CLI subcommands."""
+    from bsseqconsensusreads_tpu.pipeline import ingest
+
+    if ingest_choice not in ("auto", "native", "python"):
+        raise WorkflowError(f"unknown ingest {ingest_choice!r}")
+    # 'gather' grouping would pin every columnar batch's buffers for
+    # the whole file; only the streaming groupings keep ingest bounded
+    allow_native = allow_native and grouping != "gather"
+    use_native = allow_native and (
+        ingest_choice == "native"
+        or (ingest_choice == "auto" and ingest.available())
+    )
+    if use_native and not ingest.available():
+        raise WorkflowError(
+            "ingest 'native' requested but the native decoder is not "
+            "built (make -C native)"
+        )
+    stats.metrics.count("ingest_native", int(use_native))
+    use_grouped = (
+        use_native
+        and grouping == "coordinate"
+        and os.environ.get("BSSEQ_TPU_NATIVE_GROUPING", "1") != "0"
+    )
+    stats.metrics.count("group_native", int(use_grouped))
+    if use_grouped:
+        return ingest.GroupedColumnarStream(
+            path, strip_suffix=strip_suffix, scan_policy=scan_policy,
+        )
+    return ingest.columnar_records(path) if use_native else reader
+
+
 class PipelineBuilder:
     """Assembles the Workflow for one sample and collects stage stats."""
 
@@ -155,43 +200,12 @@ class PipelineBuilder:
                         allow_native: bool = True,
                         strip_suffix: bool = False,
                         scan_policy: str | None = None):
-        """Record stream for a consensus stage: the native columnar decoder
-        (pipeline.ingest) when configured+built, else the BamReader. With
-        grouping='coordinate' the native path also pre-groups families in
-        C (ingest.GroupedColumnarStream; disable via
-        BSSEQ_TPU_NATIVE_GROUPING=0). The chosen engine lands in
-        stats.metrics ('ingest_native'/'group_native' counters) so the
-        ingest-phase records/sec (records_in / ingest_seconds) is
-        attributable."""
-        from bsseqconsensusreads_tpu.pipeline import ingest
-
-        choice = self.cfg.ingest
-        if choice not in ("auto", "native", "python"):
-            raise WorkflowError(f"unknown ingest {choice!r}")
-        # 'gather' grouping would pin every columnar batch's buffers for
-        # the whole file; only the streaming groupings keep ingest bounded
-        allow_native = allow_native and self.cfg.grouping != "gather"
-        use_native = allow_native and (
-            choice == "native"
-            or (choice == "auto" and ingest.available())
+        return ingest_records(
+            path, reader, stats,
+            ingest_choice=self.cfg.ingest, grouping=self.cfg.grouping,
+            allow_native=allow_native, strip_suffix=strip_suffix,
+            scan_policy=scan_policy,
         )
-        if use_native and not ingest.available():
-            raise WorkflowError(
-                "ingest 'native' requested but the native decoder is not "
-                "built (make -C native)"
-            )
-        stats.metrics.count("ingest_native", int(use_native))
-        use_grouped = (
-            use_native
-            and self.cfg.grouping == "coordinate"
-            and os.environ.get("BSSEQ_TPU_NATIVE_GROUPING", "1") != "0"
-        )
-        stats.metrics.count("group_native", int(use_grouped))
-        if use_grouped:
-            return ingest.GroupedColumnarStream(
-                path, strip_suffix=strip_suffix, scan_policy=scan_policy,
-            )
-        return ingest.columnar_records(path) if use_native else reader
 
     def _pg(self, header: BamHeader, stage: str) -> BamHeader:
         """@PG provenance line for one stage output (samtools/fgbio both
