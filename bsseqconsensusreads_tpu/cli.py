@@ -34,6 +34,11 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
     p.add_argument("--batch-families", type=int, default=512)
     p.add_argument("--max-window", type=int, default=4096)
     p.add_argument(
+        "--vote-kernel", choices=("xla", "pallas"), default=None,
+        help="consensus vote kernel (default: BSSEQ_TPU_VOTE_KERNEL or "
+        "xla); pallas = the fused Mosaic VMEM-streaming reduction",
+    )
+    p.add_argument(
         "--ingest", choices=("auto", "native", "python"), default="auto",
         help="record ingest engine: the C++ columnar decoder (with C-side "
         "grouping + encode digest on coordinate input) or pure-Python "
@@ -118,16 +123,15 @@ def cmd_molecular(args) -> int:
         StageStats,
         call_molecular_batches,
     )
-    from bsseqconsensusreads_tpu.pipeline.stages import ingest_records
+    from bsseqconsensusreads_tpu.pipeline.stages import molecular_ingest_stream
 
     stats = StageStats()
     with BamReader(args.input) as reader:
         batches = call_molecular_batches(
-            ingest_records(
+            molecular_ingest_stream(
                 args.input, reader, stats,
                 ingest_choice=args.ingest, grouping=args.grouping,
-                # the C grouper carries the per-family encode digest
-                scan_policy="drop",
+                indel_policy=args.indel_policy,
             ),
             params=_params(args),
             mode=args.mode,
@@ -138,6 +142,8 @@ def cmd_molecular(args) -> int:
             emit=args.emit,
             batching=args.batching,
             transport=args.transport,
+            indel_policy=args.indel_policy,
+            vote_kernel=args.vote_kernel,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -154,21 +160,17 @@ def cmd_duplex(args) -> int:
         call_duplex_batches,
     )
 
-    from bsseqconsensusreads_tpu.pipeline.stages import ingest_records
+    from bsseqconsensusreads_tpu.pipeline.stages import duplex_ingest_stream
 
     stats = StageStats()
     fasta = FastaFile(args.reference)
     with BamReader(args.input) as reader:
         names = [n for n, _ in reader.header.references]
         batches = call_duplex_batches(
-            ingest_records(
+            duplex_ingest_stream(
                 args.input, reader, stats,
                 ingest_choice=args.ingest, grouping=args.grouping,
-                # passthrough leftovers keep their full tag set only on
-                # the Python record path (native views carry MI/RX)
-                allow_native=not args.passthrough,
-                strip_suffix=True,  # duplex groups by base MI
-                scan_policy="duplex",
+                passthrough=args.passthrough,
             ),
             fasta.fetch,
             names,
@@ -182,6 +184,7 @@ def cmd_duplex(args) -> int:
             refstore=args.reference,  # FASTA path; loaded only if wire engages
             transport=args.transport,
             passthrough=args.passthrough,
+            vote_kernel=args.vote_kernel,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -207,6 +210,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-i", "--input", required=True)
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    p.add_argument(
+        "--indel-policy", choices=("drop", "align"), default="drop",
+        help="indel reads: 'drop' = reference parity "
+        "(tools/1.convert_AG_to_CT.py:79-80), 'align' = recover them via "
+        "the banded intra-family aligner (above-parity)",
+    )
     _add_params(p, min_reads_default=1)
     p.set_defaults(fn=cmd_molecular)
 

@@ -75,9 +75,22 @@ def ingest_records(path: str, reader, stats: StageStats,
 
     if ingest_choice not in ("auto", "native", "python"):
         raise WorkflowError(f"unknown ingest {ingest_choice!r}")
+    if ingest_choice == "native" and not allow_native:
+        # an explicit request the stage cannot honor must fail loudly,
+        # not silently measure the wrong engine
+        raise WorkflowError(
+            "ingest 'native' is incompatible with this stage "
+            "(duplex passthrough needs full-tag Python records)"
+        )
     # 'gather' grouping would pin every columnar batch's buffers for
     # the whole file; only the streaming groupings keep ingest bounded
-    allow_native = allow_native and grouping != "gather"
+    if grouping == "gather":
+        if ingest_choice == "native":
+            raise WorkflowError(
+                "ingest 'native' is incompatible with grouping 'gather' "
+                "(it would pin every columnar batch for the whole file)"
+            )
+        allow_native = False
     use_native = allow_native and (
         ingest_choice == "native"
         or (ingest_choice == "auto" and ingest.available())
@@ -99,6 +112,35 @@ def ingest_records(path: str, reader, stats: StageStats,
             path, strip_suffix=strip_suffix, scan_policy=scan_policy,
         )
     return ingest.columnar_records(path) if use_native else reader
+
+
+def molecular_ingest_stream(path: str, reader, stats: StageStats,
+                            ingest_choice: str = "auto",
+                            grouping: str = "coordinate",
+                            indel_policy: str = "drop"):
+    """The molecular stage's ingest contract, shared by the CLI subcommand
+    and PipelineBuilder: full-MI grouping, C encode digest computed under
+    the stage's indel policy."""
+    return ingest_records(
+        path, reader, stats, ingest_choice=ingest_choice, grouping=grouping,
+        scan_policy=indel_policy,
+    )
+
+
+def duplex_ingest_stream(path: str, reader, stats: StageStats,
+                         ingest_choice: str = "auto",
+                         grouping: str = "coordinate",
+                         passthrough: bool = False):
+    """The duplex stage's ingest contract, shared by the CLI subcommand and
+    PipelineBuilder: strand-suffix-stripped grouping (base MI), the
+    duplex-shaped C scan, and Python records under passthrough (leftovers
+    written through must keep their full tag set; native views carry only
+    MI/RX)."""
+    return ingest_records(
+        path, reader, stats, ingest_choice=ingest_choice, grouping=grouping,
+        allow_native=not passthrough, strip_suffix=True,
+        scan_policy="duplex",
+    )
 
 
 class PipelineBuilder:
@@ -196,17 +238,6 @@ class PipelineBuilder:
             level=self._out_level(rule.outputs[0]),
         )
 
-    def _ingest_records(self, path: str, reader, stats: StageStats,
-                        allow_native: bool = True,
-                        strip_suffix: bool = False,
-                        scan_policy: str | None = None):
-        return ingest_records(
-            path, reader, stats,
-            ingest_choice=self.cfg.ingest, grouping=self.cfg.grouping,
-            allow_native=allow_native, strip_suffix=strip_suffix,
-            scan_policy=scan_policy,
-        )
-
     def _pg(self, header: BamHeader, stage: str) -> BamHeader:
         """@PG provenance line for one stage output (samtools/fgbio both
         append these on every reference step; SURVEY.md §2.2)."""
@@ -223,11 +254,11 @@ class PipelineBuilder:
             header = self._pg(reader.header, "molecular")
             ck = self._checkpointed("molecular", rule, header)
             batches = call_molecular_batches(
-                self._ingest_records(
+                molecular_ingest_stream(
                     rule.inputs[0], reader, stats,
-                    # C-side per-family encode digest rides along with the
-                    # grouped stream (ops.encode native fill path)
-                    scan_policy=self.cfg.indel_policy,
+                    ingest_choice=self.cfg.ingest,
+                    grouping=self.cfg.grouping,
+                    indel_policy=self.cfg.indel_policy,
                 ),
                 params=self.cfg.molecular,
                 mode=mode,
@@ -251,13 +282,11 @@ class PipelineBuilder:
             header = self._pg(reader.header, "duplex")
             ck = self._checkpointed("duplex", rule, header)
             batches = call_duplex_batches(
-                self._ingest_records(
+                duplex_ingest_stream(
                     rule.inputs[0], reader, stats,
-                    # leftovers written through must keep their full tag
-                    # set; native views carry only MI/RX
-                    allow_native=not self.cfg.duplex_passthrough,
-                    strip_suffix=True,  # duplex groups by base MI
-                    scan_policy="duplex",
+                    ingest_choice=self.cfg.ingest,
+                    grouping=self.cfg.grouping,
+                    passthrough=self.cfg.duplex_passthrough,
                 ),
                 fasta.fetch,
                 names,

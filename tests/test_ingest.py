@@ -104,13 +104,14 @@ class TestColumnarIngest:
         assert nat > py * 0.9, (py, nat)
 
     def test_pipeline_ingest_knob(self, ingest_bam, tmp_path):
-        from bsseqconsensusreads_tpu.config import FrameworkConfig
-        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+        from bsseqconsensusreads_tpu.pipeline.stages import ingest_records
+        from bsseqconsensusreads_tpu.pipeline.workflow import WorkflowError
 
-        cfg = FrameworkConfig(ingest="native", grouping="coordinate")
-        b = PipelineBuilder(cfg, ingest_bam["path"], str(tmp_path))
         stats = StageStats()
-        src = b._ingest_records(ingest_bam["path"], None, stats)
+        src = ingest_records(
+            ingest_bam["path"], None, stats,
+            ingest_choice="native", grouping="coordinate",
+        )
         # coordinate + native -> the C-side pre-grouped stream
         assert isinstance(src, ingest.GroupedColumnarStream)
         mi, recs = next(src.iter_groups())
@@ -123,17 +124,34 @@ class TestColumnarIngest:
         _os.environ["BSSEQ_TPU_NATIVE_GROUPING"] = "0"
         try:
             stats15 = StageStats()
-            src15 = b._ingest_records(ingest_bam["path"], None, stats15)
+            src15 = ingest_records(
+                ingest_bam["path"], None, stats15,
+                ingest_choice="native", grouping="coordinate",
+            )
             assert isinstance(next(iter(src15)), ingest.ColumnarRecordView)
             assert stats15.metrics.counters["group_native"] == 0
         finally:
             del _os.environ["BSSEQ_TPU_NATIVE_GROUPING"]
-        # gather grouping forces the python reader (buffer pinning)
-        cfg2 = FrameworkConfig(ingest="native", grouping="gather")
-        b2 = PipelineBuilder(cfg2, ingest_bam["path"], str(tmp_path))
+        # explicit native + gather grouping is refused loudly (silent
+        # engine downgrades hide what a benchmark actually measured)
+        with pytest.raises(WorkflowError, match="gather"):
+            ingest_records(
+                ingest_bam["path"], None, StageStats(),
+                ingest_choice="native", grouping="gather",
+            )
+        # ... as is explicit native when the stage disallows it
+        with pytest.raises(WorkflowError, match="passthrough"):
+            ingest_records(
+                ingest_bam["path"], None, StageStats(),
+                ingest_choice="native", allow_native=False,
+            )
+        # auto + gather falls back to the python reader (buffer pinning)
         stats2 = StageStats()
         with BamReader(ingest_bam["path"]) as r:
-            src2 = b2._ingest_records(ingest_bam["path"], r, stats2)
+            src2 = ingest_records(
+                ingest_bam["path"], r, stats2,
+                ingest_choice="auto", grouping="gather",
+            )
             assert src2 is r
         assert stats2.metrics.counters["ingest_native"] == 0
 
