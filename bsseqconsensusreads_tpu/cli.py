@@ -193,6 +193,86 @@ def cmd_duplex(args) -> int:
     return 0
 
 
+def cmd_sort(args) -> int:
+    """`fgbio SortBam` / `samtools sort` equivalent (main.snake.py:106,152):
+    external-merge sort in bounded memory, order from --order."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.pipeline.extsort import sorted_write
+    from bsseqconsensusreads_tpu.pipeline.record_ops import (
+        coordinate_key,
+        name_key,
+        template_coordinate_key,
+    )
+
+    key, so, ss = {
+        "coordinate": (coordinate_key, "coordinate", None),
+        "name": (name_key, "queryname", None),
+        # fgbio SortBam -s TemplateCoordinate declares the sub-sort
+        "template-coordinate": (
+            template_coordinate_key, "unsorted", "template-coordinate"
+        ),
+    }[args.order]
+    with BamReader(args.input) as reader:
+        header = reader.header.with_sort_order(so, ss)
+        n = sorted_write(reader, key, args.output, header)
+    print(json.dumps({"records": n, "order": args.order}), file=sys.stderr)
+    return 0
+
+
+def cmd_zipper(args) -> int:
+    """`fgbio ZipperBams --unmapped UNALIGNED --sort Coordinate` equivalent
+    (main.snake.py:106): graft consensus tags from the unaligned BAM onto
+    the aligned records, coordinate-sorted, bounded memory."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.pipeline.record_ops import zipper_bams_stream
+
+    with BamReader(args.input) as aligned, BamReader(args.unmapped) as unaligned:
+        n = 0
+        header = aligned.header.with_sort_order("coordinate")
+        with BamWriter(args.output, header) as w:
+            for rec in zipper_bams_stream(aligned, unaligned, header):
+                w.write(rec)
+                n += 1
+    print(json.dumps({"records": n}), file=sys.stderr)
+    return 0
+
+
+def cmd_sam_to_fastq(args) -> int:
+    """`picard SamToFastq` equivalent (main.snake.py:67,176): paired
+    gzipped FASTQs with in-step pairing. Records stream through the
+    external name sort first, so mates are adjacent and the pairing
+    buffer stays O(1) even on coordinate-sorted input (where mates can be
+    megabases apart — an unsorted pairing dict would hold half the
+    file)."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.io.fastq import sam_to_fastq
+    from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
+    from bsseqconsensusreads_tpu.pipeline.record_ops import name_key
+
+    with BamReader(args.input) as reader:
+        n1, n2 = sam_to_fastq(
+            external_sort(reader, name_key, reader.header),
+            args.fq1, args.fq2,
+        )
+    print(json.dumps({"r1": n1, "r2": n2}), file=sys.stderr)
+    return 0
+
+
+def cmd_filter_mapped(args) -> int:
+    """`samtools view -h -b -F 4` equivalent (main.snake.py:118)."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.pipeline.record_ops import filter_mapped
+
+    with BamReader(args.input) as reader:
+        n = 0
+        with BamWriter(args.output, reader.header) as w:
+            for rec in filter_mapped(reader):
+                w.write(rec)
+                n += 1
+    print(json.dumps({"records": n}), file=sys.stderr)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="bsseqconsensusreads_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -232,6 +312,41 @@ def main(argv: list[str] | None = None) -> int:
     )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)
+
+    p = sub.add_parser(
+        "sort", help="SortBam equivalent (external-merge, bounded memory)"
+    )
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "--order",
+        choices=("coordinate", "name", "template-coordinate"),
+        default="coordinate",
+        help="template-coordinate = fgbio SortBam -s TemplateCoordinate "
+        "(main.snake.py:152); name = samtools sort -n",
+    )
+    p.set_defaults(fn=cmd_sort)
+
+    p = sub.add_parser(
+        "zipper", help="ZipperBams equivalent (tag graft + coordinate sort)"
+    )
+    p.add_argument("-i", "--input", required=True, help="aligned BAM")
+    p.add_argument("--unmapped", required=True, help="unaligned BAM with tags")
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_zipper)
+
+    p = sub.add_parser("sam-to-fastq", help="SamToFastq equivalent")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("--fq1", required=True)
+    p.add_argument("--fq2", required=True)
+    p.set_defaults(fn=cmd_sam_to_fastq)
+
+    p = sub.add_parser(
+        "filter-mapped", help="samtools view -F 4 equivalent"
+    )
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.set_defaults(fn=cmd_filter_mapped)
 
     args = ap.parse_args(argv)
     return args.fn(args)

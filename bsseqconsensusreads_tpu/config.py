@@ -57,9 +57,12 @@ class FrameworkConfig:
     #: consensus-stage record ingest: 'native' streams flat columnar arrays
     #: from the C++ decoder (pipeline.ingest — skips per-record Python
     #: object construction on the hot path), 'python' uses the pure-Python
-    #: BamReader, 'auto' picks native when the library is built. The duplex
-    #: stage falls back to python ingest under duplex_passthrough (native
-    #: views carry only MI/RX, not the full tag set leftovers must keep).
+    #: BamReader, 'auto' picks native when the library is built. Under
+    #: 'auto' the duplex stage falls back to python ingest when
+    #: duplex_passthrough is set (native views carry only MI/RX, not the
+    #: full tag set leftovers must keep) and grouping='gather' forces the
+    #: python reader; an EXPLICIT 'native' in those configurations raises
+    #: instead of silently measuring the wrong engine.
     ingest: str = "auto"
     #: consensus-stage record emission: 'native' serializes whole kernel
     #: batches to BAM bytes in C++ (io.wirepack.emit_consensus_records —

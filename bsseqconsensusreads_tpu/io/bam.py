@@ -72,6 +72,37 @@ class BamHeader:
     def copy(self) -> "BamHeader":
         return BamHeader(self.text, list(self.references))
 
+    def with_sort_order(self, so: str, ss: str | None = None) -> "BamHeader":
+        """A copy whose @HD line declares SO:`so` (and optionally a
+        SS:`ss` sub-sort, the convention fgbio's TemplateCoordinate sort
+        uses) — samtools sort / fgbio SortBam rewrite this on every sort,
+        and downstream validators trust it. Other @HD fields survive; a
+        stale SS from a previous sort is dropped unless replaced."""
+        lines = self.text.splitlines()
+        out = []
+        replaced = False
+        for line in lines:
+            if line.startswith("@HD"):
+                fields = [
+                    f for f in line.split("\t")[1:]
+                    if not f.startswith(("SO:", "SS:"))
+                ]
+                hd = "\t".join(["@HD", *fields, f"SO:{so}"])
+                if ss:
+                    hd += f"\tSS:{ss}"
+                out.append(hd)
+                replaced = True
+            else:
+                out.append(line)
+        if not replaced:
+            hd = f"@HD\tVN:1.6\tSO:{so}"
+            if ss:
+                hd += f"\tSS:{ss}"
+            out.insert(0, hd)
+        return BamHeader(
+            "\n".join(out) + ("\n" if out else ""), list(self.references)
+        )
+
     def with_pg(
         self,
         program: str,

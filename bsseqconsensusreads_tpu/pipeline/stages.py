@@ -61,7 +61,8 @@ def ingest_records(path: str, reader, stats: StageStats,
                    grouping: str = "coordinate",
                    allow_native: bool = True,
                    strip_suffix: bool = False,
-                   scan_policy: str | None = None):
+                   scan_policy: str | None = None,
+                   native_block_reason: str = "this stage disallows it"):
     """Record stream for a consensus stage: the native columnar decoder
     (pipeline.ingest) when configured+built, else the BamReader. With
     grouping='coordinate' the native path also pre-groups families in
@@ -75,13 +76,6 @@ def ingest_records(path: str, reader, stats: StageStats,
 
     if ingest_choice not in ("auto", "native", "python"):
         raise WorkflowError(f"unknown ingest {ingest_choice!r}")
-    if ingest_choice == "native" and not allow_native:
-        # an explicit request the stage cannot honor must fail loudly,
-        # not silently measure the wrong engine
-        raise WorkflowError(
-            "ingest 'native' is incompatible with this stage "
-            "(duplex passthrough needs full-tag Python records)"
-        )
     # 'gather' grouping would pin every columnar batch's buffers for
     # the whole file; only the streaming groupings keep ingest bounded
     if grouping == "gather":
@@ -91,6 +85,12 @@ def ingest_records(path: str, reader, stats: StageStats,
                 "(it would pin every columnar batch for the whole file)"
             )
         allow_native = False
+    if ingest_choice == "native" and not allow_native:
+        # an explicit request the stage cannot honor must fail loudly,
+        # not silently measure the wrong engine
+        raise WorkflowError(
+            f"ingest 'native' is incompatible here: {native_block_reason}"
+        )
     use_native = allow_native and (
         ingest_choice == "native"
         or (ingest_choice == "auto" and ingest.available())
@@ -140,6 +140,10 @@ def duplex_ingest_stream(path: str, reader, stats: StageStats,
         path, reader, stats, ingest_choice=ingest_choice, grouping=grouping,
         allow_native=not passthrough, strip_suffix=True,
         scan_policy="duplex",
+        native_block_reason=(
+            "duplex passthrough needs full-tag Python records "
+            "(native views carry only MI/RX)"
+        ),
     )
 
 

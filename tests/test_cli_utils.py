@@ -1,0 +1,163 @@
+"""Standalone utility subcommands (sort / zipper / sam-to-fastq /
+filter-mapped): the reference invokes fgbio SortBam, fgbio ZipperBams,
+Picard SamToFastq, and samtools view -F 4 as separate tools
+(main.snake.py:67,106,118,152); these CLIs are their drop-in equivalents
+over the framework's record ops."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.cli import main as cli_main
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    CMATCH,
+    FUNMAP,
+)
+from bsseqconsensusreads_tpu.pipeline.record_ops import (
+    coordinate_key,
+    name_key,
+    template_coordinate_key,
+    zipper_bams_stream,
+)
+
+
+@pytest.fixture()
+def scrambled_bam(tmp_path):
+    rng = np.random.default_rng(9)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:unsorted\n", [("chrA", 5000), ("chrB", 5000)]
+    )
+    records = []
+    for i in range(40):
+        flag = 99 if i % 2 == 0 else 147
+        rec = BamRecord(
+            qname=f"q{i % 13}", flag=flag, ref_id=int(rng.integers(0, 2)),
+            pos=int(rng.integers(0, 4000)), mapq=60,
+            cigar=[(CMATCH, 20)], next_ref_id=0, next_pos=0,
+            seq="A" * 20, qual=bytes([30] * 20),
+        )
+        rec.set_tag("MI", str(i % 7), "Z")
+        records.append(rec)
+    records.append(BamRecord(  # one unmapped record for filter-mapped
+        qname="un", flag=FUNMAP, ref_id=-1, pos=-1, mapq=0, cigar=[],
+        next_ref_id=-1, next_pos=-1, seq="A" * 10, qual=bytes([30] * 10),
+    ))
+    path = str(tmp_path / "scrambled.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    return path, records
+
+
+@pytest.mark.parametrize(
+    "order,key",
+    [
+        ("coordinate", coordinate_key),
+        ("name", name_key),
+        ("template-coordinate", template_coordinate_key),
+    ],
+)
+def test_sort_orders(scrambled_bam, tmp_path, order, key):
+    path, records = scrambled_bam
+    out = str(tmp_path / f"sorted_{order}.bam")
+    assert cli_main(["sort", "-i", path, "-o", out, "--order", order]) == 0
+    with BamReader(out) as r:
+        got = list(r)
+        hd = next(
+            ln for ln in r.header.text.splitlines() if ln.startswith("@HD")
+        )
+    assert len(got) == len(records)
+    keys = [key(rec) for rec in got]
+    assert keys == sorted(keys)
+    # the @HD SO line is rewritten like samtools sort / fgbio SortBam do
+    want_so = {
+        "coordinate": "SO:coordinate",
+        "name": "SO:queryname",
+        "template-coordinate": "SO:unsorted\tSS:template-coordinate",
+    }[order]
+    assert want_so in hd, hd
+
+
+def test_filter_mapped(scrambled_bam, tmp_path):
+    path, records = scrambled_bam
+    out = str(tmp_path / "mapped.bam")
+    assert cli_main(["filter-mapped", "-i", path, "-o", out]) == 0
+    with BamReader(out) as r:
+        got = list(r)
+    assert len(got) == len(records) - 1
+    assert all(not rec.flag & FUNMAP for rec in got)
+
+
+def test_sam_to_fastq(tmp_path):
+    header = BamHeader("@HD\tVN:1.6\tSO:unsorted\n", [("chrA", 1000)])
+    records = []
+    for i in range(6):
+        for flag in (99, 147):
+            records.append(BamRecord(
+                qname=f"t{i}", flag=flag, ref_id=0, pos=100 + i, mapq=60,
+                cigar=[(CMATCH, 12)], next_ref_id=0, next_pos=100,
+                seq="ACGTACGTACGT", qual=bytes(range(30, 42)),
+            ))
+    path = str(tmp_path / "pairs.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    fq1, fq2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+    assert cli_main(
+        ["sam-to-fastq", "-i", path, "--fq1", fq1, "--fq2", fq2]
+    ) == 0
+    lines1 = gzip.open(fq1, "rt").read().splitlines()
+    lines2 = gzip.open(fq2, "rt").read().splitlines()
+    assert len(lines1) == len(lines2) == 6 * 4
+    # in-step pairing: same template at the same offset in both files
+    # (names carry the /1 and /2 mate suffixes)
+    assert [ln[1:].rsplit("/", 1)[0] for ln in lines1[::4]] == [
+        ln[1:].rsplit("/", 1)[0] for ln in lines2[::4]
+    ]
+    assert all(ln.endswith("/1") for ln in lines1[::4])
+    assert all(ln.endswith("/2") for ln in lines2[::4])
+
+
+def test_zipper_matches_library(tmp_path):
+    rng = np.random.default_rng(4)
+    header = BamHeader("@HD\tVN:1.6\tSO:unsorted\n", [("chrA", 5000)])
+    aligned, unaligned = [], []
+    for i in range(10):
+        for flag_a, flag_u in ((99, 77), (147, 141)):
+            seq = "".join("ACGT"[b] for b in rng.integers(0, 4, size=20))
+            aligned.append(BamRecord(
+                qname=f"z{i}", flag=flag_a, ref_id=0,
+                pos=100 + 37 * i, mapq=60, cigar=[(CMATCH, 20)],
+                next_ref_id=0, next_pos=100, seq=seq,
+                qual=bytes([30] * 20),
+            ))
+            un = BamRecord(
+                qname=f"z{i}", flag=flag_u, ref_id=-1, pos=-1, mapq=0,
+                cigar=[], next_ref_id=-1, next_pos=-1, seq=seq,
+                qual=bytes([30] * 20),
+            )
+            un.set_tag("MI", str(i), "Z")
+            un.set_tag("RX", "AC-GT", "Z")
+            unaligned.append(un)
+    pa = str(tmp_path / "aligned.bam")
+    pu = str(tmp_path / "unaligned.bam")
+    with BamWriter(pa, header) as w:
+        w.write_all(aligned)
+    with BamWriter(pu, header) as w:
+        w.write_all(unaligned)
+    out = str(tmp_path / "zipped.bam")
+    assert cli_main(
+        ["zipper", "-i", pa, "--unmapped", pu, "-o", out]
+    ) == 0
+    with BamReader(out) as r:
+        got = [(rec.qname, rec.flag, dict(rec.tags)) for rec in r]
+        assert "SO:coordinate" in r.header.text
+    want = [
+        (rec.qname, rec.flag, dict(rec.tags))
+        for rec in zipper_bams_stream(iter(aligned), iter(unaligned), header)
+    ]
+    assert got == want and len(got) == 20
+    assert all("MI" in tags and "RX" in tags for _, _, tags in got)

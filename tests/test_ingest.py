@@ -139,11 +139,16 @@ class TestColumnarIngest:
                 ingest_bam["path"], None, StageStats(),
                 ingest_choice="native", grouping="gather",
             )
-        # ... as is explicit native when the stage disallows it
+        # ... as is explicit native when the stage disallows it (the
+        # duplex wrapper names the reason)
+        from bsseqconsensusreads_tpu.pipeline.stages import (
+            duplex_ingest_stream,
+        )
+
         with pytest.raises(WorkflowError, match="passthrough"):
-            ingest_records(
+            duplex_ingest_stream(
                 ingest_bam["path"], None, StageStats(),
-                ingest_choice="native", allow_native=False,
+                ingest_choice="native", passthrough=True,
             )
         # auto + gather falls back to the python reader (buffer pinning)
         stats2 = StageStats()
