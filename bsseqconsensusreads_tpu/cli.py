@@ -219,6 +219,33 @@ def cmd_sort(args) -> int:
     return 0
 
 
+def cmd_group(args) -> int:
+    """`fgbio GroupReadsByUmi` equivalent (the step producing the
+    reference's input contract, README.md:51-55): assign MI molecule ids
+    from RX UMIs, /A|/B duplex strand suffixes under -s paired, bounded
+    memory via two external passes."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.pipeline.group_umi import (
+        GroupStats,
+        group_reads_by_umi,
+        grouped_header,
+    )
+
+    stats = GroupStats()
+    with BamReader(args.input) as reader:
+        header = grouped_header(reader.header)
+        with BamWriter(args.output, header) as w:
+            for rec in group_reads_by_umi(
+                reader, reader.header,
+                strategy=args.strategy, edits=args.edits,
+                raw_tag=args.raw_tag, min_map_q=args.min_map_q,
+                stats=stats,
+            ):
+                w.write(rec)
+    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    return 0
+
+
 def cmd_zipper(args) -> int:
     """`fgbio ZipperBams --unmapped UNALIGNED --sort Coordinate` equivalent
     (main.snake.py:106): graft consensus tags from the unaligned BAM onto
@@ -326,6 +353,24 @@ def main(argv: list[str] | None = None) -> int:
         "(main.snake.py:152); name = samtools sort -n",
     )
     p.set_defaults(fn=cmd_sort)
+
+    p = sub.add_parser(
+        "group", help="GroupReadsByUmi equivalent (RX -> MI, duplex /A|/B)"
+    )
+    p.add_argument("-i", "--input", required=True, help="aligned BAM with RX tags")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "-s", "--strategy",
+        choices=("identity", "edit", "adjacency", "paired"),
+        default="paired",
+        help="paired = duplex: strand-canonicalized UMI pairs, MI gets "
+        "/A|/B suffixes (the reference's input contract, README.md:51-55)",
+    )
+    p.add_argument("-e", "--edits", type=int, default=1,
+                   help="max UMI mismatches merged within a position group")
+    p.add_argument("-t", "--raw-tag", default="RX")
+    p.add_argument("-m", "--min-map-q", type=int, default=1)
+    p.set_defaults(fn=cmd_group)
 
     p = sub.add_parser(
         "zipper", help="ZipperBams equivalent (tag graft + coordinate sort)"

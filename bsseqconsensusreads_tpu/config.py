@@ -91,6 +91,22 @@ class FrameworkConfig:
     #: the CPU backend there is no transfer to save, and the default
     #: sharded path shards unpacked tensors).
     transport: str = "auto"
+    #: UMI grouping pre-stage (fgbio GroupReadsByUmi equivalent,
+    #: pipeline.group_umi) — the step the reference requires its USER to
+    #: have run (README.md:7,51-55). 'auto' probes the input's first
+    #: records (up to 50) and prepends the stage when they carry raw-UMI
+    #: tags but no MI; 'always' / 'never' force it. The
+    #: molecular stage then streams the MI-adjacent grouped output in
+    #: O(1-family) memory.
+    group_umis: str = "auto"
+    #: GroupReadsByUmi knobs: strategy (identity|edit|adjacency|paired),
+    #: max UMI mismatches merged within a position group, and the minimum
+    #: MAPQ a template needs to be grouped.
+    group_strategy: str = "paired"
+    group_edits: int = 1
+    group_min_map_q: int = 1
+    #: tag holding the raw UMI (fgbio --raw-tag; also what 'auto' probes).
+    group_raw_tag: str = "RX"
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)
     #: through to the output the way the reference chain would

@@ -1,0 +1,402 @@
+"""Streaming UMI grouping — fgbio GroupReadsByUmi equivalent.
+
+The reference pipeline *assumes* its input BAM was produced by
+`fgbio GroupReadsByUmi -s Paired` (reference README.md:7,51-55: RX = raw
+UMI pair, MI = molecule id with an /A or /B strand suffix). That step is
+the last fgbio capability a user of this framework would still need the
+JVM for; this module removes it, so the framework covers the whole path
+from a raw aligned duplex BAM to unfiltered duplex consensus.
+
+Semantics are built from fgbio's published strategy descriptions (the
+tool help for GroupReadsByUmi) and the umi_tools "directional adjacency"
+method its Adjacency/Paired strategies derive from — not from fgbio
+source code:
+
+* Templates are grouped by the unclipped 5' positions + strands of both
+  ends (both strands of one duplex molecule share that key: the A-strand
+  template 99/147 and B-strand template 83/163 cover the same fragment).
+* Within a position group, raw UMIs cluster by at most `edits`
+  mismatches.  `identity` = exact match; `edit` = connected components;
+  `adjacency` = count-directional absorption (a lower-count UMI joins a
+  higher-count neighbor when count(parent) >= 2*count(child) - 1,
+  chained breadth-first from the most-observed UMI);
+  `paired` = adjacency over *strand-canonicalized* duplex pairs.
+* `paired` canonical form: a template whose R1 maps to the forward
+  strand (the 99/147 orientation) reads its RX `a-b` as-is; the
+  opposite orientation (83/163) observed `b-a` off the other physical
+  strand, so its halves swap before clustering.  Members keep their
+  orientation as the MI suffix: /A for the forward-R1 orientation, /B
+  for the reverse — deterministic, and symmetric downstream (the duplex
+  caller treats the strands identically; fgbio documents the A/B
+  labels as arbitrary strand designations).
+
+Like every sort-shaped stage here, the implementation is two bounded-
+memory external passes (pipeline.extsort) instead of fgbio's in-heap
+grouping: a queryname pass to see both ends of each template, then a
+position-key pass that streams one position bucket at a time.  Host RAM
+is O(buffer + largest position bucket), never O(file).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecord,
+    CHARD_CLIP,
+    CSOFT_CLIP,
+)
+from bsseqconsensusreads_tpu.pipeline.extsort import (
+    DEFAULT_BUFFER_RECORDS,
+    external_sort,
+)
+from bsseqconsensusreads_tpu.pipeline.record_ops import name_key
+
+STRATEGIES = ("identity", "edit", "adjacency", "paired")
+
+#: temp tags carrying template metadata between the two external passes
+#: (they ride the spill shards; lowercase second letter = local use per
+#: the SAM spec, stripped before records are emitted).
+_TAG_POSKEY = "zP"
+_TAG_UMI = "zU"
+_TAG_STRAND = "zS"
+
+
+@dataclass
+class GroupStats:
+    """Counters for one grouping run (surfaced by the CLI / stage)."""
+
+    records_in: int = 0
+    templates: int = 0
+    accepted: int = 0
+    dropped_secondary: int = 0
+    dropped_unmapped: int = 0
+    dropped_mapq: int = 0
+    dropped_no_umi: int = 0
+    dropped_unpaired: int = 0
+    molecules: int = 0
+    position_groups: int = 0
+
+    def as_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+# ---- template geometry ----------------------------------------------------
+
+
+def _clips(cigar: list[tuple[int, int]]) -> tuple[int, int]:
+    """(leading, trailing) soft+hard clipped bases of a CIGAR."""
+    lead = trail = 0
+    for op, ln in cigar:
+        if op in (CSOFT_CLIP, CHARD_CLIP):
+            lead += ln
+        else:
+            break
+    for op, ln in reversed(cigar):
+        if op in (CSOFT_CLIP, CHARD_CLIP):
+            trail += ln
+        else:
+            break
+    return lead, trail
+
+
+def unclipped_end5(rec: BamRecord) -> int:
+    """Unclipped 5'-most reference position of a mapped record: the
+    coordinate the first sequenced base would occupy had the aligner not
+    clipped it.  Clip-invariant, so both strands of a duplex molecule
+    agree on it even when their softclips differ."""
+    lead, trail = _clips(rec.cigar)
+    if rec.is_reverse:
+        return rec.reference_end - 1 + trail
+    return rec.pos - lead
+
+
+def _end_key(rec: BamRecord) -> tuple[int, int, int]:
+    return (rec.ref_id, unclipped_end5(rec), int(rec.is_reverse))
+
+
+def _position_key(reads: list[BamRecord]) -> str:
+    """Orientation-normalized both-end key, packed as a fixed-width string
+    (so it string-sorts in genomic order through the raw spill shards).
+    Single-end templates use a sentinel upper end."""
+    ends = sorted(_end_key(r) for r in reads)
+    if len(ends) == 1:
+        ends.append((0x7FFFFFF, 0, 0))
+    return "".join(
+        f"{ref + 1:07x}{pos + 4096:09x}{rev:d}" for ref, pos, rev in ends
+    )
+
+
+def _is_top_strand(reads: list[BamRecord]) -> bool:
+    """/A vs /B orientation: a template is top-strand when its R1 maps
+    forward (the 99/147 duplex orientation; 83/163 is the bottom strand).
+    Deterministic for every input; for the FR duplex libraries this
+    pipeline targets it matches the physical strand of the source
+    molecule."""
+    for r in reads:
+        if r.is_read1:
+            return not r.is_reverse
+    return not reads[0].is_reverse  # fragment read / R1 missing
+
+
+# ---- UMI clustering -------------------------------------------------------
+
+
+class _UmiIndex:
+    """Mismatch-neighbor queries over one position bucket's distinct UMIs,
+    vectorized per length class (one uint8 matrix compare per query
+    instead of a Python loop — deep buckets in high-duplication libraries
+    hold hundreds of distinct UMIs)."""
+
+    def __init__(self, umis: list[str]):
+        self.by_len: dict[int, tuple[list[str], np.ndarray]] = {}
+        for ln in {len(u) for u in umis}:
+            same = [u for u in umis if len(u) == ln]
+            mat = np.frombuffer(
+                "".join(same).encode("ascii"), dtype=np.uint8
+            ).reshape(len(same), ln)
+            self.by_len[ln] = (same, mat)
+
+    def neighbors(self, umi: str, edits: int) -> list[str]:
+        entry = self.by_len.get(len(umi))
+        if entry is None:
+            return []
+        same, mat = entry
+        q = np.frombuffer(umi.encode("ascii"), dtype=np.uint8)
+        dist = (mat != q).sum(axis=1)
+        return [same[i] for i in np.nonzero(dist <= edits)[0]]
+
+
+def cluster_umis(
+    counts: dict[str, int], strategy: str, edits: int
+) -> dict[str, str]:
+    """Map each UMI to its cluster root.  Roots are visited most-observed
+    first (ties lexicographic), so molecule numbering is deterministic."""
+    if strategy == "identity" or edits == 0:
+        return {u: u for u in counts}
+    order = sorted(counts, key=lambda u: (-counts[u], u))
+    index = _UmiIndex(order)
+    assigned: dict[str, str] = {}
+    directional = strategy in ("adjacency", "paired")
+    for root in order:
+        if root in assigned:
+            continue
+        assigned[root] = root
+        frontier = [root]
+        while frontier:
+            parent = frontier.pop()
+            for cand in index.neighbors(parent, edits):
+                if cand in assigned:
+                    continue
+                if directional and counts[parent] < 2 * counts[cand] - 1:
+                    continue
+                assigned[cand] = root
+                frontier.append(cand)
+    return assigned
+
+
+# ---- the two-pass streaming grouper ---------------------------------------
+
+
+def _iter_templates(
+    records: Iterable[BamRecord],
+) -> Iterator[list[BamRecord]]:
+    """Group a queryname-sorted stream into per-template record lists."""
+    bucket: list[BamRecord] = []
+    for rec in records:
+        if bucket and rec.qname != bucket[0].qname:
+            yield bucket
+            bucket = []
+        bucket.append(rec)
+    if bucket:
+        yield bucket
+
+
+def _annotate_templates(
+    records: Iterable[BamRecord],
+    header: BamHeader,
+    strategy: str,
+    raw_tag: str,
+    min_map_q: int,
+    stats: GroupStats,
+    workdir: str | None,
+    buffer_records: int,
+) -> Iterator[BamRecord]:
+    """Pass 1: queryname external sort, then stamp every accepted
+    template's records with its position key, canonical UMI, and strand
+    (temp tags), applying fgbio's input filters."""
+
+    def counted(src: Iterable[BamRecord]) -> Iterator[BamRecord]:
+        for rec in src:
+            stats.records_in += 1
+            yield rec
+
+    name_sorted = external_sort(
+        counted(records), name_key, header,
+        workdir=workdir, buffer_records=buffer_records,
+    )
+    for template in _iter_templates(name_sorted):
+        stats.templates += 1
+        primaries = []
+        for rec in template:
+            if rec.is_secondary or rec.is_supplementary:
+                stats.dropped_secondary += 1
+            else:
+                primaries.append(rec)
+        if not primaries:
+            continue
+        if any(r.is_unmapped for r in primaries):
+            stats.dropped_unmapped += 1
+            continue
+        if any(r.mapq < min_map_q for r in primaries):
+            stats.dropped_mapq += 1
+            continue
+        if strategy == "paired" and len(primaries) != 2:
+            stats.dropped_unpaired += 1
+            continue
+        umis = {
+            str(r.get_tag(raw_tag)) for r in primaries if r.has_tag(raw_tag)
+        }
+        if len(umis) > 1:  # fgbio errors on R1/R2 UMI disagreement too
+            raise ValueError(
+                f"inconsistent {raw_tag} tags within template "
+                f"{primaries[0].qname}: {sorted(umis)}"
+            )
+        rx = umis.pop() if umis else None
+        if not rx:
+            stats.dropped_no_umi += 1
+            continue
+        if strategy == "paired":
+            halves = str(rx).split("-")
+            if len(halves) != 2:
+                raise ValueError(
+                    f"paired strategy needs duplex UMIs 'a-b'; "
+                    f"{primaries[0].qname} has {raw_tag}={rx!r}"
+                )
+            top = _is_top_strand(primaries)
+            a, b = halves if top else halves[::-1]
+            canonical = f"{a}-{b}"
+            strand = "A" if top else "B"
+        else:
+            canonical = str(rx)
+            strand = "A"
+        poskey = _position_key(primaries)
+        stats.accepted += 1
+        for rec in primaries:
+            rec.set_tag(_TAG_POSKEY, poskey, "Z")
+            rec.set_tag(_TAG_UMI, canonical, "Z")
+            rec.set_tag(_TAG_STRAND, strand, "A")
+            yield rec
+
+
+def _poskey_sort_key(rec: BamRecord) -> tuple:
+    return (
+        rec.get_tag(_TAG_POSKEY),
+        rec.get_tag(_TAG_UMI),
+        rec.qname,
+        rec.flag,
+    )
+
+
+def _emit_bucket(
+    bucket: dict[str, tuple[str, str, list[BamRecord]]],
+    strategy: str,
+    edits: int,
+    next_mi: int,
+    stats: GroupStats,
+) -> tuple[list[BamRecord], int]:
+    """Cluster one position bucket's templates and emit them MI-grouped:
+    molecules in root order, /A templates before /B, reads name-ordered
+    within a template."""
+    stats.position_groups += 1
+    counts: dict[str, int] = {}
+    for umi, _strand, _reads in bucket.values():
+        counts[umi] = counts.get(umi, 0) + 1
+    roots = cluster_umis(counts, strategy, edits)
+    root_order = sorted(
+        set(roots.values()), key=lambda u: (-counts[u], u)
+    )
+    mi_of = {}
+    for root in root_order:
+        mi_of[root] = next_mi
+        next_mi += 1
+    stats.molecules += len(root_order)
+
+    def sort_key(item):
+        umi, strand, reads = item
+        return (mi_of[roots[umi]], strand, name_key(reads[0]))
+
+    out: list[BamRecord] = []
+    for umi, strand, reads in sorted(bucket.values(), key=sort_key):
+        mi = str(mi_of[roots[umi]])
+        if strategy == "paired":
+            mi = f"{mi}/{strand}"
+        for rec in sorted(reads, key=name_key):
+            del rec.tags[_TAG_POSKEY]
+            del rec.tags[_TAG_UMI]
+            del rec.tags[_TAG_STRAND]
+            rec.set_tag("MI", mi, "Z")
+            out.append(rec)
+    return out, next_mi
+
+
+def group_reads_by_umi(
+    records: Iterable[BamRecord],
+    header: BamHeader,
+    strategy: str = "paired",
+    edits: int = 1,
+    raw_tag: str = "RX",
+    min_map_q: int = 1,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+    stats: GroupStats | None = None,
+) -> Iterator[BamRecord]:
+    """Stream `records` (any order) back out MI-grouped — the fgbio
+    GroupReadsByUmi equivalent (reference README.md:51-55 input contract).
+    Output records carry MI = sequential molecule id (with /A|/B strand
+    suffixes under the paired strategy), grouped molecule-contiguously in
+    genomic position order.  Bounded host memory at any input size."""
+    if strategy not in STRATEGIES:
+        raise ValueError(f"unknown strategy {strategy!r}; one of {STRATEGIES}")
+    if edits < 0:
+        raise ValueError(f"edits must be >= 0, got {edits}")
+    stats = stats if stats is not None else GroupStats()
+
+    annotated = _annotate_templates(
+        records, header, strategy, raw_tag, min_map_q, stats,
+        workdir, buffer_records,
+    )
+    by_position = external_sort(
+        annotated, _poskey_sort_key, header,
+        workdir=workdir, buffer_records=buffer_records,
+    )
+
+    next_mi = 0
+    bucket: dict[str, tuple[str, str, list[BamRecord]]] = {}
+    bucket_poskey: str | None = None
+    for rec in by_position:
+        poskey = rec.get_tag(_TAG_POSKEY)
+        if bucket_poskey is not None and poskey != bucket_poskey:
+            out, next_mi = _emit_bucket(bucket, strategy, edits, next_mi, stats)
+            yield from out
+            bucket = {}
+        bucket_poskey = poskey
+        entry = bucket.get(rec.qname)
+        if entry is None:
+            bucket[rec.qname] = (rec.get_tag(_TAG_UMI), rec.get_tag(_TAG_STRAND), [rec])
+        else:
+            entry[2].append(rec)
+    if bucket:
+        out, _ = _emit_bucket(bucket, strategy, edits, next_mi, stats)
+        yield from out
+
+
+def grouped_header(header: BamHeader) -> BamHeader:
+    """Output header: grouping invalidates any coordinate sort; records
+    leave template-grouped (the property fgbio's downstream consumers —
+    and this framework's molecular stage — rely on)."""
+    return header.with_sort_order("unsorted", "unsorted:umi-group")

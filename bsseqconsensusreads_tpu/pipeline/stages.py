@@ -155,8 +155,15 @@ class PipelineBuilder:
         self.bam_path = bam_path
         self.sample = sample_name(bam_path)
         self.outdir = outdir
-        self.stats: dict[str, StageStats] = {}
+        #: per-stage counters; consensus stages store StageStats, the
+        #: UMI-grouping pre-stage a group_umi.GroupStats (both expose
+        #: as_dict() for observe.emit_stage_stats).
+        self.stats: dict = {}
         self.final_output: str | None = None  # set by build()
+        #: MI streaming mode for the molecular stage; build() switches it
+        #: to 'adjacent' when the UMI-grouping pre-stage runs (its output
+        #: is MI-contiguous, not coordinate-sorted).
+        self.molecular_grouping = cfg.grouping
 
     def out(self, suffix: str) -> str:
         return os.path.join(self.outdir, f"{self.sample}{suffix}")
@@ -252,6 +259,35 @@ class PipelineBuilder:
             f"{stage} sample={self.sample}",
         )
 
+    def run_group(self, rule) -> None:
+        """UMI-grouping pre-stage (fgbio GroupReadsByUmi equivalent,
+        pipeline.group_umi): RX -> MI with /A|/B duplex suffixes, two
+        bounded-memory external passes."""
+        from bsseqconsensusreads_tpu.pipeline.group_umi import (
+            GroupStats,
+            group_reads_by_umi,
+            grouped_header,
+        )
+
+        stats = self.stats.setdefault("group", GroupStats())
+        out_path = rule.outputs[0]
+        with BamReader(rule.inputs[0]) as reader:
+            header = self._pg(grouped_header(reader.header), "group")
+            with BamWriter(
+                out_path, header, level=self._out_level(out_path)
+            ) as w:
+                for rec in group_reads_by_umi(
+                    reader, reader.header,
+                    strategy=self.cfg.group_strategy,
+                    edits=self.cfg.group_edits,
+                    raw_tag=self.cfg.group_raw_tag,
+                    min_map_q=self.cfg.group_min_map_q,
+                    workdir=self.cfg.tmp,
+                    buffer_records=self.cfg.sort_buffer_records,
+                    stats=stats,
+                ):
+                    w.write(rec)
+
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
@@ -261,14 +297,14 @@ class PipelineBuilder:
                 molecular_ingest_stream(
                     rule.inputs[0], reader, stats,
                     ingest_choice=self.cfg.ingest,
-                    grouping=self.cfg.grouping,
+                    grouping=self.molecular_grouping,
                     indel_policy=self.cfg.indel_policy,
                 ),
                 params=self.cfg.molecular,
                 mode=mode,
                 batch_families=self.cfg.batch_families,
                 max_window=self.cfg.max_window,
-                grouping=self.cfg.grouping,
+                grouping=self.molecular_grouping,
                 stats=stats,
                 skip_batches=ck.batches_done if ck else 0,
                 indel_policy=self.cfg.indel_policy,
@@ -358,14 +394,52 @@ class PipelineBuilder:
 
     # ---- pipeline assembly --------------------------------------------
 
+    def _needs_grouping(self) -> bool:
+        """Whether to prepend the GroupReadsByUmi-equivalent pre-stage.
+        'auto' probes the input's first records (up to 50, robust to an
+        odd lead record): any MI means already-grouped input; raw-UMI
+        tags without MI mean the user handed us a raw aligned BAM rather
+        than the reference's grouped input contract (README.md:51-55)."""
+        mode = self.cfg.group_umis
+        if mode == "always":
+            return True
+        if mode == "never":
+            return False
+        if mode != "auto":
+            raise WorkflowError(
+                f"unknown group_umis {mode!r} (want auto|always|never)"
+            )
+        if not os.path.exists(self.bam_path):
+            return False  # let the workflow report the missing input
+        tag = self.cfg.group_raw_tag
+        saw_umi = False
+        with BamReader(self.bam_path) as reader:
+            for i, rec in enumerate(reader):
+                if rec.has_tag("MI"):
+                    return False  # already grouped
+                saw_umi = saw_umi or rec.has_tag(tag)
+                if i >= 49:  # a raw-UMI probe, robust to odd lead records
+                    break
+        return saw_umi
+
     def build(self) -> tuple[Workflow, str]:
         cfg = self.cfg
         wf = Workflow()
+        consensus_input = self.bam_path
+        if self._needs_grouping():
+            consensus_input = self.out("_umigrouped.bam")
+            wf.rule(
+                "group_reads_by_umi",
+                [self.bam_path],
+                [consensus_input],
+                self.run_group,
+            )
+            self.molecular_grouping = "adjacent"
         if cfg.aligner == "self":
             aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
             wf.rule(
                 "call_consensus_molecular_tpu",
-                [self.bam_path],
+                [consensus_input],
                 [aligned],
                 lambda r: self.run_molecular(r, mode="self"),
             )
@@ -382,7 +456,7 @@ class PipelineBuilder:
         molecular = self.out("_unalignedConsensus_molecular.bam")
         wf.rule(
             "call_consensus_reads_molecular",
-            [self.bam_path],
+            [consensus_input],
             [molecular],
             lambda r: self.run_molecular(r, mode="unaligned"),
         )

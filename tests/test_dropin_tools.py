@@ -183,3 +183,51 @@ def test_run_entry_with_reference_style_config(tmp_path):
     outs = os.listdir(tmp_path / "output")
     finals = [f for f in outs if f.endswith(".bam")]
     assert finals, outs
+
+
+def test_group_dropin_chains_into_molecular(tmp_path):
+    """The fgbio GroupReadsByUmi rule shape, chained the way Snakemake
+    would: `group_reads_by_umi_tpu.py -s paired -e 1` producing the
+    reference's input contract (README.md:51-55), then the molecular
+    drop-in consuming it."""
+    from tests.test_group_umi import make_raw_duplex_records
+
+    rng = np.random.default_rng(80)
+    name, genome = random_genome(rng, 6000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=5
+    )
+    raw = str(tmp_path / "raw.bam")
+    with BamWriter(raw, header) as w:
+        w.write_all(records)
+
+    grouped = str(tmp_path / "grouped.bam")
+    cp = _run_tool(
+        "group_reads_by_umi_tpu.py",
+        ["-s", "paired", "-e", "1", "-i", raw, "-o", grouped],
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    assert '"molecules"' in cp.stderr  # stats JSON on stderr
+
+    with BamReader(grouped) as r:
+        assert "SO:unsorted" in r.header.text
+        back = list(r)
+    fams = {}
+    for rec in back:
+        fams.setdefault(str(rec.get_tag("MI")).split("/")[0], set()).add(rec.qname)
+    truth_fams = {}
+    for q, (fam, _s) in truth.items():
+        truth_fams.setdefault(fam, set()).add(q)
+    assert {frozenset(v) for v in fams.values()} == {
+        frozenset(v) for v in truth_fams.values()
+    }
+
+    consensus = str(tmp_path / "consensus.bam")
+    cp = _run_tool(
+        "call_molecular_consensus_tpu.py",
+        ["-i", grouped, "-o", consensus, "--grouping", "adjacent"],
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    n_strand_families = len({(f, s) for f, s in truth.values()})
+    with BamReader(consensus) as r:
+        assert sum(1 for _ in r) == 2 * n_strand_families

@@ -1,0 +1,382 @@
+"""UMI grouping (fgbio GroupReadsByUmi equivalent, pipeline.group_umi).
+
+The reference consumes `fgbio GroupReadsByUmi -s Paired` output
+(reference README.md:7,51-55) but never runs that step itself; these
+tests pin the framework's own grouper: duplex strand reunification
+(swapped RX halves -> one molecule, /A|/B suffixes), position keying on
+unclipped 5' ends, the directional-adjacency count rule, input filters,
+and the bounded-memory spill path.
+"""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecord,
+    BamReader,
+    BamWriter,
+    CMATCH,
+    CSOFT_CLIP,
+)
+from bsseqconsensusreads_tpu.pipeline.calling import call_molecular
+from bsseqconsensusreads_tpu.pipeline.group_umi import (
+    GroupStats,
+    cluster_umis,
+    group_reads_by_umi,
+    grouped_header,
+    unclipped_end5,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    BASES,
+    bisulfite_convert,
+    random_genome,
+    simulate_read,
+)
+
+
+def _umi(rng, k=6):
+    return "".join(BASES[i] for i in rng.integers(0, 4, size=k))
+
+
+def make_raw_duplex_records(
+    rng,
+    genome_name,
+    genome,
+    n_families=6,
+    reads_per_strand=(2, 3),
+    read_len=50,
+    rx_override=None,
+):
+    """Raw aligned duplex templates: RX only (B strand carries the halves
+    in swapped, as-sequenced order), no MI — the input GroupReadsByUmi
+    sees. Returns (header, records, truth) with truth[qname] =
+    (family_index, strand)."""
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n", [(genome_name, len(genome))]
+    )
+    records, truth = [], {}
+    for fam in range(n_families):
+        frag_start = int(rng.integers(10, len(genome) - 3 * read_len))
+        frag_len = int(rng.integers(read_len + 10, 2 * read_len))
+        u1, u2 = _umi(rng), _umi(rng)
+        r2_start = frag_start + frag_len - read_len
+        for strand in "AB":
+            depth = int(
+                rng.integers(reads_per_strand[0], reads_per_strand[1] + 1)
+            )
+            for d in range(depth):
+                qname = f"t{fam}x{strand}{d}"
+                truth[qname] = (fam, strand)
+                left_seq, left_qual = simulate_read(
+                    rng, genome, frag_start, read_len
+                )
+                right_seq, right_qual = simulate_read(
+                    rng, genome, r2_start, read_len
+                )
+                left_seq = bisulfite_convert(
+                    left_seq, genome, frag_start, strand
+                )
+                right_seq = bisulfite_convert(
+                    right_seq, genome, r2_start, strand
+                )
+                left_flag, right_flag = (99, 147) if strand == "A" else (163, 83)
+                rx = f"{u1}-{u2}" if strand == "A" else f"{u2}-{u1}"
+                if rx_override is not None:
+                    rx = rx_override(fam, strand, d) or rx
+                left = BamRecord(
+                    qname=qname, flag=left_flag, ref_id=0, pos=frag_start,
+                    mapq=60, cigar=[(CMATCH, read_len)], next_ref_id=0,
+                    next_pos=r2_start, tlen=frag_len, seq=left_seq,
+                    qual=left_qual,
+                )
+                right = BamRecord(
+                    qname=qname, flag=right_flag, ref_id=0, pos=r2_start,
+                    mapq=60, cigar=[(CMATCH, read_len)], next_ref_id=0,
+                    next_pos=frag_start, tlen=-frag_len, seq=right_seq,
+                    qual=right_qual,
+                )
+                for rec in (left, right):
+                    rec.set_tag("RX", rx, "Z")
+                    records.append(rec)
+    records.sort(key=lambda r: (r.ref_id, r.pos, r.qname))
+    return header, records, truth
+
+
+def _partition_by_mi(records):
+    """MI base id -> frozenset of qnames."""
+    part = {}
+    for rec in records:
+        mi = str(rec.get_tag("MI")).split("/")[0]
+        part.setdefault(mi, set()).add(rec.qname)
+    return {frozenset(v) for v in part.values()}
+
+
+def _truth_partition(truth):
+    fams = {}
+    for qname, (fam, _strand) in truth.items():
+        fams.setdefault(fam, set()).add(qname)
+    return {frozenset(v) for v in fams.values()}
+
+
+def test_paired_grouping_reunites_strands(rng):
+    name, genome = random_genome(rng, 4000)
+    header, records, truth = make_raw_duplex_records(rng, name, genome)
+    stats = GroupStats()
+    out = list(group_reads_by_umi(records, header, stats=stats))
+    assert len(out) == len(records)
+    assert _partition_by_mi(out) == _truth_partition(truth)
+    # strand suffix: 99/147 orientation -> /A, 83/163 -> /B
+    for rec in out:
+        mi = str(rec.get_tag("MI"))
+        assert mi.endswith("/" + truth[rec.qname][1])
+    assert stats.accepted == len(truth)
+    assert stats.molecules == len(_truth_partition(truth))
+    # temp tags must not leak
+    for rec in out:
+        assert not set(rec.tags) & {"zP", "zU", "zS"}
+
+
+def test_output_is_mi_adjacent(rng):
+    name, genome = random_genome(rng, 4000)
+    header, records, truth = make_raw_duplex_records(rng, name, genome)
+    out = list(group_reads_by_umi(records, header))
+    seen, prev = set(), None
+    for rec in out:
+        mi = str(rec.get_tag("MI")).split("/")[0]
+        if mi != prev:
+            assert mi not in seen, "molecule records not contiguous"
+            seen.add(mi)
+            prev = mi
+
+
+def test_single_mismatch_umi_merges_directionally(rng):
+    name, genome = random_genome(rng, 4000)
+
+    def mutate(fam, strand, d):
+        if fam == 0 and strand == "A" and d == 0:
+            return None  # filled in below via closure hack
+        return None
+
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=3, reads_per_strand=(4, 4)
+    )
+    # inject a 1-mismatch RX on one template of family 0
+    fam0 = [r for r in records if truth[r.qname][0] == 0]
+    victim_q = fam0[0].qname
+    for rec in records:
+        if rec.qname == victim_q:
+            rx = str(rec.get_tag("RX"))
+            mutated = ("A" if rx[0] != "A" else "C") + rx[1:]
+            rec.set_tag("RX", mutated, "Z")
+    out = list(group_reads_by_umi(records, header, edits=1))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_same_position_distinct_umis_stay_separate(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=1, reads_per_strand=(3, 3)
+    )
+    # clone family 0 at the same position with a far-away UMI
+    clones = []
+    for rec in records:
+        c = rec.copy()
+        c.qname = "clone_" + c.qname
+        a, b = (x * 6 for x in ("T", "G"))
+        if truth[rec.qname][1] == "B":  # as-sequenced order swaps halves
+            a, b = b, a
+        c.set_tag("RX", f"{a}-{b}", "Z")
+        truth["clone_" + rec.qname] = (1, truth[rec.qname][1])
+        clones.append(c)
+    out = list(group_reads_by_umi(records + clones, header, edits=1))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_distinct_positions_same_umi_stay_separate(rng):
+    name, genome = random_genome(rng, 4000)
+    fixed = lambda fam, strand, d: ("ACACAC-GTGTGT" if strand == "A" else "GTGTGT-ACACAC")
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=4, rx_override=fixed
+    )
+    out = list(group_reads_by_umi(records, header))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_unclipped_position_key_ignores_softclips(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=1, reads_per_strand=(2, 2), read_len=50
+    )
+    # softclip 3 leading bases off one forward read; unclipped 5' unchanged
+    victim = next(r for r in records if not r.is_reverse)
+    before = unclipped_end5(victim)
+    victim.cigar = [(CSOFT_CLIP, 3), (CMATCH, 47)]
+    victim.pos += 3
+    assert unclipped_end5(victim) == before
+    out = list(group_reads_by_umi(records, header))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_cluster_umis_directional_count_rule():
+    # 10 absorbs 1 (10 >= 2*1-1) but not 8 (10 < 2*8-1): umi_tools
+    # directional rule the adjacency/paired strategies use.
+    counts = {"AAAA": 10, "AAAT": 1}
+    roots = cluster_umis(counts, "adjacency", edits=1)
+    assert roots["AAAT"] == "AAAA"
+    counts = {"AAAA": 10, "AAAT": 8}
+    roots = cluster_umis(counts, "adjacency", edits=1)
+    assert roots["AAAT"] == "AAAT"
+    # edit strategy merges regardless of counts
+    roots = cluster_umis(counts, "edit", edits=1)
+    assert roots["AAAT"] == "AAAA"
+    # identity never merges
+    roots = cluster_umis({"AAAA": 5, "AAAT": 5}, "identity", edits=1)
+    assert roots["AAAT"] == "AAAT"
+    # chained absorption: AAAT bridges AAAA -> AATT
+    counts = {"AAAA": 20, "AAAT": 5, "AATT": 1}
+    roots = cluster_umis(counts, "adjacency", edits=1)
+    assert set(roots.values()) == {"AAAA"}
+
+
+def test_input_filters_and_stats(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=2, reads_per_strand=(2, 2)
+    )
+    qnames = sorted({r.qname for r in records})
+    bad_mapq, bad_umi = qnames[0], qnames[1]
+    secondary = []
+    for rec in records:
+        if rec.qname == bad_mapq:
+            rec.mapq = 0
+        if rec.qname == bad_umi:
+            del rec.tags["RX"]
+        if rec.qname == qnames[2] and not rec.is_reverse:
+            dup = rec.copy()
+            dup.flag |= 0x100
+            secondary.append(dup)
+    stats = GroupStats()
+    out = list(
+        group_reads_by_umi(records + secondary, header, min_map_q=1, stats=stats)
+    )
+    kept = {r.qname for r in out}
+    assert bad_mapq not in kept and bad_umi not in kept
+    assert stats.dropped_mapq == 1
+    assert stats.dropped_no_umi == 1
+    assert stats.dropped_secondary == len(secondary)
+    assert all(not r.is_secondary for r in out)
+
+
+def test_unpaired_template_dropped_for_paired_strategy(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=1, reads_per_strand=(2, 2)
+    )
+    lone = records[0].copy()
+    lone.qname = "widowed"
+    stats = GroupStats()
+    out = list(group_reads_by_umi(records + [lone], header, stats=stats))
+    assert stats.dropped_unpaired == 1
+    assert "widowed" not in {r.qname for r in out}
+
+
+def test_malformed_duplex_umi_raises(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, _ = make_raw_duplex_records(
+        rng, name, genome, n_families=1, rx_override=lambda f, s, d: "NODASH"
+    )
+    with pytest.raises(ValueError, match="duplex UMIs"):
+        list(group_reads_by_umi(records, header))
+
+
+def test_spill_path_matches_in_memory(rng, tmp_path):
+    name, genome = random_genome(rng, 6000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=10
+    )
+    big = list(group_reads_by_umi([r.copy() for r in records], header))
+    small = list(
+        group_reads_by_umi(
+            [r.copy() for r in records], header,
+            workdir=str(tmp_path), buffer_records=8,
+        )
+    )
+    assert [(r.qname, r.flag, str(r.get_tag("MI"))) for r in big] == [
+        (r.qname, r.flag, str(r.get_tag("MI"))) for r in small
+    ]
+
+
+def test_bam_round_trip(rng, tmp_path):
+    name, genome = random_genome(rng, 3000)
+    header, records, truth = make_raw_duplex_records(rng, name, genome)
+    out_path = str(tmp_path / "grouped.bam")
+    hdr = grouped_header(header)
+    assert "SO:unsorted" in hdr.text
+    with BamWriter(out_path, hdr) as w:
+        for rec in group_reads_by_umi(records, header):
+            w.write(rec)
+    with BamReader(out_path) as r:
+        back = list(r)
+    assert _partition_by_mi(back) == _truth_partition(truth)
+
+
+def test_grouped_output_feeds_molecular_caller(rng):
+    """End-to-end: raw reads -> grouper -> molecular consensus, with the
+    MI-adjacent output consumed in O(1-family) 'adjacent' mode; one
+    consensus pair per strand family (min_reads=1, reference
+    main.snake.py:54 flag surface)."""
+    name, genome = random_genome(rng, 4000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=4, reads_per_strand=(2, 3)
+    )
+    grouped = group_reads_by_umi(records, header)
+    consensus = list(call_molecular(grouped, grouping="adjacent"))
+    n_strand_families = len({(f, s) for f, s in truth.values()})
+    # paired templates -> R1+R2 consensus per strand family
+    assert len(consensus) == 2 * n_strand_families
+    mis = {str(r.get_tag("MI")) for r in consensus}
+    assert len(mis) == n_strand_families
+    assert all(mi.endswith(("/A", "/B")) for mi in mis)
+
+
+def test_inconsistent_template_umi_raises(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, _ = make_raw_duplex_records(
+        rng, name, genome, n_families=1, reads_per_strand=(2, 2)
+    )
+    victim = records[0].qname
+    flipped = next(r for r in records if r.qname == victim and r.is_reverse)
+    rx = str(flipped.get_tag("RX"))
+    flipped.set_tag("RX", rx[::-1], "Z")
+    with pytest.raises(ValueError, match="inconsistent RX"):
+        list(group_reads_by_umi(records, header))
+
+
+def test_umi_read_from_either_mate(rng):
+    """A template whose RX rides only on R2 still groups (fgbio reads the
+    UMI off any primary record of the template)."""
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=2
+    )
+    victim = records[0].qname
+    for rec in records:
+        if rec.qname == victim and rec.is_read1:
+            del rec.tags["RX"]
+    stats = GroupStats()
+    out = list(group_reads_by_umi(records, header, stats=stats))
+    assert stats.dropped_no_umi == 0
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_custom_raw_tag(rng):
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=2
+    )
+    for rec in records:
+        rec.set_tag("BX", str(rec.get_tag("RX")), "Z")
+        del rec.tags["RX"]
+    out = list(group_reads_by_umi(records, header, raw_tag="BX"))
+    assert _partition_by_mi(out) == _truth_partition(truth)

@@ -661,3 +661,89 @@ class TestBackendSelection:
 
         _apply_backend("cpu")  # conftest already pinned cpu: no-op, no raise
         _apply_backend("tpu")  # leaves selection untouched
+
+
+class TestAutoUmiGrouping:
+    """The pipeline's GroupReadsByUmi-equivalent pre-stage (config
+    group_umis='auto'): a raw aligned BAM with RX but no MI — one step
+    EARLIER than the reference's input contract (README.md:7,51-55) —
+    runs end to end without fgbio."""
+
+    @pytest.fixture(scope="class")
+    def raw_env(self, tmp_path_factory):
+        from tests.test_group_umi import make_raw_duplex_records
+
+        tmp = tmp_path_factory.mktemp("rawpipe")
+        rng = np.random.default_rng(41)
+        name, genome = random_genome(rng, 6000)
+        fasta = str(tmp / "genome.fa")
+        write_fasta(fasta, name, genome)
+        header, records, truth = make_raw_duplex_records(
+            rng, name, genome, n_families=6, reads_per_strand=(3, 4)
+        )
+        bam = str(tmp / "input" / "raw_sample.bam")
+        os.makedirs(os.path.dirname(bam), exist_ok=True)
+        with BamWriter(bam, header) as w:
+            w.write_all(records)
+        return {"tmp": tmp, "fasta": fasta, "bam": bam, "truth": truth}
+
+    def test_auto_grouping_full_self_run(self, raw_env):
+        env = raw_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        outdir = str(env["tmp"] / "output")
+        target, results, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert [r.name for r in results if r.ran] == [
+            "group_reads_by_umi",
+            "call_consensus_molecular_tpu",
+            "call_duplex_tpu",
+        ]
+        assert "group" in stats
+        n_families = len({f for f, _ in env["truth"].values()})
+        assert stats["group"].molecules == n_families
+        with BamReader(target) as r:
+            duplex = list(r)
+        assert len(duplex) == 2 * n_families  # R1+R2 per molecule
+        assert all(d.has_tag("MI") and d.has_tag("cD") for d in duplex)
+        # rerun: grouped checkpoint honored, nothing re-runs
+        _, results2, _ = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert all(not r.ran for r in results2)
+
+    def test_never_grouping_fails_on_raw_input(self, raw_env):
+        env = raw_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+            group_umis="never",
+        )
+        with pytest.raises(Exception, match="MI"):
+            run_pipeline(
+                cfg, env["bam"], outdir=str(env["tmp"] / "output_never")
+            )
+
+    def test_grouped_input_skips_pre_stage(self, pipeline_env):
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+
+        cfg = FrameworkConfig(aligner="self")
+        builder = PipelineBuilder(cfg, pipeline_env["bam"], outdir="unused")
+        assert not builder._needs_grouping()
+
+    def test_auto_probe_tolerates_umiless_lead_record(self, raw_env, tmp_path):
+        """One UMI-less leading record must not flip the 'auto' decision
+        for the whole file."""
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+
+        with BamReader(raw_env["bam"]) as r:
+            header, records = r.header, list(r)
+        lead = records[0].copy()
+        lead.qname = "umiless"
+        del lead.tags["RX"]
+        bam = str(tmp_path / "lead.bam")
+        with BamWriter(bam, header) as w:
+            w.write_all([lead] + records)
+        builder = PipelineBuilder(FrameworkConfig(aligner="self"), bam)
+        assert builder._needs_grouping()

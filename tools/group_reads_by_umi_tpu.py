@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Drop-in UMI grouping stage.
+
+The reference pipeline's input must already be `fgbio GroupReadsByUmi
+-s Paired` output (reference: README.md:7,51-55) — the one fgbio step it
+leaves to the user. This drop-in produces that contract from a raw
+aligned BAM with RX tags, so the whole path runs without the JVM:
+
+    fgbio GroupReadsByUmi -s Paired -e 1 -i aligned.bam -o grouped.bam
+becomes
+    python tools/group_reads_by_umi_tpu.py -s paired -e 1 -i aligned.bam -o grouped.bam
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bsseqconsensusreads_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["group"] + sys.argv[1:]))
