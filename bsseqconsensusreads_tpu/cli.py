@@ -227,7 +227,7 @@ def cmd_group(args) -> int:
     from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
     from bsseqconsensusreads_tpu.pipeline.group_umi import (
         GroupStats,
-        group_reads_by_umi,
+        group_reads_by_umi_raw,
         grouped_header,
     )
 
@@ -235,13 +235,14 @@ def cmd_group(args) -> int:
     with BamReader(args.input) as reader:
         header = grouped_header(reader.header)
         with BamWriter(args.output, header) as w:
-            for rec in group_reads_by_umi(
-                reader, reader.header,
-                strategy=args.strategy, edits=args.edits,
-                raw_tag=args.raw_tag, min_map_q=args.min_map_q,
-                stats=stats,
-            ):
-                w.write(rec)
+            w.write_raw_many(
+                group_reads_by_umi_raw(
+                    reader, reader.header,
+                    strategy=args.strategy, edits=args.edits,
+                    raw_tag=args.raw_tag, min_map_q=args.min_map_q,
+                    stats=stats,
+                )
+            )
     print(json.dumps(stats.as_dict()), file=sys.stderr)
     return 0
 

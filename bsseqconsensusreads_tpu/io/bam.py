@@ -256,6 +256,36 @@ def reg2bin(beg: int, end: int) -> int:
 _TAG_FMT = {"c": "<b", "C": "<B", "s": "<h", "S": "<H", "i": "<i", "I": "<I", "f": "<f"}
 
 
+def skip_tag(data: bytes, off: int) -> int:
+    """Offset just past the tag starting at data[off] (key + type char +
+    value) — the single source of tag byte widths for raw no-decode
+    walkers (e.g. pipeline.group_umi's MI splice); _decode_tags consumes
+    the same layout."""
+    tc = chr(data[off + 2])
+    off += 3
+    if tc == "A":
+        return off + 1
+    if tc in _TAG_FMT:
+        return off + struct.calcsize(_TAG_FMT[tc])
+    if tc in ("Z", "H"):
+        return data.index(0, off) + 1
+    if tc == "B":
+        sub = chr(data[off])
+        count = struct.unpack_from("<I", data, off + 1)[0]
+        return off + 5 + count * struct.calcsize(_TAG_FMT[sub])
+    raise BamError(f"unknown tag type {tc!r}")
+
+
+def tag_region_offset(blob: bytes) -> int:
+    """Byte offset of the tag region inside an encoded record blob
+    (including its leading block_size prefix): fixed fields + qname +
+    cigar + 4-bit seq + qual."""
+    l_qname = blob[12]
+    (n_cigar,) = struct.unpack_from("<H", blob, 16)
+    (l_seq,) = struct.unpack_from("<i", blob, 20)
+    return 36 + l_qname + 4 * n_cigar + (l_seq + 1) // 2 + l_seq
+
+
 def _decode_tags(data: bytes, off: int) -> dict[str, tuple[str, Any]]:
     tags: dict[str, tuple[str, Any]] = {}
     n = len(data)

@@ -265,7 +265,7 @@ class PipelineBuilder:
         bounded-memory external passes."""
         from bsseqconsensusreads_tpu.pipeline.group_umi import (
             GroupStats,
-            group_reads_by_umi,
+            group_reads_by_umi_raw,
             grouped_header,
         )
 
@@ -276,17 +276,18 @@ class PipelineBuilder:
             with BamWriter(
                 out_path, header, level=self._out_level(out_path)
             ) as w:
-                for rec in group_reads_by_umi(
-                    reader, reader.header,
-                    strategy=self.cfg.group_strategy,
-                    edits=self.cfg.group_edits,
-                    raw_tag=self.cfg.group_raw_tag,
-                    min_map_q=self.cfg.group_min_map_q,
-                    workdir=self.cfg.tmp,
-                    buffer_records=self.cfg.sort_buffer_records,
-                    stats=stats,
-                ):
-                    w.write(rec)
+                w.write_raw_many(
+                    group_reads_by_umi_raw(
+                        reader, reader.header,
+                        strategy=self.cfg.group_strategy,
+                        edits=self.cfg.group_edits,
+                        raw_tag=self.cfg.group_raw_tag,
+                        min_map_q=self.cfg.group_min_map_q,
+                        workdir=self.cfg.tmp,
+                        buffer_records=self.cfg.sort_buffer_records,
+                        stats=stats,
+                    )
+                )
 
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())

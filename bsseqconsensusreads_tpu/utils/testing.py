@@ -170,6 +170,7 @@ def stream_duplex_families(
     mutate=None,
     rx: str = "ACGTACGT-TGCATGCA",
     bisulfite: bool = False,
+    raw_umis: bool = False,
 ):
     """Stream a coordinate-sorted synthetic grouped-duplex record stream.
 
@@ -194,6 +195,13 @@ def stream_duplex_families(
     duplex convert stage is built for (reference tools/1 semantics); raw
     genome reads fed through the convert stage would trip its
     content-dependent rewrite rules pseudo-randomly.
+
+    raw_umis=True emits the stream one step EARLIER than the reference's
+    input contract: per-family duplex UMIs in RX (B-strand halves
+    swapped, as sequenced) and NO MI tag — the input shape of
+    pipeline.group_umi. UMIs are fam-deterministic with pairwise
+    mismatch distance >= 2, so edits<=1 grouping can never merge two
+    families that happen to share a position bucket.
     """
     from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
 
@@ -204,6 +212,21 @@ def stream_duplex_families(
         raise ValueError(f"genome too short: {genome_len} for {frag_len}-bp fragments")
     genome_str = codes_to_seq(codes) if bisulfite else None
     default_qual = bytes([35] * read_len)
+
+    if raw_umis and n_families > 4 ** 12:
+        raise ValueError(
+            f"raw_umis encodes fam in 12 base-4 digits; {n_families} "
+            f"families would wrap and repeat UMIs"
+        )
+
+    def _fam_umi(fam: int) -> tuple[str, str]:
+        # base-4 digits of fam, and the same digits +1 mod 4: two distinct
+        # fams differ in >=1 position of EACH half => pair distance >= 2
+        digits = [(fam >> (2 * i)) & 3 for i in range(12)]
+        u1 = "".join(BASES[d] for d in digits)
+        u2 = "".join(BASES[(d + 1) & 3] for d in digits)
+        return u1, u2
+
     for fam in range(n_families):
         start = 10 + (fam * span) // n_families
         r2 = start + frag_len - read_len
@@ -232,6 +255,11 @@ def stream_duplex_families(
                         next_ref_id=0, next_pos=mate, tlen=tl, seq=seq,
                         qual=qual_for(fam, ti, flag) if qual_for else default_qual,
                     )
-                    rec.set_tag("RX", rx, "Z")
-                    rec.set_tag("MI", f"{fam}/{strand}", "Z")
+                    if raw_umis:
+                        u1, u2 = _fam_umi(fam)
+                        a, b = (u1, u2) if strand == "A" else (u2, u1)
+                        rec.set_tag("RX", f"{a}-{b}", "Z")
+                    else:
+                        rec.set_tag("RX", rx, "Z")
+                        rec.set_tag("MI", f"{fam}/{strand}", "Z")
                     yield rec

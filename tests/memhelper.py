@@ -124,9 +124,59 @@ def main_zipper(workdir: str, n_families: int) -> dict:
     return {"rss_mb": _rss_mb(), "records": n}
 
 
+def main_group(workdir: str, n_families: int) -> dict:
+    """Streaming UMI grouping (fgbio GroupReadsByUmi equivalent,
+    pipeline.group_umi) over a raw RX-only stream: two external sorts
+    with a small spill buffer, O(buffer + position bucket) memory where
+    fgbio holds its grouping state in a JVM heap."""
+    import time
+
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.pipeline.group_umi import (
+        GroupStats,
+        group_reads_by_umi_raw,
+        grouped_header,
+    )
+    from bsseqconsensusreads_tpu.utils.testing import stream_duplex_families
+
+    rng = np.random.default_rng(9)
+    codes, _ = _genome(rng)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", GENOME_LEN)])
+    bam = os.path.join(workdir, "raw.bam")
+    with BamWriter(bam, header) as w:
+        w.write_all(
+            stream_duplex_families(
+                codes, n_families, read_len=READ_LEN, raw_umis=True
+            )
+        )
+    gen_rss = _rss_mb()
+
+    stats = GroupStats()
+    n = 0
+    t0 = time.time()
+    with BamReader(bam) as reader:
+        out = os.path.join(workdir, "grouped.bam")
+        with BamWriter(out, grouped_header(header), level=1) as w:
+            for blob in group_reads_by_umi_raw(
+                reader, header, workdir=workdir, buffer_records=25_000,
+                stats=stats,
+            ):
+                n += 1
+                w.write_raw(blob)
+    wall = time.time() - t0
+    return {
+        "rss_mb": _rss_mb(),
+        "gen_rss_mb": gen_rss,
+        "records": n,
+        "molecules": stats.molecules,
+        "wall_s": round(wall, 2),
+        "records_per_second": round(n / wall, 1),
+    }
+
+
 def main() -> None:
     mode, workdir, n_families = sys.argv[1], sys.argv[2], int(sys.argv[3])
-    fn = {"self": main_self, "zipper": main_zipper}[mode]
+    fn = {"self": main_self, "zipper": main_zipper, "group": main_group}[mode]
     print(json.dumps(fn(workdir, n_families)))
 
 

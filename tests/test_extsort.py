@@ -136,6 +136,7 @@ def test_zipper_stream_matches_in_memory(tmp_path):
 N_FAMILIES = 100_000
 SELF_CAP_MB = 1100
 ZIPPER_CAP_MB = 700
+GROUP_CAP_MB = 700
 
 
 def _run_helper(mode: str, tmp_path) -> dict:
@@ -329,3 +330,14 @@ class TestWriteBatchStream:
             got_keys = [coordinate_key(x) for x in r]
         assert got_keys == sorted(got_keys)
         assert len(got_keys) == len(recs)
+
+
+@pytest.mark.slow
+def test_peak_rss_group_umi_bounded(tmp_path):
+    """The UMI-grouping stage (two nested external sorts over 4*N_FAMILIES
+    raw records) must stay O(buffer + position bucket): fgbio's
+    GroupReadsByUmi holds its grouping state in a JVM heap."""
+    out = _run_helper("group", tmp_path)
+    assert out["records"] == 4 * N_FAMILIES
+    assert out["molecules"] == N_FAMILIES
+    assert out["rss_mb"] < GROUP_CAP_MB, out

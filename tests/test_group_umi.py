@@ -380,3 +380,117 @@ def test_custom_raw_tag(rng):
         del rec.tags["RX"]
     out = list(group_reads_by_umi(records, header, raw_tag="BX"))
     assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_multi_contig_and_chimeric_templates(rng):
+    """Position keys carry ref ids: same fragment coordinates on different
+    contigs never share a bucket, and a cross-contig (chimeric) pair gets
+    a both-ends key that still groups its duplex twin."""
+    name, genome = random_genome(rng, 2000)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n",
+        [("chr1", len(genome)), ("chr2", len(genome))],
+    )
+    _, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=2, reads_per_strand=(2, 2)
+    )
+    # project family 1 onto chr2 at the SAME coordinates as family 0
+    fam_pos = {}
+    for rec in records:
+        fam = truth[rec.qname][0]
+        fam_pos.setdefault(fam, rec.pos)
+    for rec in records:
+        if truth[rec.qname][0] == 1:
+            rec.ref_id = rec.next_ref_id = 1
+            delta = fam_pos[0] - fam_pos[1]
+            rec.pos += delta
+            rec.next_pos += delta
+    # same RX for both families: only the contig separates them
+    rx_by_strand = {"A": "AAAAAA-CCCCCC", "B": "CCCCCC-AAAAAA"}
+    for rec in records:
+        rec.set_tag("RX", rx_by_strand[truth[rec.qname][1]], "Z")
+    out = list(group_reads_by_umi(records, header))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+
+
+def test_patch_mi_byte_parity(rng):
+    """_patch_mi (raw tag splice) must byte-match decode -> set_tag ->
+    encode for MI-less records, and produce tag-equal records when an
+    existing MI is replaced (tag order is not semantic there)."""
+    from bsseqconsensusreads_tpu.io.bam import decode_record, encode_record
+    from bsseqconsensusreads_tpu.pipeline.group_umi import _patch_mi
+
+    name, genome = random_genome(rng, 2000)
+    _, records, _ = make_raw_duplex_records(rng, name, genome, n_families=2)
+    rec = records[0].copy()
+    # exercise every tag-type size class the walker must skip
+    rec.set_tag("xi", 7, "i")
+    rec.set_tag("xs", 3, "s")
+    rec.set_tag("xA", "Q", "A")
+    rec.set_tag("xB", ("S", [1, 2, 3]), "B")
+    rec.set_tag("xH", "DEADBEEF", "H")
+    blob = encode_record(rec)
+    want = rec.copy()
+    want.set_tag("MI", "42/A", "Z")
+    assert _patch_mi(blob, "42/A") == encode_record(want)
+    # replace path: existing MI moves to the tail, content identical
+    pre = rec.copy()
+    pre.set_tag("MI", "old/B", "Z")
+    patched = decode_record(_patch_mi(encode_record(pre), "9/B")[4:])
+    assert patched.tags == want.tags | {"MI": ("Z", "9/B")}
+    assert str(patched.get_tag("MI")) == "9/B"
+
+
+def test_regrouping_already_grouped_input(rng):
+    """group_umis='always' semantics: input that already carries MI is
+    regrouped from RX; old MI values are replaced, not appended."""
+    name, genome = random_genome(rng, 3000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=3
+    )
+    for i, rec in enumerate(records):
+        rec.set_tag("MI", f"stale{i}", "Z")
+    out = list(group_reads_by_umi(records, header))
+    assert _partition_by_mi(out) == _truth_partition(truth)
+    assert not any(str(r.get_tag("MI")).startswith("stale") for r in out)
+
+
+def test_template_read_order_with_high_flag_bits(rng):
+    """Within a template, R1 emits before R2 even when R1 carries flag
+    bits numerically above R2's (QC-fail 0x200): the composite key
+    orders on the READ2 bit before the raw flag, like
+    record_ops.name_key."""
+    name, genome = random_genome(rng, 2000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=1, reads_per_strand=(2, 2)
+    )
+    for rec in records:
+        if rec.is_read1:
+            rec.flag |= 0x200
+    out = list(group_reads_by_umi(records, header))
+    seen = {}
+    for rec in out:
+        seen.setdefault(rec.qname, []).append(rec.is_read1)
+    for qname, r1_flags in seen.items():
+        assert r1_flags == [True, False], (qname, r1_flags)
+
+
+def test_patch_mi_strips_duplicate_mi_tags(rng):
+    """A malformed record carrying two MI tags leaves _patch_mi with
+    exactly one (the new value) — no stale MI bytes survive."""
+    import struct as _struct
+
+    from bsseqconsensusreads_tpu.io.bam import encode_record
+    from bsseqconsensusreads_tpu.pipeline.group_umi import _patch_mi
+
+    name, genome = random_genome(rng, 2000)
+    _, records, _ = make_raw_duplex_records(rng, name, genome, n_families=1)
+    rec = records[0].copy()
+    rec.set_tag("MI", "dup1", "Z")
+    blob = encode_record(rec)
+    extra = b"MIZdup2\x00"
+    body = blob[4:] + extra
+    doubled = _struct.pack("<i", len(body)) + body
+    patched = _patch_mi(doubled, "7/A")
+    assert patched.count(b"MIZ") == 1
+    assert b"MIZ7/A\x00" in patched and b"dup1" not in patched and b"dup2" not in patched
