@@ -247,6 +247,37 @@ def cmd_group(args) -> int:
     return 0
 
 
+def cmd_filter_consensus(args) -> int:
+    """`fgbio FilterConsensusReads` equivalent (pipeline.filter): the
+    filtered variant the reference's dead rule hints at
+    (main.snake.py:70-80) — read-level drops on depth/error rate,
+    per-base masking, template-atomic."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+    from bsseqconsensusreads_tpu.pipeline.filter import (
+        FilterParams,
+        FilterStats,
+        filter_consensus,
+        filtered_header,
+    )
+
+    params = FilterParams(
+        min_reads=tuple(args.min_reads),
+        max_read_error_rate=args.max_read_error_rate,
+        max_base_error_rate=args.max_base_error_rate,
+        min_base_quality=args.min_base_quality,
+        max_no_call_fraction=args.max_no_call_fraction,
+        min_mean_base_quality=args.min_mean_base_quality,
+    )
+    stats = FilterStats()
+    with BamReader(args.input) as reader:
+        header = filtered_header(reader.header)
+        with BamWriter(args.output, header) as w:
+            for rec in filter_consensus(reader, params, stats=stats):
+                w.write(rec)
+    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    return 0
+
+
 def cmd_zipper(args) -> int:
     """`fgbio ZipperBams --unmapped UNALIGNED --sort Coordinate` equivalent
     (main.snake.py:106): graft consensus tags from the unaligned BAM onto
@@ -372,6 +403,23 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-t", "--raw-tag", default="RX")
     p.add_argument("-m", "--min-map-q", type=int, default=1)
     p.set_defaults(fn=cmd_group)
+
+    p = sub.add_parser(
+        "filter-consensus",
+        help="FilterConsensusReads equivalent (depth/error filters + masking)",
+    )
+    p.add_argument("-i", "--input", required=True, help="consensus BAM")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "-M", "--min-reads", type=int, nargs="+", default=[1],
+        help="M [A B]: total / larger-strand / smaller-strand depth floors",
+    )
+    p.add_argument("-E", "--max-read-error-rate", type=float, default=0.025)
+    p.add_argument("-e", "--max-base-error-rate", type=float, default=0.1)
+    p.add_argument("-N", "--min-base-quality", type=int, default=1)
+    p.add_argument("-n", "--max-no-call-fraction", type=float, default=0.1)
+    p.add_argument("-q", "--min-mean-base-quality", type=float, default=None)
+    p.set_defaults(fn=cmd_filter_consensus)
 
     p = sub.add_parser(
         "zipper", help="ZipperBams equivalent (tag graft + coordinate sort)"

@@ -231,3 +231,34 @@ def test_group_dropin_chains_into_molecular(tmp_path):
     n_strand_families = len({(f, s) for f, s in truth.values()})
     with BamReader(consensus) as r:
         assert sum(1 for _ in r) == 2 * n_strand_families
+
+
+def test_filter_consensus_dropin_subprocess(molecular_input, tmp_path):
+    """The FilterConsensusReads rule shape: molecular drop-in output
+    piped through `filter_consensus_reads_tpu.py -M …` as Snakemake
+    would chain them."""
+    tmp, inp = molecular_input
+    consensus = str(tmp_path / "consensus.bam")
+    cp = _run_tool("call_molecular_consensus_tpu.py", ["-i", inp, "-o", consensus])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+
+    filtered = str(tmp_path / "filtered.bam")
+    cp = _run_tool(
+        "filter_consensus_reads_tpu.py",
+        ["-i", consensus, "-o", filtered, "-M", "1",
+         "-E", "1.0", "-e", "1.0", "-N", "0", "-n", "1.0"],
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    assert '"kept_records"' in cp.stderr
+    with BamReader(consensus) as a, BamReader(filtered) as b:
+        na, nb = sum(1 for _ in a), sum(1 for _ in b)
+    assert na == nb > 0  # permissive thresholds keep everything
+
+    strict = str(tmp_path / "strict.bam")
+    cp = _run_tool(
+        "filter_consensus_reads_tpu.py",
+        ["-i", consensus, "-o", strict, "-M", "50"],
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    with BamReader(strict) as r:
+        assert sum(1 for _ in r) == 0

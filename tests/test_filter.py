@@ -1,0 +1,218 @@
+"""Consensus filtering (fgbio FilterConsensusReads equivalent,
+pipeline.filter).
+
+The reference is unfiltered by design (reference README.md:9) but left a
+dead filtered-variant rule behind (main.snake.py:70-80); these tests pin
+the framework's supplied replacement: the M/A/B depth triplet at read
+and base level, error-rate drops, quality masking, the no-call fraction,
+and template-atomic dropping.
+"""
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH
+from bsseqconsensusreads_tpu.pipeline.calling import call_molecular
+from bsseqconsensusreads_tpu.pipeline.filter import (
+    FilterParams,
+    FilterStats,
+    filter_consensus,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+def consensus_rec(
+    qname="c1",
+    flag=0,
+    seq="ACGTACGT",
+    qual=None,
+    cd=None,
+    ce=None,
+    cE=0.0,
+    ad=None,
+    bd=None,
+):
+    n = len(seq)
+    rec = BamRecord(
+        qname=qname, flag=flag, ref_id=0, pos=10, mapq=60,
+        cigar=[(CMATCH, n)], seq=seq,
+        qual=bytes(qual) if qual is not None else bytes([30] * n),
+    )
+    cd = cd if cd is not None else [4] * n
+    rec.set_tag("cd", ("S", list(cd)), "B")
+    rec.set_tag("ce", ("S", list(ce if ce is not None else [0] * n)), "B")
+    rec.set_tag("cD", max(cd), "i")
+    rec.set_tag("cE", float(cE), "f")
+    if ad is not None:
+        rec.set_tag("ad", ("S", list(ad)), "B")
+        rec.set_tag("bd", ("S", list(bd)), "B")
+        rec.set_tag("aD", max(ad), "i")
+        rec.set_tag("bD", max(bd), "i")
+    return rec
+
+
+def run(params, *recs):
+    stats = FilterStats()
+    out = list(filter_consensus(list(recs), params, stats=stats))
+    return out, stats
+
+
+class TestReadLevel:
+    def test_depth_drop_molecular(self):
+        out, stats = run(
+            FilterParams(min_reads=(5,)), consensus_rec(cd=[4] * 8)
+        )
+        assert out == [] and stats.dropped_depth == 1
+        out, _ = run(FilterParams(min_reads=(4,)), consensus_rec(cd=[4] * 8))
+        assert len(out) == 1
+
+    def test_depth_triplet_duplex(self):
+        # total 6, strands 4/2: passes (6,3,2) but not (6,3,3)
+        rec = lambda: consensus_rec(cd=[6] * 8, ad=[4] * 8, bd=[2] * 8)
+        out, _ = run(FilterParams(min_reads=(6, 3, 2)), rec())
+        assert len(out) == 1
+        out, stats = run(FilterParams(min_reads=(6, 3, 3)), rec())
+        assert out == [] and stats.dropped_depth == 1
+
+    def test_error_rate_drop(self):
+        out, stats = run(FilterParams(), consensus_rec(cE=0.03))
+        assert out == [] and stats.dropped_error_rate == 1
+        out, _ = run(FilterParams(max_read_error_rate=0.05), consensus_rec(cE=0.03))
+        assert len(out) == 1
+
+    def test_mean_quality_drop(self):
+        rec = consensus_rec(qual=[10] * 8)
+        out, stats = run(FilterParams(min_mean_base_quality=20.0), rec)
+        assert out == [] and stats.dropped_mean_quality == 1
+
+    def test_template_atomic_drop(self):
+        r1 = consensus_rec(qname="t", flag=99)
+        r2 = consensus_rec(qname="t", flag=147, cE=0.5)  # only R2 fails
+        out, stats = run(FilterParams(), r1, r2)
+        assert out == []
+        assert stats.dropped_error_rate == 1 and stats.dropped_records == 2
+        assert stats.records_in == stats.kept_records + stats.dropped_records
+
+
+class TestBaseLevel:
+    def test_low_depth_base_masked(self):
+        cd = [4, 4, 1, 4, 4, 4, 4, 4]
+        out, stats = run(
+            FilterParams(min_reads=(2,), max_no_call_fraction=0.5),
+            consensus_rec(cd=cd),
+        )
+        assert out[0].seq[2] == "N" and out[0].qual[2] == 2
+        assert out[0].seq[0] == "A"
+        assert stats.masked_bases == 1
+
+    def test_high_error_base_masked(self):
+        ce = [0, 0, 0, 2, 0, 0, 0, 0]  # 2/4 = 0.5 > 0.1
+        out, _ = run(
+            FilterParams(max_no_call_fraction=0.5), consensus_rec(ce=ce)
+        )
+        assert out[0].seq[3] == "N"
+
+    def test_low_quality_base_masked(self):
+        qual = [30] * 8
+        qual[5] = 0
+        out, _ = run(
+            FilterParams(min_base_quality=2, max_no_call_fraction=0.5),
+            consensus_rec(qual=qual),
+        )
+        assert out[0].seq[5] == "N" and out[0].qual[5] == 2
+
+    def test_duplex_strand_floor_masks_bases(self):
+        ad = [3, 3, 0, 3, 3, 3, 3, 3]
+        bd = [3] * 8
+        out, _ = run(
+            FilterParams(min_reads=(3, 2, 1), max_no_call_fraction=0.5),
+            consensus_rec(cd=[6] * 8, ad=ad, bd=bd),
+        )
+        assert out[0].seq[2] == "N"  # min-strand depth 0 < B=1
+
+    def test_no_call_fraction_drop(self):
+        cd = [1] * 6 + [4, 4]  # 6/8 masked at min_reads 2
+        out, stats = run(
+            FilterParams(min_reads=(2,), max_no_call_fraction=0.5),
+            consensus_rec(cd=cd),
+        )
+        assert out == [] and stats.dropped_no_call == 1
+
+    def test_existing_n_counts_toward_no_call(self):
+        out, stats = run(
+            FilterParams(max_no_call_fraction=0.4),
+            consensus_rec(seq="NNNNACGT"),
+        )
+        assert out == [] and stats.dropped_no_call == 1
+
+    def test_clean_read_unchanged(self):
+        rec = consensus_rec()
+        out, stats = run(FilterParams(), rec)
+        assert out[0].seq == rec.seq and out[0].qual == rec.qual
+        assert stats.masked_bases == 0 and stats.kept_records == 1
+
+
+class TestParamsValidation:
+    def test_triplet_order_enforced(self):
+        with pytest.raises(ValueError, match="non-increasing"):
+            FilterParams(min_reads=(1, 2, 3))
+        with pytest.raises(ValueError, match="1-3 values"):
+            FilterParams(min_reads=(1, 1, 1, 1))
+
+    def test_single_strand_agreement_unsupported(self):
+        with pytest.raises(ValueError, match="per-strand consensus"):
+            FilterParams(require_single_strand_agreement=True)
+
+    def test_missing_cd_raises(self):
+        rec = BamRecord(qname="x", flag=0, seq="ACGT", qual=b"\x1e" * 4,
+                        cigar=[(CMATCH, 4)])
+        with pytest.raises(ValueError, match="cd per-base depth"):
+            list(filter_consensus([rec], FilterParams()))
+
+
+def test_filters_real_consensus_output(rng):
+    """End-to-end: molecular consensus output (the real tag surface from
+    pipeline.calling) through the filter; min_reads above the simulated
+    depth range drops everything, 1 keeps everything."""
+    name, genome = random_genome(rng, 4000)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=4, reads_per_strand=(2, 3)
+    )
+    consensus = list(call_molecular(records))
+    assert consensus
+    permissive = FilterParams(
+        min_reads=(1,), max_read_error_rate=1.0, max_base_error_rate=1.0,
+        min_base_quality=0, max_no_call_fraction=1.0,
+    )
+    kept, _ = run(permissive, *consensus)
+    assert len(kept) == len(consensus)
+    # defaults do bite on low-depth noisy families: whatever survives is
+    # a subset, and drops are template-atomic (even record count)
+    some, stats = run(FilterParams(min_reads=(2,)), *consensus)
+    assert len(some) < len(consensus) and len(some) % 2 == 0
+    none, stats = run(FilterParams(min_reads=(50,)), *consensus)
+    assert none == [] and stats.dropped_depth == stats.templates
+
+
+def test_duplex_strand_thresholds_assigned_per_read():
+    """fgbio assigns the A floor to the deeper strand PER READ and tests
+    each strand's own per-base array — element-wise max/min across
+    strands would let alternating low-depth positions slip through."""
+    ad = [3, 1, 3, 1, 3, 1, 3, 1]
+    bd = [1, 3, 1, 3, 1, 3, 1, 3]
+    out, stats = run(
+        FilterParams(min_reads=(3, 3, 1), max_no_call_fraction=1.0),
+        consensus_rec(cd=[4] * 8, ad=ad, bd=bd),
+    )
+    # ad (deeper by tie->first) carries the A=3 floor: positions where it
+    # dips to 1 must mask
+    assert out[0].seq.count("N") == 4
+    # deeper-strand assignment: swapping the arrays gives the same result
+    out2, _ = run(
+        FilterParams(min_reads=(3, 3, 1), max_no_call_fraction=1.0),
+        consensus_rec(cd=[4] * 8, ad=bd, bd=ad),
+    )
+    assert out2[0].seq.count("N") == 4

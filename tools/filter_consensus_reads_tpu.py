@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Drop-in consensus filtering stage.
+
+The reference ships unfiltered consensus only (reference README.md:9),
+but its dead `consensus_to_fq` rule reads a `…_molecular_filtered.bam`
+nothing produces (reference main.snake.py:70-80) — the filtered variant
+its authors evidently ran.  This drop-in supplies it with fgbio
+FilterConsensusReads semantics:
+
+    fgbio FilterConsensusReads -i molecular.bam -o filtered.bam --min-reads 3
+becomes
+    python tools/filter_consensus_reads_tpu.py -i molecular.bam -o filtered.bam -M 3
+
+Depth units: raw-read floors (-M 3 ...) apply to MOLECULAR consensus
+output, whose cd tag is raw depth.  This framework's duplex stage merges
+single-strand consensi, so its cd/ad/bd count strand PRESENCE — against
+duplex output use `-M 2 1 1` ("both strands present"); see the
+pipeline.filter module docstring's documented deviations.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from bsseqconsensusreads_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main(["filter-consensus"] + sys.argv[1:]))
