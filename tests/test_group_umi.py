@@ -494,3 +494,26 @@ def test_patch_mi_strips_duplicate_mi_tags(rng):
     patched = _patch_mi(doubled, "7/A")
     assert patched.count(b"MIZ") == 1
     assert b"MIZ7/A\x00" in patched and b"dup1" not in patched and b"dup2" not in patched
+
+
+def test_group_accuracy_tool_smoke(tmp_path):
+    """tools/group_accuracy_eval.py runs as a subprocess and reports the
+    designed effect: edits=1 clustering tolerates UMI errors that split
+    exact-match grouping."""
+    import json
+
+    from tests.test_dropin_tools import _run_tool
+
+    out = str(tmp_path / "acc.json")
+    cp = _run_tool(
+        "group_accuracy_eval.py",
+        ["--families", "120", "--rates", "0,0.01", "--out", out],
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    report = json.loads(open(out).read())
+    clean = report["rates"]["0.0"]["edits1"]
+    assert clean["purity"] == 1.0 and clean["completeness"] == 1.0
+    noisy = report["rates"]["0.01"]
+    assert (
+        noisy["edits1"]["completeness"] > noisy["edits0"]["completeness"]
+    )
