@@ -97,7 +97,10 @@ class FrameworkConfig:
     #: records (up to 50) and prepends the stage when they carry raw-UMI
     #: tags but no MI; 'always' / 'never' force it. The
     #: molecular stage then streams the MI-adjacent grouped output in
-    #: O(1-family) memory.
+    #: O(1-family) memory (note: 'adjacent' streaming bypasses the
+    #: C-side coordinate grouper, so molecular ingest runs ~2x slower
+    #: than on coordinate-sorted grouped input — measured in
+    #: SCALERAW_r03.json vs SCALE_r03.json).
     group_umis: str = "auto"
     #: GroupReadsByUmi knobs: strategy (identity|edit|adjacency|paired),
     #: max UMI mismatches merged within a position group, and the minimum

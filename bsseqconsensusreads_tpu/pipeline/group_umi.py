@@ -48,6 +48,7 @@ from dataclasses import dataclass
 from typing import Iterable, Iterator
 
 import struct
+import time
 
 import numpy as np
 
@@ -88,9 +89,12 @@ class GroupStats:
     dropped_unpaired: int = 0
     molecules: int = 0
     position_groups: int = 0
+    wall_seconds: float = 0.0
 
     def as_dict(self) -> dict:
-        return dict(self.__dict__)
+        d = dict(self.__dict__)
+        d["wall_seconds"] = round(d["wall_seconds"], 3)
+        return d
 
 
 # ---- template geometry ----------------------------------------------------
@@ -454,6 +458,7 @@ def group_reads_by_umi_raw(
     if edits < 0:
         raise ValueError(f"edits must be >= 0, got {edits}")
     stats = stats if stats is not None else GroupStats()
+    t0 = time.monotonic()
 
     composites = _annotate_composites(
         records, header, strategy, raw_tag, min_map_q, stats,
@@ -482,6 +487,7 @@ def group_reads_by_umi_raw(
     if bucket:
         out, _ = _emit_bucket(bucket, strategy, edits, next_mi, stats)
         yield from out
+    stats.wall_seconds += time.monotonic() - t0
 
 
 def group_reads_by_umi(

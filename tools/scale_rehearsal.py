@@ -54,7 +54,7 @@ def _records_for(n_families: int) -> int:
     )
 
 
-def _child(workdir: str, n_families: int) -> None:
+def _child(workdir: str, n_families: int, raw_umis: bool = False) -> None:
     """Generate + run; prints one JSON line with stats."""
     os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
     import jax
@@ -121,6 +121,7 @@ def _child(workdir: str, n_families: int) -> None:
             codes, n_families, read_len=READ_LEN,
             frag_extra=FRAG_LEN - READ_LEN,
             templates_for=templates_for, qual_for=qual_for, mutate=mutate,
+            raw_umis=raw_umis,
         ):
             w.write(rec)
             n_records += 1
@@ -159,20 +160,34 @@ def _child(workdir: str, n_families: int) -> None:
 
 def main() -> int:
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
-        _child(sys.argv[2], int(sys.argv[3]))
+        _child(sys.argv[2], int(sys.argv[3]), raw_umis="--raw-umis" in sys.argv)
         return 0
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", type=int, default=2_000_000)
-    ap.add_argument("--out", default="SCALE_r03.json")
+    ap.add_argument(
+        "--out", default="",
+        help="artifact path (default: SCALE_r03.json, or "
+        "SCALERAW_r03.json under --raw-umis — the two runs are not "
+        "comparable and must not overwrite each other)",
+    )
     ap.add_argument("--workdir", default="")
     ap.add_argument("--rss-limit-gb", type=float, default=12.0)
     ap.add_argument("--timeout", type=int, default=14_400)
+    ap.add_argument(
+        "--raw-umis", action="store_true",
+        help="generate a RAW aligned BAM (RX only, no MI) so the run "
+        "exercises the full standalone path: GroupReadsByUmi-equivalent "
+        "pre-stage (auto-prepended) -> molecular -> duplex",
+    )
     args = ap.parse_args()
+    if not args.out:
+        args.out = "SCALERAW_r03.json" if args.raw_umis else "SCALE_r03.json"
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="bsseq_scale_")
     os.makedirs(workdir, exist_ok=True)
     report = {
         "config": {
+            "raw_umis": args.raw_umis,
             "families": args.families,
             "expected_records_approx": _records_for(args.families),
             "cfdna_fraction": CFDNA_FRACTION,
@@ -187,7 +202,7 @@ def main() -> int:
     try:
         cp = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", workdir,
-             str(args.families)],
+             str(args.families)] + (["--raw-umis"] if args.raw_umis else []),
             stdout=subprocess.PIPE, text=True, timeout=args.timeout,
             env=dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu"),
         )
@@ -201,6 +216,11 @@ def main() -> int:
             report["rss_ok"] = rss_gb < args.rss_limit_gb
             dup = child["stages"].get("duplex", {})
             mol = child["stages"].get("molecular", {})
+            grp = child["stages"].get("group")
+            if grp and grp.get("wall_seconds"):
+                report["group_records_per_s"] = round(
+                    grp.get("records_in", 0) / grp["wall_seconds"], 1
+                )
             for name, st in (("molecular", mol), ("duplex", dup)):
                 if st.get("wall_seconds"):
                     report[f"{name}_families_per_s"] = round(
