@@ -96,11 +96,10 @@ class FrameworkConfig:
     #: have run (README.md:7,51-55). 'auto' probes the input's first
     #: records (up to 50) and prepends the stage when they carry raw-UMI
     #: tags but no MI; 'always' / 'never' force it. The
-    #: molecular stage then streams the MI-adjacent grouped output in
-    #: O(1-family) memory (note: 'adjacent' streaming bypasses the
-    #: C-side coordinate grouper, so molecular ingest runs ~2x slower
-    #: than on coordinate-sorted grouped input — measured in
-    #: SCALERAW_r03.json vs SCALE_r03.json).
+    #: grouped output is MI-contiguous, so the molecular stage streams it
+    #: in 'adjacent' mode — exact for any template geometry (cross-contig
+    #: and wide-insert pairs included) — through the C grouper's
+    #: MI-change-delimited fast path.
     group_umis: str = "auto"
     #: GroupReadsByUmi knobs: strategy (identity|edit|adjacency|paired),
     #: max UMI mismatches merged within a position group, and the minimum

@@ -199,6 +199,15 @@ def _segment_runs(fams: list, i: int, j: int) -> tuple[np.ndarray, np.ndarray]:
     )
 
 
+def _run_multi_ref(fam) -> bool:
+    """True when a FamilyRun's records span more than one contig (mapped
+    records only — ref_id -1 is ignored, matching the python encoders'
+    `rid >= 0` guard so both engines skip identically)."""
+    run_refs = fam.batch.ref_id[fam.start : fam.start + fam.n]
+    mapped = run_refs[run_refs >= 0]
+    return bool(mapped.size and (mapped != mapped[0]).any())
+
+
 def _decode_fixed(raw: bytes) -> str:
     """Decode a NUL-padded fixed-width field (ColumnarBatch qname/mi/rx)."""
     return raw.rstrip(b"\x00").decode("ascii", "replace")
@@ -240,7 +249,14 @@ def encode_molecular_families(
         ref_id = -1
         rx_counts: dict[str, int] = defaultdict(int)
         lo, hi = None, None
+        multi_ref = False
         for rec in records:
+            rid = rec.ref_id
+            if rid >= 0:
+                if ref_id < 0:
+                    ref_id = rid
+                elif rid != ref_id:
+                    multi_ref = True
             trimmed = trim_softclips_keep_indels(rec)
             if trimmed is None:
                 continue
@@ -249,7 +265,6 @@ def encode_molecular_families(
                 continue
             if len(codes) == 0:
                 continue
-            ref_id = rec.ref_id
             role = 1 if rec.flag & FREAD2 else 0
             # qname_key (columnar views): raw bytes, no per-record decode —
             # only template identity matters here
@@ -267,7 +282,10 @@ def encode_molecular_families(
             skipped.append(mi)
             continue
         window = hi - lo
-        if window > max_window or len(templates) > max_templates:
+        # multi_ref: a window is one contiguous interval of ONE contig; a
+        # chimeric family whose mates land on different refs cannot be
+        # windowed and is skipped+counted like an over-wide one
+        if window > max_window or len(templates) > max_templates or multi_ref:
             skipped.append(mi)
             continue
         rx = max(rx_counts, key=rx_counts.get) if rx_counts else ""
@@ -367,7 +385,10 @@ def _encode_molecular_native(
         s, k = fam.scan, fam.fidx
         ntpl = int(s["ntpl"][k])
         window = int(s["window"][k])
-        if ntpl == 0 or window > max_window or ntpl > max_templates:
+        if (
+            ntpl == 0 or window > max_window or ntpl > max_templates
+            or _run_multi_ref(fam)
+        ):
             skipped.append(fam.mi)
             rows[i] = -1
             continue
@@ -505,7 +526,14 @@ def encode_duplex_families(
         ref_id = -1
         lo, hi = None, None
         group_size = 0
+        multi_ref = False
         for rec in records:
+            rid = rec.ref_id
+            if rid >= 0:
+                if ref_id < 0:
+                    ref_id = rid
+                elif rid != ref_id:
+                    multi_ref = True
             info = getattr(rec, "clip_info", None)  # columnar CIGAR digest
             if (
                 info[3]
@@ -521,7 +549,6 @@ def encode_duplex_families(
                 continue
             codes, quals, pos = trimmed
             rows[row] = (codes, quals, pos)
-            ref_id = rec.ref_id
             if not rx:
                 try:  # one tag parse, not a has_tag/get_tag pair
                     rx = rec.get_tag("RX")
@@ -535,7 +562,9 @@ def encode_duplex_families(
             continue
         start = max(lo - 1, 0)  # one margin column for the conversion prepend
         window = hi - start
-        if window > max_window:
+        # multi_ref: same one-contig window-space rule as the molecular
+        # encoder — chimeric groups skip+count, never a cross-ref window
+        if window > max_window or multi_ref:
             skipped.append(mi)
             continue
         placed.append((mi, ref_id, start, window, rows, rx, group_size == 4))
@@ -612,7 +641,7 @@ def _encode_duplex_native(
                 leftovers.append(
                     ColumnarRecordView(fam.batch, fam.start + int(dj))
                 )
-        if window < 0 or window > max_window:
+        if window < 0 or window > max_window or _run_multi_ref(fam):
             skipped.append(fam.mi)
             rows[i] = -1
             continue

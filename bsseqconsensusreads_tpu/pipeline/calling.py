@@ -359,19 +359,23 @@ def stream_mi_groups(
     Records without an MI tag raise, matching the reference
     (tools/2.extend_gap.py:180).
 
-    A pipeline.ingest.GroupedColumnarStream (records pre-grouped by the
-    C-side coordinate grouper, identical groups and order to this
-    function's 'coordinate' mode) delegates straight through; its
-    construction parameters must match this call's.
+    A pipeline.ingest.GroupedColumnarStream (records pre-grouped in C,
+    identical groups and order to this function's 'coordinate' or
+    'adjacent' mode per the stream's own grouping) delegates straight
+    through; its grouping and strip_suffix must match this call's, and
+    flush_margin too in 'coordinate' mode ('adjacent' never reads it).
     """
     iter_groups = getattr(records, "iter_groups", None)
     if iter_groups is not None:
-        if grouping != "coordinate":
+        stream_grouping = getattr(records, "grouping", "coordinate")
+        if stream_grouping != grouping:
             raise ValueError(
-                f"pre-grouped stream requires grouping='coordinate', got {grouping!r}"
+                f"pre-grouped stream was built for grouping="
+                f"{stream_grouping!r}; caller wants {grouping!r}"
             )
-        if (records.strip_suffix, records.flush_margin) != (
-            strip_suffix, flush_margin,
+        if records.strip_suffix != strip_suffix or (
+            grouping == "coordinate"
+            and records.flush_margin != flush_margin
         ):
             raise ValueError(
                 "pre-grouped stream was built with "

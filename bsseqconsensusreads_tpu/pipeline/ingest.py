@@ -256,19 +256,27 @@ class GroupedColumnarStream:
 
     def __init__(self, path: str, flush_margin: int = 10_000,
                  strip_suffix: bool = False,
-                 scan_policy: str | None = None):
+                 scan_policy: str | None = None,
+                 grouping: str = "coordinate"):
         if scan_policy not in (None, "drop", "align", "duplex"):
             raise ValueError(f"unknown scan_policy {scan_policy!r}")
+        if grouping not in ("coordinate", "adjacent"):
+            raise ValueError(
+                f"native grouping supports coordinate|adjacent, got {grouping!r}"
+            )
         self.path = path
         self.flush_margin = flush_margin
         self.strip_suffix = strip_suffix
         self.scan_policy = scan_policy
+        self.grouping = grouping
 
     def iter_groups(self, stats=None):
         from bsseqconsensusreads_tpu.ops.encode import INDEL_BAND
 
+        # margin < 0 selects the C grouper's adjacent (MI-change) mode
+        margin = -1 if self.grouping == "adjacent" else self.flush_margin
         for batch, fam_mi, fam_nrec, refrag in native.read_grouped_columnar(
-            self.path, self.flush_margin, self.strip_suffix
+            self.path, margin, self.strip_suffix
         ):
             if stats is not None:
                 stats.records_in += batch.n

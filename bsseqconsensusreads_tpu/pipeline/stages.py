@@ -103,13 +103,14 @@ def ingest_records(path: str, reader, stats: StageStats,
     stats.metrics.count("ingest_native", int(use_native))
     use_grouped = (
         use_native
-        and grouping == "coordinate"
+        and grouping in ("coordinate", "adjacent")
         and os.environ.get("BSSEQ_TPU_NATIVE_GROUPING", "1") != "0"
     )
     stats.metrics.count("group_native", int(use_grouped))
     if use_grouped:
         return ingest.GroupedColumnarStream(
             path, strip_suffix=strip_suffix, scan_policy=scan_policy,
+            grouping=grouping,
         )
     return ingest.columnar_records(path) if use_native else reader
 
@@ -161,8 +162,11 @@ class PipelineBuilder:
         self.stats: dict = {}
         self.final_output: str | None = None  # set by build()
         #: MI streaming mode for the molecular stage; build() switches it
-        #: to 'adjacent' when the UMI-grouping pre-stage runs (its output
-        #: is MI-contiguous, not coordinate-sorted).
+        #: to 'adjacent' when the UMI-grouping pre-stage runs: its output
+        #: is MI-contiguous, and adjacency grouping is EXACT for any
+        #: template geometry (cross-contig / wide-insert pairs would trip
+        #: the coordinate sweep's position heuristics). The C-side
+        #: grouper fast path covers both modes.
         self.molecular_grouping = cfg.grouping
 
     def out(self, suffix: str) -> str:

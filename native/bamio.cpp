@@ -712,6 +712,11 @@ struct Grouper {
   int64_t margin = 10000;
   int64_t stride = 2500;
   bool strip = false;
+  // adjacent mode (margin < 0 at bamio_group_start): groups are
+  // delimited by MI change alone — exact for MI-contiguous input
+  // whatever the template geometry (a cross-contig or wide-insert pair
+  // would trip the coordinate sweep's position heuristics)
+  bool adjacent = false;
   // insertion-ordered open set: slots + key->slot map; dead slots are
   // compacted during sweeps (mirrors Python dict iteration order)
   std::vector<OpenGroup> open;
@@ -837,8 +842,20 @@ bool grouper_feed(Grouper& g, std::vector<uint8_t>&& body) {
   }
   int32_t ref_id = rd_i32(p + 0);
   int64_t pos = rd_i32(p + 4);
-  if (pos >= 0 && !g.open.empty() &&
-      (ref_id != g.last_ref || pos - g.last_pos >= g.stride)) {
+  if (g.adjacent) {
+    if (!g.open.empty() && g.index.find(key) == g.index.end()) {
+      // MI changed: flush every live group (at most one in this mode)
+      for (auto& og : g.open)
+        if (og.live) {
+          g.flushed.insert(og.key);
+          og.live = false;
+          g.ready.push_back(std::move(og));
+        }
+      g.open.clear();
+      g.index.clear();
+    }
+  } else if (pos >= 0 && !g.open.empty() &&
+             (ref_id != g.last_ref || pos - g.last_pos >= g.stride)) {
     grouper_sweep(g, ref_id, pos);
   }
   auto it = g.index.find(key);
@@ -850,7 +867,7 @@ bool grouper_feed(Grouper& g, std::vector<uint8_t>&& body) {
     it = g.index.find(key);
   }
   OpenGroup& og = g.open[it->second];
-  if (pos >= 0) {
+  if (pos >= 0 && !g.adjacent) {  // adjacent mode never reads max_end
     int64_t end = ref_end_of_body(p);
     if (og.max_end < 0 || og.ref_id != ref_id) {
       og.ref_id = ref_id;
@@ -1063,6 +1080,10 @@ int bamio_finish_mt(MtWriter* w) {
 
 Grouper* bamio_group_start(int64_t margin, int strip) {
   Grouper* g = new Grouper();
+  if (margin < 0) {  // sentinel: adjacent (MI-change-delimited) mode
+    g->adjacent = true;
+    margin = 0;
+  }
   g->margin = margin;
   g->stride = margin / 4 > 0 ? margin / 4 : 1;
   g->strip = strip != 0;

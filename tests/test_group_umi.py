@@ -517,3 +517,118 @@ def test_group_accuracy_tool_smoke(tmp_path):
     assert (
         noisy["edits1"]["completeness"] > noisy["edits0"]["completeness"]
     )
+
+
+def test_discordant_templates_survive_grouping_chain(rng):
+    """Cross-contig and wide-insert (>flush_margin) templates must come
+    through group -> molecular WHOLE: the grouped output streams in
+    'adjacent' mode, which is exact for any template geometry (the
+    coordinate sweep's position heuristics would split these)."""
+    from bsseqconsensusreads_tpu.pipeline.calling import StageStats
+
+    name, genome = random_genome(rng, 60_000)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n",
+        [("chr1", len(genome)), ("chr2", len(genome))],
+    )
+    _, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=3, reads_per_strand=(2, 2)
+    )
+    wide_fams = {0}
+    cross_fams = {1}
+    for rec in records:
+        fam = truth[rec.qname][0]
+        if fam in wide_fams and rec.pos > min(
+            r.pos for r in records if truth[r.qname][0] == fam
+        ):
+            rec.pos += 30_000  # insert far beyond the 10k flush margin
+            rec.next_pos = rec.pos if rec.is_reverse else rec.next_pos
+        if fam in cross_fams and rec.is_reverse:
+            rec.ref_id = 1  # trans-chromosomal mate
+    # keep mate pointers consistent enough for the grouper's geometry
+    grouped = list(group_reads_by_umi(records, header))
+    assert _partition_by_mi(grouped) == _truth_partition(truth)
+
+    stats = StageStats()
+    consensus = list(
+        call_molecular(grouped, grouping="adjacent", stats=stats)
+    )
+    # every strand family reached the caller WHOLE: no refragmentation,
+    # full family count. (The molecular encoder may then skip families
+    # whose window exceeds max_window — cross-contig / 30kb-insert ones —
+    # which is its own documented policy, counted in skipped_families.)
+    assert stats.refragmented_families == 0
+    n_strand_families = len({(f, s) for f, s in truth.values()})
+    assert stats.families + stats.skipped_families == n_strand_families
+    mis = {str(r.get_tag("MI")) for r in consensus}
+    assert len(mis) == stats.families
+    assert stats.skipped_families < n_strand_families  # concordant ones emit
+
+
+def test_native_adjacent_grouping_matches_python(rng, tmp_path):
+    """The C grouper's adjacent mode (margin sentinel -1) must produce
+    the same families, order, and consensus bytes as the Python
+    'adjacent' streamer over the same grouped BAM."""
+    import os as _os
+    import subprocess as _sp
+    import sys as _sys
+
+    from bsseqconsensusreads_tpu.pipeline import ingest
+
+    if not ingest.available():
+        pytest.skip("native decoder not built")
+    name, genome = random_genome(rng, 8000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=8, reads_per_strand=(2, 3)
+    )
+    grouped_path = str(tmp_path / "grouped.bam")
+    from bsseqconsensusreads_tpu.pipeline.group_umi import grouped_header
+    with BamWriter(grouped_path, grouped_header(header)) as w:
+        for rec in group_reads_by_umi(records, header):
+            w.write(rec)
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    outs = {}
+    for engine, env_extra in (
+        ("native", {}), ("python", {"BSSEQ_TPU_NATIVE_GROUPING": "0"}),
+    ):
+        out = str(tmp_path / f"cons_{engine}.bam")
+        cp = _sp.run(
+            [_sys.executable, "-m", "bsseqconsensusreads_tpu", "molecular",
+             "-i", grouped_path, "-o", out, "--grouping", "adjacent"],
+            capture_output=True, text=True, timeout=600,
+            env=dict(_os.environ, PYTHONPATH=repo, BSSEQ_TPU_BACKEND="cpu",
+                     **env_extra),
+            cwd=repo,
+        )
+        assert cp.returncode == 0, cp.stderr[-2000:]
+        assert ('"group_native": 1' in cp.stderr) == (engine == "native"), cp.stderr[-500:]
+        outs[engine] = open(out, "rb").read()
+    assert outs["native"] == outs["python"]
+
+
+def test_cross_contig_family_skipped_not_miswindowed(rng):
+    """A chimeric family whose mates land on different contigs at
+    NUMERICALLY CLOSE positions must be skipped+counted by the encoders
+    (one window = one contig), never consensus-called in a fake window
+    merging non-homologous bases — on both the python and native
+    engines."""
+    from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_molecular
+
+    name, genome = random_genome(rng, 4000)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n",
+        [("chr1", len(genome)), ("chr2", len(genome))],
+    )
+    _, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=2, reads_per_strand=(2, 2)
+    )
+    for rec in records:
+        if truth[rec.qname][0] == 0 and rec.is_reverse:
+            rec.ref_id = 1  # same pos, other contig: window math would "fit"
+    grouped = list(group_reads_by_umi(records, header))
+    stats = StageStats()
+    consensus = list(call_molecular(grouped, grouping="adjacent", stats=stats))
+    assert stats.skipped_families == 2  # both strands of the chimeric family
+    assert stats.families == 2  # the concordant family's two strands
+    assert len({str(r.get_tag("MI")) for r in consensus}) == 2

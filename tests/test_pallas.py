@@ -40,7 +40,13 @@ def _tie_columns(bases_g, quals_g, params):
 
     ll = np.asarray(vote_partials(bases_g, quals_g, params)[0])  # [W, 4]
     top2 = np.sort(ll, axis=-1)[:, -2:]
-    return np.abs(top2[:, 1] - top2[:, 0]) <= 1e-4
+    # fp32 summation-order error grows with the reads summed per column:
+    # at depth 128 a genuine near-tie can sit several ulp-sums past a
+    # fixed 1e-4, flipping between the XLA and Pallas reduction orders —
+    # scale the ambiguity band with depth (still far below any
+    # non-ambiguous log-likelihood gap)
+    tol = 1e-4 * max(1.0, bases_g.shape[0] / 16)
+    return np.abs(top2[:, 1] - top2[:, 0]) <= tol
 
 
 def _assert_vote_matches(got_g, want, tie, tag=""):
