@@ -247,6 +247,23 @@ def cmd_group(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """`fgbio CollectDuplexSeqMetrics` equivalent (pipeline.metrics):
+    family-size histograms and duplex yield from an MI-grouped BAM, one
+    streaming pass, JSON on stdout."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.pipeline import ingest
+    from bsseqconsensusreads_tpu.pipeline.metrics import duplex_seq_metrics
+
+    if ingest.available():  # columnar views carry qname+MI — all this needs
+        m = duplex_seq_metrics(ingest.columnar_records(args.input))
+    else:
+        with BamReader(args.input) as reader:
+            m = duplex_seq_metrics(reader)
+    print(json.dumps(m.as_dict(), indent=None if args.compact else 1))
+    return 0
+
+
 def cmd_filter_consensus(args) -> int:
     """`fgbio FilterConsensusReads` equivalent (pipeline.filter): the
     filtered variant the reference's dead rule hints at
@@ -403,6 +420,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-t", "--raw-tag", default="RX")
     p.add_argument("-m", "--min-map-q", type=int, default=1)
     p.set_defaults(fn=cmd_group)
+
+    p = sub.add_parser(
+        "metrics",
+        help="CollectDuplexSeqMetrics equivalent (family sizes, duplex yield)",
+    )
+    p.add_argument("-i", "--input", required=True, help="MI-grouped BAM")
+    p.add_argument("--compact", action="store_true", help="one-line JSON")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser(
         "filter-consensus",

@@ -1,0 +1,99 @@
+"""Duplex QC metrics (fgbio CollectDuplexSeqMetrics equivalent,
+pipeline.metrics): family-size histograms, strand histograms, and the
+duplex-yield tiers, over the MI-grouped output contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamRecord, BamWriter, CMATCH
+from bsseqconsensusreads_tpu.pipeline.group_umi import group_reads_by_umi
+from bsseqconsensusreads_tpu.pipeline.metrics import duplex_seq_metrics
+from bsseqconsensusreads_tpu.utils.testing import random_genome
+from tests.test_group_umi import make_raw_duplex_records
+
+
+def _rec(qname, mi):
+    rec = BamRecord(qname=qname, flag=99, ref_id=0, pos=10, mapq=60,
+                    cigar=[(CMATCH, 10)], seq="A" * 10, qual=b"\x23" * 10)
+    rec.set_tag("MI", mi, "Z")
+    return rec
+
+
+def test_family_and_strand_histograms():
+    records = (
+        # molecule 0: 2 A-templates + 1 B-template (duplex, 2/1 tier)
+        [_rec(f"a{i}", "0/A") for i in range(2)]
+        + [_rec("b0", "0/B")]
+        # molecule 1: single strand, 3 templates
+        + [_rec(f"c{i}", "1/A") for i in range(3)]
+        # molecule 2: 1+1 duplex (yield tier only)
+        + [_rec("d0", "2/A"), _rec("e0", "2/B")]
+    )
+    m = duplex_seq_metrics(records).as_dict()
+    assert m["molecules"] == 3
+    assert m["templates"] == 8
+    assert m["duplexes"] == 2
+    assert m["duplexes_2_1"] == 1
+    assert m["family_sizes"] == {"2": 1, "3": 2}
+    assert m["strand_sizes"] == {"1": 3, "2": 1, "3": 1}
+    assert m["ab_ba_sizes"] == {"1,1": 1, "2,1": 1, "3,0": 1}
+    assert m["duplex_fraction"] == round(2 / 3, 5)
+
+
+def test_paired_records_count_one_template():
+    records = []
+    for i in range(2):
+        for flag in (99, 147):  # R1+R2 of one template
+            rec = _rec(f"t{i}", "0/A")
+            rec.flag = flag
+            records.append(rec)
+    m = duplex_seq_metrics(records).as_dict()
+    assert m["molecules"] == 1 and m["templates"] == 2
+    assert m["records"] == 4
+
+
+def test_missing_mi_raises():
+    rec = _rec("x", "0/A")
+    del rec.tags["MI"]
+    with pytest.raises(ValueError, match="MI tag"):
+        duplex_seq_metrics([rec])
+
+
+def test_metrics_over_grouper_output(rng):
+    name, genome = random_genome(rng, 6000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=5, reads_per_strand=(2, 3)
+    )
+    m = duplex_seq_metrics(group_reads_by_umi(records, header)).as_dict()
+    n_families = len({f for f, _ in truth.values()})
+    assert m["molecules"] == n_families
+    assert m["duplexes"] == n_families  # simulator emits both strands
+    assert m["templates"] == len(truth)
+
+
+def test_metrics_cli_subprocess(rng, tmp_path):
+    name, genome = random_genome(rng, 4000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=3
+    )
+    bam = str(tmp_path / "grouped.bam")
+    with BamWriter(bam, header) as w:
+        for rec in group_reads_by_umi(records, header):
+            w.write(rec)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu", "metrics",
+         "-i", bam, "--compact"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, PYTHONPATH=repo, BSSEQ_TPU_BACKEND="cpu"),
+        cwd=repo,
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    m = json.loads(cp.stdout.strip().splitlines()[-1])
+    assert m["molecules"] == len({f for f, _ in truth.values()})
+    assert m["duplex_fraction"] == 1.0
