@@ -408,7 +408,11 @@ def stream_mi_groups(
     if grouping == "adjacent":
         current_mi: str | None = None
         bucket: list[BamRecord] = []
-        seen: set[str] = set()
+        # closed-family reappearance memory, kept as int hashes: it backs
+        # ONLY the refragmented counter but must remember every family
+        # ever seen — string entries would pin tens of bytes per family
+        # forever (the C grouper makes the same trade, native/bamio.cpp)
+        seen: set[int] = set()
         for rec in records:
             if stats is not None:
                 stats.records_in += 1
@@ -416,9 +420,11 @@ def stream_mi_groups(
             if mi != current_mi:
                 if bucket:
                     yield current_mi, bucket
-                if mi in seen and stats is not None:
-                    stats.refragmented_families += 1
-                seen.add(mi)
+                if stats is not None:  # the set backs only the counter
+                    h = hash(mi)
+                    if h in seen:
+                        stats.refragmented_families += 1
+                    seen.add(h)
                 current_mi, bucket = mi, []
             bucket.append(rec)
         if bucket:
@@ -430,7 +436,7 @@ def stream_mi_groups(
 
     open_groups: dict[str, list[BamRecord]] = {}
     group_end: dict[str, tuple[int, int]] = {}  # mi -> (ref_id, max end)
-    flushed: set[str] = set()
+    flushed: set[int] = set()  # hash(mi) — see the adjacent mode's note
     # Sweeping every open group per record is O(records x open_groups) —
     # the profile showed it dominating ingest. Sweep only after the stream
     # advances a fraction of the margin (or changes contig): same flush
@@ -457,9 +463,10 @@ def stream_mi_groups(
             for g in done:
                 yield g, open_groups.pop(g)
                 del group_end[g]
-                flushed.add(g)
+                if stats is not None:  # the set backs only the counter
+                    flushed.add(hash(g))
             last_sweep = (ref_id, pos)
-        if mi in flushed and mi not in open_groups and stats is not None:
+        if stats is not None and mi not in open_groups and hash(mi) in flushed:
             stats.refragmented_families += 1
         open_groups.setdefault(mi, []).append(rec)
         if pos >= 0:

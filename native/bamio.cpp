@@ -29,6 +29,27 @@ namespace {
 
 constexpr size_t kMaxBlock = 65536;
 
+// FNV-1a 64-bit over raw bytes — the one byte-loop hash in this file,
+// shared by the grouper's `flushed` reappearance set and the encode
+// scan's qname/RX tables. The flushed set exists ONLY for the
+// refragmented diagnostic counter, but it must remember every family
+// ever closed: as std::string entries it would grow to ~3 GB over a
+// 100M-read run (38M keys x ~80 B of node+SSO+malloc); 8-byte hashes
+// cut that ~4x, and a collision (p ~ 4e-5 at 38M keys) can only nudge
+// a counter, never the grouping.
+inline uint64_t fnv1a64(const uint8_t* p, size_t n) {
+  uint64_t h = 14695981039346656037ull;
+  for (size_t i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+inline uint64_t fnv1a64(const std::string& s) {
+  return fnv1a64(reinterpret_cast<const uint8_t*>(s.data()), s.size());
+}
+
 struct MtInflate;
 
 struct Reader {
@@ -722,7 +743,7 @@ struct Grouper {
   std::vector<OpenGroup> open;
   std::unordered_map<std::string, size_t> index;
   std::deque<OpenGroup> ready;
-  std::unordered_set<std::string> flushed;
+  std::unordered_set<uint64_t> flushed;
   int64_t refragmented = 0;
   int32_t last_ref = -1;
   int64_t last_pos = -(int64_t(1) << 62);
@@ -809,7 +830,7 @@ void grouper_sweep(Grouper& g, int32_t ref_id, int64_t pos) {
   for (auto& og : g.open) {
     if (!og.live) continue;
     if (og.ref_id != ref_id || og.max_end + g.margin < pos) {
-      g.flushed.insert(og.key);
+      g.flushed.insert(fnv1a64(og.key));
       g.index.erase(og.key);
       og.live = false;
       g.ready.push_back(std::move(og));
@@ -847,7 +868,7 @@ bool grouper_feed(Grouper& g, std::vector<uint8_t>&& body) {
       // MI changed: flush every live group (at most one in this mode)
       for (auto& og : g.open)
         if (og.live) {
-          g.flushed.insert(og.key);
+          g.flushed.insert(fnv1a64(og.key));
           og.live = false;
           g.ready.push_back(std::move(og));
         }
@@ -860,7 +881,7 @@ bool grouper_feed(Grouper& g, std::vector<uint8_t>&& body) {
   }
   auto it = g.index.find(key);
   if (it == g.index.end()) {
-    if (g.flushed.count(key)) g.refragmented++;
+    if (g.flushed.count(fnv1a64(key))) g.refragmented++;
     g.index[key] = g.open.size();
     g.open.emplace_back();
     g.open.back().key = key;
@@ -1188,12 +1209,7 @@ int64_t bamio_parse_grouped(
 namespace {
 
 inline uint64_t enc_hash(const uint8_t* p, size_t n) {
-  uint64_t h = 1469598103934665603ull;  // FNV-1a
-  for (size_t i = 0; i < n; i++) {
-    h ^= p[i];
-    h *= 1099511628211ull;
-  }
-  return h;
+  return fnv1a64(p, n);  // shared byte-loop hash (top of file)
 }
 
 // Fixed-width fields are NUL-padded from NUL-terminated values, so hashing
