@@ -1,13 +1,25 @@
 """Command-line interface: `python -m bsseqconsensusreads_tpu <cmd>`.
 
-Subcommands mirror the reference's entry points (SURVEY.md §1 L4):
+Subcommands mirror the reference's entry points (SURVEY.md §1 L4) plus
+the steps its users run around it:
 
 * run       — the whole pipeline for one sample (the reference's
               `snakemake -s main.snake.py --config bam=…`, README.md:62)
+* group     — fgbio GroupReadsByUmi equivalent (the reference's input
+              contract, README.md:51-55; auto-prepended by `run` when
+              the input has RX but no MI)
+* metrics   — fgbio CollectDuplexSeqMetrics equivalent (family sizes,
+              duplex yield) over an MI-grouped BAM
 * molecular — just the molecular consensus stage (fgbio
               CallMolecularConsensusReads equivalent, main.snake.py:54)
 * duplex    — just the fused duplex stage (the reference's convert ->
               extend -> sort -> callduplex chain, main.snake.py:121-164)
+* filter-consensus — fgbio FilterConsensusReads equivalent (the
+              filtered variant of the reference's dead rule,
+              main.snake.py:70-80)
+* sort / zipper / sam-to-fastq / filter-mapped — the standalone
+              fgbio SortBam / ZipperBams / Picard SamToFastq /
+              `samtools view -F 4` equivalents
 """
 
 from __future__ import annotations
