@@ -109,6 +109,16 @@ class FrameworkConfig:
     group_min_map_q: int = 1
     #: tag holding the raw UMI (fgbio --raw-tag; also what 'auto' probes).
     group_raw_tag: str = "RX"
+    #: optional consensus-filter stage on the unaligned molecular path —
+    #: the reference ships this variant as a DEAD rule (a consensus_to_fq
+    #: reading {s}_unalignedConsensus_molecular_filtered.bam that nothing
+    #: produces, main.snake.py:70-80); setting a dict of
+    #: pipeline.filter.FilterParams fields (e.g. {min_reads: [3]})
+    #: inserts the producing rule. None (default) keeps the reference's
+    #: live unfiltered-only chain. Unsupported under aligner 'self'
+    #: (its coordinate-sorted outputs break the filter's template
+    #: adjacency; use the standalone filter-consensus subcommand there).
+    filter: dict | None = None
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)
     #: through to the output the way the reference chain would

@@ -293,6 +293,41 @@ class PipelineBuilder:
                     )
                 )
 
+    def _filter_params(self):
+        """cfg.filter dict -> validated pipeline.filter.FilterParams.
+        Called at build() time too, so a bad dict fails in seconds — not
+        after an hours-long consensus stage has already run."""
+        from bsseqconsensusreads_tpu.pipeline.filter import FilterParams
+
+        kw = dict(self.cfg.filter or {})
+        if "min_reads" in kw:
+            v = kw["min_reads"]
+            kw["min_reads"] = (v,) if isinstance(v, int) else tuple(v)
+        try:
+            return FilterParams(**kw)
+        except (TypeError, ValueError) as exc:
+            raise WorkflowError(f"invalid `filter:` config: {exc}") from exc
+
+    def run_filter(self, rule) -> None:
+        """Consensus-filter stage (pipeline.filter): the producer of the
+        `…_molecular_filtered.bam` the reference's dead rule expects
+        (main.snake.py:70-80)."""
+        from bsseqconsensusreads_tpu.pipeline.filter import (
+            FilterStats,
+            filter_consensus,
+        )
+
+        params = self._filter_params()
+        stats = self.stats.setdefault("filter", FilterStats())
+        out_path = rule.outputs[0]
+        with BamReader(rule.inputs[0]) as reader:
+            header = self._pg(reader.header, "filter")
+            with BamWriter(
+                out_path, header, level=self._out_level(out_path)
+            ) as w:
+                for rec in filter_consensus(reader, params, stats=stats):
+                    w.write(rec)
+
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
@@ -441,6 +476,14 @@ class PipelineBuilder:
             )
             self.molecular_grouping = "adjacent"
         if cfg.aligner == "self":
+            if cfg.filter is not None:
+                raise WorkflowError(
+                    "the in-workflow filter stage needs the unaligned "
+                    "molecular path (aligner 'bwameth'|'none'); 'self' "
+                    "outputs are coordinate-sorted, which breaks the "
+                    "filter's template adjacency — use the standalone "
+                    "`filter-consensus` subcommand instead"
+                )
             aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
             wf.rule(
                 "call_consensus_molecular_tpu",
@@ -465,9 +508,20 @@ class PipelineBuilder:
             [molecular],
             lambda r: self.run_molecular(r, mode="unaligned"),
         )
+        fq_src = molecular
+        if cfg.filter is not None:
+            self._filter_params()  # fail fast on a bad dict
+            # the file the reference's dead rule reads (main.snake.py:72)
+            fq_src = self.out("_unalignedConsensus_molecular_filtered.bam")
+            wf.rule(
+                "filter_consensus_molecular",
+                [molecular],
+                [fq_src],
+                self.run_filter,
+            )
         fq1 = self.out("_unalignedConsensus_unfiltered_1.fq.gz")
         fq2 = self.out("_unalignedConsensus_unfiltered_2.fq.gz")
-        wf.rule("consensus_to_fq_unfiltered", [molecular], [fq1, fq2], self.run_sam_to_fastq)
+        wf.rule("consensus_to_fq_unfiltered", [fq_src], [fq1, fq2], self.run_sam_to_fastq)
         if cfg.aligner == "none":
             self.final_output = fq1
             return wf, fq1
@@ -475,7 +529,10 @@ class PipelineBuilder:
         aligned0 = self.out("_consensus_unfiltered.bam")
         wf.rule("align_consensus_unfiltered", [fq1, fq2], [aligned0], self.run_bwameth)
         merged = self.out("_consensus_unfiltered_aunamerged.bam")
-        wf.rule("mergeAunA_consensus", [aligned0, molecular], [merged], self.run_zipper)
+        # tag-graft from the BAM that actually fed the aligner (the
+        # filtered one when the filter stage ran): same grafts, no
+        # name-sort over templates the filter already dropped
+        wf.rule("mergeAunA_consensus", [aligned0, fq_src], [merged], self.run_zipper)
         aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
         wf.rule("mergeAunA_consensus_grepaligned", [merged], [aligned], self.run_filter_mapped)
         duplex = self.out(

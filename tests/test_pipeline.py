@@ -747,3 +747,75 @@ class TestAutoUmiGrouping:
             w.write_all([lead] + records)
         builder = PipelineBuilder(FrameworkConfig(aligner="self"), bam)
         assert builder._needs_grouping()
+
+
+class TestWorkflowFilterStage:
+    """config `filter:` revives the reference's dead filtered-variant rule
+    (main.snake.py:70-80): the workflow inserts a producer for
+    `…_unalignedConsensus_molecular_filtered.bam` ahead of SamToFastq."""
+
+    def test_filter_stage_runs_and_feeds_fastq(self, pipeline_env, tmp_path):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            aligner="none",
+            filter={"min_reads": [1], "max_read_error_rate": 1.0,
+                    "max_base_error_rate": 1.0, "min_base_quality": 0,
+                    "max_no_call_fraction": 1.0},
+        )
+        outdir = str(tmp_path / "out_filtered")
+        target, results, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert [r.name for r in results if r.ran] == [
+            "call_consensus_reads_molecular",
+            "filter_consensus_molecular",
+            "consensus_to_fq_unfiltered",
+        ]
+        filtered = os.path.join(
+            outdir, sample_name(env["bam"]) + "_unalignedConsensus_molecular_filtered.bam"
+        )
+        assert os.path.exists(filtered)
+        assert stats["filter"].kept_records == stats["filter"].records_in > 0
+        assert os.path.exists(target)  # fastq 1
+
+    def test_strict_filter_drops_all(self, pipeline_env, tmp_path):
+        env = pipeline_env
+        cfg = FrameworkConfig(aligner="none", filter={"min_reads": [50]})
+        outdir = str(tmp_path / "out_strict")
+        _, _, stats = run_pipeline(cfg, env["bam"], outdir=outdir)
+        assert stats["filter"].kept_records == 0
+        assert stats["filter"].dropped_depth == stats["filter"].templates > 0
+
+    def test_filter_rejected_under_self_aligner(self, pipeline_env, tmp_path):
+        cfg = FrameworkConfig(aligner="self", filter={"min_reads": [1]})
+        with pytest.raises(WorkflowError, match="filter"):
+            run_pipeline(
+                cfg, pipeline_env["bam"], outdir=str(tmp_path / "out_self")
+            )
+
+    def test_filter_config_from_yaml(self, tmp_path):
+        cfg_path = tmp_path / "c.yaml"
+        cfg_path.write_text(
+            "aligner: none\nfilter:\n  min_reads: [3, 1, 1]\n"
+            "  max_no_call_fraction: 0.5\n"
+        )
+        cfg = FrameworkConfig.from_yaml(str(cfg_path))
+        assert cfg.filter == {"min_reads": [3, 1, 1], "max_no_call_fraction": 0.5}
+
+    def test_bad_filter_config_fails_at_build_time(self, pipeline_env, tmp_path):
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+
+        for bad in ({"min_reads": [1, 3]}, {"min_read": [3]}):
+            cfg = FrameworkConfig(aligner="none", filter=bad)
+            builder = PipelineBuilder(cfg, pipeline_env["bam"], outdir="x")
+            with pytest.raises(WorkflowError, match="invalid `filter:`"):
+                builder.build()
+
+    def test_scalar_min_reads_accepted(self, pipeline_env, tmp_path):
+        cfg = FrameworkConfig(
+            aligner="none",
+            filter={"min_reads": 1, "max_read_error_rate": 1.0,
+                    "max_base_error_rate": 1.0, "min_base_quality": 0,
+                    "max_no_call_fraction": 1.0},
+        )
+        outdir = str(tmp_path / "out_scalar")
+        _, _, stats = run_pipeline(cfg, pipeline_env["bam"], outdir=outdir)
+        assert stats["filter"].kept_records > 0
