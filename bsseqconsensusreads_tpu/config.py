@@ -115,9 +115,10 @@ class FrameworkConfig:
     #: produces, main.snake.py:70-80); setting a dict of
     #: pipeline.filter.FilterParams fields (e.g. {min_reads: [3]})
     #: inserts the producing rule. None (default) keeps the reference's
-    #: live unfiltered-only chain. Unsupported under aligner 'self'
-    #: (its coordinate-sorted outputs break the filter's template
-    #: adjacency; use the standalone filter-consensus subcommand there).
+    #: live unfiltered-only chain. Under aligner 'self' the filter runs
+    #: on the final duplex output instead (name-sort -> filter ->
+    #: coordinate-sort, bounded memory; duplex depth tags count strand
+    #: PRESENCE there — min_reads [2, 1, 1] = require both strands).
     filter: dict | None = None
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)

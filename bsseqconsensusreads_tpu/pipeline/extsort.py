@@ -256,10 +256,12 @@ def sorted_write(
     header: BamHeader,
     workdir: str | None = None,
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
+    level: int = 6,
 ) -> int:
-    """external_sort + streaming write to `out_path`; returns record count."""
+    """external_sort + streaming write to `out_path` at BGZF deflate
+    `level`; returns record count."""
     n = 0
-    with BamWriter(out_path, header) as w:
+    with BamWriter(out_path, header, level=level) as w:
         for rec in external_sort(
             records, key, header, workdir=workdir, buffer_records=buffer_records
         ):

@@ -328,6 +328,46 @@ class PipelineBuilder:
                 for rec in filter_consensus(reader, params, stats=stats):
                     w.write(rec)
 
+    def run_filter_duplex(self, rule) -> None:
+        """Self-mode consensus filter: the duplex output is
+        coordinate-sorted, so template mates are not adjacent — stream it
+        through an external name sort, filter template-atomically, and
+        coordinate-sort the survivors back out. Three bounded-memory
+        passes over the final output — deliberately NOT fused into the
+        duplex stage's own sort: filtering pre-sort would need decoded
+        records and so would force the per-record python emit path,
+        costing about what the two extra raw-blob passes cost, while
+        keeping the optional QC stage out of the hot path entirely."""
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort,
+            sorted_write,
+        )
+        from bsseqconsensusreads_tpu.pipeline.filter import (
+            FilterStats,
+            filter_consensus,
+        )
+        from bsseqconsensusreads_tpu.pipeline.record_ops import (
+            coordinate_key,
+            name_key,
+        )
+
+        params = self._filter_params()
+        stats = self.stats.setdefault("filter", FilterStats())
+        with BamReader(rule.inputs[0]) as reader:
+            header = self._pg(reader.header, "filter")
+            name_sorted = external_sort(
+                reader, name_key, header,
+                workdir=self.cfg.tmp,
+                buffer_records=self.cfg.sort_buffer_records,
+            )
+            sorted_write(
+                filter_consensus(name_sorted, params, stats=stats),
+                coordinate_key, rule.outputs[0], header,
+                workdir=self.cfg.tmp,
+                buffer_records=self.cfg.sort_buffer_records,
+                level=self._out_level(rule.outputs[0]),
+            )
+
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats())
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
@@ -476,14 +516,6 @@ class PipelineBuilder:
             )
             self.molecular_grouping = "adjacent"
         if cfg.aligner == "self":
-            if cfg.filter is not None:
-                raise WorkflowError(
-                    "the in-workflow filter stage needs the unaligned "
-                    "molecular path (aligner 'bwameth'|'none'); 'self' "
-                    "outputs are coordinate-sorted, which breaks the "
-                    "filter's template adjacency — use the standalone "
-                    "`filter-consensus` subcommand instead"
-                )
             aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
             wf.rule(
                 "call_consensus_molecular_tpu",
@@ -498,6 +530,26 @@ class PipelineBuilder:
                 [target],
                 lambda r: self.run_duplex(r, mode="self"),
             )
+            if cfg.filter is not None:
+                self._filter_params()  # fail fast on a bad dict
+                if cfg.duplex_passthrough:
+                    raise WorkflowError(
+                        "filter + duplex_passthrough: passthrough "
+                        "leftovers carry no cd consensus tags, which the "
+                        "filter requires — disable one of the two"
+                    )
+                # duplex cd/ad/bd count strand PRESENCE here (the stage
+                # merges single-strand consensi) — min_reads [2,1,1]
+                # means "both strands present"; see pipeline.filter docs
+                ftarget = self.out("_consensus_duplex_filtered.bam")
+                wf.rule(
+                    "filter_consensus_duplex",
+                    [target],
+                    [ftarget],
+                    self.run_filter_duplex,
+                )
+                self.final_output = ftarget
+                return wf, ftarget
             self.final_output = target
             return wf, target
 

@@ -13,6 +13,7 @@ from bsseqconsensusreads_tpu.io.bam import BamHeader, BamReader, BamRecord, BamW
 from bsseqconsensusreads_tpu.io.sam import format_sam_record, parse_sam_line, read_sam
 from bsseqconsensusreads_tpu.pipeline.calling import StageStats, call_duplex, call_molecular
 from bsseqconsensusreads_tpu.pipeline.record_ops import (
+    coordinate_key,
     coordinate_sort,
     filter_mapped,
     name_sort,
@@ -784,12 +785,43 @@ class TestWorkflowFilterStage:
         assert stats["filter"].kept_records == 0
         assert stats["filter"].dropped_depth == stats["filter"].templates > 0
 
-    def test_filter_rejected_under_self_aligner(self, pipeline_env, tmp_path):
-        cfg = FrameworkConfig(aligner="self", filter={"min_reads": [1]})
-        with pytest.raises(WorkflowError, match="filter"):
-            run_pipeline(
-                cfg, pipeline_env["bam"], outdir=str(tmp_path / "out_self")
-            )
+    def test_self_mode_filters_duplex_output(self, pipeline_env, tmp_path):
+        """Under aligner 'self' the filter runs on the final duplex BAM
+        via name-sort -> filter -> coordinate-sort; duplex depth tags
+        count strand presence, so [2,1,1] = both strands present."""
+        env = pipeline_env
+        base_cfg = dict(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+        )
+        permissive = FrameworkConfig(
+            **base_cfg,
+            filter={"min_reads": [2, 1, 1], "max_read_error_rate": 1.0,
+                    "max_base_error_rate": 1.0, "min_base_quality": 0,
+                    "max_no_call_fraction": 1.0},
+        )
+        outdir = str(tmp_path / "out_selffilter")
+        target, results, stats = run_pipeline(permissive, env["bam"], outdir=outdir)
+        assert target.endswith("_consensus_duplex_filtered.bam")
+        assert [r.name for r in results if r.ran][-1] == "filter_consensus_duplex"
+        with BamReader(target) as r:
+            kept = list(r)
+        # simulator emits both strands for every family: everything survives,
+        # and the output is coordinate-sorted
+        unfiltered = os.path.join(
+            outdir, sample_name(env["bam"]) + "_consensus_duplex_unfiltered.bam"
+        )
+        with BamReader(unfiltered) as r:
+            assert len(kept) == sum(1 for _ in r) > 0
+        assert [coordinate_key(r) for r in kept] == sorted(
+            coordinate_key(r) for r in kept
+        )
+        strict = FrameworkConfig(**base_cfg, filter={"min_reads": [50]})
+        _, _, stats = run_pipeline(
+            strict, env["bam"], outdir=str(tmp_path / "out_selfstrict")
+        )
+        assert stats["filter"].kept_records == 0
 
     def test_filter_config_from_yaml(self, tmp_path):
         cfg_path = tmp_path / "c.yaml"
@@ -819,3 +851,13 @@ class TestWorkflowFilterStage:
         outdir = str(tmp_path / "out_scalar")
         _, _, stats = run_pipeline(cfg, pipeline_env["bam"], outdir=outdir)
         assert stats["filter"].kept_records > 0
+
+    def test_filter_with_passthrough_rejected(self, pipeline_env):
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+
+        cfg = FrameworkConfig(
+            aligner="self", filter={"min_reads": [1]}, duplex_passthrough=True
+        )
+        builder = PipelineBuilder(cfg, pipeline_env["bam"], outdir="x")
+        with pytest.raises(WorkflowError, match="passthrough"):
+            builder.build()
