@@ -354,7 +354,9 @@ class PipelineBuilder:
         params = self._filter_params()
         stats = self.stats.setdefault("filter", FilterStats())
         with BamReader(rule.inputs[0]) as reader:
-            header = self._pg(reader.header, "filter")
+            header = self._pg(
+                reader.header, "filter"
+            ).with_sort_order("coordinate")
             name_sorted = external_sort(
                 reader, name_key, header,
                 workdir=self.cfg.tmp,
@@ -400,6 +402,8 @@ class PipelineBuilder:
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("duplex"):
             names = [n for n, _ in reader.header.references]
             header = self._pg(reader.header, "duplex")
+            if mode == "self":  # output leaves coordinate-sorted
+                header = header.with_sort_order("coordinate")
             ck = self._checkpointed("duplex", rule, header)
             batches = call_duplex_batches(
                 duplex_ingest_stream(

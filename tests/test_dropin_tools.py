@@ -262,3 +262,55 @@ def test_filter_consensus_dropin_subprocess(molecular_input, tmp_path):
     assert cp.returncode == 0, cp.stderr[-2000:]
     with BamReader(strict) as r:
         assert sum(1 for _ in r) == 0
+
+
+def test_full_user_journey_via_dropins(tmp_path):
+    """The complete fgbio-free journey, every step a subprocess drop-in
+    the way Snakemake rule bodies would chain them: raw aligned BAM ->
+    group -> metrics -> molecular consensus -> filter."""
+    import json
+
+    from tests.test_group_umi import make_raw_duplex_records
+
+    rng = np.random.default_rng(81)
+    name, genome = random_genome(rng, 8000)
+    header, records, truth = make_raw_duplex_records(
+        rng, name, genome, n_families=6, reads_per_strand=(3, 4)
+    )
+    raw = str(tmp_path / "raw.bam")
+    with BamWriter(raw, header) as w:
+        w.write_all(records)
+    n_families = len({f for f, _ in truth.values()})
+
+    grouped = str(tmp_path / "grouped.bam")
+    cp = _run_tool("group_reads_by_umi_tpu.py",
+                   ["-s", "paired", "-e", "1", "-i", raw, "-o", grouped])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu", "metrics",
+         "-i", grouped, "--compact"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu"),
+        cwd=REPO,
+    )
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    m = json.loads(cp.stdout.strip().splitlines()[-1])
+    assert m["molecules"] == n_families and m["duplex_fraction"] == 1.0
+
+    consensus = str(tmp_path / "consensus.bam")
+    cp = _run_tool("call_molecular_consensus_tpu.py",
+                   ["-i", grouped, "-o", consensus, "--grouping", "adjacent"])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+
+    filtered = str(tmp_path / "filtered.bam")
+    cp = _run_tool("filter_consensus_reads_tpu.py",
+                   ["-i", consensus, "-o", filtered, "-M", "2",
+                    "-E", "1.0", "-e", "1.0", "-N", "0", "-n", "1.0"])
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    with BamReader(filtered) as r:
+        kept = list(r)
+    # every strand family simulated at depth >= 3 survives -M 2: R1+R2 per
+    # strand family
+    assert len(kept) == 2 * 2 * n_families
+    assert all(rec.has_tag("MI") and rec.has_tag("cD") for rec in kept)

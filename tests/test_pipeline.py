@@ -861,3 +861,22 @@ class TestWorkflowFilterStage:
         builder = PipelineBuilder(cfg, pipeline_env["bam"], outdir="x")
         with pytest.raises(WorkflowError, match="passthrough"):
             builder.build()
+
+    def test_final_headers_declare_coordinate_order(self, pipeline_env, tmp_path):
+        env = pipeline_env
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="self",
+            filter={"min_reads": [1], "max_read_error_rate": 1.0,
+                    "max_base_error_rate": 1.0, "min_base_quality": 0,
+                    "max_no_call_fraction": 1.0},
+        )
+        outdir = str(tmp_path / "out_hd")
+        target, _, _ = run_pipeline(cfg, env["bam"], outdir=outdir)
+        for path in (
+            target,
+            os.path.join(outdir, sample_name(env["bam"]) + "_consensus_duplex_unfiltered.bam"),
+        ):
+            with BamReader(path) as r:
+                assert "SO:coordinate" in r.header.text, path
