@@ -86,6 +86,7 @@ class GroupStats:
     dropped_unmapped: int = 0
     dropped_mapq: int = 0
     dropped_no_umi: int = 0
+    dropped_n_umi: int = 0
     dropped_unpaired: int = 0
     molecules: int = 0
     position_groups: int = 0
@@ -138,6 +139,22 @@ def _position_key(reads: list[BamRecord]) -> str:
     ends = sorted(_end_key(r) for r in reads)
     if len(ends) == 1:
         ends.append((0x7FFFFFF, 0, 0))
+    for ref, pos, _rev in ends:
+        # The packed fields are fixed-width (7 hex ref, 9 hex pos) so the
+        # composite sorts lexicographically == genomically and pass 2 can
+        # slice on _POSKEY_WIDTH.  An unclipped 5' start below -4096
+        # (>4 kb leading clip — long-read input, outside this pipeline's
+        # short-read envelope) or past 9 hex digits would format out of
+        # width and silently corrupt bucket boundaries; fail loudly.
+        if not (0 <= pos + 4096 <= 0xFFFFFFFFF) or not (
+            0 <= ref + 1 <= 0xFFFFFFF
+        ):
+            raise ValueError(
+                f"unclipped template end (ref={ref}, pos={pos}) outside "
+                "the packable grouping envelope "
+                "(-4096 <= pos < 16**9 - 4096); input is not short-read "
+                "duplex data this grouper supports"
+            )
     return "".join(
         f"{ref + 1:07x}{pos + 4096:09x}{rev:d}" for ref, pos, rev in ends
     )
@@ -362,6 +379,13 @@ def _annotate_composites(
         else:
             canonical = str(rx)
             strand = "A"
+        if "N" in canonical.upper():
+            # fgbio GroupReadsByUmi drops templates whose UMI contains an
+            # N base (it cannot participate in mismatch clustering); keep
+            # parity rather than letting it seed its own molecule.  After
+            # the format checks so a malformed duplex UMI still raises.
+            stats.dropped_n_umi += 1
+            continue
         poskey = _position_key(reads)
         stats.accepted += 1
         for rec, blob in primaries:

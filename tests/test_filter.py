@@ -173,13 +173,22 @@ class TestParamsValidation:
             list(filter_consensus([rec], FilterParams()))
 
 
-def test_filters_real_consensus_output(rng):
+def test_filters_real_consensus_output():
     """End-to-end: molecular consensus output (the real tag surface from
     pipeline.calling) through the filter; min_reads above the simulated
-    depth range drops everything, 1 keeps everything."""
-    name, genome = random_genome(rng, 4000)
+    depth range drops everything, 1 keeps everything.
+
+    Locally seeded rng (NOT the session fixture): the "defaults bite"
+    assertion below depends on the drawn depths, and drawing from the
+    shared session stream would couple it to test-file ordering (the
+    documented rng-coupling flake class).  With this seed the draw
+    contains both depth-1 strands (always dropped by min_reads=2) and
+    deeper strands that survive; re-seeding requires re-checking that
+    both sides of the split still occur."""
+    local_rng = np.random.default_rng(20260731)
+    name, genome = random_genome(local_rng, 4000)
     header, records = make_grouped_bam_records(
-        rng, name, genome, n_families=4, reads_per_strand=(2, 3)
+        local_rng, name, genome, n_families=6, reads_per_strand=(1, 3)
     )
     consensus = list(call_molecular(records))
     assert consensus

@@ -281,6 +281,38 @@ def test_unpaired_template_dropped_for_paired_strategy(rng):
     assert "widowed" not in {r.qname for r in out}
 
 
+def test_n_umi_template_dropped(rng):
+    """fgbio GroupReadsByUmi drops templates whose UMI contains an N
+    base; the drop is counted and the clean families still group."""
+    name, genome = random_genome(rng, 2000)
+    header, records, _ = make_raw_duplex_records(
+        rng, name, genome, n_families=2,
+        rx_override=lambda f, s, d: "ACGTN-CCAGT" if f == 0 else None,
+    )
+    fam0_templates = len(
+        {r.qname for r in records if r.qname.startswith("t0x")}
+    )
+    stats = GroupStats()
+    out = list(group_reads_by_umi(records, header, stats=stats))
+    assert stats.dropped_n_umi == fam0_templates
+    assert out and all("t0x" not in r.qname for r in out)
+
+
+def test_position_key_envelope_raises():
+    """A >4 kb leading clip pushes the unclipped 5' start below the
+    packable envelope; the grouper must fail loudly, not mis-sort
+    (round-3 advisor finding)."""
+    from bsseqconsensusreads_tpu.pipeline.group_umi import _position_key
+
+    rec = BamRecord(
+        qname="longclip", flag=0, ref_id=0, pos=10, mapq=60,
+        seq="A" * 5000, qual=b"\x1e" * 5000,
+        cigar=[(CSOFT_CLIP, 4999), (CMATCH, 1)],
+    )
+    with pytest.raises(ValueError, match="envelope"):
+        _position_key([rec])
+
+
 def test_malformed_duplex_umi_raises(rng):
     name, genome = random_genome(rng, 2000)
     header, records, _ = make_raw_duplex_records(
