@@ -61,7 +61,9 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
         help="device transport: ONE packed u32 array per direction "
         "(+ device-resident genome on duplex; round-robin across devices "
         "on multi-device runs), or plain tensors — byte-identical output "
-        "either way",
+        "either way; 'auto' = wire on single-device accelerators, "
+        "unpacked on CPU and on meshes (say 'wire' explicitly for the "
+        "multi-device round-robin wire)",
     )
     p.add_argument(
         "--grouping",

@@ -87,9 +87,11 @@ class FrameworkConfig:
     #: output); on multi-device runs 'wire' round-robins whole batches
     #: across the devices (zero collectives, genome uploaded once per
     #: device). 'unpacked' ships plain tensors (+ host-fetched ref windows
-    #: on duplex); 'auto' picks wire on single-device accelerator runs (on
-    #: the CPU backend there is no transfer to save, and the default
-    #: sharded path shards unpacked tensors).
+    #: on duplex); 'auto' picks wire on single-device accelerator runs
+    #: ONLY — on the CPU backend there is no transfer to save, and on a
+    #: multi-device mesh 'auto' resolves to the sharded unpacked path
+    #: (round-robin wire must be requested explicitly with 'wire'; see
+    #: pipeline.calling._resolve_transport).
     transport: str = "auto"
     #: UMI grouping pre-stage (fgbio GroupReadsByUmi equivalent,
     #: pipeline.group_umi) — the step the reference requires its USER to

@@ -2,9 +2,10 @@
 
 The reference delegates all record I/O to pysam/htslib and samtools
 (reference: tools/1.convert_AG_to_CT.py:25-26, main.snake.py:93). This package
-implements the formats directly in a pure-Python codec. (A native C++ codec
-for the hot decode path is planned under native/ and will be preferred when
-built; until then this is the only codec.)
+implements the formats directly in a pure-Python codec, with a native C++
+fast path for the hot decode/emit paths (native/bamio.cpp, native/wirepack.cpp
+via io.native / io.wirepack) that is preferred automatically when built; the
+pure-Python codec is the reference implementation and the fallback.
 """
 
 from bsseqconsensusreads_tpu.io.bam import (  # noqa: F401

@@ -2,8 +2,10 @@
 
 Pure-Python implementation over zlib. The reference reads/writes BGZF only
 through htslib (via pysam / samtools); this is a first-party replacement so the
-framework has no dependency on either. (A native C++ codec for the hot decode
-path is planned under native/; until it lands this module is the only codec.)
+framework has no dependency on either. The hot decode path has a native C++
+codec (native/bamio.cpp multi-threaded inflate via io.native); this module is
+the reference implementation and the fallback when the native library is not
+built.
 
 Format: a BGZF file is a sequence of gzip members, each with an FEXTRA "BC"
 subfield carrying BSIZE (total member size - 1), uncompressed payload at most

@@ -444,16 +444,33 @@ class PipelineBuilder:
             f"{self.cfg.bwameth} --reference {shlex.quote(self.cfg.genome_fasta)} "
             f"-t 8 {shlex.quote(rule.inputs[0])} {shlex.quote(rule.inputs[1])}"
         )
-        proc = subprocess.Popen(
-            cmd, shell=True, stdout=subprocess.PIPE, text=True
-        )
-        header, records = read_sam(proc.stdout)
-        with BamWriter(
-            rule.outputs[0], header, level=self._out_level(rule.outputs[0])
-        ) as writer:
-            writer.write_all(records)
-        if proc.wait() != 0:
-            raise WorkflowError(f"bwameth failed: {cmd}")
+        # The reference tees bwameth stderr of the FIRST alignment to
+        # output/log/bwameth_results/{sample}_consensus_unfiltered.log
+        # (main.snake.py:88-89) and declares no log on the final duplex
+        # alignment (:186-189); same shape here.
+        log_fh = None
+        if rule.name == "align_consensus_unfiltered":
+            log_path = os.path.join(
+                self.outdir, "log", "bwameth_results",
+                f"{self.sample}_consensus_unfiltered.log",
+            )
+            os.makedirs(os.path.dirname(log_path), exist_ok=True)
+            log_fh = open(log_path, "w")
+        try:
+            proc = subprocess.Popen(
+                cmd, shell=True, stdout=subprocess.PIPE, stderr=log_fh,
+                text=True,
+            )
+            header, records = read_sam(proc.stdout)
+            with BamWriter(
+                rule.outputs[0], header, level=self._out_level(rule.outputs[0])
+            ) as writer:
+                writer.write_all(records)
+            if proc.wait() != 0:
+                raise WorkflowError(f"bwameth failed: {cmd}")
+        finally:
+            if log_fh is not None:
+                log_fh.close()
 
     def run_zipper(self, rule) -> None:
         with BamReader(rule.inputs[0]) as aligned, BamReader(rule.inputs[1]) as unaligned:

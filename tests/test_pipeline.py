@@ -280,6 +280,56 @@ class TestParityModeStages:
         with pytest.raises(WorkflowError, match="bwameth"):
             run_pipeline(cfg, env["bam"], outdir=outdir)
 
+    def test_bwameth_stderr_logged(self, pipeline_env, tmp_path):
+        """The reference tees the first alignment's bwameth stderr to
+        output/log/bwameth_results/{sample}_consensus_unfiltered.log
+        (main.snake.py:88-89) and declares no log on the final duplex
+        alignment (:186-189); run_bwameth reproduces both."""
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+        from bsseqconsensusreads_tpu.pipeline.workflow import Rule
+
+        env = pipeline_env
+        fake = tmp_path / "fake_bwameth.sh"
+        fake.write_text(
+            "#!/bin/sh\n"
+            "echo 'bwameth-parity-log-line' >&2\n"
+            "printf '@HD\\tVN:1.6\\tSO:unsorted\\n'\n"
+            "printf '@SQ\\tSN:chr1\\tLN:1000\\n'\n"
+            "printf 'r1\\t0\\tchr1\\t1\\t60\\t4M\\t*\\t0\\t0\\tACGT\\tIIII\\n'\n"
+        )
+        fake.chmod(0o755)
+        fq = tmp_path / "in_1.fq.gz"
+        with gzip.open(fq, "wt") as fh:
+            fh.write("@r1\nACGT\n+\nIIII\n")
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="bwameth",
+            bwameth=str(fake),
+        )
+        outdir = str(tmp_path / "output")
+        builder = PipelineBuilder(cfg, env["bam"], outdir=outdir)
+        out_bam = str(tmp_path / "aligned.bam")
+        builder.run_bwameth(Rule(
+            name="align_consensus_unfiltered",
+            inputs=[str(fq), str(fq)], outputs=[out_bam], run=None,
+        ))
+        log = os.path.join(
+            outdir, "log", "bwameth_results",
+            f"{builder.sample}_consensus_unfiltered.log",
+        )
+        assert "bwameth-parity-log-line" in open(log).read()
+        with BamReader(out_bam) as r:
+            assert [rec.qname for rec in r] == ["r1"]
+        # final duplex alignment: no log, stderr falls through
+        out2 = str(tmp_path / "aligned2.bam")
+        builder.run_bwameth(Rule(
+            name="align_consensus_unfiltered_duplex",
+            inputs=[str(fq), str(fq)], outputs=[out2], run=None,
+        ))
+        logs = os.listdir(os.path.join(outdir, "log", "bwameth_results"))
+        assert logs == [f"{builder.sample}_consensus_unfiltered.log"]
+
 
 class TestStreaming:
     def _tagged(self, qname, mi, pos):
