@@ -199,6 +199,7 @@ def cmd_duplex(args) -> int:
             transport=args.transport,
             passthrough=args.passthrough,
             vote_kernel=args.vote_kernel,
+            pos0=args.pos0,
         )
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
@@ -399,6 +400,13 @@ def main(argv: list[str] | None = None) -> int:
         help="reference-parity emission of off-vocabulary records (the "
         "convert-stage treatment of tools/1.convert_AG_to_CT.py applied "
         "to leftovers; default drops them, counted in stats)",
+    )
+    p.add_argument(
+        "--pos0", choices=("skip", "shift"), default="skip",
+        help="conversion prepend for reads at reference position 0: "
+        "'skip' (default, documented deviation) or 'shift' = exact "
+        "reference parity incl. the one-base register shift "
+        "(tools/1.convert_AG_to_CT.py:87-92)",
     )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)

@@ -128,6 +128,13 @@ class FrameworkConfig:
     #: (tools/1.convert_AG_to_CT.py:70-73, tools/2.extend_gap.py:114-115);
     #: False (default) drops them, counted in stats.leftover_records.
     duplex_passthrough: bool = False
+    #: conversion-prepend behavior for convert-flag reads mapped at
+    #: reference position 0: 'skip' (default) skips the prepend — the
+    #: documented sane deviation (ops/convert.py) — while 'shift'
+    #: reproduces the reference exactly, register shift included
+    #: (tools/1.convert_AG_to_CT.py:87-92); 'shift' keeps the duplex
+    #: encode on the Python placement path.
+    pos0: str = "skip"
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

@@ -28,10 +28,14 @@ with the standalone A-over-ref-G rule at that position, and the skipped
 iteration would have been a no-op (G stays G). Hence this op is a single
 vectorized select over (read, ref, ref-shifted, read-shifted).
 
-Documented deviation: a read mapped at reference position 0 cannot be
-prepended (no column to the left). The reference still prepends there,
+Documented deviation (default): a read mapped at reference position 0 cannot
+be prepended (no column to the left). The reference still prepends there,
 shifting the whole read one base out of register (a faithful-but-wrong
-translation we refuse to reproduce); we skip the prepend and set LA=0.
+translation, tools/1.convert_AG_to_CT.py:87-92); by default we skip the
+prepend and set LA=0. Exact parity is available: pos0='shift' at the encode
+layer (ops.encode.encode_duplex_families, config.pos0) places the read one
+window column right, after which this op's ordinary prepend path reproduces
+the reference's register shift bit-for-bit — this op itself needs no mode.
 """
 
 from __future__ import annotations

@@ -486,6 +486,7 @@ def encode_duplex_families(
     ref_names: Sequence[str],
     max_window: int = 4096,
     fetch_ref: bool = True,
+    pos0: str = "skip",
 ) -> tuple[DuplexBatch, list[BamRecord], list[str]]:
     """Encode duplex MI groups (strand suffix already stripped) for the fused
     convert+extend+duplex TPU stage.
@@ -509,9 +510,27 @@ def encode_duplex_families(
     fetch_ref=False leaves batch.ref all-N — for the wire transport, whose
     kernel gathers the windows from the device-resident genome
     (ops.refstore) instead of shipping them from the host.
+
+    pos0: what a convert-row read mapped at reference position 0 does about
+    the conversion prepend (there is no column to its left).  'skip' (the
+    default) skips the prepend — the sane behavior documented in
+    ops/convert.py.  'shift' reproduces the reference exactly
+    (tools/1.convert_AG_to_CT.py:87-92: prepend anyway, clamp pos to 0,
+    shifting the whole read one base out of register): the read is placed
+    one window column right, so the standard prepend path then writes the
+    reference base at its original start column and every comparison runs
+    at the reference's shifted register.  'shift' disables the native
+    duplex encode scan (the rare-parity mode stays on the Python
+    placement path).
     """
+    if pos0 not in ("skip", "shift"):
+        raise ValueError(f"pos0 must be 'skip'|'shift', got {pos0!r}")
     fams = families if isinstance(families, list) else list(families)
-    if fams and all(scan_matches(f, "duplex") for f in fams):
+    if (
+        pos0 == "skip"
+        and fams
+        and all(scan_matches(f, "duplex") for f in fams)
+    ):
         return _encode_duplex_native(
             fams, ref_fetch, ref_names, max_window, fetch_ref
         )
@@ -548,6 +567,10 @@ def encode_duplex_families(
                 leftovers.append(rec)
                 continue
             codes, quals, pos = trimmed
+            if pos0 == "shift" and pos == 0 and row in CONVERT_ROWS:
+                # reference pos-0 register shift (see docstring): place one
+                # column right; the conversion prepend then fills column 0
+                pos = 1
             rows[row] = (codes, quals, pos)
             if not rx:
                 try:  # one tag parse, not a has_tag/get_tag pair

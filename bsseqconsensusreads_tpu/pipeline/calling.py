@@ -1034,7 +1034,8 @@ _PASS_FLAGS = (0, 99, 147)
 _CONVERT_FLAGS = (1, 83, 163)
 
 
-def _passthrough_records(leftovers, ref_fetch, ref_names) -> list[BamRecord]:
+def _passthrough_records(leftovers, ref_fetch, ref_names,
+                         pos0: str = "skip") -> list[BamRecord]:
     """Reference-parity emission for records the duplex tensorizer rejected
     (off-vocabulary flags, duplicate rows, non-4-group members).
 
@@ -1069,7 +1070,7 @@ def _passthrough_records(leftovers, ref_fetch, ref_names) -> list[BamRecord]:
             0 <= rec.ref_id < len(ref_names)
         ) else ""
         cseq, cquals, cpos, la, rd = oracle_convert_read(
-            seq, [int(q) for q in quals], pos - ws, window
+            seq, [int(q) for q in quals], pos - ws, window, pos0=pos0
         )
         new = BamRecord(
             qname=rec.qname, flag=rec.flag, ref_id=rec.ref_id,
@@ -1101,6 +1102,7 @@ def call_duplex_batches(
     emit: str = "python",
     refstore=None,
     transport: str = "auto",
+    pos0: str = "skip",
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -1136,6 +1138,11 @@ def call_duplex_batches(
     mesh: 'auto' shards the family axis across all visible devices when
     more than one is present (results identical to single-device — every
     family is computed whole on one device); None forces single-device.
+
+    pos0: conversion-prepend behavior for reads mapped at reference
+    position 0 — 'skip' (default, documented deviation) or 'shift'
+    (exact reference parity incl. the register shift; see
+    ops.encode.encode_duplex_families).
     """
     import os
 
@@ -1278,13 +1285,15 @@ def call_duplex_batches(
                 # fetch (batch.ref stays all-N and unused)
                 batch, leftovers, skipped = encode_duplex_families(
                     chunk, ref_fetch, ref_names, max_window=max_window,
-                    fetch_ref=not use_wire,
+                    fetch_ref=not use_wire, pos0=pos0,
                 )
             stats.skipped_families += len(skipped)
             stats.leftover_records += len(leftovers)
             passed: list[BamRecord] = []
             if passthrough and leftovers:
-                passed = _passthrough_records(leftovers, ref_fetch, ref_names)
+                passed = _passthrough_records(
+                    leftovers, ref_fetch, ref_names, pos0=pos0
+                )
             if not batch.meta:
                 yield "now", passed
                 continue
@@ -1385,10 +1394,11 @@ def call_duplex(
     grouping: str = "gather",
     stats: StageStats | None = None,
     passthrough: bool = False,
+    pos0: str = "skip",
 ) -> Iterator[BamRecord]:
     """Flat-record view of call_duplex_batches (same arguments)."""
     for batch in call_duplex_batches(
         records, ref_fetch, ref_names, params, mode, batch_families,
-        max_window, grouping, stats, passthrough=passthrough,
+        max_window, grouping, stats, passthrough=passthrough, pos0=pos0,
     ):
         yield from batch

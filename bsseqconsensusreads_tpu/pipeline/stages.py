@@ -427,6 +427,7 @@ class PipelineBuilder:
                 # the wire transport engages (call_duplex_batches decides)
                 refstore=self.cfg.genome_fasta,
                 transport=self.cfg.transport,
+                pos0=self.cfg.pos0,
             )
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
 

@@ -93,19 +93,22 @@ def oracle_column_vote(
     return cons, int(round(qual)), depth, errors
 
 
-def oracle_convert_read(seq: str, quals, pos: int, genome: str):
+def oracle_convert_read(seq: str, quals, pos: int, genome: str,
+                        pos0: str = "skip"):
     """Scalar oracle for the B-strand AG->CT conversion (SURVEY.md §3.2).
 
     seq is the softclip-trimmed read (genome-forward orientation), quals a
     list of Phred ints, pos its 0-based mapped position. Returns
     (seq, quals, pos, la, rd). Mirrors the reference loop exactly — mutable
-    list, skip after a CpG pair rewrite — except at pos==0, where the
-    framework deliberately skips the prepend (see ops/convert.py docstring)
-    instead of shifting the read out of register.
+    list, skip after a CpG pair rewrite — except at pos==0 under the
+    default pos0='skip', where the framework deliberately skips the prepend
+    (see ops/convert.py docstring) instead of shifting the read out of
+    register; pos0='shift' reproduces the reference's
+    prepend-and-clamp register shift (tools/1.convert_AG_to_CT.py:87-92).
     """
-    prepend = pos > 0
+    prepend = pos > 0 or pos0 == "shift"
     if prepend:
-        new_pos = pos - 1
+        new_pos = max(pos - 1, 0)
         s = list("N" + seq)
         q = [40] + list(quals)
     else:

@@ -110,6 +110,11 @@ def golden_env(tmp_path_factory):
     records.append(
         _special_read("pzero", 83, 0, bisulfite_convert(g[0:30], g, 0, "B"), "108/B")
     )
+    # pos-0 degenerate-flag-1 convert read: exercises the passthrough
+    # (oracle) conversion path's pos-0 handling
+    records.append(
+        _special_read("f1zero", 1, 0, bisulfite_convert(g[0:25], g, 0, "B"), "111/B")
+    )
     # convert read ending at the contig end (short fetch -> N padding)
     end_pos = len(g) - 35
     records.append(_special_read(
@@ -156,13 +161,19 @@ def _trim_softclips(rec):
     return seq, qual, cig
 
 
-def _op_convert(seq, quals, pos, genome, convert=True):
-    """One read through the JAX convert op; returns (seq, quals, pos, la, rd)."""
+def _op_convert(seq, quals, pos, genome, convert=True, pos0="skip"):
+    """One read through the JAX convert op; returns (seq, quals, pos, la, rd).
+
+    pos0='shift' applies the encode-layer placement rule for the reference's
+    pos-0 register shift (ops.encode.encode_duplex_families): the read goes
+    one window column right and the op's ordinary prepend does the rest."""
     window_start = max(pos - 4, 0)
     bases = np.full((1, 4, W), 4, dtype=np.int8)
     q = np.zeros((1, 4, W), dtype=np.float32)
     cover = np.zeros((1, 4, W), dtype=bool)
     off = pos - window_start
+    if pos0 == "shift" and pos == 0 and convert:
+        off = 1
     codes = seq_to_codes(seq)
     bases[0, 0, off : off + len(codes)] = codes
     q[0, 0, off : off + len(codes)] = quals
@@ -290,10 +301,11 @@ class TestGoldenTool1:
             qname, flag, pos, seq, quals, la, rd = fw
             assert ref_rec.qname == qname
             assert ref_rec.flag == flag
-            if qname == "pzero":
+            if qname in ("pzero", "f1zero"):
                 # enumerated deviation (ops/convert.py docstring): the
                 # reference prepends at pos 0, shifting the read out of
-                # register; the framework skips the prepend (LA=0)
+                # register; the framework default skips the prepend (LA=0)
+                # — pos0='shift' parity is pinned separately below
                 assert ref_rec.get_tag("LA") == 1 and la == 0
                 assert ref_rec.pos == 0 and pos == 0
                 assert len(ref_rec.seq) >= len(seq)
@@ -310,17 +322,95 @@ class TestGoldenTool1:
         assert {"drop4", "drop2048", "drop355", "dropins", "drophard"}.isdisjoint(got)
         assert {"p0", "f1", "passdel", "pzero", "pend"} <= got
 
+    def test_pos0_shift_mode_matches_reference_exactly(self, golden_env):
+        """pos0='shift' (VERDICT r3 item 5): the pos-0 convert read must
+        match the reference tool record-for-record, register shift,
+        prepended reference base, LA tag, qual 'I' and all
+        (tools/1.convert_AG_to_CT.py:87-92)."""
+        ref_rec = {r.qname: r for r in _read_bam(golden_env["out1"])}["pzero"]
+        src = next(
+            r for r in golden_env["records"] if r.qname == "pzero"
+        )
+        seq, quals, _ = _trim_softclips(src)
+        cseq, cquals, cpos, la, rd = _op_convert(
+            seq, quals, src.pos, golden_env["genome"], pos0="shift"
+        )
+        assert cpos == ref_rec.pos == 0
+        assert cseq == ref_rec.seq
+        assert cquals == list(ref_rec.qual)
+        assert la == ref_rec.get_tag("LA") == 1
+        assert rd == ref_rec.get_tag("RD")
+
+    def test_pos0_shift_oracle_passthrough_path(self, golden_env):
+        """The scalar-oracle conversion used by the duplex passthrough
+        emission must honor pos0='shift' too: the pos-0 flag-1 leftover
+        matches the reference tool record-for-record."""
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            _passthrough_records,
+        )
+
+        ref_rec = {r.qname: r for r in _read_bam(golden_env["out1"])}["f1zero"]
+        src = next(r for r in golden_env["records"] if r.qname == "f1zero")
+        genome = golden_env["genome"]
+
+        def fetch(name, start, end):
+            return genome[start:end]
+
+        (got,) = _passthrough_records(
+            [src], fetch, [golden_env["name"]], pos0="shift"
+        )
+        assert got.pos == ref_rec.pos == 0
+        assert got.seq == ref_rec.seq
+        assert list(got.qual) == list(ref_rec.qual)
+        assert got.get_tag("LA") == ref_rec.get_tag("LA") == 1
+        assert got.get_tag("RD") == ref_rec.get_tag("RD")
+        # default mode keeps the documented skip deviation (no prepend)
+        (dflt,) = _passthrough_records([src], fetch, [golden_env["name"]])
+        assert dflt.get_tag("LA") == 0 and len(dflt.seq) < len(ref_rec.seq)
+
+    def test_pos0_shift_encode_layer(self, golden_env):
+        """The production path: encode_duplex_families(pos0='shift') places
+        the pos-0 convert read one column right so the device prepend
+        reproduces the reference register shift."""
+        from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
+        from bsseqconsensusreads_tpu.ops.encode import encode_duplex_families
+
+        src = next(r for r in golden_env["records"] if r.qname == "pzero")
+        genome = golden_env["genome"]
+
+        def fetch(name, start, end):
+            return genome[start:end]
+
+        batch, leftovers, skipped = encode_duplex_families(
+            [("108", [src])], fetch, [golden_env["name"]], pos0="shift"
+        )
+        assert not leftovers and not skipped
+        row = 2  # flag 83
+        assert batch.meta[0].window_start == 0
+        assert not batch.cover[0, row, 0] and batch.cover[0, row, 1]
+        ob, oq, oc, la, rd = convert_ag_to_ct(
+            batch.bases, batch.quals, batch.cover, batch.ref,
+            batch.convert_mask,
+        )
+        ob, oq, oc = np.asarray(ob), np.asarray(oq), np.asarray(oc)
+        idx = np.nonzero(oc[0, row])[0]
+        ref_rec = {r.qname: r for r in _read_bam(golden_env["out1"])}["pzero"]
+        assert int(idx[0]) == 0 and int(la[0, row]) == 1
+        assert codes_to_seq(ob[0, row, idx]) == ref_rec.seq
+        assert [int(v) for v in oq[0, row, idx]] == list(ref_rec.qual)
+
 
 class TestGoldenChain:
     def test_tool2_parity(self, golden_env):
+        pos0_names = ("pzero", "f1zero")  # enumerated pos-0 deviation
         got_ref = [
             (r.qname, r.flag, r.pos, r.seq, list(r.qual))
             for r in _read_bam(golden_env["out2"])
-            if "pzero" not in r.qname  # enumerated pos-0 deviation
+            if r.qname not in pos0_names
         ]
         want = [
             t for t in _fw_chain(golden_env["records"], golden_env["genome"])
-            if "pzero" not in t[0]
+            if t[0] not in pos0_names
         ]
         assert got_ref == want
 
