@@ -119,8 +119,9 @@ class FrameworkConfig:
     #: inserts the producing rule. None (default) keeps the reference's
     #: live unfiltered-only chain. Under aligner 'self' the filter runs
     #: on the final duplex output instead (name-sort -> filter ->
-    #: coordinate-sort, bounded memory; duplex depth tags count strand
-    #: PRESENCE there — min_reads [2, 1, 1] = require both strands).
+    #: coordinate-sort, bounded memory); duplex depth tags carry RAW
+    #: per-strand read depths (threaded from the molecular cd/ce tags),
+    #: so fgbio-style floors like min_reads [3, 2, 1] apply directly.
     filter: dict | None = None
     #: reference-parity emission of off-vocabulary records at the duplex
     #: stage: True writes leftover records (flag 0, non-4-group members, …)

@@ -38,6 +38,7 @@ def _try_load():
         required_symbols=(
             "wirepack_pack_duplex",
             "wirepack_unpack_duplex_outputs",
+            "wirepack_unpack_duplex_b0",
             "wirepack_emit_consensus_records",
         ),
     )
@@ -53,7 +54,13 @@ def _try_load():
     lib.wirepack_unpack_duplex_outputs.argtypes = [
         C.c_void_p, C.c_int64, C.c_int64,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
-        C.c_void_p,
+        C.c_void_p, C.c_void_p, C.c_void_p,
+    ]
+    lib.wirepack_unpack_duplex_b0.restype = None
+    lib.wirepack_unpack_duplex_b0.argtypes = [
+        C.c_void_p, C.c_int64, C.c_int64,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p, C.c_void_p,
     ]
     lib.wirepack_emit_consensus_records.restype = C.c_int
     lib.wirepack_emit_consensus_records.argtypes = (
@@ -146,7 +153,7 @@ def pack_duplex(bases, quals, cover, convert_mask, eligible, qual_mode):
 
 def unpack_duplex_outputs(wire_u8: np.ndarray, f: int, w: int) -> dict:
     """Native unpack of the family-major planar output wire ([f, 4, w] u8:
-    b0 planes then qual planes per family) -> dict of [f, 2, w] arrays."""
+    v2 b0 planes then qual planes per family) -> dict of [f, 2, w] arrays."""
     _try_load()
     if _lib is None:
         raise OSError(_load_error or "native wirepack unavailable")
@@ -159,6 +166,8 @@ def unpack_duplex_outputs(wire_u8: np.ndarray, f: int, w: int) -> dict:
         "errors": np.empty(cols, np.int16),
         "a_depth": np.empty(cols, np.int8),
         "b_depth": np.empty(cols, np.int8),
+        "a_err": np.empty(cols, np.int8),
+        "b_err": np.empty(cols, np.int8),
     }
     _lib.wirepack_unpack_duplex_outputs(
         wire_u8.ctypes.data_as(C.c_void_p), f, w,
@@ -168,6 +177,38 @@ def unpack_duplex_outputs(wire_u8: np.ndarray, f: int, w: int) -> dict:
         out["errors"].ctypes.data_as(C.c_void_p),
         out["a_depth"].ctypes.data_as(C.c_void_p),
         out["b_depth"].ctypes.data_as(C.c_void_p),
+        out["a_err"].ctypes.data_as(C.c_void_p),
+        out["b_err"].ctypes.data_as(C.c_void_p),
+    )
+    return {k: v.reshape(f, 2, w) for k, v in out.items()}
+
+
+def unpack_duplex_b0(wire_u8: np.ndarray, f: int, w: int) -> dict:
+    """Native unpack of the b0-only tunnel wire ([f, 2, w] u8) -> dict of
+    [f, 2, w] arrays; no 'qual' key (ops.reconstruct rebuilds it)."""
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    cols = f * 2 * w
+    wire_u8 = np.ascontiguousarray(wire_u8[:cols], dtype=np.uint8)
+    out = {
+        "base": np.empty(cols, np.int8),
+        "depth": np.empty(cols, np.int16),
+        "errors": np.empty(cols, np.int16),
+        "a_depth": np.empty(cols, np.int8),
+        "b_depth": np.empty(cols, np.int8),
+        "a_err": np.empty(cols, np.int8),
+        "b_err": np.empty(cols, np.int8),
+    }
+    _lib.wirepack_unpack_duplex_b0(
+        wire_u8.ctypes.data_as(C.c_void_p), f, w,
+        out["base"].ctypes.data_as(C.c_void_p),
+        out["depth"].ctypes.data_as(C.c_void_p),
+        out["errors"].ctypes.data_as(C.c_void_p),
+        out["a_depth"].ctypes.data_as(C.c_void_p),
+        out["b_depth"].ctypes.data_as(C.c_void_p),
+        out["a_err"].ctypes.data_as(C.c_void_p),
+        out["b_err"].ctypes.data_as(C.c_void_p),
     )
     return {k: v.reshape(f, 2, w) for k, v in out.items()}
 
@@ -205,7 +246,7 @@ def emit_consensus_records(
     """Native batch emit: kernel output planes -> BAM record bytes.
 
     out: dict of [f, 2, w] arrays (base int8, qual uint8, depth/errors
-    int16, plus a_depth/b_depth int8 when duplex). Per-family metadata as
+    int16, plus a_depth/b_depth int16 when duplex). Per-family metadata as
     documented on wirepack_emit_consensus_records (native/wirepack.cpp).
     rx entries may be "" (no RX tag). Returns (record bytes, n_records,
     n_families_skipped); the bytes are ready for BamWriter.write_raw —
@@ -221,8 +262,8 @@ def emit_consensus_records(
     errors = np.ascontiguousarray(out["errors"], dtype=np.int16)
     f, _, w = base.shape
     if duplex:
-        a_depth = np.ascontiguousarray(out["a_depth"], dtype=np.int8)
-        b_depth = np.ascontiguousarray(out["b_depth"], dtype=np.int8)
+        a_depth = np.ascontiguousarray(out["a_depth"], dtype=np.int16)
+        b_depth = np.ascontiguousarray(out["b_depth"], dtype=np.int16)
         a_ptr = a_depth.ctypes.data_as(C.c_void_p)
         b_ptr = b_depth.ctypes.data_as(C.c_void_p)
     else:

@@ -43,6 +43,13 @@ from bsseqconsensusreads_tpu.ops.extend import (
 R1_ROWS = (ROW_99, ROW_163)
 R2_ROWS = (ROW_83, ROW_147)
 A_ROWS = (ROW_99, ROW_147)
+#: (a_row, b_row) per emitted role — the single derivation the host-side
+#: raw-depth threading (pipeline.calling) and qual reconstruction
+#: (ops.reconstruct) both import, so they can never desync from _merge.
+ROLE_STRAND_ROWS = tuple(
+    (rr[0], rr[1]) if rr[0] in A_ROWS else (rr[1], rr[0])
+    for rr in (R1_ROWS, R2_ROWS)
+)
 
 
 def _merge(bases, quals, rows, params):
@@ -51,11 +58,22 @@ def _merge(bases, quals, rows, params):
     out = column_vote(b, q, params)
     a_row, b_row = (rows[0], rows[1]) if rows[0] in A_ROWS else (rows[1], rows[0])
     # per-strand depths use the same observation filter as the vote, so
-    # a_depth + b_depth == depth always (the packed wire format relies on it)
-    for key, row in (("a_depth", a_row), ("b_depth", b_row)):
-        out[key] = (
+    # a_depth + b_depth == depth always (the packed wire format relies on
+    # it); per-strand error bits split count_errors the same way, so
+    # a_err + b_err == errors — the wire ships the per-strand bits and
+    # derives the totals host-side
+    for key, err, row in (
+        ("a_depth", "a_err", a_row), ("b_depth", "b_err", b_row)
+    ):
+        obs = (
             (bases[..., row, :] != NBASE)
             & (quals[..., row, :] >= params.min_input_base_quality)
+        )
+        out[key] = obs.astype(jnp.int32)
+        out[err] = (
+            obs
+            & (out["base"] != NBASE)
+            & (bases[..., row, :] != out["base"])
         ).astype(jnp.int32)
     return out
 
@@ -117,6 +135,37 @@ def duplex_call_pipeline(
     return out
 
 
+def _duplex_b0(out: dict):
+    """The duplex per-column byte: base(3b) | a_depth<<3 | b_depth<<4 |
+    a_err<<5 | b_err<<6 (bit 7 spare).  depth/errors are derived sums, so
+    one byte carries the complete per-column call except the qual — which
+    the wire format omits entirely (ops.reconstruct rebuilds it host-side
+    from the shipped strand bits + the host's own input quals, exactly)."""
+    return (
+        out["base"].astype(jnp.uint8)
+        | (out["a_depth"].astype(jnp.uint8) << 3)
+        | (out["b_depth"].astype(jnp.uint8) << 4)
+        | (out["a_err"].astype(jnp.uint8) << 5)
+        | (out["b_err"].astype(jnp.uint8) << 6)
+    )
+
+
+def _decode_b0(b0, np):
+    a_depth = ((b0 >> 3) & 0x1).astype(np.int8)
+    b_depth = ((b0 >> 4) & 0x1).astype(np.int8)
+    a_err = ((b0 >> 5) & 0x1).astype(np.int8)
+    b_err = ((b0 >> 6) & 0x1).astype(np.int8)
+    return {
+        "base": (b0 & 0x7).astype(np.int8),
+        "depth": (a_depth + b_depth).astype(np.int16),
+        "errors": (a_err + b_err).astype(np.int16),
+        "a_depth": a_depth,
+        "b_depth": b_depth,
+        "a_err": a_err,
+        "b_err": b_err,
+    }
+
+
 def pack_duplex_outputs(out: dict):
     """Pack the per-column duplex outputs into one planar u32 wire array.
 
@@ -125,11 +174,8 @@ def pack_duplex_outputs(out: dict):
     the tunnel compresses); six separate array fetches per batch dominate
     the stage. Duplex columns fit 2 bytes, laid out FAMILY-MAJOR PLANAR —
     per family, the byte0 planes of both roles then the qual planes
-    ([F, 4, W] u8: rows 0-1 = b0 of R1/R2, rows 2-3 = qual of R1/R2):
-
-      b0[col]   = base(3b) | depth(2b)<<3 | errors(2b)<<5 | a_depth(1b)<<7
-      qual[col] = consensus qual  (duplex depth/errors are bounded by 2
-                                   strands; b_depth = depth - a_depth)
+    ([F, 4, W] u8: rows 0-1 = b0 of R1/R2 (_duplex_b0 layout), rows 2-3 =
+    qual of R1/R2).
 
     Planar order groups same-distribution bytes into W-length runs, which
     the tunnel's compressor exploits — both planes draw from small value
@@ -138,20 +184,34 @@ def pack_duplex_outputs(out: dict):
     per-device concatenation (parallel.sharding.sharded_duplex_packed)
     preserves the layout. la/rd ride separately (tiny [..., 4] int8).
     Unpack host-side with unpack_duplex_outputs.
+
+    This is the NON-wire packed format (used where the transfer is free,
+    e.g. the CPU backend's sharded path — the qual plane costs nothing
+    there and saves the host reconstruction); the tunnel wire ships
+    pack_duplex_b0_outputs instead, at half the bytes.
     """
-    b0 = (
-        out["base"].astype(jnp.uint8)
-        | (out["depth"].astype(jnp.uint8) << 3)
-        | (out["errors"].astype(jnp.uint8) << 5)
-        | (out["a_depth"].astype(jnp.uint8) << 7)
-    )
     planar = jnp.concatenate(
-        [b0, out["qual"].astype(jnp.uint8)], axis=-2
+        [_duplex_b0(out), out["qual"].astype(jnp.uint8)], axis=-2
     )  # [..., F, 4, W]
     # Flatten to 1D u32 for the wire: the tunnel moves 1D word-sized arrays
     # ~2x faster than small-minor-dim u8 arrays (measured 34 vs 18 MB/s).
     return jax.lax.bitcast_convert_type(
         planar.reshape(-1, 4), jnp.uint32
+    ).reshape(-1)
+
+
+def pack_duplex_b0_outputs(out: dict):
+    """Tunnel-wire pack: the b0 planes ONLY ([..., F, 2, W] u8 -> flat u32).
+
+    Half the D2H bytes of pack_duplex_outputs: consensus quals are a
+    deterministic function of (the observation quals the host already
+    holds, the per-strand presence/error bits in b0), so they are
+    reconstructed host-side (ops.reconstruct) instead of shipped — the
+    output direction drops below the input direction, flipping the
+    tunnel bottleneck back to H2D (BENCH wire metrics track both).
+    """
+    return jax.lax.bitcast_convert_type(
+        _duplex_b0(out).reshape(-1, 4), jnp.uint32
     ).reshape(-1)
 
 
@@ -168,18 +228,24 @@ def unpack_duplex_outputs(packed, f: int, w: int) -> dict:
     if wirepack.available():
         return wirepack.unpack_duplex_outputs(u8, f=f, w=w)
     planes = u8[: f * 4 * w].reshape(f, 4, w)
-    b0 = planes[:, :2, :]
-    qual = planes[:, 2:, :]
-    depth = (b0 >> 3) & 0x3
-    a_depth = (b0 >> 7) & 0x1
-    return {
-        "base": (b0 & 0x7).astype(np.int8),
-        "qual": qual,
-        "depth": depth.astype(np.int16),
-        "errors": ((b0 >> 5) & 0x3).astype(np.int16),
-        "a_depth": a_depth.astype(np.int8),
-        "b_depth": (depth - a_depth).astype(np.int8),
-    }
+    out = _decode_b0(planes[:, :2, :], np)
+    out["qual"] = planes[:, 2:, :]
+    return out
+
+
+def unpack_duplex_b0_outputs(packed, f: int, w: int) -> dict:
+    """Inverse of pack_duplex_b0_outputs (host side) -> [f, 2, w] arrays;
+    no 'qual' key — reconstruct it with ops.reconstruct. Native C++ sweep
+    when built, numpy fallback otherwise."""
+    import numpy as np
+
+    packed = np.asarray(packed)
+    u8 = packed.view(np.uint8) if packed.dtype != np.uint8 else packed
+    from bsseqconsensusreads_tpu.io import wirepack
+
+    if wirepack.available():
+        return wirepack.unpack_duplex_b0(u8, f=f, w=w)
+    return _decode_b0(u8[: f * 2 * w].reshape(f, 2, w), np)
 
 
 @partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "vote_kernel"))
@@ -197,9 +263,10 @@ def duplex_call_wire(
     the wire carries 4 bits/cell of bases+cover, 1 B/cell of quals, and
     8 B/family of offsets instead of the ~5 B/cell of the unpacked path.
 
-    Returns one u32 wire array: pack_duplex_outputs columns [f*w words]
-    followed by la/rd bytes [ceil(f/4) words]; split host-side with
-    unpack_duplex_wire_outputs.
+    Returns one u32 wire array: pack_duplex_b0_outputs columns
+    [f*2*w/4 words] followed by la/rd bytes [ceil(f/4) words]; split
+    host-side with unpack_duplex_wire_outputs (quals are reconstructed
+    there, not shipped — see pack_duplex_b0_outputs).
     """
     from bsseqconsensusreads_tpu.ops.refstore import gather_windows
     from bsseqconsensusreads_tpu.ops.wire import pack_lard, unpack_duplex_inputs
@@ -212,7 +279,7 @@ def duplex_call_wire(
         bases, quals, cover, ref, convert_mask, eligible, params=params,
         vote_kernel=vote_kernel,
     )
-    packed = pack_duplex_outputs(out)
+    packed = pack_duplex_b0_outputs(out)
     return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
 
 
@@ -248,13 +315,17 @@ def duplex_call_wire_fused(
 
 
 def unpack_duplex_wire_outputs(wire, f: int, w: int) -> dict:
-    """numpy split+unpack of the duplex_call_wire result (host side)."""
+    """numpy split+unpack of the duplex_call_wire result (host side).
+
+    No 'qual' key — the wire ships b0 planes only; callers reconstruct
+    quals with ops.reconstruct.reconstruct_duplex_quals."""
     from bsseqconsensusreads_tpu.ops.wire import unpack_lard
     import numpy as np
 
     wire = np.asarray(wire)
-    out = unpack_duplex_outputs(wire[: f * w], f=f, w=w)
-    out["la"], out["rd"] = unpack_lard(wire[f * w :], f)
+    b0_words = f * 2 * w // 4
+    out = unpack_duplex_b0_outputs(wire[:b0_words], f=f, w=w)
+    out["la"], out["rd"] = unpack_lard(wire[b0_words:], f)
     return out
 
 

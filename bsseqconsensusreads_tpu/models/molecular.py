@@ -95,11 +95,23 @@ def vote_partials(bases, quals, params: ConsensusParams):
 def vote_finalize(ll, depth, params: ConsensusParams):
     """Turn reduced vote sums into (base, qual): argmax + posterior + pre-UMI
     adjustment. Deterministic given (ll, depth) — replicas holding identical
-    psum results finalize identically."""
+    psum results finalize identically.
+
+    The posterior denominator sums the candidate exponentials in ASCENDING
+    VALUE order (not slot order), so the consensus quality is invariant
+    under any permutation of which bases the observations happened to be —
+    the property ops.reconstruct's (qa, qb, agreement)-indexed qual tables
+    rely on — and slightly more accurate (small-to-large summation).
+    utils.oracle.oracle_column_vote mirrors the same canonical order.
+    """
     called = depth > 0
     cons = jnp.argmax(ll, axis=-1)  # [W]
-    post = jax.nn.softmax(ll, axis=-1)
-    p_cons = 1.0 - jnp.take_along_axis(post, cons[..., None], axis=-1)[..., 0]
+    m = jnp.max(ll, axis=-1, keepdims=True)
+    e = jnp.sort(jnp.exp(ll - m), axis=-1)  # ascending
+    denom = ((e[..., 0] + e[..., 1]) + e[..., 2]) + e[..., 3]
+    # exp(ll[cons] - m) == 1 exactly (cons is the argmax), so the posterior
+    # of the call is 1/denom
+    p_cons = 1.0 - 1.0 / denom
     p_final = phred.prob_error_two_trials(
         p_cons, phred.phred_to_prob(params.error_rate_pre_umi)
     )
@@ -125,7 +137,8 @@ def narrow_outputs(out: dict) -> dict:
     bottleneck on this hardware — SURVEY.md §6 HBM/host budget): depths and
     errors fit int16 (family depth is bounded by the template bucket, max
     1024), per-strand coverage fits int8."""
-    narrow = {"depth": jnp.int16, "errors": jnp.int16, "a_depth": jnp.int8, "b_depth": jnp.int8}
+    narrow = {"depth": jnp.int16, "errors": jnp.int16, "a_depth": jnp.int8,
+              "b_depth": jnp.int8, "a_err": jnp.int8, "b_err": jnp.int8}
     return {k: (v.astype(narrow[k]) if k in narrow else v) for k, v in out.items()}
 
 

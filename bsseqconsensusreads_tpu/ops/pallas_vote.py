@@ -101,7 +101,6 @@ def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
             depth = jnp.sum(cnt, axis=0, keepdims=True)  # [1, W]
             called = depth > 0
             cons = jnp.argmax(ll, axis=0, keepdims=True)  # [1, W]
-            post = jax.nn.softmax(ll, axis=0)
 
             def pick(arr, idx):
                 out = jnp.zeros_like(arr[0:1, :])
@@ -109,7 +108,20 @@ def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
                     out = jnp.where(idx == b, arr[b : b + 1, :], out)
                 return out
 
-            p_cons = 1.0 - pick(post, cons)
+            # posterior with the canonical ascending-order denominator
+            # (models/molecular.vote_finalize): a 4-row sorting network
+            # (5 compare-exchanges) keeps everything 2D [1, W] for Mosaic
+            m = jnp.max(ll, axis=0, keepdims=True)
+            e0, e1, e2, e3 = (
+                jnp.exp(ll[b : b + 1, :] - m) for b in range(NUM_BASES)
+            )
+            a, b_ = jnp.minimum(e0, e1), jnp.maximum(e0, e1)
+            c, d = jnp.minimum(e2, e3), jnp.maximum(e2, e3)
+            a, c = jnp.minimum(a, c), jnp.maximum(a, c)
+            b_, d = jnp.minimum(b_, d), jnp.maximum(b_, d)
+            b_, c = jnp.minimum(b_, c), jnp.maximum(b_, c)
+            denom = ((a + b_) + c) + d
+            p_cons = 1.0 - 1.0 / denom
             p_final = phred.prob_error_two_trials(
                 p_cons, phred.phred_to_prob(params.error_rate_pre_umi)
             )
@@ -250,12 +262,20 @@ def duplex_consensus_pallas(bases, quals,
     strand = {}
     for role, rr in enumerate(rows):
         a_row, b_row = (rr[0], rr[1]) if rr[0] in A_ROWS else (rr[1], rr[0])
-        for key, row in (("a_depth", a_row), ("b_depth", b_row)):
+        cons = out["base"][:, role, :]
+        for key, err, row in (
+            ("a_depth", "a_err", a_row), ("b_depth", "b_err", b_row)
+        ):
             obs = (
                 (bases[:, row, :] != NBASE)
                 & (quals[:, row, :] >= params.min_input_base_quality)
-            ).astype(jnp.int32)
-            strand.setdefault(key, []).append(obs)
+            )
+            strand.setdefault(key, []).append(obs.astype(jnp.int32))
+            strand.setdefault(err, []).append(
+                (
+                    obs & (cons != NBASE) & (bases[:, row, :] != cons)
+                ).astype(jnp.int32)
+            )
     for key, planes in strand.items():
         out[key] = jnp.stack(planes, axis=1)  # [F, 2, W]
     return narrow_outputs(out)

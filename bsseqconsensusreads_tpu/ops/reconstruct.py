@@ -1,0 +1,194 @@
+"""Host-side reconstruction of duplex consensus quals from the b0 wire.
+
+The tunnel's D2H direction is the duplex stage's measured bottleneck
+(BENCH wire metrics; ~20-30 MB/s through the compressing tunnel). The
+round-3 wire shipped 2 bytes per output column per role — a b0 call byte
+plus the consensus qual byte. But the qual byte is REDUNDANT: a duplex
+column merges at most two strand observations, and its consensus quality
+is a deterministic function of
+
+  (the two observation quals, which strand(s) were observed, whether each
+   agreed with the called base)
+
+— everything after the first item is in the b0 byte, and the observation
+quals are the host's OWN input quals evolved through the convert/extend
+edge ops (whose la/rd decisions also ride the wire). So the round-4 wire
+ships b0 only (models.duplex.pack_duplex_b0_outputs, half the D2H bytes)
+and this module rebuilds the qual plane exactly:
+
+* evolve_duplex_quals — a vectorized numpy mirror of the EDGE effects of
+  ops.convert + ops.extend on (cover, quals): the conversion prepend
+  (qual 40 'I'), the trailing-C trim, and the extend-gap boundary copies.
+  Window-space makes this cheap: neither op shifts interior columns, so
+  the evolution is a handful of per-row index updates, not a re-run of
+  the transforms. The base rewrites don't matter here — only quals and
+  coverage feed the vote's quality arithmetic.
+* qual_tables — three lookup tables (single / agree / disagree, indexed
+  by the uint8 observation quals in A-then-B strand order) built by
+  running the PRODUCTION vote kernel itself over every (qa, qb) pair
+  once per (params, vote_kernel) and caching the fetched results. The
+  tables are exact by construction: every reconstructed value was
+  computed by the same kernel + backend that produced the batch, so
+  kernel-specific rounding (XLA vs Pallas) is captured, not modeled.
+* reconstruct_duplex_quals — the per-batch lookup pass.
+
+The reference has no analog (its quals are computed where its records
+are, on the host); this is the TPU design's answer to a link that is
+~10^3 slower than HBM: ship decisions, not derivable bytes.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.convert import PREPEND_QUAL
+from bsseqconsensusreads_tpu.ops.phred import NO_CALL_QUAL
+
+
+def evolve_duplex_quals(cover, quals, la, rd, eligible=None):
+    """Observation quals/coverage after convert+extend edge effects.
+
+    cover: bool [f, 4, w] (pre-transform), quals: [f, 4, w] integer-valued,
+    la/rd: int8 [f, 4] as returned over the wire (la/rd are nonzero only
+    on rows where ops.convert acted, so no convert_mask is needed),
+    eligible: bool [f] extend gate (None = all eligible).
+
+    Returns (quals uint8 [f, 4, w], cover bool [f, 4, w]) matching the
+    device arrays entering the duplex merge, exactly:
+      * la row: gains its column one left of its first covered column,
+        qual 40 (ops/convert.py PREPEND_QUAL);
+      * rd row: loses its last covered column;
+      * extend pairs (163->99, 83->147): la copies the left row's first
+        column into the right row; rd copies the right row's last column
+        into the left row (same column, cross-row — window space never
+        shifts interiors). Gates mirror ops.extend.extend_gap.
+    """
+    f, r, w = cover.shape
+    cov = np.asarray(cover).copy()
+    q = np.asarray(quals).astype(np.uint8).copy()
+
+    # conversion prepend (la == 1 implies first > 0 by construction)
+    fam, row = np.nonzero(np.asarray(la) == 1)
+    if fam.size:
+        first = cov[fam, row].argmax(-1)
+        q[fam, row, first - 1] = int(PREPEND_QUAL)
+        cov[fam, row, first - 1] = True
+    # trailing trim (prepend only changes the left edge, so the row's last
+    # covered column is the same before and after it)
+    fam, row = np.nonzero(np.asarray(rd) == 1)
+    if fam.size:
+        last = w - 1 - cov[fam, row, ::-1].argmax(-1)
+        cov[fam, row, last] = False
+
+    # extend-gap boundary copies (ops/extend.py PAIRS, post-convert state)
+    has = cov.any(-1)
+    first = cov.argmax(-1)
+    last = w - 1 - cov[..., ::-1].argmax(-1)
+    la = np.asarray(la)
+    rd = np.asarray(rd)
+    for left, right in ((1, 0), (2, 3)):
+        both = has[:, left] & has[:, right]
+        if eligible is not None:
+            both = both & np.asarray(eligible)
+        idx = np.nonzero(both & (la[:, left] == 1))[0]
+        if idx.size:
+            c = first[idx, left]
+            q[idx, right, c] = q[idx, left, c]
+            cov[idx, right, c] = True
+        idx = np.nonzero(both & (rd[:, left] == 1))[0]
+        if idx.size:
+            c = last[idx, right]
+            q[idx, left, c] = q[idx, right, c]
+            cov[idx, left, c] = True
+    return q, cov
+
+
+@lru_cache(maxsize=16)
+def _qual_tables_cached(params: ConsensusParams, vote_kernel: str):
+    """(T_single [256], T_agree [256, 256], T_disagree [256, 256]) uint8.
+
+    Built by the production duplex vote itself: one [256, 4, 520] batch
+    whose role-0 columns enumerate every case — family index = the
+    A-strand qual, columns 0-255 = agreeing pair vs B qual, 256-511 =
+    disagreeing pair, 512 = A-strand singleton. One small device call per
+    (params, kernel), cached for the session.
+    """
+    import jax.numpy as jnp
+
+    n = 256
+    w = 520  # 256 agree + 256 disagree + 1 single, padded even
+    bases = np.full((n, 4, w), NBASE, dtype=np.int8)
+    quals = np.zeros((n, 4, w), dtype=np.float32)
+    qa = np.arange(n, dtype=np.float32)[:, None]
+    # row 0 = A strand (flag 99), row 1 = B strand (flag 163), role 0
+    bases[:, 0, :513] = 0  # base A
+    quals[:, 0, :513] = qa
+    bases[:, 1, 0:256] = 0  # agree: B also base A
+    bases[:, 1, 256:512] = 1  # disagree: B base C
+    quals[:, 1, 0:512] = np.tile(np.arange(256, dtype=np.float32), 2)[None, :]
+
+    if vote_kernel == "pallas":
+        from bsseqconsensusreads_tpu.ops.pallas_vote import (
+            duplex_consensus_pallas,
+        )
+
+        out = duplex_consensus_pallas(jnp.asarray(bases), jnp.asarray(quals),
+                                      params)
+    else:
+        from bsseqconsensusreads_tpu.models.duplex import duplex_consensus
+
+        out = duplex_consensus(jnp.asarray(bases), jnp.asarray(quals), params)
+    qual = np.asarray(out["qual"])[:, 0, :]  # [256, w]
+    return (
+        np.ascontiguousarray(qual[:, 512].astype(np.uint8)),
+        np.ascontiguousarray(qual[:, 0:256].astype(np.uint8)),
+        np.ascontiguousarray(qual[:, 256:512].astype(np.uint8)),
+    )
+
+
+def qual_tables(params: ConsensusParams, vote_kernel: str = "xla"):
+    return _qual_tables_cached(params, vote_kernel)
+
+
+def reconstruct_duplex_quals(out: dict, evolved_quals: np.ndarray,
+                             params: ConsensusParams,
+                             vote_kernel: str = "xla") -> np.ndarray:
+    """Rebuild the consensus qual plane [f, 2, w] from the b0 fields.
+
+    out: unpacked b0 dict (base/a_depth/b_depth/a_err/b_err [f, 2, w]);
+    evolved_quals: uint8 [f, 4, w] from evolve_duplex_quals. Exact: every
+    value comes from the qual_tables the production kernel filled.
+    """
+    t_single, t_agree, t_dis = qual_tables(params, vote_kernel)
+    base = np.asarray(out["base"])
+    f, _, w = base.shape
+    qual = np.full((f, 2, w), NO_CALL_QUAL, np.uint8)
+    for role, (a_row, b_row) in enumerate(ROLE_STRAND_ROWS):
+        qa = evolved_quals[:, a_row, :]
+        qb = evolved_quals[:, b_row, :]
+        ap = np.asarray(out["a_depth"])[:, role, :] > 0
+        bp = np.asarray(out["b_depth"])[:, role, :] > 0
+        erred = (
+            (np.asarray(out["a_err"])[:, role, :] > 0)
+            | (np.asarray(out["b_err"])[:, role, :] > 0)
+        )
+        masked = base[:, role, :] == NBASE
+        res = qual[:, role, :]
+        m = ap & ~bp & ~masked
+        res[m] = t_single[qa[m]]
+        m = bp & ~ap & ~masked
+        res[m] = t_single[qb[m]]
+        both = ap & bp
+        m = both & ~erred & ~masked
+        res[m] = t_agree[qa[m], qb[m]]
+        m = both & erred  # called base exists by construction (err bits
+        # require cons != NBASE), so no ~masked needed
+        res[m] = t_dis[qa[m], qb[m]]
+        # remaining covered cells are masked calls (base == NBASE): the
+        # kernel wrote NO_CALL_QUAL for every one of them — already filled
+    return qual

@@ -1247,7 +1247,7 @@ def call_duplex_batches(
             copy_async()
         return packed, pf
 
-    def retire_and_emit(packed, pf, batch, passed):
+    def retire_and_emit(packed, pf, batch, passed, sidecar):
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         with stats.metrics.timed("fetch"):
             host = jax.device_get(packed)
@@ -1261,6 +1261,23 @@ def call_duplex_batches(
                 out = unpack_duplex_outputs(host, f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
         with stats.metrics.timed("emit"):
+            if "qual" not in out:
+                # b0-only wire: rebuild the qual plane host-side from the
+                # shipped strand bits + this host's own input quals
+                # (ops.reconstruct — exact, kernel-built tables)
+                from bsseqconsensusreads_tpu.ops.reconstruct import (
+                    evolve_duplex_quals,
+                    reconstruct_duplex_quals,
+                )
+
+                evolved, _cov = evolve_duplex_quals(
+                    batch.cover, batch.quals, out["la"], out["rd"],
+                    batch.extend_eligible,
+                )
+                out["qual"] = reconstruct_duplex_quals(
+                    out, evolved, params, kernel
+                )
+            out = _duplex_rawize(out, batch, sidecar)
             main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
             return [main] + passed
@@ -1297,16 +1314,173 @@ def call_duplex_batches(
             if not batch.meta:
                 yield "now", passed
                 continue
+            sidecar = _duplex_sidecar(chunk, pos0=pos0)
             stats.batches += 1
             used = int(batch.cover.sum())
             stats.pad_cells += batch.cover.size - used
             stats.used_cells += used
             with stats.metrics.timed("kernel"):
                 packed, pf = dispatch_kernel(batch)
-            yield "deferred", partial(retire_and_emit, packed, pf, batch, passed)
+            yield "deferred", partial(
+                retire_and_emit, packed, pf, batch, passed, sidecar
+            )
 
     yield from _pipelined(events(), depth=_pipeline_depth(wire_rr))
     stats.wall_seconds += time.monotonic() - t0
+
+
+def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
+    """Raw per-strand depth/error arrays for the duplex emitters.
+
+    The duplex stage's input records are molecular consensus reads whose
+    cd/ce tags carry RAW per-read depths/errors — exactly what fgbio's
+    duplex caller reports in ad/bd/cd and this stage's own presence-unit
+    kernel outputs cannot (VERDICT r3 item 4). Capture them per family
+    BEFORE encode consumes the records: {mi: [{row: (pos, cd, ce)}, ...]}
+    — one dict per chunk occurrence of the MI (a refragmented family can
+    appear twice in a chunk; _duplex_rawize picks the occurrence whose
+    placement intersects the meta's window) — with row =
+    DUPLEX_ROW_OF_FLAG and arrays softclip-trimmed into the register the
+    encoder places (incl. the pos0='shift' one-column displacement).
+    Records without cd/ce (foreign input) are simply absent — the
+    emitters fall back to presence units there (PARITY.md row 5).
+    """
+    from bsseqconsensusreads_tpu.io.bam import CHARD_CLIP, CSOFT_CLIP
+    from bsseqconsensusreads_tpu.ops.encode import (
+        CONVERT_ROWS,
+        DUPLEX_ROW_OF_FLAG,
+    )
+
+    side: dict = {}
+    for mi, records in chunk:
+        rows: dict = {}
+        for rec in records:
+            row = DUPLEX_ROW_OF_FLAG.get(rec.flag)
+            if row is None or row in rows:
+                continue
+            try:
+                _sub, cd = rec.get_tag("cd")
+                _sub, ce = rec.get_tag("ce")
+            except (KeyError, TypeError, ValueError):
+                continue
+            info = getattr(rec, "clip_info", None)
+            if info is not None:
+                lead, trail, _indel, hard = info
+                if hard:
+                    continue
+            else:
+                cigar = rec.cigar
+                if any(op == CHARD_CLIP for op, _ in cigar):
+                    continue
+                lead = cigar[0][1] if cigar and cigar[0][0] == CSOFT_CLIP else 0
+                trail = (
+                    cigar[-1][1]
+                    if len(cigar) > 1 and cigar[-1][0] == CSOFT_CLIP
+                    else 0
+                )
+            cd = np.asarray(cd, dtype=np.int32)
+            ce = np.asarray(ce, dtype=np.int32)
+            if len(cd) != len(ce) or len(cd) <= lead + trail:
+                continue
+            pos = rec.pos
+            if pos0 == "shift" and pos == 0 and row in CONVERT_ROWS:
+                pos = 1  # mirror the encoder's register-shift placement
+            end = len(cd) - trail
+            rows[row] = (pos, cd[lead:end], ce[lead:end])
+        if rows:
+            side.setdefault(mi, []).append(rows)
+    return side
+
+
+def _place_raw(entry, presence, window_start, w):
+    """One strand's raw per-base array into window space [w], masked and
+    edge-filled against the kernel's presence plane.
+
+    Columns the kernel says the strand covered but the raw array does not
+    (the conversion prepend / extend-gap boundary columns — synthetic
+    bases fgbio's raw-read accounting has no row for) take the nearest
+    raw value, so a depth floor never masks a base solely for being the
+    synthesized boundary column (PARITY.md row 5)."""
+    pos, arr = entry
+    out = np.zeros(w, dtype=np.int32)
+    off = pos - window_start
+    lo, hi = max(off, 0), min(off + len(arr), w)
+    if hi > lo:
+        out[lo:hi] = arr[lo - off : hi - off]
+    halo = presence & (out == 0)
+    if halo.any() and hi > lo:
+        idx = np.nonzero(halo)[0]
+        out[idx] = out[np.clip(idx, lo, hi - 1)]
+    return np.where(presence, out, 0)
+
+
+def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
+    """Convert the duplex kernel's presence-unit planes to fgbio's raw
+    units wherever the sidecar has the molecular cd/ce arrays.
+
+    Per role and strand: ad/bd become raw per-read strand depths, cd
+    their sum; ce becomes the raw disagreement count vs the DUPLEX call:
+    exact when the strand consensus agrees with the duplex call (its
+    molecular ce is that count), and `cd - ce` when it disagrees (the
+    raw reads that voted the strand base disagree with the duplex call;
+    the molecular-dissenting reads are assumed to match it — the one
+    documented approximation, PARITY.md row 6). Families absent from the
+    sidecar keep presence units."""
+    if not sidecar:
+        return out
+    a_p = np.asarray(out["a_depth"])
+    b_p = np.asarray(out["b_depth"])
+    a_e = np.asarray(out["a_err"])
+    b_e = np.asarray(out["b_err"])
+    f, _, w = a_p.shape
+    ad = a_p.astype(np.int32).copy()
+    bd = b_p.astype(np.int32).copy()
+    ae = a_e.astype(np.int32).copy()
+    be = b_e.astype(np.int32).copy()
+    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
+
+    for fi, meta in enumerate(batch.meta):
+        rows = None
+        for cand in sidecar.get(meta.mi, ()):
+            # refragmented families repeat an MI within a chunk; fragments
+            # are >flush-margin apart, so exactly one occurrence's reads
+            # intersect this meta's window
+            if any(
+                pos < meta.window_start + w
+                and pos + len(cd) > meta.window_start
+                for pos, cd, _ce in cand.values()
+            ):
+                rows = cand
+                break
+        if not rows:
+            continue
+        for role in range(2):
+            a_row, b_row = ROLE_STRAND_ROWS[role]
+            for row, dplane, eplane, errbit in (
+                (a_row, ad, ae, a_e), (b_row, bd, be, b_e),
+            ):
+                entry = rows.get(row)
+                if entry is None:
+                    continue
+                pres = dplane[fi, role] > 0
+                raw_d = _place_raw(
+                    entry[:2], pres, meta.window_start, w
+                )
+                raw_e = _place_raw(
+                    (entry[0], entry[2]), pres, meta.window_start, w
+                )
+                # strand disagrees with the duplex call -> its agreeing
+                # raw reads are the errors (see docstring)
+                disagree = errbit[fi, role] > 0
+                dplane[fi, role] = raw_d
+                eplane[fi, role] = np.where(
+                    disagree, raw_d - raw_e, raw_e
+                )
+    out = dict(out)
+    out["a_depth"], out["b_depth"] = ad.astype(np.int16), bd.astype(np.int16)
+    out["depth"] = (ad + bd).astype(np.int16)
+    out["errors"] = np.clip(ae + be, 0, None).astype(np.int16)
+    return out
 
 
 def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
@@ -1343,10 +1517,10 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             )
             # fgbio duplex per-strand tag surface (README.md:9 contract;
             # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
-            # min depth, ad/bd per-base depth arrays. At this stage each
-            # strand contributes its single-strand consensus read, so
-            # per-column strand depth is presence (0/1); the raw-read
-            # depths live in the molecular stage's cD/cd tags upstream.
+            # min depth, ad/bd per-base depth arrays — RAW per-read
+            # strand units when the input carried the molecular cd/ce
+            # tags (_duplex_rawize), presence units (0/1) otherwise
+            # (PARITY.md row 5).
             a_cov = a_depth[fi, role, sl]
             b_cov = b_depth[fi, role, sl]
             tags["aD"] = ("i", int(a_cov.max()))

@@ -33,16 +33,13 @@ Deviations (documented per the §7.3 mandate):
   raises.
 * Per-base arrays are taken in the record's emitted base order (this
   framework's own emitters, pipeline.calling, write them that way).
-* **Duplex depth units.** This framework's duplex stage merges the four
-  single-strand CONSENSUS reads (the reference's architecture,
-  main.snake.py:121-164), so its cd/ad/bd arrays count strand-consensus
-  PRESENCE (ad/bd are 0/1, cd tops out at 2) — raw per-read depths live
-  in the upstream molecular output's tags.  fgbio's duplex caller works
-  from raw reads and reports raw depths.  Depth floors against this
-  framework's duplex output therefore mean "strands present":
-  ``min_reads=(2, 1, 1)`` = require both strands (fgbio's ``-M 1 1 1``
-  spirit at presence granularity); apply raw-read floors like
-  ``-M 3 1 1`` to the MOLECULAR consensus BAM, where cd is raw depth.
+* **Duplex depth units are RAW** (fgbio's): the duplex stage threads the
+  molecular stage's cd/ce tags through its emitters
+  (pipeline.calling._duplex_rawize), so ad/bd/cd on duplex output count
+  raw per-read strand depths and fgbio-style ``-M 3 2 1`` floors work
+  directly.  Only when the duplex input lacks cd/ce (consensus reads
+  produced outside this framework) do ad/bd degrade to strand-consensus
+  presence (0/1) — documented in PARITY.md row 5.
 """
 
 from __future__ import annotations

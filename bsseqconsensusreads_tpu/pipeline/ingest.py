@@ -148,7 +148,8 @@ class ColumnarRecordView:
             return None
         return bytes(raw)
 
-    # --- tags (MI/RX are the only tags the hot path reads) -----------------
+    # --- tags (MI/RX + the cd/ce consensus arrays the duplex raw-depth
+    # sidecar reads; everything else is absent from the columnar digest) ----
 
     def _tag(self, name: str) -> str | None:
         if name == "MI":
@@ -160,10 +161,30 @@ class ColumnarRecordView:
         s = _decode_fixed(raw)
         return s if s else None
 
+    def _aux_arrays(self):
+        """(cd, ce) u16 views from the C parser's aux plane, or None."""
+        b = self._b
+        aux = getattr(b, "aux", None)
+        if aux is None:
+            return None
+        n = int(b.aux_len[self._i])
+        if n == 0:
+            return None
+        off = int(b.aux_off[self._i])
+        return aux[off : off + n], aux[off + n : off + 2 * n]
+
     def has_tag(self, name: str) -> bool:
+        if name in ("cd", "ce"):
+            return self._aux_arrays() is not None
         return self._tag(name) is not None
 
     def get_tag(self, name: str):
+        if name in ("cd", "ce"):
+            pair = self._aux_arrays()
+            if pair is None:
+                raise KeyError(name)
+            # BamRecord 'B' tag surface: (subtype, values)
+            return ("S", pair[0] if name == "cd" else pair[1])
         v = self._tag(name)
         if v is None:
             raise KeyError(name)

@@ -83,7 +83,10 @@ def oracle_column_vote(
         return NBASE, 2, 0, 0
     cons = max(range(4), key=lambda c: ll[c])
     m = max(ll)
-    denom = sum(math.exp(v - m) for v in ll)
+    # canonical ascending-order denominator, matching the kernels'
+    # permutation-invariant summation (models/molecular.vote_finalize)
+    e = sorted(math.exp(v - m) for v in ll)
+    denom = ((e[0] + e[1]) + e[2]) + e[3]
     p_cons = 1.0 - math.exp(ll[cons] - m) / denom
     p_final = _two_trials(p_cons, _perr(error_rate_pre_umi))
     qual = _to_phred(p_final)

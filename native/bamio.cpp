@@ -574,6 +574,80 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
   }
 }
 
+// Locate the cd/ce consensus per-base B-array tags in one tag-region walk
+// (the duplex stage threads these raw molecular depths/errors through to
+// fgbio-unit ad/bd output, pipeline.calling._duplex_sidecar). Any integer
+// subtype is accepted; values are widened/clamped to u16 at copy time.
+struct BTagRef {
+  const uint8_t* data = nullptr;
+  uint32_t cnt = 0;
+  char sub = 0;
+};
+
+void find_cdce_tags(const uint8_t* tags, size_t n, BTagRef& cd, BTagRef& ce) {
+  size_t off = 0;
+  while (off + 3 <= n) {
+    char t0 = char(tags[off]), t1 = char(tags[off + 1]);
+    char tc = char(tags[off + 2]);
+    off += 3;
+    switch (tc) {
+      case 'A': case 'c': case 'C': off += 1; continue;
+      case 's': case 'S': off += 2; continue;
+      case 'i': case 'I': case 'f': off += 4; continue;
+      case 'Z': case 'H': {
+        while (off < n && tags[off] != 0) off++;
+        off++;
+        continue;
+      }
+      case 'B': {
+        if (off + 5 > n) return;
+        char sub = char(tags[off]);
+        uint32_t cnt = rd_u32(tags + off + 1);
+        size_t esz = (sub == 'c' || sub == 'C') ? 1
+                     : (sub == 's' || sub == 'S') ? 2 : 4;
+        if (off + 5 + size_t(cnt) * esz > n) return;
+        if (t0 == 'c' && sub != 'f') {
+          if (t1 == 'd') cd = BTagRef{tags + off + 5, cnt, sub};
+          else if (t1 == 'e') ce = BTagRef{tags + off + 5, cnt, sub};
+        }
+        off += 5 + size_t(cnt) * esz;
+        continue;
+      }
+      default:
+        return;  // unknown tag type: stop scanning
+    }
+  }
+}
+
+inline uint16_t btag_u16(const BTagRef& t, uint32_t i) {
+  switch (t.sub) {
+    case 'c': {
+      int8_t v;
+      std::memcpy(&v, t.data + i, 1);
+      return uint16_t(v < 0 ? 0 : v);
+    }
+    case 'C':
+      return t.data[i];
+    case 's': {
+      int16_t v;
+      std::memcpy(&v, t.data + i * 2, 2);
+      return uint16_t(v < 0 ? 0 : v);
+    }
+    case 'S': {
+      uint16_t v;
+      std::memcpy(&v, t.data + i * 2, 2);
+      return v;
+    }
+    default: {  // i / I
+      int32_t v;
+      std::memcpy(&v, t.data + i * 4, 4);
+      if (v < 0) v = 0;
+      if (v > 65535) v = 65535;
+      return uint16_t(v);
+    }
+  }
+}
+
 // ---- shared columnar record emission --------------------------------------
 
 }  // namespace (reopened below: the stream reader is part of the C ABI)
@@ -613,6 +687,15 @@ struct ColumnarOut {
   int32_t* left_clip;
   int32_t* right_clip;
   uint8_t* cigar_flags;
+  // cd/ce aux planes: per record, cd values then ce values (aux_len[i]
+  // u16 each) at aux[aux_off[i]]; aux_len 0 = tags absent/unusable.
+  // aux_cap = 2 * var_cap keeps "fits in var" implying "fits in aux"
+  // whenever cnt <= l_seq (larger counts are treated as absent).
+  uint16_t* aux = nullptr;
+  int64_t aux_cap = 0;
+  int64_t* aux_off = nullptr;
+  int32_t* aux_len = nullptr;
+  int64_t aux_used = 0;
 };
 
 bool record_fits(const uint8_t* p, ColumnarOut& o) {
@@ -686,6 +769,22 @@ void emit_record_body(const uint8_t* p, size_t bs, ColumnarOut& o) {
   o.vused += lseq;
   find_z_tag(p + off, bs - off, "MI", o.mi + nrec * o.mi_w, o.mi_w);
   find_z_tag(p + off, bs - off, "RX", o.rx + nrec * o.rx_w, o.rx_w);
+  if (o.aux != nullptr) {
+    o.aux_off[nrec] = o.aux_used;
+    o.aux_len[nrec] = 0;
+    BTagRef cd, ce;
+    find_cdce_tags(p + off, bs - off, cd, ce);
+    if (cd.data && ce.data && cd.cnt == ce.cnt && cd.cnt &&
+        int64_t(cd.cnt) <= int64_t(lseq) &&
+        o.aux_used + 2 * int64_t(cd.cnt) <= o.aux_cap) {
+      uint16_t* dst = o.aux + o.aux_used;
+      for (uint32_t i = 0; i < cd.cnt; i++) dst[i] = btag_u16(cd, i);
+      dst += cd.cnt;
+      for (uint32_t i = 0; i < ce.cnt; i++) dst[i] = btag_u16(ce, i);
+      o.aux_len[nrec] = int32_t(cd.cnt);
+      o.aux_used += 2 * int64_t(cd.cnt);
+    }
+  }
   o.nrec++;
 }
 
@@ -974,10 +1073,12 @@ void bamio_close(Reader* r) {
 // softclip lengths), cigar_flags (bit0 = has I/D, bit1 = has hardclip).
 // Returns records parsed, -1 on error. Stops early (returning fewer) when
 // a capacity would be exceeded; the blocking record is buffered internally
-// and returned by the next call. (The "2" suffix versions the signature:
-// loading a stale pre-digest .so fails symbol lookup and triggers a
-// rebuild instead of corrupting memory through a mismatched call.)
-int64_t bamio_parse_records2(
+// and returned by the next call. (The numeric suffix versions the
+// signature: loading a stale .so fails symbol lookup and triggers a
+// rebuild instead of corrupting memory through a mismatched call. "3"
+// adds the cd/ce aux planes: aux u16 [aux_cap = 2*var_cap], per-record
+// aux_off/aux_len — see ColumnarOut.)
+int64_t bamio_parse_records3(
     Reader* r, int64_t max_records,
     int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
     int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
@@ -986,12 +1087,14 @@ int64_t bamio_parse_records2(
     uint32_t* cigar, int64_t cigar_cap, int64_t* cigar_off,
     char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w,
     int32_t* ref_span, int32_t* left_clip, int32_t* right_clip,
-    uint8_t* cigar_flags) {
+    uint8_t* cigar_flags,
+    uint16_t* aux, int64_t aux_cap, int64_t* aux_off, int32_t* aux_len) {
   ColumnarOut o{ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
                 n_cigar, seq_codes, quals, var_cap, var_off, cigar,
                 cigar_cap, cigar_off, qname, qname_w, mi, mi_w, rx, rx_w,
                 max_records, 0, 0, 0,
-                ref_span, left_clip, right_clip, cigar_flags};
+                ref_span, left_clip, right_clip, cigar_flags,
+                aux, aux_cap, aux_off, aux_len};
   std::vector<uint8_t> body;
   while (o.nrec < max_records) {
     if (!r->pending.empty()) {
@@ -1117,7 +1220,7 @@ int64_t bamio_group_refragmented(Grouper* g) { return g->refragmented; }
 
 void bamio_group_free(Grouper* g) { delete g; }
 
-// Grouped columnar parse: the bamio_parse_records2 output surface with
+// Grouped columnar parse: the bamio_parse_records3 output surface with
 // records reordered into CONTIGUOUS whole-family runs (coordinate-sorted
 // input; flush-margin semantics of pipeline.calling.stream_mi_groups
 // 'coordinate', including insertion-order flushing and refragmentation
@@ -1126,7 +1229,7 @@ void bamio_group_free(Grouper* g) { delete g; }
 // (0 = stream complete), -1 stream error (bamio_error), -2 record without
 // an MI tag (bamio_group_error -> offending qname), -3 the next family
 // alone exceeds a capacity (retry with larger buffers).
-int64_t bamio_parse_grouped(
+int64_t bamio_parse_grouped2(
     Reader* r, Grouper* g, int64_t max_records,
     int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
     int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
@@ -1136,13 +1239,15 @@ int64_t bamio_parse_grouped(
     char* qname, int qname_w, char* mi, int mi_w, char* rx, int rx_w,
     int32_t* ref_span, int32_t* left_clip, int32_t* right_clip,
     uint8_t* cigar_flags,
+    uint16_t* aux, int64_t aux_cap, int64_t* aux_off, int32_t* aux_len,
     char* fam_mi, int fam_mi_w, int32_t* fam_nrec, int64_t fam_cap,
     int64_t* n_fams) {
   ColumnarOut o{ref_id, pos, flag, mapq, l_seq, next_ref, next_pos, tlen,
                 n_cigar, seq_codes, quals, var_cap, var_off, cigar,
                 cigar_cap, cigar_off, qname, qname_w, mi, mi_w, rx, rx_w,
                 max_records, 0, 0, 0,
-                ref_span, left_clip, right_clip, cigar_flags};
+                ref_span, left_clip, right_clip, cigar_flags,
+                aux, aux_cap, aux_off, aux_len};
   std::vector<uint8_t> body;
   int64_t fams = 0;
   bool batch_full = false;

@@ -235,7 +235,8 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 //
 // Per-column planes, C-contiguous [f, 2, w]:
 //   base int8 (framework codes), qual uint8, depth int16, errors int16,
-//   a_depth/b_depth int8 or NULL (duplex per-strand tags when present).
+//   a_depth/b_depth int16 or NULL (duplex per-strand tags when present —
+//   int16 because raw strand depths from _duplex_rawize exceed int8).
 // Per-family meta:
 //   ref_id int32, window_start int64, n_reads int32 (min_reads filter
 //   operand), role_reverse uint8 [f, 2],
@@ -249,7 +250,7 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 // min_reads-skipped families for StageStats.
 int wirepack_emit_consensus_records(
     const int8_t* base, const uint8_t* qual, const int16_t* depth,
-    const int16_t* errors, const int8_t* a_depth, const int8_t* b_depth,
+    const int16_t* errors, const int16_t* a_depth, const int16_t* b_depth,
     int64_t f, int64_t w, const int32_t* ref_id, const int64_t* window_start,
     const int32_t* n_reads, const uint8_t* role_reverse,
     const uint8_t* mi_blob, const int32_t* mi_off, const int32_t* mi_len,
@@ -407,8 +408,8 @@ int wirepack_emit_consensus_records(
         c.put_u8(0);
       }
       if (a_depth != nullptr) {
-        const int8_t* arow = a_depth + row + lo0;
-        const int8_t* brow = b_depth + row + lo0;
+        const int16_t* arow = a_depth + row + lo0;
+        const int16_t* brow = b_depth + row + lo0;
         int32_t amax = INT32_MIN, amin = INT32_MAX;
         int32_t bmax = INT32_MIN, bmin = INT32_MAX;
         for (int64_t i = 0; i < n; ++i) {
@@ -441,31 +442,59 @@ int wirepack_emit_consensus_records(
   return 0;
 }
 
+namespace {
+
+// One v2 b0 byte (models/duplex._duplex_b0):
+//   base(3b) | a_depth<<3 | b_depth<<4 | a_err<<5 | b_err<<6
+inline void decode_b0(uint8_t b0, int64_t i, int8_t* base, int16_t* depth,
+                      int16_t* errors, int8_t* a_depth, int8_t* b_depth,
+                      int8_t* a_err, int8_t* b_err) {
+  const int8_t ad = int8_t((b0 >> 3) & 0x1);
+  const int8_t bd = int8_t((b0 >> 4) & 0x1);
+  const int8_t ae = int8_t((b0 >> 5) & 0x1);
+  const int8_t be = int8_t((b0 >> 6) & 0x1);
+  base[i] = int8_t(b0 & 0x7);
+  depth[i] = int16_t(ad + bd);
+  errors[i] = int16_t(ae + be);
+  a_depth[i] = ad;
+  b_depth[i] = bd;
+  a_err[i] = ae;
+  b_err[i] = be;
+}
+
+}  // namespace
+
 // Unpack the family-major planar duplex output wire
-// (models/duplex.pack_duplex_outputs): wire uint8 [f, 4, w] — per family,
-// rows 0-1 = byte0 planes of duplex R1/R2
-// (base(3b)|depth(2b)<<3|errors(2b)<<5|a_depth(1b)<<7), rows 2-3 = the
-// consensus qual planes. Fills six C-contiguous [f*2*w] arrays.
+// (models/duplex.pack_duplex_outputs, the NON-wire packed format): wire
+// uint8 [f, 4, w] — per family, rows 0-1 = v2 b0 planes of duplex R1/R2,
+// rows 2-3 = the consensus qual planes. Fills eight [f*2*w] arrays.
 void wirepack_unpack_duplex_outputs(const uint8_t* wire, int64_t f, int64_t w,
                                     int8_t* base, uint8_t* qual,
                                     int16_t* depth, int16_t* errors,
-                                    int8_t* a_depth, int8_t* b_depth) {
+                                    int8_t* a_depth, int8_t* b_depth,
+                                    int8_t* a_err, int8_t* b_err) {
   for (int64_t fam = 0; fam < f; ++fam) {
     const uint8_t* plane_b = wire + fam * 4 * w;
     const uint8_t* plane_q = plane_b + 2 * w;
     const int64_t out0 = fam * 2 * w;
     for (int64_t i = 0; i < 2 * w; ++i) {
-      const uint8_t b0 = plane_b[i];
-      const int16_t d = int16_t((b0 >> 3) & 0x3);
-      const int8_t a = int8_t((b0 >> 7) & 0x1);
-      base[out0 + i] = int8_t(b0 & 0x7);
+      decode_b0(plane_b[i], out0 + i, base, depth, errors, a_depth, b_depth,
+                a_err, b_err);
       qual[out0 + i] = plane_q[i];
-      depth[out0 + i] = d;
-      errors[out0 + i] = int16_t((b0 >> 5) & 0x3);
-      a_depth[out0 + i] = a;
-      b_depth[out0 + i] = int8_t(d - a);
     }
   }
+}
+
+// Unpack the b0-only tunnel wire (models/duplex.pack_duplex_b0_outputs):
+// wire uint8 [f, 2, w] b0 planes, no qual (reconstructed host-side by
+// ops.reconstruct). Fills seven [f*2*w] arrays.
+void wirepack_unpack_duplex_b0(const uint8_t* wire, int64_t f, int64_t w,
+                               int8_t* base, int16_t* depth, int16_t* errors,
+                               int8_t* a_depth, int8_t* b_depth,
+                               int8_t* a_err, int8_t* b_err) {
+  const int64_t n = f * 2 * w;
+  for (int64_t i = 0; i < n; ++i)
+    decode_b0(wire[i], i, base, depth, errors, a_depth, b_depth, a_err, b_err);
 }
 
 }  // extern "C"

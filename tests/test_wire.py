@@ -121,12 +121,32 @@ def test_wire_path_matches_unpacked_pipeline():
     got = unpack_duplex_wire_outputs(jax.device_get(out_wire), f=f, w=w)
 
     np.testing.assert_array_equal(got["base"], np.asarray(want["base"]))
-    np.testing.assert_array_equal(got["qual"], np.asarray(want["qual"]))
     np.testing.assert_array_equal(got["depth"], np.asarray(want["depth"]))
     np.testing.assert_array_equal(got["errors"], np.asarray(want["errors"]))
     np.testing.assert_array_equal(got["a_depth"], np.asarray(want["a_depth"]))
+    np.testing.assert_array_equal(got["a_err"], np.asarray(want["a_err"]))
+    np.testing.assert_array_equal(got["b_err"], np.asarray(want["b_err"]))
     np.testing.assert_array_equal(got["la"], np.asarray(want["la"]))
     np.testing.assert_array_equal(got["rd"], np.asarray(want["rd"]))
+
+    # the b0-only wire ships no qual plane; the host reconstruction from
+    # (shipped strand bits x this host's own evolved input quals) must be
+    # bit-identical to the device-computed quals of the unpacked path
+    assert "qual" not in got
+    from bsseqconsensusreads_tpu.ops.reconstruct import (
+        evolve_duplex_quals,
+        reconstruct_duplex_quals,
+    )
+
+    evolved, cov = evolve_duplex_quals(cover, quals, got["la"], got["rd"], elig)
+    # device presence (which also excludes in-span N observations) is a
+    # subset of the host's evolved coverage — the qual lookups only ever
+    # read evolved cells the device says were observed
+    for role, (a_row, b_row) in enumerate(((0, 1), (3, 2))):
+        assert not ((got["a_depth"][:, role] > 0) & ~cov[:, a_row]).any()
+        assert not ((got["b_depth"][:, role] > 0) & ~cov[:, b_row]).any()
+    got["qual"] = reconstruct_duplex_quals(got, evolved, PARAMS)
+    np.testing.assert_array_equal(got["qual"], np.asarray(want["qual"]))
 
 
 @pytest.mark.parametrize("n_levels,want_mode", [(3, "q2"), (9, "q4"), (30, "q8")])
