@@ -151,7 +151,17 @@ def bench_tpu(iters: int = 10, vote_kernel: str = "xla", f: int = F) -> dict:
         return out
 
     def retire(out):
-        unpack_duplex_wire_outputs(jax.device_get(out), f=f, w=W)
+        # full host retire path: b0 unpack + the qual reconstruction the
+        # b0-only wire trades the shipped qual plane for (ops.reconstruct;
+        # table build is cached after the warmup call)
+        from bsseqconsensusreads_tpu.ops.reconstruct import (
+            evolve_duplex_quals,
+            reconstruct_duplex_quals,
+        )
+
+        o = unpack_duplex_wire_outputs(jax.device_get(out), f=f, w=W)
+        evolved, _cov = evolve_duplex_quals(cover, quals, o["la"], o["rd"], elig)
+        o["qual"] = reconstruct_duplex_quals(o, evolved, PARAMS, vote_kernel)
 
     retire(submit())  # warmup/compile
     inflight: deque = deque()

@@ -39,6 +39,7 @@ def _try_load():
             "wirepack_pack_duplex",
             "wirepack_unpack_duplex_outputs",
             "wirepack_unpack_duplex_b0",
+            "wirepack_duplex_rawize",
             "wirepack_emit_consensus_records",
         ),
     )
@@ -61,6 +62,15 @@ def _try_load():
         C.c_void_p, C.c_int64, C.c_int64,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p, C.c_void_p,
+    ]
+    lib.wirepack_duplex_rawize.restype = None
+    lib.wirepack_duplex_rawize.argtypes = [
+        C.c_int64, C.c_int64,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_void_p,
     ]
     lib.wirepack_emit_consensus_records.restype = C.c_int
     lib.wirepack_emit_consensus_records.argtypes = (
@@ -211,6 +221,49 @@ def unpack_duplex_b0(wire_u8: np.ndarray, f: int, w: int) -> dict:
         out["b_err"].ctypes.data_as(C.c_void_p),
     )
     return {k: v.reshape(f, 2, w) for k, v in out.items()}
+
+
+def duplex_rawize(out: dict, row_pos, row_off, row_len, aux, window_start,
+                  role_rows) -> dict:
+    """Native raw-unit conversion of duplex presence planes (the C twin of
+    pipeline.calling's fallback loop — see wirepack_duplex_rawize).
+
+    out: unpacked b0 dict; row_* int64/int64/int32 [f*4]; aux u16 flat
+    cd/ce buffer; window_start int64 [f]; role_rows int32 [4]. Returns a
+    new dict with int16 raw planes.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    a_p = np.ascontiguousarray(out["a_depth"], dtype=np.int8)
+    b_p = np.ascontiguousarray(out["b_depth"], dtype=np.int8)
+    a_e = np.ascontiguousarray(out["a_err"], dtype=np.int8)
+    b_e = np.ascontiguousarray(out["b_err"], dtype=np.int8)
+    f, _, w = a_p.shape
+    # pre-fill with presence units: the C pass only overwrites sidecar rows
+    ad = a_p.astype(np.int16)
+    bd = b_p.astype(np.int16)
+    ae = a_e.astype(np.int16)
+    be = b_e.astype(np.int16)
+    depth = np.empty((f, 2, w), np.int16)
+    errors = np.empty((f, 2, w), np.int16)
+    row_pos = np.ascontiguousarray(row_pos, dtype=np.int64)
+    row_off = np.ascontiguousarray(row_off, dtype=np.int64)
+    row_len = np.ascontiguousarray(row_len, dtype=np.int32)
+    aux = np.ascontiguousarray(aux, dtype=np.uint16)
+    window_start = np.ascontiguousarray(window_start, dtype=np.int64)
+    role_rows = np.ascontiguousarray(role_rows, dtype=np.int32)
+    p = lambda a: a.ctypes.data_as(C.c_void_p)  # noqa: E731
+    _lib.wirepack_duplex_rawize(
+        f, w, p(a_p), p(b_p), p(a_e), p(b_e),
+        p(row_pos), p(row_off), p(row_len), p(aux), p(window_start),
+        p(role_rows),
+        p(ad), p(bd), p(ae), p(be), p(depth), p(errors),
+    )
+    new = dict(out)
+    new["a_depth"], new["b_depth"] = ad, bd
+    new["depth"], new["errors"] = depth, errors
+    return new
 
 
 def _string_blob(strings: list[str]):

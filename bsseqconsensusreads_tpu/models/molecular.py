@@ -180,6 +180,80 @@ def molecular_consensus(bases, quals, params: ConsensusParams = ConsensusParams(
     return narrow_outputs(out)
 
 
+def _overlap_cocall_np(bases, quals):
+    """numpy twin of overlap_cocall for [F, 2, W] singleton families —
+    integer/float comparisons only, so it matches the jit path exactly."""
+    import numpy as np
+
+    b1, b2 = bases[:, 0, :], bases[:, 1, :]
+    q1, q2 = quals[:, 0, :], quals[:, 1, :]
+    both = (b1 != NBASE) & (b2 != NBASE)
+    agree = both & (b1 == b2)
+    disagree = both & (b1 != b2)
+    qsum = q1 + q2
+    qdiff = np.abs(q1 - q2)
+    winner = np.where(q1 >= q2, b1, b2)
+    tie = disagree & (qdiff == 0)
+    new_b = np.where(agree, b1, np.where(disagree, winner, -1))
+    new_q = np.where(agree, qsum, np.where(disagree, qdiff, 0.0))
+    out_b1 = np.where(both, np.where(tie, NBASE, new_b), b1)
+    out_b2 = np.where(both, np.where(tie, NBASE, new_b), b2)
+    out_q1 = np.where(both, new_q, q1)
+    out_q2 = np.where(both, new_q, q2)
+    return (
+        np.stack([out_b1, out_b2], axis=1).astype(bases.dtype),
+        np.stack([out_q1, out_q2], axis=1),
+    )
+
+
+def singleton_consensus_host(bases, quals,
+                             params: ConsensusParams = ConsensusParams(),
+                             vote_kernel: str = "xla") -> dict:
+    """Host fast path for T == 1 batches: numerically identical to
+    molecular_consensus on [F, 1, 2, W] with no device round trip.
+
+    ~70% of real cfDNA families are singletons (BASELINE config 5 / the
+    SCALE mixture); their "vote" is the R1/R2 overlap co-call followed by
+    a single-observation finalize — a pure function of the (possibly
+    summed) qual, served from the kernel-built single-obs tables
+    (ops.reconstruct.qual_tables, so XLA-vs-Pallas rounding is captured).
+    At scale these families skip encode-to-device, the wire, and the
+    kernel entirely. The tables also carry the kernel's two non-obvious
+    base verdicts: the masked call (N) and the low-qual ARGMAX FLIP —
+    an observation with post-UMI error probability > 0.75 makes every
+    other base likelier, so the call becomes the lowest-index other base
+    with one counted error, exactly as the device kernel decides.
+    """
+    import numpy as np
+
+    f, t, _, w = bases.shape
+    if t != 1:
+        raise ValueError(f"singleton path needs T == 1 batches, got T={t}")
+    from bsseqconsensusreads_tpu.ops.reconstruct import qual_tables
+
+    t_single, _a, _d, t_masked, t_flip = qual_tables(params, vote_kernel)
+    b = np.asarray(bases)[:, 0]  # [F, 2, W]
+    q = np.asarray(quals)[:, 0].astype(np.float32)
+    if params.consensus_call_overlapping_bases:
+        b, q = _overlap_cocall_np(b, q)
+    observed = (b != NBASE) & (q >= params.min_input_base_quality)
+    # co-called quals are sums of two Phreds <= 93 each: always < 256
+    qi = np.clip(q, 0.0, 255.0).astype(np.uint8)
+    masked = t_masked[qi]
+    flip = t_flip[qi]
+    # argmax ties across the three other bases resolve to the lowest index
+    call = np.where(flip, np.where(b == 0, 1, 0), b)
+    called = observed & ~masked
+    from bsseqconsensusreads_tpu.ops.phred import NO_CALL_QUAL
+
+    return {
+        "base": np.where(called, call, NBASE).astype(np.int8),
+        "qual": np.where(called, t_single[qi], NO_CALL_QUAL).astype(np.uint8),
+        "depth": observed.astype(np.int16),
+        "errors": (called & flip).astype(np.int16),
+    }
+
+
 def pack_molecular_outputs(out: dict):
     """Pack the molecular output dict into one family-major planar u32 wire.
 

@@ -110,13 +110,22 @@ def evolve_duplex_quals(cover, quals, la, rd, eligible=None):
 
 @lru_cache(maxsize=16)
 def _qual_tables_cached(params: ConsensusParams, vote_kernel: str):
-    """(T_single [256], T_agree [256, 256], T_disagree [256, 256]) uint8.
+    """(T_single [256], T_agree [256, 256], T_disagree [256, 256],
+    T_single_masked bool [256], T_single_flip bool [256]) — quals uint8.
 
     Built by the production duplex vote itself: one [256, 4, 520] batch
     whose role-0 columns enumerate every case — family index = the
     A-strand qual, columns 0-255 = agreeing pair vs B qual, 256-511 =
-    disagreeing pair, 512 = A-strand singleton. One small device call per
-    (params, kernel), cached for the session.
+    disagreeing pair, 512 = A-strand singleton. The two bool tables
+    carry the kernel's base verdict for a lone observation, which the
+    singleton host fast path (models.molecular.singleton_consensus_host)
+    must reproduce: T_single_masked = call masked to N
+    (min_consensus_base_quality); T_single_flip = the log-likelihood
+    argmax FLIPPED away from the observed base (post-UMI error
+    probability > 0.75, i.e. raw quals 0-1 under the production error
+    model — the call becomes the lowest-index other base and the column
+    counts one error). One small device call per (params, kernel),
+    cached for the session.
     """
     import jax.numpy as jnp
 
@@ -144,10 +153,14 @@ def _qual_tables_cached(params: ConsensusParams, vote_kernel: str):
 
         out = duplex_consensus(jnp.asarray(bases), jnp.asarray(quals), params)
     qual = np.asarray(out["qual"])[:, 0, :]  # [256, w]
+    base = np.asarray(out["base"])[:, 0, :]
+    single_base = base[:, 512]  # observation was base A (0)
     return (
         np.ascontiguousarray(qual[:, 512].astype(np.uint8)),
         np.ascontiguousarray(qual[:, 0:256].astype(np.uint8)),
         np.ascontiguousarray(qual[:, 256:512].astype(np.uint8)),
+        np.ascontiguousarray(single_base == NBASE),
+        np.ascontiguousarray((single_base != NBASE) & (single_base != 0)),
     )
 
 
@@ -164,7 +177,7 @@ def reconstruct_duplex_quals(out: dict, evolved_quals: np.ndarray,
     evolved_quals: uint8 [f, 4, w] from evolve_duplex_quals. Exact: every
     value comes from the qual_tables the production kernel filled.
     """
-    t_single, t_agree, t_dis = qual_tables(params, vote_kernel)
+    t_single, t_agree, t_dis = qual_tables(params, vote_kernel)[:3]
     base = np.asarray(out["base"])
     f, _, w = base.shape
     qual = np.full((f, 2, w), NO_CALL_QUAL, np.uint8)

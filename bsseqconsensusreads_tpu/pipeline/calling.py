@@ -265,13 +265,21 @@ def _pipelined(events, depth: int = 1):
         yield pending.popleft()()
 
 
+def _resolve_vote_kernel(vote_kernel: str | None) -> str:
+    """THE vote-kernel resolution (explicit arg > BSSEQ_TPU_VOTE_KERNEL >
+    'xla') — one definition so the dispatched kernel and the kernel-built
+    lookup tables (singleton path, qual reconstruction) can never
+    disagree."""
+    import os
+
+    return vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
+
+
 def _molecular_kernel(vote_kernel: str | None):
     """Resolve the molecular vote kernel: 'xla' (default) or 'pallas'
     (ops.pallas_vote — the fused Mosaic reduction). Overridable per call or
     via BSSEQ_TPU_VOTE_KERNEL for whole-pipeline experiments."""
-    import os
-
-    choice = vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
+    choice = _resolve_vote_kernel(vote_kernel)
     if choice == "pallas":
         from bsseqconsensusreads_tpu.ops.pallas_vote import (
             molecular_consensus_pallas,
@@ -824,9 +832,12 @@ def call_molecular_batches(
     single-device wire on accelerator runs, like call_duplex_batches;
     'unpacked' forces plain tensors.
     """
+    import os
+
     from bsseqconsensusreads_tpu.ops import encode as encode_mod
 
     stats = stats if stats is not None else StageStats()
+    kernel_choice = _resolve_vote_kernel(vote_kernel)
     consensus_fn = _molecular_kernel(vote_kernel)
     emit_fn = (
         _emit_molecular_batch_raw
@@ -869,6 +880,24 @@ def call_molecular_batches(
         emits the previous one (depth-1 software pipeline, same rationale
         as call_duplex_batches)."""
         f = batch.bases.shape[0]
+        if (
+            batch.bases.shape[1] == 1
+            and sharded_fn is None
+            and wire_rr is None
+            and os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
+        ):
+            # T == 1 batches (the cfDNA majority at scale) never touch the
+            # device: cocall + single-obs LUT on the host is numerically
+            # identical (models.molecular.singleton_consensus_host) and
+            # skips the wire both ways
+            from bsseqconsensusreads_tpu.models.molecular import (
+                singleton_consensus_host,
+            )
+
+            out = singleton_consensus_host(
+                batch.bases, batch.quals, params, kernel_choice
+            )
+            return ("host", out), f
         if sharded_fn is None:
             if use_wire:
                 t, w = batch.bases.shape[1], batch.bases.shape[-1]
@@ -897,9 +926,14 @@ def call_molecular_batches(
 
     def retire_and_emit(wire, pf, batch, deep_emitted):
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
-        with stats.metrics.timed("fetch"):
-            out = unpack_molecular_outputs(jax.device_get(wire), f=pf, w=w)
-            out = {k: v[:f] for k, v in out.items()}
+        if isinstance(wire, tuple) and wire[0] == "host":
+            out = wire[1]  # singleton fast path: already host arrays
+        else:
+            with stats.metrics.timed("fetch"):
+                out = unpack_molecular_outputs(
+                    jax.device_get(wire), f=pf, w=w
+                )
+                out = {k: v[:f] for k, v in out.items()}
         with stats.metrics.timed("emit"):
             main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
@@ -1147,7 +1181,7 @@ def call_duplex_batches(
     import os
 
     stats = stats if stats is not None else StageStats()
-    kernel = vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
+    kernel = _resolve_vote_kernel(vote_kernel)
     emit_fn = (
         _emit_duplex_batch_raw
         if _resolve_emit(emit, mode) == "native"
@@ -1314,7 +1348,8 @@ def call_duplex_batches(
             if not batch.meta:
                 yield "now", passed
                 continue
-            sidecar = _duplex_sidecar(chunk, pos0=pos0)
+            with stats.metrics.timed("encode"):
+                sidecar = _duplex_sidecar(chunk, pos0=pos0)
             stats.batches += 1
             used = int(batch.cover.sum())
             stats.pad_cells += batch.cover.size - used
@@ -1378,8 +1413,11 @@ def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
                     if len(cigar) > 1 and cigar[-1][0] == CSOFT_CLIP
                     else 0
                 )
-            cd = np.asarray(cd, dtype=np.int32)
-            ce = np.asarray(ce, dtype=np.int32)
+            # uint16 matches the native decoder's aux planes, so columnar
+            # views pass through copy-free and the native rawize's flat
+            # buffer assembles with one concatenate
+            cd = np.asarray(cd, dtype=np.uint16)
+            ce = np.asarray(ce, dtype=np.uint16)
             if len(cd) != len(ce) or len(cd) <= lead + trail:
                 continue
             pos = rec.pos
@@ -1414,6 +1452,19 @@ def _place_raw(entry, presence, window_start, w):
     return np.where(presence, out, 0)
 
 
+def _sidecar_rows_for(meta, sidecar: dict, w: int):
+    """The sidecar occurrence whose reads intersect this meta's window.
+    Refragmented families repeat an MI within a chunk; fragments are
+    >flush-margin apart, so exactly one occurrence intersects."""
+    for cand in sidecar.get(meta.mi, ()):
+        if any(
+            pos < meta.window_start + w and pos + len(cd) > meta.window_start
+            for pos, cd, _ce in cand.values()
+        ):
+            return cand
+    return None
+
+
 def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
     """Convert the duplex kernel's presence-unit planes to fgbio's raw
     units wherever the sidecar has the molecular cd/ce arrays.
@@ -1425,33 +1476,58 @@ def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
     raw reads that voted the strand base disagree with the duplex call;
     the molecular-dissenting reads are assumed to match it — the one
     documented approximation, PARITY.md row 6). Families absent from the
-    sidecar keep presence units."""
+    sidecar keep presence units.
+
+    The per-column work runs in C (io.wirepack.duplex_rawize) when the
+    native library is built — the pure-Python per-family loop was the
+    duplex emit wall at scale — with this module's numpy loop as the
+    fallback and reference implementation."""
     if not sidecar:
         return out
+    from bsseqconsensusreads_tpu.io import wirepack
+    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
+
+    f, _, w = np.asarray(out["a_depth"]).shape
+    if wirepack.available():
+        row_pos = np.full(f * 4, -1, np.int64)
+        row_off = np.zeros(f * 4, np.int64)
+        row_len = np.zeros(f * 4, np.int32)
+        window_start = np.empty(f, np.int64)
+        chunks: list[np.ndarray] = []
+        cursor = 0
+        for fi, meta in enumerate(batch.meta):
+            window_start[fi] = meta.window_start
+            rows = _sidecar_rows_for(meta, sidecar, w)
+            if not rows:
+                continue
+            for row, (pos, cd, ce) in rows.items():
+                k = fi * 4 + row
+                row_pos[k] = pos
+                row_off[k] = cursor
+                row_len[k] = len(cd)
+                chunks.append(cd)
+                chunks.append(ce)
+                cursor += 2 * len(cd)
+        aux = (
+            np.concatenate(chunks) if chunks else np.zeros(0, np.uint16)
+        )
+        role_rows = np.asarray(
+            [r for pair in ROLE_STRAND_ROWS for r in pair], np.int32
+        )
+        return wirepack.duplex_rawize(
+            out, row_pos, row_off, row_len, aux, window_start, role_rows
+        )
+
     a_p = np.asarray(out["a_depth"])
     b_p = np.asarray(out["b_depth"])
     a_e = np.asarray(out["a_err"])
     b_e = np.asarray(out["b_err"])
-    f, _, w = a_p.shape
     ad = a_p.astype(np.int32).copy()
     bd = b_p.astype(np.int32).copy()
     ae = a_e.astype(np.int32).copy()
     be = b_e.astype(np.int32).copy()
-    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
-
     for fi, meta in enumerate(batch.meta):
-        rows = None
-        for cand in sidecar.get(meta.mi, ()):
-            # refragmented families repeat an MI within a chunk; fragments
-            # are >flush-margin apart, so exactly one occurrence's reads
-            # intersect this meta's window
-            if any(
-                pos < meta.window_start + w
-                and pos + len(cd) > meta.window_start
-                for pos, cd, _ce in cand.values()
-            ):
-                rows = cand
-                break
+        rows = _sidecar_rows_for(meta, sidecar, w)
         if not rows:
             continue
         for role in range(2):
@@ -1473,13 +1549,13 @@ def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
                 # raw reads are the errors (see docstring)
                 disagree = errbit[fi, role] > 0
                 dplane[fi, role] = raw_d
-                eplane[fi, role] = np.where(
-                    disagree, raw_d - raw_e, raw_e
+                eplane[fi, role] = np.clip(
+                    np.where(disagree, raw_d - raw_e, raw_e), 0, None
                 )
     out = dict(out)
     out["a_depth"], out["b_depth"] = ad.astype(np.int16), bd.astype(np.int16)
     out["depth"] = (ad + bd).astype(np.int16)
-    out["errors"] = np.clip(ae + be, 0, None).astype(np.int16)
+    out["errors"] = (ae + be).astype(np.int16)
     return out
 
 

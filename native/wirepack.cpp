@@ -485,6 +485,88 @@ void wirepack_unpack_duplex_outputs(const uint8_t* wire, int64_t f, int64_t w,
   }
 }
 
+// Raw-unit conversion of the duplex kernel's presence planes
+// (pipeline.calling._duplex_rawize, the C hot path): per family/role/
+// strand, place the molecular cd/ce arrays into window space, mask by
+// presence, fill synthetic boundary columns with the nearest raw value,
+// and apply the strand-disagreement error rule. Inputs:
+//   a_p/b_p/a_e/b_e int8 [f*2*w]  presence / error bits from the wire
+//   row_pos int64 [f*4]  placement pos per (family, DUPLEX row); -1 absent
+//   row_off int64 [f*4]  element offset into aux (cd at off, ce at off+len)
+//   row_len int32 [f*4]
+//   aux     u16 buffer, window_start int64 [f]
+//   role_rows int32 [4] = (a_row role0, b_row role0, a_row role1, b_row r1)
+// Outputs int16 [f*2*w]: ad, bd, ae, be, depth, errors. Families whose
+// four row_pos are all -1 keep presence units (the caller passes the
+// presence planes widened; this function only overwrites sidecar rows).
+void wirepack_duplex_rawize(
+    int64_t f, int64_t w, const int8_t* a_p, const int8_t* b_p,
+    const int8_t* a_e, const int8_t* b_e, const int64_t* row_pos,
+    const int64_t* row_off, const int32_t* row_len, const uint16_t* aux,
+    const int64_t* window_start, const int32_t* role_rows, int16_t* ad,
+    int16_t* bd, int16_t* ae, int16_t* be, int16_t* depth, int16_t* errors) {
+  for (int64_t fi = 0; fi < f; ++fi) {
+    for (int role = 0; role < 2; ++role) {
+      const int64_t plane = (fi * 2 + role) * w;
+      for (int strand = 0; strand < 2; ++strand) {
+        const int row = role_rows[role * 2 + strand];
+        const int8_t* pres = (strand == 0 ? a_p : b_p) + plane;
+        const int8_t* errbit = (strand == 0 ? a_e : b_e) + plane;
+        int16_t* draw = (strand == 0 ? ad : bd) + plane;
+        int16_t* eraw = (strand == 0 ? ae : be) + plane;
+        const int64_t k = fi * 4 + row;
+        if (row_pos[k] < 0) continue;  // no sidecar: keep presence units
+        const int64_t off = row_pos[k] - window_start[fi];
+        const int32_t n = row_len[k];
+        const uint16_t* cd = aux + row_off[k];
+        const uint16_t* ce = cd + n;
+        const int64_t lo = off < 0 ? 0 : off;
+        int64_t hi = off + n;
+        if (hi > w) hi = w;
+        // nearest in-range source column for the boundary fill
+        const int64_t lo_src = lo - off, hi_src = hi - 1 - off;
+        for (int64_t i = 0; i < w; ++i) {
+          if (!pres[i]) {
+            draw[i] = 0;
+            eraw[i] = 0;
+            continue;
+          }
+          int64_t s = i - off;
+          if (s < lo_src) s = lo_src;
+          if (s > hi_src) s = hi_src;
+          int32_t d = 0, e = 0;
+          if (hi > lo && s >= 0 && s < n) {
+            d = cd[s];
+            e = ce[s];
+            // exact only at the record's own columns; boundary columns
+            // (conversion prepend / extend copies) borrow the nearest
+            int64_t own = i - off;
+            if (own >= 0 && own < n && cd[own] != 0) {
+              d = cd[own];
+              e = ce[own];
+            }
+          }
+          if (errbit[i]) e = d - e;  // strand disagrees with the call
+          if (e < 0) e = 0;
+          draw[i] = int16_t(d);
+          eraw[i] = int16_t(e);
+        }
+      }
+      // totals
+      int16_t* drow = depth + plane;
+      int16_t* erow = errors + plane;
+      const int16_t* arow = ad + plane;
+      const int16_t* brow = bd + plane;
+      const int16_t* aer = ae + plane;
+      const int16_t* ber = be + plane;
+      for (int64_t i = 0; i < w; ++i) {
+        drow[i] = int16_t(arow[i] + brow[i]);
+        erow[i] = int16_t(aer[i] + ber[i]);
+      }
+    }
+  }
+}
+
 // Unpack the b0-only tunnel wire (models/duplex.pack_duplex_b0_outputs):
 // wire uint8 [f, 2, w] b0 planes, no qual (reconstructed host-side by
 // ops.reconstruct). Fills seven [f*2*w] arrays.

@@ -424,3 +424,27 @@ class TestRawStrandDepths:
         for rec in duplex:
             _sub, ad = rec.get_tag("ad")
             assert max(ad) >= 3, (rec.pos, list(ad))
+
+    def test_native_rawize_matches_python_fallback(self, monkeypatch):
+        """The C rawize pass (io.wirepack.duplex_rawize) and the numpy
+        fallback loop must produce identical raw tag surfaces."""
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if not wirepack.available():
+            pytest.skip("native wirepack not built")
+        _, with_native = self._chain(seed=99)
+        monkeypatch.setattr(wirepack, "available", lambda: False)
+        _, without = self._chain(seed=99)
+
+        def surface(recs):
+            return sorted(
+                (
+                    r.qname, r.flag, r.pos, r.seq,
+                    tuple(r.get_tag("cd")[1]), tuple(r.get_tag("ce")[1]),
+                    tuple(r.get_tag("ad")[1]), tuple(r.get_tag("bd")[1]),
+                    int(r.get_tag("aD")), int(r.get_tag("bD")),
+                )
+                for r in recs
+            )
+
+        assert surface(with_native) == surface(without)

@@ -258,3 +258,60 @@ def test_interior_nocall_emits_contiguous_N_not_compacted():
         tags = dict(rec.tags)
         assert tags["cd"][1][1][7] == 0  # per-base depth records the hole
         assert tags["cM"][1] == 0
+
+
+def test_singleton_host_path_matches_device(monkeypatch):
+    """T==1 batches take the host cocall+LUT fast path
+    (models.molecular.singleton_consensus_host); its records must be
+    identical to the device kernel's, tag for tag."""
+    from bsseqconsensusreads_tpu.pipeline.calling import call_molecular
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_grouped_bam_records,
+        random_genome,
+    )
+
+    local = np.random.default_rng(424242)
+    name, genome = random_genome(local, 3000)
+    _, records = make_grouped_bam_records(
+        local, name, genome, n_families=6, reads_per_strand=(1, 1),
+        error_rate=0.02,
+    )
+
+    def surface(recs):
+        return [
+            (
+                r.qname, r.flag, r.pos, r.seq, r.qual,
+                tuple(r.get_tag("cd")[1]), tuple(r.get_tag("ce")[1]),
+                int(r.get_tag("cD")), float(r.get_tag("cE")),
+            )
+            for r in recs
+        ]
+
+    fast = surface(call_molecular([r.copy() for r in records], mode="self"))
+    monkeypatch.setenv("BSSEQ_TPU_SINGLETON", "0")
+    slow = surface(call_molecular([r.copy() for r in records], mode="self"))
+    assert fast == slow and fast
+
+
+def test_singleton_host_path_exhaustive_base_qual():
+    """Every (base, qual 0-255) single observation: the host fast path must
+    reproduce the device kernel's base/qual/depth/errors exactly — incl.
+    the low-qual argmax flip (error prob > 0.75 makes every OTHER base
+    likelier) and mask behavior the r4 review caught."""
+    from bsseqconsensusreads_tpu.models.molecular import (
+        molecular_consensus,
+        singleton_consensus_host,
+    )
+
+    params = ConsensusParams(min_reads=1)
+    w = 256
+    bases = np.full((4, 1, 2, w), NBASE, np.int8)
+    quals = np.zeros((4, 1, 2, w), np.float32)
+    for fb in range(4):  # family index = observed base
+        bases[fb, 0, 0, :] = fb  # lone R1 observation per column
+        quals[fb, 0, 0, :] = np.arange(w, dtype=np.float32)
+    dev = {k: np.asarray(v) for k, v in molecular_consensus(
+        bases, quals, params).items()}
+    host = singleton_consensus_host(bases, quals, params)
+    for key in ("base", "qual", "depth", "errors"):
+        np.testing.assert_array_equal(host[key], dev[key], err_msg=key)
