@@ -181,12 +181,17 @@ def molecular_consensus(bases, quals, params: ConsensusParams = ConsensusParams(
 
 
 def _overlap_cocall_np(bases, quals):
-    """numpy twin of overlap_cocall for [F, 2, W] singleton families —
-    integer/float comparisons only, so it matches the jit path exactly."""
+    """numpy twin of overlap_cocall for [..., 2, W] tensors.
+
+    Exact for integer-valued quals in ANY dtype: every operation is a
+    comparison, sum, or absolute difference of integers, identical in
+    int16 and in the jit op's float32. Callers pass int16 — Phreds <= 93
+    sum within range and the narrow dtype halves the memory traffic of
+    this (host-bound) pass."""
     import numpy as np
 
-    b1, b2 = bases[:, 0, :], bases[:, 1, :]
-    q1, q2 = quals[:, 0, :], quals[:, 1, :]
+    b1, b2 = bases[..., 0, :], bases[..., 1, :]
+    q1, q2 = quals[..., 0, :], quals[..., 1, :]
     both = (b1 != NBASE) & (b2 != NBASE)
     agree = both & (b1 == b2)
     disagree = both & (b1 != b2)
@@ -195,14 +200,15 @@ def _overlap_cocall_np(bases, quals):
     winner = np.where(q1 >= q2, b1, b2)
     tie = disagree & (qdiff == 0)
     new_b = np.where(agree, b1, np.where(disagree, winner, -1))
-    new_q = np.where(agree, qsum, np.where(disagree, qdiff, 0.0))
+    zero = quals.dtype.type(0)
+    new_q = np.where(agree, qsum, np.where(disagree, qdiff, zero))
     out_b1 = np.where(both, np.where(tie, NBASE, new_b), b1)
     out_b2 = np.where(both, np.where(tie, NBASE, new_b), b2)
     out_q1 = np.where(both, new_q, q1)
     out_q2 = np.where(both, new_q, q2)
     return (
-        np.stack([out_b1, out_b2], axis=1).astype(bases.dtype),
-        np.stack([out_q1, out_q2], axis=1),
+        np.stack([out_b1, out_b2], axis=-2).astype(bases.dtype),
+        np.stack([out_q1, out_q2], axis=-2),
     )
 
 
@@ -233,12 +239,12 @@ def singleton_consensus_host(bases, quals,
 
     t_single, _a, _d, t_masked, t_flip = qual_tables(params, vote_kernel)
     b = np.asarray(bases)[:, 0]  # [F, 2, W]
-    q = np.asarray(quals)[:, 0].astype(np.float32)
+    q = np.asarray(quals)[:, 0].astype(np.int16)
     if params.consensus_call_overlapping_bases:
         b, q = _overlap_cocall_np(b, q)
     observed = (b != NBASE) & (q >= params.min_input_base_quality)
     # co-called quals are sums of two Phreds <= 93 each: always < 256
-    qi = np.clip(q, 0.0, 255.0).astype(np.uint8)
+    qi = np.clip(q, 0, 255).astype(np.uint8)
     masked = t_masked[qi]
     flip = t_flip[qi]
     # argmax ties across the three other bases resolve to the lowest index
@@ -325,6 +331,64 @@ def packed_molecular_kernel(kernel_fn=None):
     return _packed_kernel_cached(kernel_fn or molecular_consensus)
 
 
+def pack_molecular_slim_outputs(out: dict):
+    """Tunnel-wire pack: base + qual planes ONLY ([F, 4, W] u8 rows —
+    base of R1/R2 then qual of R1/R2 — flattened to u32).
+
+    A third of pack_molecular_outputs' bytes: per-column depth and error
+    counts are pure integer tallies over the observation tensors the
+    host itself encoded, so the wire-path retire recomputes them exactly
+    (recompute_molecular_counts) instead of shipping 8 count byte-planes
+    through the tunnel."""
+    planes = jnp.concatenate(
+        [out["base"].astype(jnp.uint8), out["qual"].astype(jnp.uint8)],
+        axis=-2,
+    )  # [..., F, 4, W]
+    return jax.lax.bitcast_convert_type(
+        planes.reshape(-1, 4), jnp.uint32
+    ).reshape(-1)
+
+
+def unpack_molecular_slim_outputs(wire, f: int, w: int) -> dict:
+    """numpy inverse of pack_molecular_slim_outputs -> base/qual [f, 2, w];
+    complete the dict with recompute_molecular_counts."""
+    import numpy as np
+
+    wire = np.asarray(wire)
+    u8 = wire.view(np.uint8) if wire.dtype != np.uint8 else wire
+    planes = u8[: f * 4 * w].reshape(f, 4, w)
+    return {
+        "base": planes[:, 0:2].astype(np.int8),
+        "qual": planes[:, 2:4].copy(),
+    }
+
+
+def recompute_molecular_counts(out: dict, bases, quals,
+                               params: ConsensusParams) -> dict:
+    """Fill depth/errors from the host's own input tensors — exact.
+
+    depth and errors are integer counts over exact comparisons (the
+    overlap co-call twin _overlap_cocall_np mirrors the jit op on
+    integer-valued quals), so no float rounding is involved: the result
+    is bit-identical to the kernel's shipped planes, at a few numpy
+    passes per batch instead of 8 tunnel byte-planes.
+    """
+    import numpy as np
+
+    b = np.asarray(bases)  # [F, T, 2, W]
+    q = np.asarray(quals).astype(np.int16)
+    if params.consensus_call_overlapping_bases:
+        b, q = _overlap_cocall_np(b, q)
+    observed = (b != NBASE) & (q >= params.min_input_base_quality)
+    cons = np.asarray(out["base"])[:, None]  # [F, 1, 2, W]
+    out = dict(out)
+    out["depth"] = observed.sum(axis=1).astype(np.int16)
+    out["errors"] = (
+        (observed & (cons != NBASE) & (b != cons)).sum(axis=1).astype(np.int16)
+    )
+    return out
+
+
 @lru_cache(maxsize=64)
 def _wire_kernel_cached(kernel_fn):
     @partial(jax.jit, static_argnames=("f", "t", "w", "params", "qual_mode"))
@@ -348,7 +412,7 @@ def _wire_kernel_cached(kernel_fn):
         out = kernel_fn(
             bases.reshape(f, t, 2, w), quals.reshape(f, t, 2, w), params
         )
-        return pack_molecular_outputs(out)
+        return pack_molecular_slim_outputs(out)
 
     return fn
 
@@ -358,7 +422,10 @@ def molecular_wire_kernel(kernel_fn=None):
     the tunnel-optimal molecular stage — ONE u32 array each way. Input is
     ops.wire.pack_molecular_inputs' 2T-row wire (4 bits/cell bases, the
     adaptive qual codebook) split and unpacked on device; output is the
-    same planar wire packed_molecular_kernel emits. ~4x fewer H2D bytes
-    than the unpacked [F,T,2,W] int8+uint8 pair on a transfer-bound link,
-    bit-identical results (the codebook is lossless)."""
+    SLIM planar wire (pack_molecular_slim_outputs: base+qual planes only
+    — the retire side recomputes the count planes exactly with
+    recompute_molecular_counts). ~4x fewer H2D bytes than the unpacked
+    [F,T,2,W] int8+uint8 pair and 3x fewer D2H bytes than the full
+    packed wire on a transfer-bound link, bit-identical results (the
+    codebook is lossless, the counts are exact integer tallies)."""
     return _wire_kernel_cached(kernel_fn or molecular_consensus)

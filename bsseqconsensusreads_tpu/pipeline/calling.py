@@ -872,6 +872,19 @@ def call_molecular_batches(
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_molecular_packed(mesh, params, kernel_fn=consensus_fn)
 
+    def is_singleton_batch(batch) -> bool:
+        """T == 1 batches (the cfDNA majority at scale) never touch the
+        device: cocall + single-obs LUT on the host is numerically
+        identical (models.molecular.singleton_consensus_host) and skips
+        the wire both ways. Timed as 'host_vote', not 'kernel', so
+        chip-busy accounting stays honest."""
+        return (
+            batch.bases.shape[1] == 1
+            and sharded_fn is None
+            and wire_rr is None
+            and os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
+        )
+
     def dispatch_kernel(batch):
         """Submit one batch; returns (device wire array, padded f). Outputs
         ride the packed planar wire (models.molecular.pack_molecular_outputs
@@ -880,16 +893,7 @@ def call_molecular_batches(
         emits the previous one (depth-1 software pipeline, same rationale
         as call_duplex_batches)."""
         f = batch.bases.shape[0]
-        if (
-            batch.bases.shape[1] == 1
-            and sharded_fn is None
-            and wire_rr is None
-            and os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
-        ):
-            # T == 1 batches (the cfDNA majority at scale) never touch the
-            # device: cocall + single-obs LUT on the host is numerically
-            # identical (models.molecular.singleton_consensus_host) and
-            # skips the wire both ways
+        if is_singleton_batch(batch):
             from bsseqconsensusreads_tpu.models.molecular import (
                 singleton_consensus_host,
             )
@@ -907,9 +911,12 @@ def call_molecular_batches(
                 words = win.to_words()
                 if wire_rr is not None:  # round-robin device placement
                     words = jax.device_put(words, wire_rr.next_device())
-                wire = wire_fn(
-                    words, f, t, w, params=params,
-                    qual_mode=win.qual_mode,
+                wire = (
+                    "slim",
+                    wire_fn(
+                        words, f, t, w, params=params,
+                        qual_mode=win.qual_mode,
+                    ),
                 )
             else:
                 wire = packed_fn(batch.bases, batch.quals, params)
@@ -919,7 +926,8 @@ def call_molecular_batches(
                 (batch.bases, batch.quals), f, data_size
             )
             wire = sharded_fn(pb, pq)
-        copy_async = getattr(wire, "copy_to_host_async", None)
+        dev = wire[1] if isinstance(wire, tuple) else wire
+        copy_async = getattr(dev, "copy_to_host_async", None)
         if copy_async is not None:
             copy_async()
         return wire, pf
@@ -928,6 +936,22 @@ def call_molecular_batches(
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         if isinstance(wire, tuple) and wire[0] == "host":
             out = wire[1]  # singleton fast path: already host arrays
+        elif isinstance(wire, tuple) and wire[0] == "slim":
+            # slim wire: base+qual shipped, count planes recomputed from
+            # the host's own input tensors (exact integer tallies)
+            from bsseqconsensusreads_tpu.models.molecular import (
+                recompute_molecular_counts,
+                unpack_molecular_slim_outputs,
+            )
+
+            with stats.metrics.timed("fetch"):
+                out = unpack_molecular_slim_outputs(
+                    jax.device_get(wire[1]), f=pf, w=w
+                )
+                out = {k: v[:f] for k, v in out.items()}
+                out = recompute_molecular_counts(
+                    out, batch.bases, batch.quals, params
+                )
         else:
             with stats.metrics.timed("fetch"):
                 out = unpack_molecular_outputs(
@@ -1033,7 +1057,8 @@ def call_molecular_batches(
             used = int((batch.bases != NBASE).sum())
             stats.pad_cells += batch.bases.size - used
             stats.used_cells += used
-            with stats.metrics.timed("kernel"):
+            phase = "host_vote" if is_singleton_batch(batch) else "kernel"
+            with stats.metrics.timed(phase):
                 out_dev, trim = dispatch_kernel(batch)
             yield "deferred", partial(
                 retire_and_emit, out_dev, trim, batch, deep_emitted

@@ -54,12 +54,32 @@ def _records_for(n_families: int) -> int:
     )
 
 
-def _child(workdir: str, n_families: int, raw_umis: bool = False) -> None:
+def _child(workdir: str, n_families: int, raw_umis: bool = False,
+           backend: str = "cpu") -> None:
     """Generate + run; prints one JSON line with stats."""
-    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    if backend == "cpu":
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=1"
+        )
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        # backend == 'tpu': let the site plugin claim the real chip — the
+        # round-3 verdict's core ask is this exact run with the chip in
+        # the loop (the consensus stages then engage the wire transport
+        # via transport='auto' on a single-device accelerator). The
+        # persistent compilation cache amortizes the ~30-40 s/shape TPU
+        # compiles across batch-shape variants, runs, and retries.
+        try:
+            cache_dir = os.environ.get(
+                "BSSEQ_TPU_COMPILE_CACHE", "/tmp/bsseq_jax_cache"
+            )
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # cache is an optimization, never a requirement
     import resource
 
     import numpy as np
@@ -141,6 +161,8 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False) -> None:
     target, _, stats = run_pipeline(cfg, bam, outdir=os.path.join(workdir, "output"))
     pipe_s = time.monotonic() - t0
     out = {
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
         "n_families": n_families,
         "n_records": n_records,
         "input_bytes": os.path.getsize(bam),
@@ -160,15 +182,25 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False) -> None:
 
 def main() -> int:
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
-        _child(sys.argv[2], int(sys.argv[3]), raw_umis="--raw-umis" in sys.argv)
+        _child(
+            sys.argv[2], int(sys.argv[3]),
+            raw_umis="--raw-umis" in sys.argv,
+            backend="tpu" if "--tpu" in sys.argv else "cpu",
+        )
         return 0
     ap = argparse.ArgumentParser()
     ap.add_argument("--families", type=int, default=2_000_000)
     ap.add_argument(
+        "--backend", choices=("cpu", "tpu"), default="cpu",
+        help="tpu = run the consensus stages on the real chip (wire "
+        "transport engages via transport='auto'); the r4 at-scale-on-chip "
+        "artifact mode",
+    )
+    ap.add_argument(
         "--out", default="",
-        help="artifact path (default: SCALE_r03.json, or "
-        "SCALERAW_r03.json under --raw-umis — the two runs are not "
-        "comparable and must not overwrite each other)",
+        help="artifact path (default: SCALE_r04.json / SCALERAW_r04.json "
+        "under --raw-umis / SCALE_TPU_r04.json under --backend tpu — "
+        "the runs are not comparable and must not overwrite each other)",
     )
     ap.add_argument("--workdir", default="")
     ap.add_argument("--rss-limit-gb", type=float, default=12.0)
@@ -181,13 +213,17 @@ def main() -> int:
     )
     args = ap.parse_args()
     if not args.out:
-        args.out = "SCALERAW_r03.json" if args.raw_umis else "SCALE_r03.json"
+        if args.backend == "tpu":
+            args.out = "SCALE_TPU_r04.json"
+        else:
+            args.out = "SCALERAW_r04.json" if args.raw_umis else "SCALE_r04.json"
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="bsseq_scale_")
     os.makedirs(workdir, exist_ok=True)
     report = {
         "config": {
             "raw_umis": args.raw_umis,
+            "backend": args.backend,
             "families": args.families,
             "expected_records_approx": _records_for(args.families),
             "cfdna_fraction": CFDNA_FRACTION,
@@ -199,12 +235,25 @@ def main() -> int:
         "ok": False,
     }
     t0 = time.monotonic()
+    # APPEND the repo to PYTHONPATH — replacing it would drop the site
+    # TPU plugin's sitecustomize dir and silently fall back to CPU
+    inherited = os.environ.get("PYTHONPATH", "")
+    child_env = dict(
+        os.environ,
+        PYTHONPATH=REPO + (os.pathsep + inherited if inherited else ""),
+    )
+    if args.backend == "cpu":
+        child_env["BSSEQ_TPU_BACKEND"] = "cpu"
+    else:
+        child_env.pop("BSSEQ_TPU_BACKEND", None)
     try:
         cp = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", workdir,
-             str(args.families)] + (["--raw-umis"] if args.raw_umis else []),
+             str(args.families)]
+            + (["--raw-umis"] if args.raw_umis else [])
+            + (["--tpu"] if args.backend == "tpu" else []),
             stdout=subprocess.PIPE, text=True, timeout=args.timeout,
-            env=dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu"),
+            env=child_env,
         )
         report["wall_s"] = round(time.monotonic() - t0, 1)
         if cp.returncode != 0:
@@ -229,7 +278,24 @@ def main() -> int:
             report["records_per_s_end_to_end"] = round(
                 child["n_records"] / child["pipeline_s"], 1
             )
-            report["ok"] = bool(report["rss_ok"])
+            # chip-busy fraction (VERDICT r3 item 1): device-facing
+            # seconds (kernel dispatch + fetch) over the stage walls.
+            # host_vote (the T==1 singleton host path) is pure host CPU
+            # and deliberately excluded; only meaningful on-chip.
+            if args.backend == "tpu":
+                dev_s = sum(
+                    st.get("kernel_seconds", 0) + st.get("fetch_seconds", 0)
+                    for st in child["stages"].values()
+                )
+                walls = sum(
+                    st.get("wall_seconds", 0)
+                    for st in child["stages"].values()
+                )
+                if walls:
+                    report["chip_busy_fraction"] = round(dev_s / walls, 3)
+            report["ok"] = bool(report["rss_ok"]) and (
+                args.backend != "tpu" or child.get("backend") == "tpu"
+            )
     except subprocess.TimeoutExpired:
         report["error"] = f"child timed out after {args.timeout}s"
         report["wall_s"] = round(time.monotonic() - t0, 1)
