@@ -24,7 +24,10 @@ def _try_load():
     if _lib is not None or _load_error is not None:
         return
     lib, _load_error = load_library(
-        "libbamio.so",
+        # BSSEQ_TPU_BAMIO_SO selects an alternate build of the same ABI —
+        # e.g. libbamio_tsan.so for the ThreadSanitizer stress run
+        # (tools/tsan_stress.py); the make target is named after the .so
+        os.environ.get("BSSEQ_TPU_BAMIO_SO", "libbamio.so"),
         "bamio.cpp",
         required_symbols=(
             "bamio_open", "bamio_read", "bamio_error", "bamio_close",

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""ThreadSanitizer stress run over the threaded native codec (r4 item 7).
+
+SURVEY.md §5.2 set the condition: "host I/O layer should be tested under
+TSan if threaded C++ is added" — and native/bamio.cpp runs a
+multi-threaded BGZF inflate worker pool (MtInflate) and a multi-threaded
+writer (MtWriter) on the production path. This tool:
+
+1. builds the `-fsanitize=thread` variant of the codec
+   (make libbamio_tsan.so);
+2. re-execs a CHILD with libtsan LD_PRELOADed and
+   BSSEQ_TPU_BAMIO_SO=libbamio_tsan.so, which stresses the two threaded
+   surfaces under concurrency: several Python threads each drive their
+   own mt reader (4 inflate workers apiece) over one shared BAM file
+   while another thread rewrites a second BAM through the mt writer,
+   for `--rounds` rounds (Python threads release the GIL inside the
+   ctypes calls, so the C worker pools genuinely interleave);
+3. collects ThreadSanitizer reports from TSAN_OPTIONS=log_path files
+   and writes a JSON artifact: {"ok": races == 0, "races": N, ...}.
+
+Usage: python tools/tsan_stress.py [--out TSAN_r04.json] [--rounds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _child(workdir: str, rounds: int) -> None:
+    import threading
+
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io import native
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter, BamReader
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_grouped_bam_records,
+        random_genome,
+    )
+
+    assert native.available(), native.load_error()
+    rng = np.random.default_rng(5)
+    name, genome = random_genome(rng, 8000)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=400, reads_per_strand=(2, 3)
+    )
+    src = os.path.join(workdir, "stress.bam")
+    # mt writer builds the shared input (BSSEQ_TPU_BGZF_THREADS set by
+    # the parent selects the 4-worker deflate pool)
+    with BamWriter(src, header) as w:
+        w.write_all(records)
+
+    errors: list[str] = []
+
+    def read_loop(i: int) -> None:
+        try:
+            for _ in range(rounds):
+                # native mt inflate pool + columnar parse, per thread
+                n = 0
+                for batch in native.read_columnar(src, batch_records=512):
+                    n += batch.n
+                assert n == len(records), (i, n)
+                with BamReader(src) as r:  # mt BGZF reader path
+                    m = sum(1 for _ in r)
+                assert m == len(records)
+        except Exception as e:  # surface child-side failures in the log
+            errors.append(f"reader {i}: {e!r}")
+
+    def write_loop() -> None:
+        try:
+            for k in range(rounds * 2):
+                dst = os.path.join(workdir, f"out{k % 2}.bam")
+                with BamWriter(dst, header) as w:
+                    w.write_all(records[:200])
+        except Exception as e:
+            errors.append(f"writer: {e!r}")
+
+    threads = [
+        threading.Thread(target=read_loop, args=(i,)) for i in range(3)
+    ] + [threading.Thread(target=write_loop)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        print(json.dumps({"child_errors": errors}))
+        raise SystemExit(1)
+    print(json.dumps({"child_ok": True, "records": len(records)}))
+
+
+def main() -> int:
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        _child(sys.argv[2], int(sys.argv[3]))
+        return 0
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="TSAN_r04.json")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--timeout", type=int, default=1200)
+    args = ap.parse_args()
+
+    report: dict = {"ok": False, "tool": "ThreadSanitizer (gcc libtsan)"}
+    t0 = time.monotonic()
+    workdir = tempfile.mkdtemp(prefix="bsseq_tsan_")
+    try:
+        mk = subprocess.run(
+            ["make", "-C", os.path.join(REPO, "native"), "libbamio_tsan.so"],
+            capture_output=True, text=True, timeout=300,
+        )
+        if mk.returncode != 0:
+            report["error"] = f"tsan build failed: {mk.stderr[-500:]}"
+            return 1
+        libtsan = subprocess.run(
+            ["g++", "-print-file-name=libtsan.so"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        log_base = os.path.join(workdir, "tsan")
+        env = dict(
+            os.environ,
+            LD_PRELOAD=libtsan,
+            BSSEQ_TPU_BAMIO_SO="libbamio_tsan.so",
+            BSSEQ_TPU_BGZF_THREADS="4",
+            TSAN_OPTIONS=f"log_path={log_base} exitcode=66",
+            PYTHONPATH=REPO
+            + (os.pathsep + os.environ.get("PYTHONPATH", "")
+               if os.environ.get("PYTHONPATH") else ""),
+        )
+        cp = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", workdir,
+             str(args.rounds)],
+            capture_output=True, text=True, timeout=args.timeout, env=env,
+        )
+        report["child_rc"] = cp.returncode
+        report["child_stdout"] = cp.stdout.strip()[-500:]
+        warnings = []
+        for path in glob.glob(log_base + "*"):
+            for line in open(path, errors="replace"):
+                if "WARNING: ThreadSanitizer" in line:
+                    warnings.append(line.strip())
+        report["races"] = len(warnings)
+        report["race_summaries"] = warnings[:20]
+        report["rounds"] = args.rounds
+        report["surfaces"] = [
+            "MtInflate worker pool (3 concurrent readers x 4 workers)",
+            "columnar parser over mt-inflated stream",
+            "MtWriter deflate pool under concurrent readers",
+        ]
+        # rc 66 = TSan found races (exitcode option); any other nonzero
+        # is a functional child failure
+        report["ok"] = cp.returncode == 0 and not warnings
+    except subprocess.TimeoutExpired:
+        report["error"] = "child timed out"
+    finally:
+        report["wall_s"] = round(time.monotonic() - t0, 1)
+        with open(args.out, "w") as fh:
+            json.dump(report, fh, indent=1)
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps({k: report.get(k) for k in ("ok", "races", "wall_s",
+                                                 "error", "child_rc")}))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
