@@ -1,0 +1,183 @@
+"""A SECOND, independent transcription of fgbio's published consensus
+model — the round-3 verdict's fidelity demand (VERDICT item 3): the
+kernel was only ever validated against utils/oracle.py, written by the
+same author from the same reading; a shared misreading would pass. This
+module re-derives the same documented semantics by a DIFFERENT route so
+a misreading would have to happen twice, differently, to agree:
+
+* probability domain, base-10, float64 PRODUCTS of per-observation
+  likelihoods (the oracle and the kernels work in log-likelihood SUMS);
+* the documented two-process error combination written in its
+  published closed form  p1 + p2 - (4/3) p1 p2  (error in either
+  process, minus both-err-and-restore under uniform substitution; the
+  oracle composes it as p1(1-p2) + (1-p1)p2 + (2/3)p1p2);
+* scalar Python throughout, no imports from bsseqconsensusreads_tpu
+  beyond nothing at all — base codes are plain ints 0..3, 4 = N.
+
+Documented semantics transcribed (fgbio CallMolecularConsensusReads /
+CallDuplexConsensusReads tool docs; flag surface = the reference's
+main.snake.py:54,163):
+
+1. each raw base quality is adjusted by the post-UMI error rate (the
+   two-process rule above);
+2. per column, for each candidate base: likelihood = product over
+   observations of (1 - p_i) if the observation is the candidate else
+   p_i / 3; observations that are N or below --min-input-base-quality
+   are excluded;
+3. consensus base = the likelihood argmax; its error probability is
+   1 - L(cons) / sum(L); that error is combined with the pre-UMI error
+   rate by the same two-process rule, converted to Phred, clamped to
+   [2, 93], and rounded; below --min-consensus-base-quality the call
+   masks to N / qual 2;
+4. --consensus-call-overlapping-bases=true co-calls R1/R2 overlap
+   first: agreement keeps the base at the summed quality, disagreement
+   keeps the higher-quality base at the quality difference, an exact
+   tie masks both;
+5. the duplex call is the same vote over the two strand consensi.
+"""
+
+from __future__ import annotations
+
+NBASE = 4
+NO_CALL = 2
+
+
+def _perr(q: float) -> float:
+    return 10.0 ** (-q / 10.0)
+
+
+def _two_process(p1: float, p2: float) -> float:
+    # published closed form: error in either process, minus the chance
+    # both err and the second lands back on the original base
+    return p1 + p2 - (4.0 / 3.0) * p1 * p2
+
+
+def _to_phred(p: float) -> float:
+    import math
+
+    p = min(max(p, 1e-12), 1.0)
+    return min(max(-10.0 * math.log10(p), 2.0), 93.0)
+
+
+def column_likelihoods(bases, quals, *, post_umi=30.0, min_input_q=0.0):
+    """(per-candidate likelihood products, kept observations)."""
+    p_post = _perr(post_umi)
+    obs = []
+    for b, q in zip(bases, quals):
+        if b == NBASE or q < min_input_q:
+            continue
+        p = _two_process(_perr(float(q)), p_post)
+        # the same numeric floor/ceiling the likelihood terms need to
+        # stay finite (log route) / nonzero (product route)
+        obs.append((b, min(max(p, 1e-12), 1.0 - 1e-7)))
+    likes = []
+    for cand in range(4):
+        like = 1.0
+        for b, p in obs:
+            like *= (1.0 - p) if b == cand else (p / 3.0)
+        likes.append(like)
+    return likes, obs
+
+
+def tied_candidates(bases, quals, *, post_umi=30.0, min_input_q=0.0,
+                    rel=3e-6):
+    """Candidates whose likelihood ties the max within `rel`.
+
+    Two tie sources: an exact mathematical tie (same multiset of
+    factors) breaks on summation-order ulps in the log-domain
+    implementations; and a float32-resolution collapse — the kernels
+    fold quals through the two-process rule in float32, where adjusted
+    error probabilities that differ by less than ~1e-7 relative (e.g.
+    raw quals 93 vs 95 under post-UMI 30) round together, compounding to
+    ~1e-6 over a deep column's product. `rel` sits above that band and
+    far below any semantic divergence (a wrong formula/clamp/prior moves
+    likelihoods by orders of magnitude)."""
+    likes, obs = column_likelihoods(
+        bases, quals, post_umi=post_umi, min_input_q=min_input_q
+    )
+    if not obs:
+        return {NBASE}
+    m = max(likes)
+    return {c for c in range(4) if likes[c] >= m * (1.0 - rel)}
+
+
+def column_call(bases, quals, *, pre_umi=45.0, post_umi=30.0,
+                min_input_q=0.0, min_consensus_q=0.0):
+    """One column: observation base codes + Phred quals ->
+    (base, qual, depth, errors)."""
+    likes, obs = column_likelihoods(
+        bases, quals, post_umi=post_umi, min_input_q=min_input_q
+    )
+    if not obs:
+        return NBASE, NO_CALL, 0, 0
+    best = max(range(4), key=lambda c: likes[c])
+    total = sum(likes)
+    p_cons = 1.0 - likes[best] / total
+    qual = _to_phred(_two_process(p_cons, _perr(pre_umi)))
+    if qual < min_consensus_q:
+        return NBASE, NO_CALL, len(obs), 0
+    errors = sum(1 for b, _ in obs if b != best)
+    return best, int(round(qual)), len(obs), errors
+
+
+def cocall_pair(b1, q1, b2, q2):
+    """Overlap co-call of one R1/R2 column pair -> ((b1', q1'), (b2', q2'))."""
+    if b1 == NBASE or b2 == NBASE:
+        return (b1, q1), (b2, q2)
+    if b1 == b2:
+        return (b1, q1 + q2), (b2, q1 + q2)
+    if q1 == q2:
+        return (NBASE, 0), (NBASE, 0)
+    win = b1 if q1 > q2 else b2
+    d = abs(q1 - q2)
+    return (win, d), (win, d)
+
+
+def family_call(reads, *, overlap=True, **kw):
+    """One single-strand family -> per-role consensus.
+
+    reads: list of templates; each template is a pair (r1, r2) with
+    r = (bases list, quals list) aligned to a common window (4 = no
+    coverage). Returns {role: (bases, quals, depths, errors)}.
+    """
+    w = len(reads[0][0][0])
+    cooked = []
+    for (b1, q1), (b2, q2) in reads:
+        nb1, nq1 = list(b1), list(q1)
+        nb2, nq2 = list(b2), list(q2)
+        if overlap:
+            for i in range(w):
+                (nb1[i], nq1[i]), (nb2[i], nq2[i]) = cocall_pair(
+                    b1[i], q1[i], b2[i], q2[i]
+                )
+        cooked.append(((nb1, nq1), (nb2, nq2)))
+    out = {}
+    for role in range(2):
+        bases, quals, depths, errors = [], [], [], []
+        for i in range(w):
+            col_b = [t[role][0][i] for t in cooked]
+            col_q = [t[role][1][i] for t in cooked]
+            b, q, d, e = column_call(col_b, col_q, **kw)
+            bases.append(b)
+            quals.append(q)
+            depths.append(d)
+            errors.append(e)
+        out[role] = (bases, quals, depths, errors)
+    return out
+
+
+def duplex_call(a_strand, b_strand, **kw):
+    """Duplex merge of two strand-consensus reads (per role window
+    lists): the same column vote at depth <= 2."""
+    bases, quals, depths, errors = [], [], [], []
+    for i in range(len(a_strand[0])):
+        b, q, d, e = column_call(
+            [a_strand[0][i], b_strand[0][i]],
+            [a_strand[1][i], b_strand[1][i]],
+            **kw,
+        )
+        bases.append(b)
+        quals.append(q)
+        depths.append(d)
+        errors.append(e)
+    return bases, quals, depths, errors

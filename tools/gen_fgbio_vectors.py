@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Regenerate tests/data/fgbio_golden/vectors.json (inputs only).
+
+The vector corpus for the three-way fgbio-model fidelity suite
+(tests/test_fgbio_golden.py): systematic shallow columns over a base/qual
+grid plus seeded randomized deeper columns with N and filtered
+observations. Inputs only — expected values are computed at test time by
+two independent transcriptions and cross-checked against the kernels, so
+no single implementation owns the truth. Deterministic: rerunning
+reproduces the committed file byte-for-byte; extend by editing the grids
+below. Thresholds in `params` must stay integral (ConsensusParams takes
+int quality floors; the test asserts this).
+"""
+
+import itertools
+import json
+import os
+import random
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "data", "fgbio_golden", "vectors.json",
+)
+
+
+def main() -> int:
+    rng = random.Random(20260731)
+    cases = []
+    grid_q = [0, 1, 2, 12, 23, 37, 40, 93]
+    for d in (1, 2, 3):
+        for bases in itertools.product((0, 1, 3), repeat=d):
+            for quals in itertools.combinations_with_replacement(grid_q, d):
+                cases.append({"bases": list(bases), "quals": list(quals)})
+    for _ in range(400):
+        d = rng.randint(4, 12)
+        cases.append({
+            "bases": [rng.choice([0, 1, 2, 3, 3, 3, 4]) for _ in range(d)],
+            "quals": [rng.choice(grid_q + [5, 17, 30]) for _ in range(d)],
+        })
+    params = [
+        {"pre_umi": 45.0, "post_umi": 30.0, "min_input_q": 0.0,
+         "min_consensus_q": 0.0},
+        {"pre_umi": 45.0, "post_umi": 30.0, "min_input_q": 10.0,
+         "min_consensus_q": 0.0},
+        {"pre_umi": 20.0, "post_umi": 15.0, "min_input_q": 0.0,
+         "min_consensus_q": 13.0},
+    ]
+    out = {
+        "comment": "fgbio-model fidelity vectors (inputs only): expected "
+                   "values are computed at test time by TWO independent "
+                   "transcriptions of the published model and cross-checked "
+                   "against the kernels (tests/test_fgbio_golden.py); "
+                   "regenerate with tools/gen_fgbio_vectors.py",
+        "params": params,
+        "columns": cases,
+    }
+    with open(OUT, "w") as fh:
+        json.dump(out, fh)
+    print(f"wrote {len(cases)} cases to {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
