@@ -40,6 +40,7 @@ def _try_load():
             "wirepack_unpack_duplex_outputs",
             "wirepack_unpack_duplex_b0",
             "wirepack_duplex_rawize",
+            "wirepack_duplex_retire",
             "wirepack_emit_consensus_records",
         ),
     )
@@ -63,6 +64,13 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p, C.c_void_p,
     ]
+    lib.wirepack_duplex_retire.restype = None
+    lib.wirepack_duplex_retire.argtypes = (
+        [C.c_void_p, C.c_int64, C.c_int64]  # b0, f, w
+        + [C.c_void_p] * 6  # cover, quals_pre, la, rd, eligible, role_rows
+        + [C.c_void_p] * 3  # t_single, t_agree, t_dis
+        + [C.c_void_p] * 8  # base, qual, depth, errors, a/b presence+err
+    )
     lib.wirepack_duplex_rawize.restype = None
     lib.wirepack_duplex_rawize.argtypes = [
         C.c_int64, C.c_int64,
@@ -219,6 +227,49 @@ def unpack_duplex_b0(wire_u8: np.ndarray, f: int, w: int) -> dict:
         out["b_depth"].ctypes.data_as(C.c_void_p),
         out["a_err"].ctypes.data_as(C.c_void_p),
         out["b_err"].ctypes.data_as(C.c_void_p),
+    )
+    return {k: v.reshape(f, 2, w) for k, v in out.items()}
+
+
+def duplex_retire(b0_u8: np.ndarray, f: int, w: int, cover, quals_pre,
+                  la, rd, eligible, role_rows,
+                  t_single, t_agree, t_dis) -> dict:
+    """One-pass native duplex retire: b0 decode + qual reconstruction
+    (wirepack_duplex_retire; ops.reconstruct holds the numpy reference).
+    Returns the full output dict minus la/rd (the caller splits those)."""
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    cols = f * 2 * w
+    b0_u8 = np.ascontiguousarray(b0_u8[:cols], dtype=np.uint8)
+    cover = np.ascontiguousarray(cover, dtype=np.uint8)
+    quals_pre = np.ascontiguousarray(quals_pre, dtype=np.float32)
+    la = np.ascontiguousarray(la, dtype=np.int8)
+    rd = np.ascontiguousarray(rd, dtype=np.int8)
+    eligible = np.ascontiguousarray(eligible, dtype=np.uint8)
+    role_rows = np.ascontiguousarray(role_rows, dtype=np.int32)
+    t_single = np.ascontiguousarray(t_single, dtype=np.uint8)
+    t_agree = np.ascontiguousarray(t_agree, dtype=np.uint8)
+    t_dis = np.ascontiguousarray(t_dis, dtype=np.uint8)
+    out = {
+        "base": np.empty(cols, np.int8),
+        "qual": np.empty(cols, np.uint8),
+        "depth": np.empty(cols, np.int16),
+        "errors": np.empty(cols, np.int16),
+        "a_depth": np.empty(cols, np.int8),
+        "b_depth": np.empty(cols, np.int8),
+        "a_err": np.empty(cols, np.int8),
+        "b_err": np.empty(cols, np.int8),
+    }
+    p = lambda a: a.ctypes.data_as(C.c_void_p)  # noqa: E731
+    _lib.wirepack_duplex_retire(
+        p(b0_u8), f, w,
+        p(cover), p(quals_pre), p(la), p(rd), p(eligible), p(role_rows),
+        p(t_single),
+        p(t_agree), p(t_dis), p(out["base"]),
+        p(out["qual"]), p(out["depth"]), p(out["errors"]),
+        p(out["a_depth"]), p(out["b_depth"]), p(out["a_err"]),
+        p(out["b_err"]),
     )
     return {k: v.reshape(f, 2, w) for k, v in out.items()}
 

@@ -1311,31 +1311,22 @@ def call_duplex_batches(
         with stats.metrics.timed("fetch"):
             host = jax.device_get(packed)
             if use_wire:
-                from bsseqconsensusreads_tpu.models.duplex import (
-                    unpack_duplex_wire_outputs,
+                # b0-only wire: decode + rebuild the qual plane host-side
+                # from the shipped strand bits + this host's own input
+                # quals (ops.reconstruct — exact, kernel-built tables;
+                # one native pass when built)
+                from bsseqconsensusreads_tpu.ops.reconstruct import (
+                    retire_duplex_wire,
                 )
 
-                out = unpack_duplex_wire_outputs(host, f=pf, w=w)
+                out = retire_duplex_wire(
+                    host, pf, w, batch.cover, batch.quals,
+                    batch.extend_eligible, params, kernel,
+                )
             else:
                 out = unpack_duplex_outputs(host, f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
         with stats.metrics.timed("emit"):
-            if "qual" not in out:
-                # b0-only wire: rebuild the qual plane host-side from the
-                # shipped strand bits + this host's own input quals
-                # (ops.reconstruct — exact, kernel-built tables)
-                from bsseqconsensusreads_tpu.ops.reconstruct import (
-                    evolve_duplex_quals,
-                    reconstruct_duplex_quals,
-                )
-
-                evolved, _cov = evolve_duplex_quals(
-                    batch.cover, batch.quals, out["la"], out["rd"],
-                    batch.extend_eligible,
-                )
-                out["qual"] = reconstruct_duplex_quals(
-                    out, evolved, params, kernel
-                )
             out = _duplex_rawize(out, batch, sidecar)
             main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):

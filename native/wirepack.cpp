@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -562,6 +563,118 @@ void wirepack_duplex_rawize(
       for (int64_t i = 0; i < w; ++i) {
         drow[i] = int16_t(arow[i] + brow[i]);
         erow[i] = int16_t(aer[i] + ber[i]);
+      }
+    }
+  }
+}
+
+// One-pass duplex retire for the b0-only tunnel wire: decode the b0
+// planes AND reconstruct the consensus qual plane from the kernel-built
+// tables over the host's own evolved input quals
+// (ops/reconstruct.py is the numpy reference; this is the hot path —
+// the numpy retire was the largest serial block of the on-chip stage).
+//
+//   b0_planes u8 [f, 2, w]   the D2H wire (base|a_p|b_p|a_e|b_e bits)
+//   cover     u8 [f, 4, w]   pre-transform row coverage (host's own)
+//   quals_pre f32 [f, 4, w]  pre-transform observation quals
+//   la/rd     i8 [f, 4], eligible u8 [f]  (la/rd ride the wire)
+//   role_rows i32 [4]        (a_row, b_row) per role
+//   t_single u8 [256], t_agree/t_dis u8 [256*256]  (qa-major)
+// Outputs [f, 2, w]: base i8, qual u8, depth/errors i16, a/b presence
+// and error bits i8.
+void wirepack_duplex_retire(
+    const uint8_t* b0_planes, int64_t f, int64_t w, const uint8_t* cover,
+    const float* quals_pre, const int8_t* la, const int8_t* rd,
+    const uint8_t* eligible, const int32_t* role_rows,
+    const uint8_t* t_single, const uint8_t* t_agree, const uint8_t* t_dis,
+    int8_t* base, uint8_t* qual, int16_t* depth, int16_t* errors,
+    int8_t* a_p_out, int8_t* b_p_out, int8_t* a_e_out, int8_t* b_e_out) {
+  constexpr uint8_t kPrependQual = 40;  // ops/convert.py PREPEND_QUAL
+  constexpr uint8_t kNoCall = 2;        // ops/phred.py NO_CALL_QUAL
+  constexpr int8_t kNBase = 4;
+  std::vector<uint8_t> q(4 * size_t(w));
+  std::vector<uint8_t> cov(4 * size_t(w));
+  for (int64_t fi = 0; fi < f; ++fi) {
+    // ---- evolve quals/cover (numpy twin: ops/reconstruct.py) ----
+    for (int row = 0; row < 4; ++row) {
+      const float* src = quals_pre + (fi * 4 + row) * w;
+      const uint8_t* cv = cover + (fi * 4 + row) * w;
+      uint8_t* qd = q.data() + row * w;
+      uint8_t* cd = cov.data() + row * w;
+      for (int64_t i = 0; i < w; ++i) {
+        qd[i] = uint8_t(src[i]);
+        cd[i] = cv[i];
+      }
+    }
+    int64_t first[4], last[4];
+    bool has[4];
+    auto span_of = [&](int row) {
+      const uint8_t* cd = cov.data() + row * w;
+      int64_t lo = -1, hi = -1;
+      for (int64_t i = 0; i < w; ++i)
+        if (cd[i]) {
+          if (lo < 0) lo = i;
+          hi = i;
+        }
+      first[row] = lo < 0 ? 0 : lo;
+      last[row] = hi < 0 ? 0 : hi;
+      has[row] = lo >= 0;
+    };
+    for (int row = 0; row < 4; ++row) {
+      span_of(row);
+      // conversion prepend (la==1 implies first>0 by construction)
+      if (la[fi * 4 + row] == 1 && has[row] && first[row] > 0) {
+        q[row * w + first[row] - 1] = kPrependQual;
+        cov[row * w + first[row] - 1] = 1;
+      }
+      // trailing trim (prepend only changes the left edge)
+      if (rd[fi * 4 + row] == 1 && has[row]) cov[row * w + last[row]] = 0;
+    }
+    // post-convert state for the extend copies
+    for (int row = 0; row < 4; ++row) span_of(row);
+    const bool elig = eligible[fi] != 0;
+    const int pairs[2][2] = {{1, 0}, {2, 3}};
+    for (const auto& pr : pairs) {
+      const int left = pr[0], right = pr[1];
+      const bool both = has[left] && has[right] && elig;
+      if (both && la[fi * 4 + left] == 1) {
+        const int64_t c = first[left];
+        q[right * w + c] = q[left * w + c];
+        cov[right * w + c] = 1;
+      }
+      if (both && rd[fi * 4 + left] == 1) {
+        const int64_t c = last[right];
+        q[left * w + c] = q[right * w + c];
+        cov[left * w + c] = 1;
+      }
+    }
+    // ---- decode b0 + qual lookup per role/column ----
+    for (int role = 0; role < 2; ++role) {
+      const uint8_t* b0 = b0_planes + (fi * 2 + role) * w;
+      const int64_t out0 = (fi * 2 + role) * w;
+      const uint8_t* qa_row = q.data() + role_rows[role * 2] * w;
+      const uint8_t* qb_row = q.data() + role_rows[role * 2 + 1] * w;
+      for (int64_t i = 0; i < w; ++i) {
+        decode_b0(b0[i], out0 + i, base, depth, errors, a_p_out, b_p_out,
+                  a_e_out, b_e_out);
+        const int8_t ap = a_p_out[out0 + i];
+        const int8_t bp = b_p_out[out0 + i];
+        const int8_t ae = a_e_out[out0 + i];
+        const int8_t be = b_e_out[out0 + i];
+        const int8_t bs = base[out0 + i];
+        uint8_t qv = kNoCall;
+        const bool masked = bs == kNBase;
+        if (ap && bp) {
+          if (ae || be)
+            qv = t_dis[size_t(qa_row[i]) * 256 + qb_row[i]];
+          else if (!masked)
+            qv = t_agree[size_t(qa_row[i]) * 256 + qb_row[i]];
+        } else if (ap && !masked) {
+          qv = t_single[qa_row[i]];
+        } else if (bp && !masked) {
+          qv = t_single[qb_row[i]];
+        }
+        qual[out0 + i] = qv;
       }
     }
   }

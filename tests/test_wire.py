@@ -273,3 +273,35 @@ def test_fused_single_array_wire_matches_five_array_wire():
         )
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_native_retire_matches_numpy_reference(monkeypatch):
+    """The one-pass C retire (io.wirepack.duplex_retire) must reproduce
+    the numpy reference (b0 unpack + evolve + table reconstruction)
+    field for field."""
+    from bsseqconsensusreads_tpu.io import wirepack
+    from bsseqconsensusreads_tpu.ops.reconstruct import retire_duplex_wire
+
+    if not wirepack.available():
+        pytest.skip("native wirepack not built")
+    f, w = 8, 32
+    bases, quals, cover, cmask, elig = random_batch(f, w, seed=23)
+    rng = np.random.default_rng(24)
+    genome_codes = rng.integers(0, 4, size=2000).astype(np.int8)
+    store = RefStore(["g"], codes=genome_codes, lengths=[2000])
+    starts, limits = store.window_offsets(
+        np.zeros(f, dtype=int), rng.integers(0, 1900, size=f)
+    )
+    wire = pack_duplex_inputs(bases, quals, cover, cmask, elig, starts, limits)
+    out_wire = np.asarray(jax.device_get(duplex_call_wire(
+        wire.nib, wire.qual, wire.meta, wire.starts, wire.limits,
+        store.device_codes, f, w, PARAMS, wire.qual_mode,
+    )))
+    native = retire_duplex_wire(out_wire, f, w, cover, quals, elig, PARAMS)
+    monkeypatch.setattr(wirepack, "available", lambda: False)
+    ref = retire_duplex_wire(out_wire, f, w, cover, quals, elig, PARAMS)
+    assert set(native) == set(ref)
+    for k in ref:
+        np.testing.assert_array_equal(
+            np.asarray(native[k]), np.asarray(ref[k]), err_msg=k
+        )
