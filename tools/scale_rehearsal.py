@@ -158,7 +158,9 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         batch_families=2048,
     )
     t0 = time.monotonic()
-    target, _, stats = run_pipeline(cfg, bam, outdir=os.path.join(workdir, "output"))
+    target, results, stats = run_pipeline(
+        cfg, bam, outdir=os.path.join(workdir, "output")
+    )
     pipe_s = time.monotonic() - t0
     out = {
         "backend": jax.default_backend(),
@@ -173,6 +175,11 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
         ),
         "output_bytes": os.path.getsize(target),
+        # rule-level walls expose the between-stage share (sorts, stage
+        # output writes) the stage StageStats cannot see
+        "rules": {
+            r.name: round(r.seconds, 1) for r in results if r.ran
+        },
         "stages": {
             name: st.as_dict() for name, st in stats.items()
         },
