@@ -12,8 +12,10 @@ main.snake.py:54,106,152,163); the framework's contract is <16 GB
 (BASELINE.md), enforced here with margin.
 
 Writes a JSON artifact: per-stage families/sec, phase metrics
-(StageStats.metrics: ingest/encode/kernel/fetch/emit splits), peak RSS, and
-the generation/pipeline wall clocks.
+(StageStats.metrics: ingest/encode/host_vote/kernel/fetch/emit splits),
+per-RULE wall clocks (exposing the between-stage sort/write share the
+stage metrics cannot see), peak RSS, the generation/pipeline wall
+clocks, and — under --backend tpu — the chip-busy fraction.
 
 Usage: python tools/scale_rehearsal.py [--families 2000000]
        [--out SCALE_r03.json] [--workdir DIR] [--rss-limit-gb 12]
