@@ -73,6 +73,12 @@ class RefStore:
                 f"offset cap {MAX_GENOME}; shard contigs across RefStores"
             )
         self._device = None
+        # overlap workers (pipeline.calling) hit the lazy upload
+        # concurrently; without the lock both would device_put the whole
+        # genome over the tunnel
+        import threading
+
+        self._device_lock = threading.Lock()
 
     @classmethod
     def from_fasta(cls, path: str) -> "RefStore":
@@ -85,9 +91,11 @@ class RefStore:
 
     @property
     def device_codes(self):
-        """The genome on device (uploaded lazily, once)."""
+        """The genome on device (uploaded lazily, once — thread-safe)."""
         if self._device is None:
-            self._device = jax.device_put(self.codes)
+            with self._device_lock:
+                if self._device is None:
+                    self._device = jax.device_put(self.codes)
         return self._device
 
     def contig_indices(self, names) -> np.ndarray:

@@ -148,6 +148,53 @@ def _pipeline_depth(rr: "_WireRoundRobin | None") -> int:
     return len(rr) if rr is not None else 1
 
 
+def _overlap_workers() -> int:
+    """Worker-thread count for the dispatch/fetch overlap pipeline.
+
+    On an accelerator backend the per-batch device work is mostly WAITING
+    (tunnel H2D, remote kernel, tunnel D2H) with the host CPU idle; worker
+    threads move that waiting — plus the host-side compute that rides the
+    retire path (singleton host votes, slim-wire count recomputes, the
+    duplex qual reconstruction) — off the main thread, so ingest/encode/
+    emit of neighbouring batches run DURING the waits instead of after
+    them. The round-4 scale artifact measured the cost of not doing this:
+    kernel 63 s + fetch 60 s serialized against ~198 s of host work
+    (SCALE_TPU_r04.json), making the chip-attached run slower than the
+    cpu-backend one.
+
+    Default: 2 workers on accelerator backends (one can run host-side
+    retire compute while the other blocks on the tunnel), 0 on the host
+    backend (kernels run on the same CPU the pipeline needs — threads add
+    contention, no idle to fill). BSSEQ_TPU_OVERLAP_THREADS overrides
+    (0 disables)."""
+    import os
+
+    env = os.environ.get("BSSEQ_TPU_OVERLAP_THREADS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return 2 if jax.default_backend() != "cpu" else 0
+
+
+def _make_overlap_pool(wire_rr, sharded_fn):
+    """(executor, pipeline_depth) for the overlap pipeline, or (None, 0)
+    when inline dispatch is the right call (host backend, an explicit
+    disable, or the multi-device paths, which pipeline by device count
+    instead and whose round-robin state is not thread-safe). Depth is
+    workers + 1: every worker holds one batch, one more sits queued."""
+    if wire_rr is not None or sharded_fn is not None:
+        return None, 0
+    n = _overlap_workers()
+    if n <= 0:
+        return None, 0
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=n, thread_name_prefix="bsseq-ovl")
+    return pool, n + 1
+
+
 def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     """Partition (mi, records) groups by encodable template count: families
     whose count exceeds `threshold` go to the deep-family path (sharded
@@ -871,6 +918,7 @@ def call_molecular_batches(
 
         data_size = mesh.shape[DATA_AXIS]
         sharded_fn = sharded_molecular_packed(mesh, params, kernel_fn=consensus_fn)
+    pool, pool_depth = _make_overlap_pool(wire_rr, sharded_fn)
 
     def is_singleton_batch(batch) -> bool:
         """T == 1 batches (the cfDNA majority at scale) never touch the
@@ -932,11 +980,14 @@ def call_molecular_batches(
             copy_async()
         return wire, pf
 
-    def retire_and_emit(wire, pf, batch, deep_emitted):
+    def fetch_out(wire, pf, batch) -> dict:
+        """Blocking device fetch + host-side count recompute for one
+        dispatched batch — the worker-thread half of the retire path in
+        overlap mode, the front of retire_and_emit inline."""
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         if isinstance(wire, tuple) and wire[0] == "host":
-            out = wire[1]  # singleton fast path: already host arrays
-        elif isinstance(wire, tuple) and wire[0] == "slim":
+            return wire[1]  # singleton fast path: already host arrays
+        if isinstance(wire, tuple) and wire[0] == "slim":
             # slim wire: base+qual shipped, count planes recomputed from
             # the host's own input tensors (exact integer tallies)
             from bsseqconsensusreads_tpu.models.molecular import (
@@ -949,20 +1000,43 @@ def call_molecular_batches(
                     jax.device_get(wire[1]), f=pf, w=w
                 )
                 out = {k: v[:f] for k, v in out.items()}
-                out = recompute_molecular_counts(
+                return recompute_molecular_counts(
                     out, batch.bases, batch.quals, params
                 )
-        else:
-            with stats.metrics.timed("fetch"):
-                out = unpack_molecular_outputs(
-                    jax.device_get(wire), f=pf, w=w
-                )
-                out = {k: v[:f] for k, v in out.items()}
+        with stats.metrics.timed("fetch"):
+            out = unpack_molecular_outputs(
+                jax.device_get(wire), f=pf, w=w
+            )
+            return {k: v[:f] for k, v in out.items()}
+
+    def emit_out(out, batch, deep_emitted):
         with stats.metrics.timed("emit"):
             main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
             return [main] + deep_emitted
         return main + deep_emitted
+
+    def retire_and_emit(wire, pf, batch, deep_emitted):
+        return emit_out(fetch_out(wire, pf, batch), batch, deep_emitted)
+
+    def dispatch_fetch(batch) -> dict:
+        """Worker-side unit of the overlap pipeline: dispatch (H2D + kernel
+        enqueue, or the T==1 host vote) and the blocking fetch, returning
+        host arrays ready for emit. Runs OFF the main thread so the
+        tunnel's waits and the singleton vote's CPU both hide under the
+        main thread's ingest/encode/emit of neighbouring batches."""
+        phase = "host_vote" if is_singleton_batch(batch) else "kernel"
+        with stats.metrics.timed(phase):
+            wire, pf = dispatch_kernel(batch)
+        return fetch_out(wire, pf, batch)
+
+    def retire_future(fut, batch, deep_emitted):
+        """Main-thread retire of one overlapped batch: join the worker
+        ('stall' = main-thread seconds actually blocked on it — the
+        pipeline's unhidden remainder), then emit in event order."""
+        with stats.metrics.timed("stall"):
+            out = fut.result()
+        return emit_out(out, batch, deep_emitted)
 
     def run_deep_kernel(batch):
         """One deep family [1, T, 2, W]: template axis over the devices."""
@@ -1057,6 +1131,12 @@ def call_molecular_batches(
             used = int((batch.bases != NBASE).sum())
             stats.pad_cells += batch.bases.size - used
             stats.used_cells += used
+            if pool is not None:
+                yield "deferred", partial(
+                    retire_future, pool.submit(dispatch_fetch, batch),
+                    batch, deep_emitted,
+                )
+                continue
             phase = "host_vote" if is_singleton_batch(batch) else "kernel"
             with stats.metrics.timed(phase):
                 out_dev, trim = dispatch_kernel(batch)
@@ -1064,7 +1144,12 @@ def call_molecular_batches(
                 retire_and_emit, out_dev, trim, batch, deep_emitted
             )
 
-    yield from _pipelined(events(), depth=_pipeline_depth(wire_rr))
+    depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)
+    try:
+        yield from _pipelined(events(), depth=depth)
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -1238,6 +1323,12 @@ def call_duplex_batches(
         refstore = RefStore.from_fasta(refstore)
     rid_map = refstore.contig_indices(ref_names) if use_wire else None
     wire_rr = _WireRoundRobin(mesh) if wire_mc else None
+    pool, pool_depth = _make_overlap_pool(wire_rr, sharded_fn)
+    if use_wire and pool is not None:
+        # pre-warm the one-time genome upload on the main thread (the lazy
+        # property is lock-guarded, but warming here keeps the first two
+        # worker dispatches from queueing behind a genome-sized transfer)
+        refstore.device_codes
     genome_per_dev: dict = {}
 
     def _wire_device_args(words):
@@ -1306,7 +1397,11 @@ def call_duplex_batches(
             copy_async()
         return packed, pf
 
-    def retire_and_emit(packed, pf, batch, passed, sidecar):
+    def fetch_out(packed, pf, batch, sidecar) -> dict:
+        """Blocking fetch + host-side reconstruction for one dispatched
+        duplex batch — worker-thread half of the retire path in overlap
+        mode. 'rawize' (the presence→raw-unit conversion) is timed apart
+        from 'fetch' so the artifact shows transfer vs host compute."""
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         with stats.metrics.timed("fetch"):
             host = jax.device_get(packed)
@@ -1326,12 +1421,32 @@ def call_duplex_batches(
             else:
                 out = unpack_duplex_outputs(host, f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
+        with stats.metrics.timed("rawize"):
+            return _duplex_rawize(out, batch, sidecar)
+
+    def emit_out(out, batch, passed):
         with stats.metrics.timed("emit"):
-            out = _duplex_rawize(out, batch, sidecar)
             main = emit_fn(batch, out, params, mode, stats)
         if isinstance(main, RawRecords):
             return [main] + passed
         return main + passed
+
+    def retire_and_emit(packed, pf, batch, passed, sidecar):
+        return emit_out(fetch_out(packed, pf, batch, sidecar), batch, passed)
+
+    def dispatch_fetch(batch, sidecar) -> dict:
+        """Worker-side unit of the overlap pipeline (see the molecular
+        stage's twin): dispatch + blocking fetch + rawize off the main
+        thread, hiding tunnel waits and retire compute under ingest/
+        encode/emit of neighbouring batches."""
+        with stats.metrics.timed("kernel"):
+            packed, pf = dispatch_kernel(batch)
+        return fetch_out(packed, pf, batch, sidecar)
+
+    def retire_future(fut, batch, passed):
+        with stats.metrics.timed("stall"):
+            out = fut.result()
+        return emit_out(out, batch, passed)
 
     groups = _timed_groups(
         stream_mi_groups(
@@ -1370,13 +1485,24 @@ def call_duplex_batches(
             used = int(batch.cover.sum())
             stats.pad_cells += batch.cover.size - used
             stats.used_cells += used
+            if pool is not None:
+                yield "deferred", partial(
+                    retire_future, pool.submit(dispatch_fetch, batch, sidecar),
+                    batch, passed,
+                )
+                continue
             with stats.metrics.timed("kernel"):
                 packed, pf = dispatch_kernel(batch)
             yield "deferred", partial(
                 retire_and_emit, packed, pf, batch, passed, sidecar
             )
 
-    yield from _pipelined(events(), depth=_pipeline_depth(wire_rr))
+    depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)
+    try:
+        yield from _pipelined(events(), depth=depth)
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
     stats.wall_seconds += time.monotonic() - t0
 
 

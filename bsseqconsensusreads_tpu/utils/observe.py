@@ -21,6 +21,7 @@ import contextlib
 import json
 import os
 import sys
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -54,13 +55,21 @@ class Metrics:
     Counters accumulate (records moved, bytes packed); timers accumulate
     seconds per named phase via the `timed` context manager. as_dict()
     flattens to one JSON-able payload; rates are derived, not stored.
+
+    Thread-safe accumulation: the overlap pipeline (pipeline.calling) times
+    phases from worker threads concurrently with the main thread — the
+    read-modify-write on a shared key must not lose seconds.
     """
 
     counters: dict = field(default_factory=dict)
     seconds: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def count(self, name: str, n: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + n
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     @contextlib.contextmanager
     def timed(self, name: str):
@@ -68,9 +77,9 @@ class Metrics:
         try:
             yield
         finally:
-            self.seconds[name] = (
-                self.seconds.get(name, 0.0) + time.monotonic() - t0
-            )
+            dt = time.monotonic() - t0
+            with self._lock:
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
 
     def rate(self, counter: str, timer: str) -> float:
         dt = self.seconds.get(timer, 0.0)

@@ -1,0 +1,150 @@
+"""Overlap pipeline (pipeline.calling worker-thread dispatch/fetch): output
+must be byte-identical to inline dispatch, batch order preserved (the
+checkpoint skip_batches contract), and the pool must wind down cleanly on
+early generator close.
+
+The overlap engine exists for the tunneled-TPU production path (round-4
+scale artifact: kernel+fetch serialized against host work); on the CPU
+test backend it is off by default, so these tests force it via
+BSSEQ_TPU_OVERLAP_THREADS and assert pure equivalence.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import BamWriter, write_items
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+@pytest.fixture(scope="module")
+def molecular_corpus():
+    rng = np.random.default_rng(23)
+    name, genome = random_genome(rng, 14000)
+    # reads_per_strand from 1 exercises the T==1 singleton host-vote path
+    # (worker-side in overlap mode) alongside normal kernel batches
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=24, reads_per_strand=(1, 3)
+    )
+    return header, records
+
+
+@pytest.fixture(scope="module")
+def duplex_corpus():
+    rng = np.random.default_rng(29)
+    name, genome = random_genome(rng, 16000)
+    records = []
+    for fam in range(30):
+        records.extend(
+            make_aligned_duplex_group(
+                rng, name, genome, fam, 60 + fam * 120, 70,
+                softclip=2 if fam % 4 == 0 else 0,
+            )
+        )
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return name, genome, records
+
+
+def _mol_bytes(records, header, tmp_path, tag, transport="auto"):
+    stats = StageStats()
+    out = str(tmp_path / f"mol_{tag}.bam")
+    batches = call_molecular_batches(
+        iter(list(records)), params=ConsensusParams(min_reads=1),
+        mode="self", batch_families=7, grouping="coordinate",
+        stats=stats, mesh=None, transport=transport,
+    )
+    with BamWriter(out, header, engine="python") as w:
+        for b in batches:
+            write_items(w, b)
+    return open(out, "rb").read(), stats
+
+
+def _dup_bytes(corpus, tmp_path, tag):
+    name, genome, records = corpus
+    stats = StageStats()
+    out = str(tmp_path / f"dup_{tag}.bam")
+    batches = call_duplex_batches(
+        iter(list(records)), lambda n, s, e: genome[s:e], [name],
+        mode="self", batch_families=8, grouping="coordinate",
+        stats=stats, mesh=None,
+    )
+    from bsseqconsensusreads_tpu.io.bam import BamHeader
+
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [(name, len(genome))])
+    with BamWriter(out, header, engine="python") as w:
+        for b in batches:
+            write_items(w, b)
+    return open(out, "rb").read(), stats
+
+
+class TestOverlapEquivalence:
+    def test_molecular_overlap_matches_inline(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        header, records = molecular_corpus
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "0")
+        inline, st0 = _mol_bytes(records, header, tmp_path, "inline")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        overlap, st2 = _mol_bytes(records, header, tmp_path, "overlap")
+        assert overlap == inline and len(inline) > 200
+        assert st2.batches == st0.batches
+        assert st2.consensus_out == st0.consensus_out
+        # worker-side phases accounted; main-thread stall visible
+        assert "stall" in st2.metrics.seconds
+        assert "stall" not in st0.metrics.seconds
+
+    def test_molecular_overlap_matches_inline_wire(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        """Explicit wire transport: worker-side H2D pack + slim fetch +
+        exact count recompute must match the inline wire run."""
+        header, records = molecular_corpus
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "0")
+        inline, _ = _mol_bytes(records, header, tmp_path, "inw", "wire")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "3")
+        overlap, _ = _mol_bytes(records, header, tmp_path, "ovw", "wire")
+        assert overlap == inline
+
+    def test_duplex_overlap_matches_inline(
+        self, duplex_corpus, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "0")
+        inline, st0 = _dup_bytes(duplex_corpus, tmp_path, "inline")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        overlap, st2 = _dup_bytes(duplex_corpus, tmp_path, "overlap")
+        assert overlap == inline and len(inline) > 200
+        assert st2.consensus_out == st0.consensus_out
+        assert "stall" in st2.metrics.seconds
+
+    def test_early_close_shuts_pool_down(self, duplex_corpus, monkeypatch):
+        """Closing the batch generator mid-stream (a consumer break) must
+        not hang on in-flight workers or leak the executor."""
+        import threading
+
+        name, genome, records = duplex_corpus
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        before = {t.name for t in threading.enumerate()}
+        batches = call_duplex_batches(
+            iter(list(records)), lambda n, s, e: genome[s:e], [name],
+            mode="self", batch_families=5, grouping="coordinate",
+            stats=StageStats(), mesh=None,
+        )
+        next(batches)
+        batches.close()
+        leaked = {
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith("bsseq-ovl") and t.is_alive()
+        } - before
+        assert not leaked
