@@ -290,6 +290,7 @@ def cmd_filter_consensus(args) -> int:
         FilterStats,
         filter_consensus,
         filtered_header,
+        probe_strand_tag_support,
     )
 
     params = FilterParams(
@@ -299,8 +300,10 @@ def cmd_filter_consensus(args) -> int:
         min_base_quality=args.min_base_quality,
         max_no_call_fraction=args.max_no_call_fraction,
         min_mean_base_quality=args.min_mean_base_quality,
+        require_single_strand_agreement=args.require_single_strand_agreement,
     )
     stats = FilterStats()
+    probe_strand_tag_support(args.input, params)  # fail before any write
     with BamReader(args.input) as reader:
         header = filtered_header(reader.header)
         with BamWriter(args.output, header) as w:
@@ -466,6 +469,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-N", "--min-base-quality", type=int, default=1)
     p.add_argument("-n", "--max-no-call-fraction", type=float, default=0.1)
     p.add_argument("-q", "--min-mean-base-quality", type=float, default=None)
+    p.add_argument(
+        "-s", "--require-single-strand-agreement", action="store_true",
+        help="mask duplex bases where the two single-strand calls "
+        "disagree (consumes the ac/bc tags this framework's duplex "
+        "output carries)",
+    )
     p.set_defaults(fn=cmd_filter_consensus)
 
     p = sub.add_parser(

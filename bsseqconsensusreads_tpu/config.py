@@ -136,6 +136,14 @@ class FrameworkConfig:
     #: (tools/1.convert_AG_to_CT.py:87-92); 'shift' keeps the duplex
     #: encode on the Python placement path.
     pos0: str = "skip"
+    #: molecular-stage cB raw base histogram tags (exact duplex ce input —
+    #: models.molecular.molecular_base_counts); disable to shave tag bytes
+    #: when no duplex stage follows.
+    base_count_tags: bool = True
+    #: duplex-stage ac/bc per-strand consensus call string tags (fgbio
+    #: surface; FilterConsensusReads --require-single-strand-agreement
+    #: input — pipeline.calling._duplex_rawize).
+    duplex_strand_tags: bool = True
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

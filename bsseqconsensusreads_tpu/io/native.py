@@ -34,7 +34,7 @@ def _try_load():
             "bamio_create", "bamio_write", "bamio_writer_error",
             "bamio_finish", "bamio_create_mt", "bamio_write_mt",
             "bamio_writer_error_mt", "bamio_finish_mt",
-            "bamio_parse_records3", "bamio_parse_grouped2",
+            "bamio_parse_records4", "bamio_parse_grouped3",
             "bamio_group_start", "bamio_group_error",
             "bamio_group_refragmented", "bamio_group_free",
             "bamio_encode_scan", "bamio_encode_fill",
@@ -71,8 +71,8 @@ def _try_load():
     lib.bamio_writer_error_mt.argtypes = [C.c_void_p]
     lib.bamio_finish_mt.restype = C.c_int
     lib.bamio_finish_mt.argtypes = [C.c_void_p]
-    lib.bamio_parse_records3.restype = C.c_int64
-    lib.bamio_parse_records3.argtypes = [
+    lib.bamio_parse_records4.restype = C.c_int64
+    lib.bamio_parse_records4.argtypes = [
         C.c_void_p, C.c_int64,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
@@ -90,10 +90,10 @@ def _try_load():
     lib.bamio_group_refragmented.restype = C.c_int64
     lib.bamio_group_refragmented.argtypes = [C.c_void_p]
     lib.bamio_group_free.argtypes = [C.c_void_p]
-    lib.bamio_parse_grouped2.restype = C.c_int64
-    lib.bamio_parse_grouped2.argtypes = (
+    lib.bamio_parse_grouped3.restype = C.c_int64
+    lib.bamio_parse_grouped3.argtypes = (
         [C.c_void_p, C.c_void_p, C.c_int64]  # Reader*, Grouper*, max_records
-        + lib.bamio_parse_records3.argtypes[2:]
+        + lib.bamio_parse_records4.argtypes[2:]
         + [C.c_char_p, C.c_int, C.c_void_p, C.c_int64, C.c_void_p]
     )
     lib.bamio_encode_scan.restype = C.c_int64
@@ -323,8 +323,8 @@ def _skip_header(r: "NativeBgzfReader", path: str) -> None:
 
 
 def _alloc_batch(n: int, var_bytes: int, qname_width: int, tag_width: int):
-    """Batch buffers + the ctypes argument list bamio_parse_records3 /
-    bamio_parse_grouped2 share (from max_records onward)."""
+    """Batch buffers + the ctypes argument list bamio_parse_records4 /
+    bamio_parse_grouped3 share (from max_records onward)."""
     bufs = {
         "ref_id": np.empty(n, np.int32),
         "pos": np.empty(n, np.int32),
@@ -349,12 +349,14 @@ def _alloc_batch(n: int, var_bytes: int, qname_width: int, tag_width: int):
         "left_clip": np.empty(n, np.int32),
         "right_clip": np.empty(n, np.int32),
         "cigar_flags": np.empty(n, np.uint8),
-        # cd/ce aux planes (consensus-input ingest): per record, cd then
-        # ce values (aux_len[i] u16 each) at aux[aux_off[i]]; len 0 =
-        # absent. Sized 2*var_bytes ELEMENTS so a var-capacity fit
-        # implies an aux fit; np.empty is lazy, raw-read inputs without
-        # the tags never commit these pages.
-        "aux": np.empty(2 * var_bytes, np.uint16),
+        # cd/ce(/cB) aux planes (consensus-input ingest): per record, cd
+        # then ce values (n u16 each) at aux[aux_off[i]], plus the 4n cB
+        # histogram when aux_len[i] carries the 1<<30 flag bit (see
+        # native/bamio.cpp kAuxHasCb / pipeline.ingest). Sized
+        # 6*var_bytes ELEMENTS so a var-capacity fit implies an aux fit
+        # even with every record carrying cB; np.empty is lazy, raw-read
+        # inputs without the tags never commit these pages.
+        "aux": np.empty(6 * var_bytes, np.uint16),
         "aux_off": np.empty(n, np.int64),
         "aux_len": np.empty(n, np.int32),
     }
@@ -368,7 +370,7 @@ def _alloc_batch(n: int, var_bytes: int, qname_width: int, tag_width: int):
         bufs["mi"].ctypes.data_as(C.c_char_p), tag_width,
         bufs["rx"].ctypes.data_as(C.c_char_p), tag_width,
         p("ref_span"), p("left_clip"), p("right_clip"), p("cigar_flags"),
-        p("aux"), 2 * var_bytes, p("aux_off"), p("aux_len"),
+        p("aux"), 6 * var_bytes, p("aux_off"), p("aux_len"),
     ]
     return bufs, args
 
@@ -420,7 +422,7 @@ def read_columnar(
             bufs, args = _alloc_batch(
                 batch_records, var_bytes, qname_width, tag_width
             )
-            got = _lib.bamio_parse_records3(r._h, batch_records, *args)
+            got = _lib.bamio_parse_records4(r._h, batch_records, *args)
             if got < 0:
                 raise IOError(_lib.bamio_error(r._h).decode())
             if got == 0:
@@ -443,7 +445,7 @@ def read_grouped_columnar(
 ):
     """Stream ColumnarBatches whose records are reordered into CONTIGUOUS
     whole-MI-family runs by the C-side coordinate grouper
-    (bamio_parse_grouped2 — the native equivalent of
+    (bamio_parse_grouped3 — the native equivalent of
     pipeline.calling.stream_mi_groups grouping='coordinate').
 
     Yields (batch, fam_mi bytes array [nf], fam_nrec int32 [nf],
@@ -467,7 +469,7 @@ def read_grouped_columnar(
             fam_mi = np.zeros(fam_cap * tag_width, np.uint8)
             fam_nrec = np.empty(fam_cap, np.int32)
             n_fams = C.c_int64(0)
-            got = _lib.bamio_parse_grouped2(
+            got = _lib.bamio_parse_grouped3(
                 r._h, g, batch_records, *args,
                 fam_mi.ctypes.data_as(C.c_char_p), tag_width,
                 fam_nrec.ctypes.data_as(C.c_void_p), fam_cap,

@@ -41,7 +41,7 @@ def _try_load():
             "wirepack_unpack_duplex_b0",
             "wirepack_duplex_rawize",
             "wirepack_duplex_retire",
-            "wirepack_emit_consensus_records",
+            "wirepack_emit_consensus_records_v2",
         ),
     )
     if lib is None:
@@ -80,9 +80,9 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p,
     ]
-    lib.wirepack_emit_consensus_records.restype = C.c_int
-    lib.wirepack_emit_consensus_records.argtypes = (
-        [C.c_void_p] * 6
+    lib.wirepack_emit_consensus_records_v2.restype = C.c_int
+    lib.wirepack_emit_consensus_records_v2.argtypes = (
+        [C.c_void_p] * 9  # planes: base..b_depth, bcount, a_call, b_call
         + [C.c_int64, C.c_int64]
         + [C.c_void_p] * 10
         + [C.c_int, C.c_int, C.c_void_p, C.c_int64]
@@ -313,6 +313,10 @@ def duplex_rawize(out: dict, row_pos, row_off, row_len, aux, window_start,
     )
     new = dict(out)
     new["a_depth"], new["b_depth"] = ad, bd
+    # raw-unit per-strand error planes (the C pass computes them for the
+    # errors sum anyway): pipeline.calling's exact-ce pass refines these
+    # wherever the cB histogram exists
+    new["a_err"], new["b_err"] = ae, be
     new["depth"], new["errors"] = depth, errors
     return new
 
@@ -346,15 +350,20 @@ def emit_consensus_records(
     min_reads: int,
     mode_self: bool,
     duplex: bool,
+    bcount=None,
+    strand_calls=None,
 ) -> tuple[bytes, int, int]:
     """Native batch emit: kernel output planes -> BAM record bytes.
 
     out: dict of [f, 2, w] arrays (base int8, qual uint8, depth/errors
     int16, plus a_depth/b_depth int16 when duplex). Per-family metadata as
-    documented on wirepack_emit_consensus_records (native/wirepack.cpp).
-    rx entries may be "" (no RX tag). Returns (record bytes, n_records,
-    n_families_skipped); the bytes are ready for BamWriter.write_raw —
-    byte-identical to the Python emit + encode_record path
+    documented on wirepack_emit_consensus_records_v2 (native/wirepack.cpp).
+    rx entries may be "" (no RX tag). bcount (uint16 [f, 2, 4, w]) adds
+    the molecular cB histogram tag; strand_calls ((a_call, b_call) int8
+    [f, 2, w]) adds the duplex ac/bc strand-call string tags. Returns
+    (record bytes, n_records, n_families_skipped); the bytes are ready
+    for BamWriter.write_raw — byte-identical to the Python emit +
+    encode_record path
     (pipeline.calling cites: _emit_molecular_batch/_emit_duplex_batch).
     """
     _try_load()
@@ -372,6 +381,18 @@ def emit_consensus_records(
         b_ptr = b_depth.ctypes.data_as(C.c_void_p)
     else:
         a_ptr = b_ptr = None
+    if bcount is not None:
+        bcount = np.ascontiguousarray(bcount, dtype=np.uint16)
+        bc_ptr = bcount.ctypes.data_as(C.c_void_p)
+    else:
+        bc_ptr = None
+    if strand_calls is not None:
+        a_call = np.ascontiguousarray(strand_calls[0], dtype=np.int8)
+        b_call = np.ascontiguousarray(strand_calls[1], dtype=np.int8)
+        ac_ptr = a_call.ctypes.data_as(C.c_void_p)
+        bcall_ptr = b_call.ctypes.data_as(C.c_void_p)
+    else:
+        ac_ptr = bcall_ptr = None
     ref_id = np.ascontiguousarray(ref_id, dtype=np.int32)
     window_start = np.ascontiguousarray(window_start, dtype=np.int64)
     n_reads = np.ascontiguousarray(n_reads, dtype=np.int32)
@@ -380,17 +401,23 @@ def emit_consensus_records(
     rx_blob, rx_off, rx_len = _string_blob(rx)
     mi_max = int(mi_len.max()) if len(mi) else 0
     rx_max = int(rx_len.max()) if len(rx) else 0
-    cap = int(f) * 2 * ((10 + 4 * duplex) * int(w) + 2 * mi_max + rx_max + 160)
+    per_col = (
+        10
+        + 4 * duplex
+        + (8 if bcount is not None else 0)
+        + (2 if strand_calls is not None else 0)
+    )
+    cap = int(f) * 2 * (per_col * int(w) + 2 * mi_max + rx_max + 200)
     buf = np.empty(max(cap, 4096), dtype=np.uint8)
     out_len = C.c_int64(0)
     n_records = C.c_int64(0)
     n_skipped = C.c_int64(0)
-    rc = _lib.wirepack_emit_consensus_records(
+    rc = _lib.wirepack_emit_consensus_records_v2(
         base.ctypes.data_as(C.c_void_p),
         qual.ctypes.data_as(C.c_void_p),
         depth.ctypes.data_as(C.c_void_p),
         errors.ctypes.data_as(C.c_void_p),
-        a_ptr, b_ptr, f, w,
+        a_ptr, b_ptr, bc_ptr, ac_ptr, bcall_ptr, f, w,
         ref_id.ctypes.data_as(C.c_void_p),
         window_start.ctypes.data_as(C.c_void_p),
         n_reads.ctypes.data_as(C.c_void_p),

@@ -364,7 +364,8 @@ def unpack_molecular_slim_outputs(wire, f: int, w: int) -> dict:
 
 
 def recompute_molecular_counts(out: dict, bases, quals,
-                               params: ConsensusParams) -> dict:
+                               params: ConsensusParams,
+                               with_histogram: bool = False) -> dict:
     """Fill depth/errors from the host's own input tensors — exact.
 
     depth and errors are integer counts over exact comparisons (the
@@ -372,6 +373,12 @@ def recompute_molecular_counts(out: dict, bases, quals,
     integer-valued quals), so no float rounding is involved: the result
     is bit-identical to the kernel's shipped planes, at a few numpy
     passes per batch instead of 8 tunnel byte-planes.
+
+    with_histogram: also stash the cB raw base histogram in
+    out['bcount'] and DERIVE depth/errors from it (depth = counts summed
+    over bases; errors = depth - counts[consensus] where called) — one
+    cocall+filter pass instead of two when the emit path needs the
+    histogram anyway (the r5 exact-ce tag surface).
     """
     import numpy as np
 
@@ -380,13 +387,66 @@ def recompute_molecular_counts(out: dict, bases, quals,
     if params.consensus_call_overlapping_bases:
         b, q = _overlap_cocall_np(b, q)
     observed = (b != NBASE) & (q >= params.min_input_base_quality)
-    cons = np.asarray(out["base"])[:, None]  # [F, 1, 2, W]
+    cons = np.asarray(out["base"])  # [F, 2, W]
     out = dict(out)
+    if with_histogram:
+        counts = _base_histogram(b, observed)
+        out["bcount"] = counts
+        depth = counts.sum(axis=2, dtype=np.int32).astype(np.int16)
+        cnt_cons = np.take_along_axis(
+            counts, np.clip(cons, 0, 3)[:, :, None, :].astype(np.int64),
+            axis=2,
+        )[:, :, 0, :].astype(np.int16)
+        out["depth"] = depth
+        out["errors"] = np.where(cons != NBASE, depth - cnt_cons, 0).astype(
+            np.int16
+        )
+        return out
     out["depth"] = observed.sum(axis=1).astype(np.int16)
     out["errors"] = (
-        (observed & (cons != NBASE) & (b != cons)).sum(axis=1).astype(np.int16)
+        (observed & (cons[:, None] != NBASE) & (b != cons[:, None]))
+        .sum(axis=1).astype(np.int16)
     )
     return out
+
+
+def _base_histogram(b, observed):
+    """uint16 [F, 2, 4, W] per-base counts over co-called observations —
+    the ONE tally shared by molecular_base_counts and the slim-wire
+    retire (recompute_molecular_counts with_histogram), so the cB tag
+    payload and the kernel-identical depth/errors derivation can never
+    desynchronize."""
+    import numpy as np
+
+    f, _t, _r, w = b.shape
+    counts = np.empty((f, 2, NUM_BASES, w), np.uint16)
+    for x in range(NUM_BASES):
+        counts[:, :, x, :] = (observed & (b == x)).sum(axis=1)
+    return counts
+
+
+def molecular_base_counts(bases, quals, params: ConsensusParams) -> "np.ndarray":
+    """Per-column raw base histogram: uint16 [F, 2, 4, W].
+
+    counts[f, role, x, i] = observations of base x at column i, under the
+    SAME observation filter as the vote (post overlap-cocall, min input
+    qual) — so counts.sum over x == the kernel's depth plane exactly, and
+    depth - counts[consensus] == the kernel's errors plane wherever the
+    consensus called. This is the payload of the molecular emitters' cB
+    tag: the duplex stage consumes it to count raw reads against the
+    DUPLEX call exactly (pipeline.calling._duplex_rawize), closing the
+    round-4 ce approximation (PARITY.md row 6). Host-side numpy — the
+    integer tallies need no device round trip (same rationale as
+    recompute_molecular_counts).
+    """
+    import numpy as np
+
+    b = np.asarray(bases)  # [F, T, 2, W]
+    q = np.asarray(quals).astype(np.int16)
+    if params.consensus_call_overlapping_bases:
+        b, q = _overlap_cocall_np(b, q)
+    observed = (b != NBASE) & (q >= params.min_input_base_quality)
+    return _base_histogram(b, observed)
 
 
 @lru_cache(maxsize=64)

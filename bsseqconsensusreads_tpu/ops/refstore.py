@@ -106,6 +106,27 @@ class RefStore:
             [self._index.get(n, -1) for n in names], dtype=np.int64
         )
 
+    def host_windows(self, starts, limits, width: int) -> np.ndarray:
+        """numpy twin of gather_windows over the HOST copy of the genome:
+        int8 [F, width] windows with the same NO_REF / past-limit N
+        semantics. The duplex raw-unit accounting uses this when the wire
+        transport skipped the per-family host reference fetch
+        (pipeline.calling._duplex_rawize needs the window to evaluate the
+        conversion context host-side)."""
+        starts = np.asarray(starts, dtype=np.uint32)
+        limits = np.asarray(limits, dtype=np.uint32)
+        idx = starts[:, None].astype(np.int64) + np.arange(width)
+        valid = (starts[:, None] != NO_REF) & (
+            idx < limits[:, None].astype(np.int64)
+        )
+        safe = np.minimum(idx, max(self.codes.size - 1, 0))
+        ref = (
+            self.codes[safe]
+            if self.codes.size
+            else np.zeros(idx.shape, np.int8)
+        )
+        return np.where(valid, ref, np.int8(NBASE))
+
     def window_offsets(self, ref_ids, window_starts):
         """Vectorized (starts, limits) uint32 arrays for gather_windows.
 

@@ -606,13 +606,30 @@ def _group_batches_bucketed(
         yield pending[b]
 
 
-def _consensus_tags(depth_arr, err_arr, mi, rx):
+def _consensus_tags(depth_arr, err_arr, mi, rx, bcount=None,
+                    flip: bool = False):
     """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce.
+
+    bcount (uint16 [4, n] or None) adds the cB raw base histogram —
+    4 plane-major runs of per-base counts (A,C,G,T order), the duplex
+    stage's input for exact raw-vs-duplex-call error units
+    (models.molecular.molecular_base_counts).
+
+    flip: the record is emitted reverse-complemented (unaligned mode,
+    reverse role) — per-base arrays reverse with the SEQ (fgbio stores
+    per-base tags in record base order) and the histogram's base planes
+    complement (a window-space A count is a T count on the emitted
+    strand).
 
     Vectorized: on the 100M-read config this runs once per consensus read
     — per-element Python loops here dominated the emit phase."""
     depth_arr = np.asarray(depth_arr)
     err_arr = np.asarray(err_arr)
+    if flip:
+        depth_arr = depth_arr[::-1]
+        err_arr = err_arr[::-1]
+        if bcount is not None:
+            bcount = bcount[::-1, ::-1]  # complement planes + reverse cols
     # int64 accumulators: int16 per-column counts sum past 32767 on deep
     # families over a full window
     total = int(depth_arr.sum(dtype=np.int64))
@@ -625,6 +642,8 @@ def _consensus_tags(depth_arr, err_arr, mi, rx):
         "cd": ("B", ("S", depth_arr.tolist())),
         "ce": ("B", ("S", err_arr.tolist())),
     }
+    if bcount is not None:
+        tags["cB"] = ("B", ("S", bcount.reshape(-1).tolist()))
     if rx:
         tags["RX"] = ("Z", rx)
     return tags
@@ -712,7 +731,8 @@ def _resolve_emit(emit: str, mode: str) -> str:
 
 
 def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
-                    role_reverse, duplex) -> RawRecords:
+                    role_reverse, duplex, bcount=None,
+                    strand_calls=None) -> RawRecords:
     """Native batch emit (io.wirepack) — byte-identical to the Python
     emit + encode_record path, minus the per-record Python."""
     from bsseqconsensusreads_tpu.io import wirepack
@@ -728,6 +748,8 @@ def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
         min_reads=params.min_reads,
         mode_self=(mode == "self"),
         duplex=duplex,
+        bcount=bcount,
+        strand_calls=strand_calls,
     )
     stats.families += len(batch.meta)
     stats.skipped_families += skipped
@@ -735,7 +757,17 @@ def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
     return RawRecords(blob, n)
 
 
-def _emit_molecular_batch_raw(batch, out, params, mode, stats) -> RawRecords:
+def _emit_molecular_batch_raw(batch, out, params, mode, stats,
+                              base_counts: bool = False) -> RawRecords:
+    bcount = None
+    if base_counts:
+        bcount = out.get("bcount")  # slim-wire retire computed it already
+        if bcount is None:
+            from bsseqconsensusreads_tpu.models.molecular import (
+                molecular_base_counts,
+            )
+
+            bcount = molecular_base_counts(batch.bases, batch.quals, params)
     return _emit_batch_raw(
         batch, out, params, mode, stats,
         n_reads=(batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
@@ -748,27 +780,41 @@ def _emit_molecular_batch_raw(batch, out, params, mode, stats) -> RawRecords:
             np.uint8,
         ),
         duplex=False,
+        bcount=bcount,
     )
 
 
 def _emit_duplex_batch_raw(batch, out, params, mode, stats) -> RawRecords:
-    """Duplex variant: adds the per-strand tag surface aD/bD/aM/bM/ad/bd;
+    """Duplex variant: adds the per-strand tag surface aD/bD/aM/bM/ad/bd
+    (+ ac/bc strand-call strings when the rawize pass derived them);
     roles are (forward, reverse) by construction."""
+    sc = (out["a_call"], out["b_call"]) if "a_call" in out else None
     return _emit_batch_raw(
         batch, out, params, mode, stats,
         n_reads=np.array([m.n_templates for m in batch.meta], np.int32),
         role_reverse=np.tile(np.array([0, 1], np.uint8), (len(batch.meta), 1)),
         duplex=True,
+        strand_calls=sc,
     )
 
 
-def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
+def _emit_molecular_batch(batch, out, params, mode, stats,
+                          base_counts: bool = False) -> list[BamRecord]:
     """Build consensus records from one molecular kernel output batch.
     Shared by the single-device, family-sharded, and deep-family paths."""
     base = np.asarray(out["base"])
     qual = np.asarray(out["qual"])
     depth = np.asarray(out["depth"])
     errors = np.asarray(out["errors"])
+    bcounts = None
+    if base_counts:
+        bcounts = out.get("bcount")  # slim-wire retire computed it already
+        if bcounts is None:
+            from bsseqconsensusreads_tpu.models.molecular import (
+                molecular_base_counts,
+            )
+
+            bcounts = molecular_base_counts(batch.bases, batch.quals, params)
     emitted: list[BamRecord] = []
     for fi, meta in enumerate(batch.meta):
         stats.families += 1
@@ -796,7 +842,9 @@ def _emit_molecular_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             seq_fwd = codes_to_seq(base[fi, role, sl])
             quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
-                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx
+                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx,
+                bcount=None if bcounts is None else bcounts[fi, role, :, sl],
+                flip=mode != "self" and bool(meta.role_reverse[role]),
             )
             other = 1 - role
             tlen = 0
@@ -840,6 +888,7 @@ def call_molecular_batches(
     emit: str = "python",
     batching: str = "bucketed",
     transport: str = "auto",
+    base_counts: bool = True,
 ) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -878,6 +927,11 @@ def call_molecular_batches(
     (zero collectives, pipeline depth = device count). 'auto' engages the
     single-device wire on accelerator runs, like call_duplex_batches;
     'unpacked' forces plain tensors.
+
+    base_counts: emit the cB per-column raw base histogram tag
+    (models.molecular.molecular_base_counts) — the duplex stage's input
+    for EXACT raw-unit ce/cE (PARITY.md row 6 closure). Host-side integer
+    tallies; disable to shave tag bytes when no duplex stage follows.
     """
     import os
 
@@ -886,10 +940,11 @@ def call_molecular_batches(
     stats = stats if stats is not None else StageStats()
     kernel_choice = _resolve_vote_kernel(vote_kernel)
     consensus_fn = _molecular_kernel(vote_kernel)
-    emit_fn = (
+    emit_fn = partial(
         _emit_molecular_batch_raw
         if _resolve_emit(emit, mode) == "native"
-        else _emit_molecular_batch
+        else _emit_molecular_batch,
+        base_counts=base_counts,
     )
     if deep_threshold is None:
         deep_threshold = encode_mod.MAX_TEMPLATES
@@ -1000,8 +1055,11 @@ def call_molecular_batches(
                     jax.device_get(wire[1]), f=pf, w=w
                 )
                 out = {k: v[:f] for k, v in out.items()}
+                # with_histogram: one cocall+filter pass serves both the
+                # count planes and the emit path's cB tags
                 return recompute_molecular_counts(
-                    out, batch.bases, batch.quals, params
+                    out, batch.bases, batch.quals, params,
+                    with_histogram=base_counts,
                 )
         with stats.metrics.timed("fetch"):
             out = unpack_molecular_outputs(
@@ -1247,6 +1305,7 @@ def call_duplex_batches(
     refstore=None,
     transport: str = "auto",
     pos0: str = "skip",
+    strand_tags: bool = True,
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -1287,6 +1346,12 @@ def call_duplex_batches(
     position 0 — 'skip' (default, documented deviation) or 'shift'
     (exact reference parity incl. the register shift; see
     ops.encode.encode_duplex_families).
+
+    strand_tags: emit the fgbio-style ac/bc per-strand consensus call
+    string tags (host twin of the window transforms — _duplex_rawize),
+    enabling FilterConsensusReads --require-single-strand-agreement on
+    the output. Exact raw-unit ce (via the input's cB histograms)
+    engages automatically regardless of this flag.
     """
     import os
 
@@ -1331,6 +1396,35 @@ def call_duplex_batches(
         refstore.device_codes
     genome_per_dev: dict = {}
 
+    def wire_window_offsets(batch):
+        """(starts, limits) uint32 global offsets for one wire batch —
+        the ONE ref_id -> store-contig mapping shared by the device
+        dispatch and the host-side rawize window fetch (a drifted copy
+        would hand the tag passes a different window than the kernel
+        gathered)."""
+        fb = len(batch.meta)
+        rids = np.fromiter((m.ref_id for m in batch.meta), np.int64, fb)
+        valid = (rids >= 0) & (rids < len(rid_map))
+        # a plain rid_map[rids] would let -1 wrap to the last contig
+        mapped = np.where(valid, rid_map[np.where(valid, rids, 0)], -1)
+        return refstore.window_offsets(
+            mapped,
+            np.fromiter(
+                (m.window_start for m in batch.meta), np.int64, fb
+            ),
+        )
+
+    def host_ref(batch):
+        """Reference windows [F, W+1] for the host-side rawize passes:
+        the encode-fetched plane off the wire, the host genome copy
+        (ops.refstore.host_windows) when the wire skipped the fetch."""
+        if not use_wire:
+            return batch.ref
+        starts, limits = wire_window_offsets(batch)
+        return refstore.host_windows(
+            starts, limits, batch.bases.shape[-1] + 1
+        )
+
     def _wire_device_args(words):
         """(words, genome) placed on this dispatch's device: the default
         device for single-device wire, else the round-robin target (the
@@ -1360,14 +1454,7 @@ def call_duplex_batches(
             from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
 
             w = batch.bases.shape[-1]
-            rids = np.fromiter((m.ref_id for m in batch.meta), np.int64, f)
-            valid = (rids >= 0) & (rids < len(rid_map))
-            # a plain rid_map[rids] would let -1 wrap to the last contig
-            mapped = np.where(valid, rid_map[np.where(valid, rids, 0)], -1)
-            starts, limits = refstore.window_offsets(
-                mapped,
-                np.fromiter((m.window_start for m in batch.meta), np.int64, f),
-            )
+            starts, limits = wire_window_offsets(batch)
             wire = pack_duplex_inputs(
                 batch.bases, batch.quals.astype(np.uint8), batch.cover,
                 batch.convert_mask, batch.extend_eligible, starts, limits,
@@ -1422,7 +1509,11 @@ def call_duplex_batches(
                 out = unpack_duplex_outputs(host, f=pf, w=w)
             out = {k: v[:f] for k, v in out.items()}
         with stats.metrics.timed("rawize"):
-            return _duplex_rawize(out, batch, sidecar)
+            return _duplex_rawize(
+                out, batch, sidecar,
+                ref=host_ref(batch) if (strand_tags or sidecar) else None,
+                strand_tags=strand_tags,
+            )
 
     def emit_out(out, batch, passed):
         with stats.metrics.timed("emit"):
@@ -1566,7 +1657,18 @@ def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
             if pos0 == "shift" and pos == 0 and row in CONVERT_ROWS:
                 pos = 1  # mirror the encoder's register-shift placement
             end = len(cd) - trail
-            rows[row] = (pos, cd[lead:end], ce[lead:end])
+            # cB raw base histogram (4 plane-major runs): the exact-ce
+            # input. Absent/malformed -> None: that row keeps the r4
+            # err-bit split rule.
+            cb = None
+            try:
+                _sub, cbv = rec.get_tag("cB")
+                cbv = np.asarray(cbv, dtype=np.uint16)
+                if cbv.size == 4 * len(cd):
+                    cb = cbv.reshape(4, len(cd))[:, lead:end]
+            except (KeyError, TypeError, ValueError):
+                pass
+            rows[row] = (pos, cd[lead:end], ce[lead:end], cb)
         if rows:
             side.setdefault(mi, []).append(rows)
     return side
@@ -1601,35 +1703,77 @@ def _sidecar_rows_for(meta, sidecar: dict, w: int):
     for cand in sidecar.get(meta.mi, ()):
         if any(
             pos < meta.window_start + w and pos + len(cd) > meta.window_start
-            for pos, cd, _ce in cand.values()
+            for pos, cd, *_rest in cand.values()
         ):
             return cand
     return None
 
 
-def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
-    """Convert the duplex kernel's presence-unit planes to fgbio's raw
-    units wherever the sidecar has the molecular cd/ce arrays.
+def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
+                   strand_tags: bool = True) -> dict:
+    """Raw-unit + strand-call enrichment of one retired duplex batch.
 
-    Per role and strand: ad/bd become raw per-read strand depths, cd
-    their sum; ce becomes the raw disagreement count vs the DUPLEX call:
-    exact when the strand consensus agrees with the duplex call (its
-    molecular ce is that count), and `cd - ce` when it disagrees (the
-    raw reads that voted the strand base disagree with the duplex call;
-    the molecular-dissenting reads are assumed to match it — the one
-    documented approximation, PARITY.md row 6). Families absent from the
-    sidecar keep presence units.
+    Three passes, all host-side (worker thread in overlap mode):
 
-    The per-column work runs in C (io.wirepack.duplex_rawize) when the
-    native library is built — the pure-Python per-family loop was the
-    duplex emit wall at scale — with this module's numpy loop as the
-    fallback and reference implementation."""
-    if not sidecar:
-        return out
+    1. STRAND CALLS (strand_tags): per-strand consensus call planes
+       a_call/b_call [F, 2, W] from the host twin of the convert/extend
+       transforms (ops.hosttwin.strand_call_planes), masked by the
+       kernel's per-strand presence bits — the content of the fgbio-style
+       ac/bc tags and the basis of FilterConsensusReads
+       --require-single-strand-agreement.
+
+    2. RAW DEPTHS: ad/bd become raw per-read strand depths wherever the
+       sidecar carries the molecular cd arrays (native C pass,
+       io.wirepack.duplex_rawize, numpy loop fallback), cd their sum —
+       unchanged from round 4. After this pass a_err/b_err hold raw-unit
+       per-strand error counts (r4 err-bit split rule).
+
+    3. EXACT ERRORS: wherever the sidecar also carries the molecular cB
+       raw base HISTOGRAM, per-strand errors are recomputed exactly as
+       cd - (raw reads whose base, pushed through the strand's own
+       conversion context, equals the DUPLEX call)
+       (_exact_strand_errors) — retiring the r4 approximation
+       documented in PARITY.md row 6. Note the conversion can merge a
+       raw-space dissent into agreement (an unconverted C over a
+       converted-T call is not an error in converted space), so exact
+       counts can differ from the molecular ce even where the strand
+       agrees with the call.
+
+    Families absent from the sidecar keep presence units; rows without
+    cB keep the r4 rule."""
     from bsseqconsensusreads_tpu.io import wirepack
     from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
 
     f, _, w = np.asarray(out["a_depth"]).shape
+    a_pres = np.asarray(out["a_depth"]) > 0
+    b_pres = np.asarray(out["b_depth"]) > 0
+    need_exact = bool(sidecar) and any(
+        entry[3] is not None
+        for occs in sidecar.values()
+        for rows in occs
+        for entry in rows.values()
+    )
+    calls = None
+    if strand_tags and ref is not None:
+        from bsseqconsensusreads_tpu.ops import hosttwin
+
+        calls, _ccov = hosttwin.strand_call_planes(
+            batch.bases, batch.cover, ref, batch.convert_mask,
+            batch.extend_eligible,
+        )
+    out = dict(out)
+    if strand_tags and calls is not None:
+        rows_a = [p[0] for p in ROLE_STRAND_ROWS]
+        rows_b = [p[1] for p in ROLE_STRAND_ROWS]
+        out["a_call"] = np.where(
+            a_pres, calls[:, rows_a, :], np.int8(NBASE)
+        ).astype(np.int8)
+        out["b_call"] = np.where(
+            b_pres, calls[:, rows_b, :], np.int8(NBASE)
+        ).astype(np.int8)
+    if not sidecar:
+        return out
+
     if wirepack.available():
         row_pos = np.full(f * 4, -1, np.int64)
         row_off = np.zeros(f * 4, np.int64)
@@ -1642,7 +1786,7 @@ def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
             rows = _sidecar_rows_for(meta, sidecar, w)
             if not rows:
                 continue
-            for row, (pos, cd, ce) in rows.items():
+            for row, (pos, cd, ce, _cb) in rows.items():
                 k = fi * 4 + row
                 row_pos[k] = pos
                 row_off[k] = cursor
@@ -1656,48 +1800,164 @@ def _duplex_rawize(out: dict, batch, sidecar: dict) -> dict:
         role_rows = np.asarray(
             [r for pair in ROLE_STRAND_ROWS for r in pair], np.int32
         )
-        return wirepack.duplex_rawize(
+        raw = wirepack.duplex_rawize(
             out, row_pos, row_off, row_len, aux, window_start, role_rows
         )
+    else:
+        a_e = np.asarray(out["a_err"])
+        b_e = np.asarray(out["b_err"])
+        ad = a_pres.astype(np.int32)
+        bd = b_pres.astype(np.int32)
+        ae = a_e.astype(np.int32).copy()
+        be = b_e.astype(np.int32).copy()
+        for fi, meta in enumerate(batch.meta):
+            rows = _sidecar_rows_for(meta, sidecar, w)
+            if not rows:
+                continue
+            for role in range(2):
+                a_row, b_row = ROLE_STRAND_ROWS[role]
+                for row, dplane, eplane, errbit in (
+                    (a_row, ad, ae, a_e), (b_row, bd, be, b_e),
+                ):
+                    entry = rows.get(row)
+                    if entry is None:
+                        continue
+                    pres = dplane[fi, role] > 0
+                    raw_d = _place_raw(
+                        entry[:2], pres, meta.window_start, w
+                    )
+                    raw_e = _place_raw(
+                        (entry[0], entry[2]), pres, meta.window_start, w
+                    )
+                    # strand disagrees with the duplex call -> its
+                    # agreeing raw reads are the errors (r4 rule; rows
+                    # with cB are recomputed exactly below)
+                    disagree = errbit[fi, role] > 0
+                    dplane[fi, role] = raw_d
+                    eplane[fi, role] = np.clip(
+                        np.where(disagree, raw_d - raw_e, raw_e), 0, None
+                    )
+        raw = dict(out)
+        raw["a_depth"], raw["b_depth"] = (
+            ad.astype(np.int16), bd.astype(np.int16)
+        )
+        raw["a_err"], raw["b_err"] = ae.astype(np.int16), be.astype(np.int16)
+        raw["depth"] = (ad + bd).astype(np.int16)
+        raw["errors"] = (ae + be).astype(np.int16)
+    if need_exact and ref is not None:
+        raw = _exact_strand_errors(
+            raw, batch, sidecar, ref, (a_pres, b_pres), w
+        )
+    return raw
 
-    a_p = np.asarray(out["a_depth"])
-    b_p = np.asarray(out["b_depth"])
-    a_e = np.asarray(out["a_err"])
-    b_e = np.asarray(out["b_err"])
-    ad = a_p.astype(np.int32).copy()
-    bd = b_p.astype(np.int32).copy()
-    ae = a_e.astype(np.int32).copy()
-    be = b_e.astype(np.int32).copy()
+
+def _exact_strand_errors(out: dict, batch, sidecar: dict, ref,
+                         presence, w: int) -> dict:
+    """Pass 3 of _duplex_rawize: exact per-strand raw error counts.
+
+    For every sidecar row carrying the molecular cB histogram:
+    ae = ad - (raw reads whose conversion-mapped base equals the duplex
+    call), per column, halo-filled/masked with the same rules as the
+    raw depth placement (nearest raw column for the synthetic
+    prepend/extend boundary columns, zero outside presence). Fully
+    vectorized over the batch — per-family Python touches only the
+    ragged index assembly."""
+    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
+    from bsseqconsensusreads_tpu.ops import hosttwin
+
+    f = np.asarray(out["base"]).shape[0]
+    e_fi: list[int] = []
+    e_row: list[int] = []
+    e_off: list[int] = []
+    e_len: list[int] = []
+    cbs: list[np.ndarray] = []
     for fi, meta in enumerate(batch.meta):
         rows = _sidecar_rows_for(meta, sidecar, w)
         if not rows:
             continue
-        for role in range(2):
-            a_row, b_row = ROLE_STRAND_ROWS[role]
-            for row, dplane, eplane, errbit in (
-                (a_row, ad, ae, a_e), (b_row, bd, be, b_e),
-            ):
-                entry = rows.get(row)
-                if entry is None:
-                    continue
-                pres = dplane[fi, role] > 0
-                raw_d = _place_raw(
-                    entry[:2], pres, meta.window_start, w
-                )
-                raw_e = _place_raw(
-                    (entry[0], entry[2]), pres, meta.window_start, w
-                )
-                # strand disagrees with the duplex call -> its agreeing
-                # raw reads are the errors (see docstring)
-                disagree = errbit[fi, role] > 0
-                dplane[fi, role] = raw_d
-                eplane[fi, role] = np.clip(
-                    np.where(disagree, raw_d - raw_e, raw_e), 0, None
-                )
-    out = dict(out)
-    out["a_depth"], out["b_depth"] = ad.astype(np.int16), bd.astype(np.int16)
-    out["depth"] = (ad + bd).astype(np.int16)
-    out["errors"] = (ae + be).astype(np.int16)
+        for row, (pos, _cd, _ce, cb) in rows.items():
+            if cb is None:
+                continue
+            e_fi.append(fi)
+            e_row.append(row)
+            e_off.append(pos - meta.window_start)
+            e_len.append(cb.shape[1])
+            cbs.append(cb)
+    if not e_fi:
+        return out
+    e_fi_a = np.asarray(e_fi)
+    e_row_a = np.asarray(e_row)
+    off = np.asarray(e_off)
+    lens = np.asarray(e_len)
+    cb_all = np.concatenate(cbs, axis=1)  # [4, total]
+    tot = int(lens.sum())
+    ent = np.repeat(np.arange(len(lens)), lens)
+    cum0 = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    j = np.arange(tot) - np.repeat(cum0, lens)
+    col = off[ent] + j
+    inw = (col >= 0) & (col < w)
+    fi_e, row_e, col_e = e_fi_a[ent][inw], e_row_a[ent][inw], col[inw]
+    role_of_row = np.empty(4, np.int64)
+    for role, (ar, br) in enumerate(ROLE_STRAND_ROWS):
+        role_of_row[ar] = role
+        role_of_row[br] = role
+    role_e = role_of_row[row_e]
+    base = np.asarray(out["base"])
+    callv = base[fi_e, role_e, col_e]
+    conv = hosttwin.conv_base_map(
+        batch.bases, batch.cover, ref, batch.convert_mask
+    )
+    cnt = np.zeros(len(col_e), np.int64)
+    for x in range(4):
+        cnt += cb_all[x][inw].astype(np.int64) * (
+            conv[x][fi_e, row_e, col_e] == callv
+        )
+    # scatter counts + per-row window-clipped spans for the clamp halo
+    plane = np.zeros((f, 4, w), np.int64)
+    plane[fi_e, row_e, col_e] = cnt
+    lo_all = np.full((f, 4), w, np.int64)
+    hi_all = np.zeros((f, 4), np.int64)
+    has = np.zeros((f, 4), bool)
+    lo_entry = np.clip(off, 0, w)
+    hi_entry = np.clip(off + lens, 0, w)
+    lo_all[e_fi_a, e_row_a] = lo_entry
+    hi_all[e_fi_a, e_row_a] = hi_entry
+    has[e_fi_a, e_row_a] = hi_entry > lo_entry
+    a_pres, b_pres = presence
+    colw = np.arange(w)[None, :]
+    for role, (a_row, b_row) in enumerate(ROLE_STRAND_ROWS):
+        for srow, dkey, ekey, pres in (
+            (a_row, "a_depth", "a_err", a_pres),
+            (b_row, "b_depth", "b_err", b_pres),
+        ):
+            hb = has[:, srow]
+            if not hb.any():
+                continue
+            # entry-less families keep their init spans (w, 0): substitute
+            # a safe in-bounds span for the gather — their columns are
+            # discarded by the hb gate in `upd` below, but out-of-range
+            # indices would crash take_along_axis regardless
+            lo = np.where(hb, lo_all[:, srow], 0)[:, None]
+            hi = np.where(hb, hi_all[:, srow], 1)[:, None]
+            p = plane[:, srow, :]
+            clamped = np.clip(colw, lo, np.maximum(hi - 1, lo))
+            halo = np.take_along_axis(p, clamped, axis=1)
+            direct = (colw >= lo) & (colw < hi)
+            cntw = np.where(direct, p, halo)
+            prole = pres[:, role, :]
+            cntw = np.where(prole, cntw, 0)
+            ad_plane = np.asarray(out[dkey])[:, role, :].astype(np.int64)
+            callp = base[:, role, :]
+            upd = hb[:, None] & prole & (callp != NBASE)
+            ae_new = np.clip(ad_plane - cntw, 0, None)
+            cur = np.asarray(out[ekey])[:, role, :]
+            out[ekey][:, role, :] = np.where(upd, ae_new, cur).astype(
+                out[ekey].dtype
+            )
+    out["errors"] = (
+        np.asarray(out["a_err"]).astype(np.int32)
+        + np.asarray(out["b_err"]).astype(np.int32)
+    ).astype(np.int16)
     return out
 
 
@@ -1730,23 +1990,40 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             sl = slice(int(cov[0]), int(cov[-1]) + 1)
             seq_fwd = codes_to_seq(base[fi, role, sl])
             quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
+            flip = mode != "self" and bool(role)
             tags = _consensus_tags(
-                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx
+                depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx,
+                flip=flip,
             )
             # fgbio duplex per-strand tag surface (README.md:9 contract;
             # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
             # min depth, ad/bd per-base depth arrays — RAW per-read
             # strand units when the input carried the molecular cd/ce
             # tags (_duplex_rawize), presence units (0/1) otherwise
-            # (PARITY.md row 5).
+            # (PARITY.md row 5). Per-base arrays follow the emitted SEQ
+            # orientation (reversed with it in unaligned mode).
             a_cov = a_depth[fi, role, sl]
             b_cov = b_depth[fi, role, sl]
+            if flip:
+                a_cov, b_cov = a_cov[::-1], b_cov[::-1]
             tags["aD"] = ("i", int(a_cov.max()))
             tags["bD"] = ("i", int(b_cov.max()))
             tags["aM"] = ("i", int(a_cov.min()))
             tags["bM"] = ("i", int(b_cov.min()))
             tags["ad"] = ("B", ("S", a_cov.tolist()))
             tags["bd"] = ("B", ("S", b_cov.tolist()))
+            if "a_call" in out:
+                # per-strand consensus call strings (fgbio's ac/bc surface):
+                # what each strand actually voted in the merge, N where the
+                # strand has no coverage — FilterConsensusReads
+                # --require-single-strand-agreement consumes these.
+                # Reverse-complemented with the SEQ in unaligned mode.
+                ac = codes_to_seq(out["a_call"][fi, role, sl])
+                bc = codes_to_seq(out["b_call"][fi, role, sl])
+                if flip:
+                    ac, bc = _revcomp(ac), _revcomp(bc)
+                tags["ac"] = ("Z", ac)
+                tags["bc"] = ("Z", bc)
             other = 1 - role
             tlen = 0
             if starts[0] >= 0 and starts[1] >= 0:

@@ -27,10 +27,13 @@ Semantics (from the fgbio tool's published docs, not its source):
 
 Deviations (documented per the §7.3 mandate):
 
-* fgbio's ``--require-single-strand-agreement`` needs the per-strand
-  consensus base arrays fgbio stows in its own extension tags; this
-  framework's duplex emitter does not carry them, so requesting it
-  raises.
+* ``--require-single-strand-agreement`` consumes the ac/bc per-strand
+  consensus call strings this framework's duplex emitter writes
+  (pipeline.calling._duplex_rawize, the fgbio tag surface): a base is
+  masked when BOTH strands called and the calls differ. Requesting it
+  on input without ac/bc (foreign duplex BAMs, strand_tags=False
+  output) raises — silently skipping the check would pass disagreeing
+  bases through a filter the user asked for.
 * Per-base arrays are taken in the record's emitted base order (this
   framework's own emitters, pipeline.calling, write them that way).
 * **Duplex depth units are RAW** (fgbio's): the duplex stage threads the
@@ -76,12 +79,6 @@ class FilterParams:
             raise ValueError(
                 f"min_reads triplet must be non-increasing (M >= A >= B), "
                 f"got {self.min_reads}"
-            )
-        if self.require_single_strand_agreement:
-            raise ValueError(
-                "require_single_strand_agreement needs per-strand consensus "
-                "base arrays this framework's duplex emitter does not carry "
-                "(documented deviation, pipeline.filter module docstring)"
             )
 
     @property
@@ -173,6 +170,24 @@ def _evaluate(
         with np.errstate(divide="ignore", invalid="ignore"):
             rate = np.where(cd[:Le] > 0, ce[:Le] / np.maximum(cd[:Le], 1), 1.0)
         mask[:Le] |= rate > params.max_base_error_rate
+    if params.require_single_strand_agreement and duplex:
+        # fgbio -s: mask duplex bases where the two single-strand
+        # consensus calls disagree. The ac/bc strand-call strings are the
+        # duplex emitter's fgbio-style tag surface; a strand that made no
+        # call (N) cannot disagree.
+        if not (rec.has_tag("ac") and rec.has_tag("bc")):
+            raise ValueError(
+                f"{rec.qname}: require_single_strand_agreement needs the "
+                "ac/bc per-strand call tags (this framework's duplex "
+                "output carries them unless strand_tags was disabled)"
+            )
+        ac = np.frombuffer(str(rec.get_tag("ac")).encode("ascii"), np.uint8)
+        bc = np.frombuffer(str(rec.get_tag("bc")).encode("ascii"), np.uint8)
+        Ls = min(n, len(ac), len(bc))
+        nn = ord("N")
+        mask[:Ls] |= (
+            (ac[:Ls] != bc[:Ls]) & (ac[:Ls] != nn) & (bc[:Ls] != nn)
+        )
     if qual.size:
         Lq = min(n, qual.size)
         mask[:Lq] |= qual[:Lq] < params.min_base_quality
@@ -238,6 +253,31 @@ def filter_consensus(
         for rec, (_, _, mask) in zip(template, verdicts):
             stats.kept_records += 1
             yield _apply_mask(rec, mask, stats)
+
+
+def probe_strand_tag_support(path: str, params: FilterParams,
+                             n_probe: int = 50) -> None:
+    """Fail BEFORE any output is written when -s is requested on input
+    that cannot support it: peek the lead records — a duplex record
+    (ad/bd present) without ac/bc means the whole file will raise
+    mid-stream, after kept records were already written."""
+    if not params.require_single_strand_agreement:
+        return
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+
+    with BamReader(path) as reader:
+        for i, rec in enumerate(reader):
+            if rec.has_tag("ad") and rec.has_tag("bd"):
+                if not (rec.has_tag("ac") and rec.has_tag("bc")):
+                    raise ValueError(
+                        f"{path}: require_single_strand_agreement needs "
+                        "the ac/bc per-strand call tags on duplex input "
+                        "(this framework's duplex output carries them "
+                        "unless strand_tags was disabled)"
+                    )
+                return
+            if i >= n_probe - 1:
+                return
 
 
 def filtered_header(header: BamHeader) -> BamHeader:

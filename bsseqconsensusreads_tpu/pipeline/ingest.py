@@ -148,8 +148,12 @@ class ColumnarRecordView:
             return None
         return bytes(raw)
 
-    # --- tags (MI/RX + the cd/ce consensus arrays the duplex raw-depth
+    # --- tags (MI/RX + the cd/ce/cB consensus arrays the duplex raw-depth
     # sidecar reads; everything else is absent from the columnar digest) ----
+
+    #: aux_len flag bit: aux span carries the 4n cB histogram after cd/ce
+    #: (native/bamio.cpp kAuxHasCb).
+    _AUX_HAS_CB = 1 << 30
 
     def _tag(self, name: str) -> str | None:
         if name == "MI":
@@ -162,29 +166,40 @@ class ColumnarRecordView:
         return s if s else None
 
     def _aux_arrays(self):
-        """(cd, ce) u16 views from the C parser's aux plane, or None."""
+        """(cd, ce, cB|None) u16 views from the C parser's aux plane, or
+        None when the record carried no usable cd/ce tags."""
         b = self._b
         aux = getattr(b, "aux", None)
         if aux is None:
             return None
-        n = int(b.aux_len[self._i])
+        raw_len = int(b.aux_len[self._i])
+        n = raw_len & ~self._AUX_HAS_CB
         if n == 0:
             return None
         off = int(b.aux_off[self._i])
-        return aux[off : off + n], aux[off + n : off + 2 * n]
+        cb = (
+            aux[off + 2 * n : off + 6 * n]
+            if raw_len & self._AUX_HAS_CB
+            else None
+        )
+        return aux[off : off + n], aux[off + n : off + 2 * n], cb
 
     def has_tag(self, name: str) -> bool:
         if name in ("cd", "ce"):
             return self._aux_arrays() is not None
+        if name == "cB":
+            trip = self._aux_arrays()
+            return trip is not None and trip[2] is not None
         return self._tag(name) is not None
 
     def get_tag(self, name: str):
-        if name in ("cd", "ce"):
-            pair = self._aux_arrays()
-            if pair is None:
+        if name in ("cd", "ce", "cB"):
+            trip = self._aux_arrays()
+            idx = {"cd": 0, "ce": 1, "cB": 2}[name]
+            if trip is None or trip[idx] is None:
                 raise KeyError(name)
             # BamRecord 'B' tag surface: (subtype, values)
-            return ("S", pair[0] if name == "cd" else pair[1])
+            return ("S", trip[idx])
         v = self._tag(name)
         if v is None:
             raise KeyError(name)

@@ -19,6 +19,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     BamHeader,
     BamRecord,
     FREAD2,
+    FREVERSE,
     FUNMAP,
 )
 from bsseqconsensusreads_tpu.pipeline.extsort import (
@@ -30,9 +31,37 @@ from bsseqconsensusreads_tpu.pipeline.extsort import (
 #: record (fgbio semantics: attributes of the source molecule, not the
 #: alignment).
 GRAFT_TAGS = (
-    "MI", "RX", "cD", "cM", "cE", "cd", "ce",
-    "aD", "bD", "aM", "bM", "ad", "bd",
+    "MI", "RX", "cD", "cM", "cE", "cd", "ce", "cB",
+    "aD", "bD", "aM", "bM", "ad", "bd", "ac", "bc",
 )
+
+#: Per-base tags that track record base order: when the aligner mapped the
+#: read to the reverse strand (SEQ re-reverse-complemented), the grafted
+#: arrays must flip with it — fgbio ZipperBams' tags-to-reverse/revcomp
+#: semantics for its consensus tag families.
+_REVERSE_ARRAY_TAGS = frozenset(("cd", "ce", "ad", "bd"))
+_REVCOMP_STRING_TAGS = frozenset(("ac", "bc"))
+
+
+def _flip_tag(tag: str, val):
+    """Reorient one per-base tag value for a reverse-strand graft target."""
+    if tag in _REVERSE_ARRAY_TAGS:
+        sub, vals = val[1]
+        return (val[0], (sub, list(vals)[::-1]))
+    if tag == "cB":
+        # 4 plane-major runs: complement the plane order (A<->T, C<->G)
+        # and reverse columns — a window A count is a T count on the
+        # emitted strand (pipeline.calling._consensus_tags)
+        sub, vals = val[1]
+        vals = list(vals)
+        n = len(vals) // 4
+        planes = [vals[p * n : (p + 1) * n][::-1] for p in (3, 2, 1, 0)]
+        return (val[0], (sub, [v for plane in planes for v in plane]))
+    if tag in _REVCOMP_STRING_TAGS:
+        from bsseqconsensusreads_tpu.io.fastq import reverse_complement
+
+        return (val[0], reverse_complement(val[1]))
+    return val
 
 
 def filter_mapped(records: Iterable[BamRecord]) -> Iterator[BamRecord]:
@@ -98,9 +127,14 @@ def template_coordinate_sort(records: Iterable[BamRecord]) -> list[BamRecord]:
 
 
 def _graft(rec: BamRecord, src: BamRecord, tags: tuple[str, ...]) -> None:
+    # the unaligned source stores SEQ in sequencing orientation; a
+    # reverse-strand alignment stores revcomp(SEQ), so per-base tags
+    # reorient with it (see _flip_tag)
+    flip = bool(rec.flag & FREVERSE) and not bool(src.flag & FREVERSE)
     for tag in tags:
         if src.has_tag(tag) and not rec.has_tag(tag):
-            rec.tags[tag] = src.tags[tag]
+            val = src.tags[tag]
+            rec.tags[tag] = _flip_tag(tag, val) if flip else val
 
 
 def zipper_bams_stream(

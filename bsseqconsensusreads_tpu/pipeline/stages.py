@@ -199,26 +199,42 @@ class PipelineBuilder:
         )
 
     def _write_stage_output(self, batches, out_path: str, header, mode: str,
-                            ck: BatchCheckpoint | None) -> None:
+                            ck: BatchCheckpoint | None,
+                            stats: StageStats | None = None) -> None:
         """Write a consensus batch stream: straight through, or via durable
         per-batch shards when intra-stage checkpointing is on (the batch
         stream is already offset by ck.batches_done). The 'self' mode's
         coordinate sort is external-merge, never whole-file in RAM. Batch
         items may be BamRecord objects or io.bam.RawRecords blocks (native
-        batch emit); the 'self' coordinate sort runs on encoded blobs."""
+        batch emit); the 'self' coordinate sort runs on encoded blobs.
+
+        When `stats` is given, the writer-side share that falls OUTSIDE
+        the stage's stream-active wall (the external sort's final merge,
+        header/finalize) lands in metrics 'sort_write' — so the rule's
+        wall decomposes into attributed phases (round-4 VERDICT item 7:
+        the at-scale artifact's ~30% unattributed time was exactly this)."""
+        import time as _time
+
+        w0 = stats.wall_seconds if stats is not None else 0.0
+        t0 = _time.monotonic()
         if ck is not None:
             ck.write_batches(batches)
             ck.finalize(
                 self._sorted_raw(ck.iter_raw_records(), header)
                 if mode == "self" else None  # None = raw shard concatenation
             )
-            return
-        write_batch_stream(
-            batches, out_path, header, mode,
-            workdir=self.cfg.tmp or None,
-            buffer_records=self.cfg.sort_buffer_records,
-            level=self._out_level(out_path),
-        )
+        else:
+            write_batch_stream(
+                batches, out_path, header, mode,
+                workdir=self.cfg.tmp or None,
+                buffer_records=self.cfg.sort_buffer_records,
+                level=self._out_level(out_path),
+            )
+        if stats is not None:
+            stream_active = stats.wall_seconds - w0
+            stats.metrics.add_seconds(
+                "sort_write", max(_time.monotonic() - t0 - stream_active, 0.0)
+            )
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
         """Arm intra-stage checkpointing for one stage target, fingerprinted
@@ -315,9 +331,11 @@ class PipelineBuilder:
         from bsseqconsensusreads_tpu.pipeline.filter import (
             FilterStats,
             filter_consensus,
+            probe_strand_tag_support,
         )
 
         params = self._filter_params()
+        probe_strand_tag_support(rule.inputs[0], params)
         stats = self.stats.setdefault("filter", FilterStats())
         out_path = rule.outputs[0]
         with BamReader(rule.inputs[0]) as reader:
@@ -345,6 +363,7 @@ class PipelineBuilder:
         from bsseqconsensusreads_tpu.pipeline.filter import (
             FilterStats,
             filter_consensus,
+            probe_strand_tag_support,
         )
         from bsseqconsensusreads_tpu.pipeline.record_ops import (
             coordinate_key,
@@ -352,6 +371,7 @@ class PipelineBuilder:
         )
 
         params = self._filter_params()
+        probe_strand_tag_support(rule.inputs[0], params)
         stats = self.stats.setdefault("filter", FilterStats())
         with BamReader(rule.inputs[0]) as reader:
             header = self._pg(
@@ -393,8 +413,9 @@ class PipelineBuilder:
                 emit=self.cfg.emit,
                 transport=self.cfg.transport,
                 batching=self.cfg.batching,
+                base_counts=self.cfg.base_count_tags,
             )
-            self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
+            self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
 
     def run_duplex(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("duplex", StageStats())
@@ -428,8 +449,9 @@ class PipelineBuilder:
                 refstore=self.cfg.genome_fasta,
                 transport=self.cfg.transport,
                 pos0=self.cfg.pos0,
+                strand_tags=self.cfg.duplex_strand_tags,
             )
-            self._write_stage_output(batches, rule.outputs[0], header, mode, ck)
+            self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
 
     def run_sam_to_fastq(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:

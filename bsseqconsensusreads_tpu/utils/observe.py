@@ -81,6 +81,13 @@ class Metrics:
             with self._lock:
                 self.seconds[name] = self.seconds.get(name, 0.0) + dt
 
+    def add_seconds(self, name: str, dt: float) -> None:
+        """Accumulate an externally measured duration (e.g. the stage
+        writers' post-stream merge share, computed as rule wall minus
+        stream-active wall — pipeline.stages)."""
+        with self._lock:
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+
     def rate(self, counter: str, timer: str) -> float:
         dt = self.seconds.get(timer, 0.0)
         return self.counters.get(counter, 0) / dt if dt else 0.0

@@ -574,17 +574,23 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
   }
 }
 
-// Locate the cd/ce consensus per-base B-array tags in one tag-region walk
-// (the duplex stage threads these raw molecular depths/errors through to
-// fgbio-unit ad/bd output, pipeline.calling._duplex_sidecar). Any integer
-// subtype is accepted; values are widened/clamped to u16 at copy time.
+// Locate the cd/ce/cB consensus per-base B-array tags in one tag-region
+// walk (the duplex stage threads these raw molecular depths/errors/base
+// histograms through to fgbio-unit ad/bd + exact-ce output,
+// pipeline.calling._duplex_sidecar). Any integer subtype is accepted;
+// values are widened/clamped to u16 at copy time.
 struct BTagRef {
   const uint8_t* data = nullptr;
   uint32_t cnt = 0;
   char sub = 0;
 };
 
-void find_cdce_tags(const uint8_t* tags, size_t n, BTagRef& cd, BTagRef& ce) {
+// aux_len flag bit: the record's aux span carries the cB histogram
+// (4n extra u16 after cd/ce). Mirrored in pipeline/ingest.py.
+constexpr int32_t kAuxHasCb = 1 << 30;
+
+void find_cdce_tags(const uint8_t* tags, size_t n, BTagRef& cd, BTagRef& ce,
+                    BTagRef& cb) {
   size_t off = 0;
   while (off + 3 <= n) {
     char t0 = char(tags[off]), t1 = char(tags[off + 1]);
@@ -609,6 +615,7 @@ void find_cdce_tags(const uint8_t* tags, size_t n, BTagRef& cd, BTagRef& ce) {
         if (t0 == 'c' && sub != 'f') {
           if (t1 == 'd') cd = BTagRef{tags + off + 5, cnt, sub};
           else if (t1 == 'e') ce = BTagRef{tags + off + 5, cnt, sub};
+          else if (t1 == 'B') cb = BTagRef{tags + off + 5, cnt, sub};
         }
         off += 5 + size_t(cnt) * esz;
         continue;
@@ -772,8 +779,8 @@ void emit_record_body(const uint8_t* p, size_t bs, ColumnarOut& o) {
   if (o.aux != nullptr) {
     o.aux_off[nrec] = o.aux_used;
     o.aux_len[nrec] = 0;
-    BTagRef cd, ce;
-    find_cdce_tags(p + off, bs - off, cd, ce);
+    BTagRef cd, ce, cb;
+    find_cdce_tags(p + off, bs - off, cd, ce, cb);
     if (cd.data && ce.data && cd.cnt == ce.cnt && cd.cnt &&
         int64_t(cd.cnt) <= int64_t(lseq) &&
         o.aux_used + 2 * int64_t(cd.cnt) <= o.aux_cap) {
@@ -783,6 +790,16 @@ void emit_record_body(const uint8_t* p, size_t bs, ColumnarOut& o) {
       for (uint32_t i = 0; i < ce.cnt; i++) dst[i] = btag_u16(ce, i);
       o.aux_len[nrec] = int32_t(cd.cnt);
       o.aux_used += 2 * int64_t(cd.cnt);
+      // cB histogram plane (4n values) appended when present + well
+      // formed; flagged via kAuxHasCb in aux_len (the layout stays
+      // [cd(n); ce(n)] for rows without it)
+      if (cb.data && cb.cnt == 4 * cd.cnt &&
+          o.aux_used + 4 * int64_t(cd.cnt) <= o.aux_cap) {
+        dst += ce.cnt;
+        for (uint32_t i = 0; i < cb.cnt; i++) dst[i] = btag_u16(cb, i);
+        o.aux_len[nrec] |= kAuxHasCb;
+        o.aux_used += 4 * int64_t(cd.cnt);
+      }
     }
   }
   o.nrec++;
@@ -1076,9 +1093,11 @@ void bamio_close(Reader* r) {
 // and returned by the next call. (The numeric suffix versions the
 // signature: loading a stale .so fails symbol lookup and triggers a
 // rebuild instead of corrupting memory through a mismatched call. "3"
-// adds the cd/ce aux planes: aux u16 [aux_cap = 2*var_cap], per-record
-// aux_off/aux_len — see ColumnarOut.)
-int64_t bamio_parse_records3(
+// added the cd/ce aux planes with per-record aux_off/aux_len; "4" appends
+// the optional 4n cB histogram run, flagged via kAuxHasCb in aux_len —
+// size aux_cap at 6*var_cap u16 elements so a var-capacity fit implies an
+// aux fit even when every record carries cB. See ColumnarOut.)
+int64_t bamio_parse_records4(
     Reader* r, int64_t max_records,
     int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
     int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,
@@ -1220,7 +1239,7 @@ int64_t bamio_group_refragmented(Grouper* g) { return g->refragmented; }
 
 void bamio_group_free(Grouper* g) { delete g; }
 
-// Grouped columnar parse: the bamio_parse_records3 output surface with
+// Grouped columnar parse: the bamio_parse_records4 output surface with
 // records reordered into CONTIGUOUS whole-family runs (coordinate-sorted
 // input; flush-margin semantics of pipeline.calling.stream_mi_groups
 // 'coordinate', including insertion-order flushing and refragmentation
@@ -1229,7 +1248,7 @@ void bamio_group_free(Grouper* g) { delete g; }
 // (0 = stream complete), -1 stream error (bamio_error), -2 record without
 // an MI tag (bamio_group_error -> offending qname), -3 the next family
 // alone exceeds a capacity (retry with larger buffers).
-int64_t bamio_parse_grouped2(
+int64_t bamio_parse_grouped3(
     Reader* r, Grouper* g, int64_t max_records,
     int32_t* ref_id, int32_t* pos, uint16_t* flag, uint8_t* mapq,
     int32_t* l_seq, int32_t* next_ref, int32_t* next_pos, int32_t* tlen,

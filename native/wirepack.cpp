@@ -70,15 +70,22 @@ inline void put_int_tag(Cursor& c, const char* key, int32_t v) {
   c.put_i32(v);
 }
 
-// B:S (uint16) array tag from int16/int8 sources
+// B:S (uint16) array tag from int16/int8 sources; `flip` writes the
+// values reversed (per-base tags follow the emitted SEQ orientation —
+// reverse-complemented records in unaligned mode store reversed arrays,
+// mirroring pipeline.calling._consensus_tags)
 template <typename T>
 inline void put_arr_tag(Cursor& c, const char* key, const T* vals,
-                        int64_t n) {
+                        int64_t n, bool flip = false) {
   c.put_bytes(key, 2);
   c.put_u8('B');
   c.put_u8('S');
   c.put_u32(uint32_t(n));
-  for (int64_t i = 0; i < n; ++i) c.put_u16(uint16_t(vals[i]));
+  if (flip) {
+    for (int64_t i = n - 1; i >= 0; --i) c.put_u16(uint16_t(vals[i]));
+  } else {
+    for (int64_t i = 0; i < n; ++i) c.put_u16(uint16_t(vals[i]));
+  }
 }
 
 // Error codes mirrored by the Python wrapper (io/wirepack.py).
@@ -237,7 +244,10 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 // Per-column planes, C-contiguous [f, 2, w]:
 //   base int8 (framework codes), qual uint8, depth int16, errors int16,
 //   a_depth/b_depth int16 or NULL (duplex per-strand tags when present —
-//   int16 because raw strand depths from _duplex_rawize exceed int8).
+//   int16 because raw strand depths from _duplex_rawize exceed int8),
+//   bcount uint16 [f, 2, 4, w] or NULL (molecular cB raw base histogram,
+//   4 plane-major runs per record), a_call/b_call int8 [f, 2, w] or NULL
+//   (duplex per-strand consensus call codes -> ac/bc Z tags).
 // Per-family meta:
 //   ref_id int32, window_start int64, n_reads int32 (min_reads filter
 //   operand), role_reverse uint8 [f, 2],
@@ -249,9 +259,12 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 // raises for the same input — silent truncation would corrupt the record
 // stream). n_records/n_skipped report emitted records and
 // min_reads-skipped families for StageStats.
-int wirepack_emit_consensus_records(
+// (Symbol versioned _v2: the cB/ac/bc tag surface — a stale built library
+// must fail symbol lookup and rebuild, not silently emit the old tags.)
+int wirepack_emit_consensus_records_v2(
     const int8_t* base, const uint8_t* qual, const int16_t* depth,
     const int16_t* errors, const int16_t* a_depth, const int16_t* b_depth,
+    const uint16_t* bcount, const int8_t* a_call, const int8_t* b_call,
     int64_t f, int64_t w, const int32_t* ref_id, const int64_t* window_start,
     const int32_t* n_reads, const uint8_t* role_reverse,
     const uint8_t* mi_blob, const int32_t* mi_off, const int32_t* mi_len,
@@ -400,8 +413,29 @@ int wirepack_emit_consensus_records(
       c.put_bytes("cE", 2);
       c.put_u8('f');
       c.put_f32(dtot ? float(double(etot) / double(dtot)) : 0.0f);
-      put_arr_tag(c, "cd", drow, n);
-      put_arr_tag(c, "ce", erow, n);
+      put_arr_tag(c, "cd", drow, n, flip);
+      put_arr_tag(c, "ce", erow, n, flip);
+      if (bcount != nullptr) {
+        // cB: 4 plane-major runs (A,C,G,T) of per-column raw counts —
+        // one B:S tag of 4n entries (pipeline.calling._consensus_tags).
+        // Flipped records complement the plane order (3-p) and reverse
+        // columns: a window-space A count is a T count on the emitted
+        // strand.
+        c.put_bytes("cB", 2);
+        c.put_u8('B');
+        c.put_u8('S');
+        c.put_u32(uint32_t(4 * n));
+        for (int plane = 0; plane < 4; ++plane) {
+          const int src_plane = flip ? 3 - plane : plane;
+          const uint16_t* src =
+              bcount + ((fi * 2 + role) * 4 + src_plane) * w + lo0;
+          if (flip) {
+            for (int64_t i = n - 1; i >= 0; --i) c.put_u16(src[i]);
+          } else {
+            for (int64_t i = 0; i < n; ++i) c.put_u16(src[i]);
+          }
+        }
+      }
       if (rx_len[fi] > 0) {
         c.put_bytes("RX", 2);
         c.put_u8('Z');
@@ -424,8 +458,27 @@ int wirepack_emit_consensus_records(
         put_int_tag(c, "bD", bmax);
         put_int_tag(c, "aM", amin);
         put_int_tag(c, "bM", bmin);
-        put_arr_tag(c, "ad", arow, n);
-        put_arr_tag(c, "bd", brow, n);
+        put_arr_tag(c, "ad", arow, n, flip);
+        put_arr_tag(c, "bd", brow, n, flip);
+        if (a_call != nullptr && b_call != nullptr) {
+          // ac/bc: per-strand consensus call strings (fgbio surface);
+          // codes -> ACGTN, mirroring ops.encode.codes_to_seq —
+          // reverse-complemented with the SEQ on flipped records
+          static const char kBaseChar[6] = "ACGTN";
+          for (int sc = 0; sc < 2; ++sc) {
+            const int8_t* src = (sc ? b_call : a_call) + row + lo0;
+            c.put_bytes(sc ? "bc" : "ac", 2);
+            c.put_u8('Z');
+            for (int64_t i = 0; i < n; ++i) {
+              const int64_t si = flip ? n - 1 - i : i;
+              uint8_t code = uint8_t(src[si]);
+              if (code > 4) code = 4;
+              if (flip) code = kComp[code];
+              c.put_u8(uint8_t(kBaseChar[code]));
+            }
+            c.put_u8(0);
+          }
+        }
       }
       if (c.overflow) break;
       const int32_t block_size = int32_t(c.p - block_size_at - 4);

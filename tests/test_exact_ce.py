@@ -1,0 +1,468 @@
+"""Exact raw-unit duplex error accounting (round-5: PARITY rows 6/12 closure).
+
+Covers the full chain: molecular cB histogram tag invariants -> duplex
+exact ce via the conversion-mapped histogram -> ac/bc strand-call tags ->
+FilterConsensusReads --require-single-strand-agreement. The load-bearing
+case is a strand whose dissenting raw read voted a THIRD base (neither
+the strand call nor the duplex call): the r4 approximation (ce = cd -
+ce_strand on disagreement) undercounts it; the exact path must not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecord,
+    BamWriter,
+    CMATCH,
+    write_items,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.pipeline.filter import (
+    FilterParams,
+    filter_consensus,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+def _run_molecular(records, tag):
+    out = []
+    for batch in call_molecular_batches(
+        iter(list(records)), params=ConsensusParams(min_reads=1),
+        mode="self", batch_families=6, grouping="coordinate",
+        stats=StageStats(), mesh=None,
+    ):
+        out.extend(batch)
+    return out
+
+
+class TestMolecularBaseCounts:
+    @pytest.fixture(scope="class")
+    def consensus(self):
+        rng = np.random.default_rng(41)
+        name, genome = random_genome(rng, 9000)
+        _header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=14, reads_per_strand=(1, 4),
+            error_rate=0.05,
+        )
+        return _run_molecular(records, "mol")
+
+    def test_cb_tag_shape_and_sum(self, consensus):
+        assert consensus, "no consensus records emitted"
+        for rec in consensus:
+            _s, cd = rec.get_tag("cd")
+            _s, cb = rec.get_tag("cB")
+            cd = np.asarray(cd, np.int64)
+            cb = np.asarray(cb, np.int64).reshape(4, len(cd))
+            np.testing.assert_array_equal(cb.sum(axis=0), cd)
+
+    def test_cb_call_count_reproduces_ce(self, consensus):
+        for rec in consensus:
+            _s, cd = rec.get_tag("cd")
+            _s, ce = rec.get_tag("ce")
+            _s, cb = rec.get_tag("cB")
+            cd = np.asarray(cd, np.int64)
+            ce = np.asarray(ce, np.int64)
+            cb = np.asarray(cb, np.int64).reshape(4, len(cd))
+            for i, ch in enumerate(rec.seq):
+                if ch == "N":
+                    continue
+                x = "ACGT".index(ch)
+                assert cd[i] - cb[x, i] == ce[i], (rec.qname, i)
+
+
+def _duplex_family(tmp_path, with_cb=True, third_base=True):
+    """One hand-built duplex group: strand A (3 raw reads: 2xG + 1
+    dissenter) vs strand B (2 raw reads, both T, higher qual) over an
+    all-A reference window (conversion = identity there). The duplex
+    merge calls T; strand A's dissenter voted C (third base) when
+    third_base, else T."""
+    L = 20
+    pos = 50
+    k = 9  # assert column
+    genome = "A" * 400
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chrT", 400)])
+    a_seq = "G" * L
+    b_seq = "T" * L
+    recs = []
+    for flag, mi, seq, qual, cd, ce, cb in (
+        (99, "7/A", a_seq, 30, 3, 1, {"A": 0, "C": 1, "G": 2, "T": 0}),
+        (163, "7/B", b_seq, 35, 2, 0, {"A": 0, "C": 0, "G": 0, "T": 2}),
+        (83, "7/B", b_seq, 35, 2, 0, {"A": 0, "C": 0, "G": 0, "T": 2}),
+        (147, "7/A", a_seq, 30, 3, 1, {"A": 0, "C": 1, "G": 2, "T": 0}),
+    ):
+        if third_base and cb["C"]:
+            pass  # dissenter already votes C
+        elif cb["C"]:
+            cb = {"A": 0, "C": 0, "G": 2, "T": 1}
+        rec = BamRecord(
+            qname=f"m{flag}", flag=flag, ref_id=0, pos=pos, mapq=60,
+            cigar=[(CMATCH, L)], next_ref_id=0, next_pos=pos, tlen=L,
+            seq=seq, qual=bytes([qual] * L),
+        )
+        rec.set_tag("MI", mi, "Z")
+        rec.set_tag("RX", "AAAA-TTTT", "Z")
+        rec.tags["cd"] = ("B", ("S", [cd] * L))
+        rec.tags["ce"] = ("B", ("S", [ce] * L))
+        if with_cb:
+            flat = []
+            for base in "ACGT":
+                flat += [cb[base]] * L
+            rec.tags["cB"] = ("B", ("S", flat))
+        recs.append(rec)
+    recs.sort(key=lambda r: (r.ref_id, r.pos))
+    return genome, header, recs, k
+
+
+def _run_duplex(genome, records, strand_tags=True, emit="python"):
+    out = []
+    for batch in call_duplex_batches(
+        iter(list(records)), lambda n, s, e: genome[s:e], ["chrT"],
+        mode="self", batch_families=4, grouping="coordinate",
+        stats=StageStats(), mesh=None, strand_tags=strand_tags, emit=emit,
+    ):
+        out.extend(batch)
+    return out
+
+
+class TestExactDuplexCe:
+    def test_third_base_dissenter_counted_exactly(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path, third_base=True)
+        out = _run_duplex(genome, recs)
+        r1 = [r for r in out if r.flag & 0x40]  # duplex R1 (merged 99+163)
+        assert len(r1) == 1
+        rec = r1[0]
+        assert rec.seq[k] == "T"  # duplex call = strand B base
+        _s, ce = rec.get_tag("ce")
+        _s, cd = rec.get_tag("cd")
+        # strand A: all 3 raw reads (2xG + 1xC) disagree with T -> 3;
+        # strand B: both T reads agree -> 0. The r4 approximation said 2.
+        assert int(cd[k]) == 5
+        assert int(ce[k]) == 3
+
+    def test_without_third_base_matches_r4_rule(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path, third_base=False)
+        out = _run_duplex(genome, recs)
+        rec = [r for r in out if r.flag & 0x40][0]
+        # dissenter voted T == duplex call: 2 errors either way
+        _s, ce = rec.get_tag("ce")
+        assert int(ce[k]) == 2
+
+    def test_without_cb_keeps_r4_approximation(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path, with_cb=False)
+        out = _run_duplex(genome, recs)
+        rec = [r for r in out if r.flag & 0x40][0]
+        _s, ce = rec.get_tag("ce")
+        assert int(ce[k]) == 2  # cd_A - ce_A = 3 - 1 (documented fallback)
+
+    def test_strand_call_tags(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs)
+        rec = [r for r in out if r.flag & 0x40][0]
+        ac = str(rec.get_tag("ac"))
+        bc = str(rec.get_tag("bc"))
+        assert len(ac) == len(rec.seq) == len(bc)
+        assert ac[k] == "G" and bc[k] == "T"
+
+    def test_strand_tags_off(self, tmp_path):
+        genome, _header, recs, _k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs, strand_tags=False)
+        rec = [r for r in out if r.flag & 0x40][0]
+        assert not rec.has_tag("ac") and not rec.has_tag("bc")
+
+    def test_native_emit_matches_python(self, tmp_path):
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if not wirepack.available():
+            pytest.skip(f"native wirepack: {wirepack.load_error()}")
+        genome, header, recs, _k = _duplex_family(tmp_path)
+        blobs = {}
+        for emit in ("python", "native"):
+            out = str(tmp_path / f"d_{emit}.bam")
+            with BamWriter(out, header, engine="python") as w:
+                write_items(w, _run_duplex(genome, recs, emit=emit))
+            blobs[emit] = open(out, "rb").read()
+        assert blobs["python"] == blobs["native"]
+
+    def test_native_ingest_carries_cb(self, tmp_path):
+        """The C columnar parser must deliver cB to the sidecar: duplex
+        output over GroupedColumnarStream == over Python records,
+        including the exact-ce column the histogram changes."""
+        from bsseqconsensusreads_tpu.pipeline import ingest
+
+        if not ingest.available():
+            pytest.skip("native ingest unavailable")
+        genome, header, recs, k = _duplex_family(tmp_path)
+        src = str(tmp_path / "mol_in.bam")
+        with BamWriter(src, header, engine="python") as w:
+            w.write_all(recs)
+        stream = ingest.GroupedColumnarStream(
+            src, strip_suffix=True, scan_policy="duplex",
+            grouping="coordinate",
+        )
+        out_native = []
+        from bsseqconsensusreads_tpu.pipeline.calling import StageStats
+
+        for batch in call_duplex_batches(
+            stream, lambda n, s, e: genome[s:e], ["chrT"],
+            mode="self", batch_families=4, grouping="coordinate",
+            stats=StageStats(), mesh=None,
+        ):
+            out_native.extend(batch)
+        rec = [r for r in out_native if r.flag & 0x40][0]
+        _s, ce = rec.get_tag("ce")
+        assert int(ce[k]) == 3  # exact value, not the r4 approximation
+
+
+class TestMixedBatches:
+    def test_mixed_cb_batch_no_crash(self, tmp_path):
+        """One batch mixing a cB family, a cd-only family, and a family
+        with no consensus tags at all must not crash the exact pass
+        (review finding: entry-less families' init spans indexed out of
+        bounds) and must keep per-family semantics."""
+        genome, _header, recs, k = _duplex_family(tmp_path, with_cb=True)
+        # family 2: cd/ce but no cB (r4 fallback); family 3: no tags
+        g2, _h2, recs2, _k2 = _duplex_family(tmp_path, with_cb=False)
+        recs3 = []
+        for r in recs2:
+            r2 = r.copy()
+            r2.tags = dict(r.tags)
+            mi = str(r2.get_tag("MI"))
+            r2.tags["MI"] = ("Z", "8" + mi[1:])
+            r2.pos += 40
+            recs3.append(r2)
+        recs4 = []
+        for r in recs2:
+            r4 = r.copy()
+            r4.tags = {
+                "MI": ("Z", "9" + str(r4.get_tag("MI"))[1:]),
+                "RX": r4.tags["RX"],
+            }
+            r4.pos += 80
+            recs4.append(r4)
+        allrecs = sorted(
+            recs + recs3 + recs4, key=lambda r: (r.ref_id, r.pos)
+        )
+        out = _run_duplex(genome, allrecs)
+        by_mi = {}
+        for rec in out:
+            if rec.flag & 0x40:
+                by_mi[str(rec.get_tag("MI"))] = rec
+        assert set(by_mi) == {"7", "8", "9"}
+        _s, ce7 = by_mi["7"].get_tag("ce")
+        _s, ce8 = by_mi["8"].get_tag("ce")
+        assert int(ce7[k]) == 3  # exact (cB)
+        assert int(ce8[k]) == 2  # r4 rule (no cB)
+        _s, cd9 = by_mi["9"].get_tag("cd")
+        assert int(cd9[k]) == 2  # presence units (no tags at all)
+
+
+class TestUnalignedOrientation:
+    """Per-base tags follow the emitted SEQ orientation (review finding:
+    reverse-role unaligned records stored window-order arrays against a
+    revcomped SEQ)."""
+
+    def test_molecular_unaligned_reverse_tags_flip(self):
+        rng = np.random.default_rng(51)
+        name, genome = random_genome(rng, 8000)
+        _header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=4, reads_per_strand=(2, 2),
+            error_rate=0.05,
+        )
+        outs = {}
+        for mode in ("self", "unaligned"):
+            outs[mode] = {}
+            for batch in call_molecular_batches(
+                iter(list(records)), params=ConsensusParams(min_reads=1),
+                mode=mode, batch_families=4, grouping="coordinate",
+                stats=StageStats(), mesh=None,
+            ):
+                for rec in batch:
+                    key = (str(rec.get_tag("MI")), bool(rec.flag & 0x80))
+                    outs[mode][key] = rec
+        flipped = 0
+        for key, srec in outs["self"].items():
+            urec = outs["unaligned"][key]
+            _s, scd = srec.get_tag("cd")
+            _s, ucd = urec.get_tag("cd")
+            _s, scb = srec.get_tag("cB")
+            _s, ucb = urec.get_tag("cB")
+            n = len(scd)
+            if urec.seq == srec.seq:  # forward-emitted role
+                assert list(ucd) == list(scd)
+                assert list(ucb) == list(scb)
+                continue
+            flipped += 1
+            from bsseqconsensusreads_tpu.io.fastq import reverse_complement
+
+            assert urec.seq == reverse_complement(srec.seq)
+            assert list(ucd) == list(scd)[::-1]
+            s4 = np.asarray(scb).reshape(4, n)
+            u4 = np.asarray(ucb).reshape(4, n)
+            np.testing.assert_array_equal(u4, s4[::-1, ::-1])
+            _s, sce = srec.get_tag("ce")
+            _s, uce = urec.get_tag("ce")
+            assert list(uce) == list(sce)[::-1]
+        assert flipped  # reverse roles existed
+
+    def test_duplex_unaligned_reverse_ac_revcomp(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.fastq import reverse_complement
+
+        genome, _header, recs, _k = _duplex_family(tmp_path)
+        by = {}
+        for mode in ("self", "unaligned"):
+            out = []
+            for batch in call_duplex_batches(
+                iter(list(recs)), lambda n, s, e: genome[s:e], ["chrT"],
+                mode=mode, batch_families=4, grouping="coordinate",
+                stats=StageStats(), mesh=None,
+            ):
+                out.extend(batch)
+            by[mode] = {r.flag & 0x80: r for r in out}
+        s2, u2 = by["self"][0x80], by["unaligned"][0x80]
+        assert u2.seq == reverse_complement(s2.seq)
+        assert str(u2.get_tag("ac")) == reverse_complement(
+            str(s2.get_tag("ac"))
+        )
+        _s, sad = s2.get_tag("ad")
+        _s, uad = u2.get_tag("ad")
+        assert list(uad) == list(sad)[::-1]
+
+    def test_unaligned_native_emit_matches_python(self, tmp_path):
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if not wirepack.available():
+            pytest.skip(f"native wirepack: {wirepack.load_error()}")
+        genome, header, recs, _k = _duplex_family(tmp_path)
+        blobs = {}
+        for emit in ("python", "native"):
+            out = []
+            for batch in call_duplex_batches(
+                iter(list(recs)), lambda n, s, e: genome[s:e], ["chrT"],
+                mode="unaligned", batch_families=4, grouping="coordinate",
+                stats=StageStats(), mesh=None, emit=emit,
+            ):
+                out.extend(batch)
+            p = str(tmp_path / f"u_{emit}.bam")
+            with BamWriter(p, header, engine="python") as w:
+                write_items(w, out)
+            blobs[emit] = open(p, "rb").read()
+        assert blobs["python"] == blobs["native"]
+
+
+class TestZipperTagReorientation:
+    def test_reverse_strand_graft_flips_arrays(self):
+        from bsseqconsensusreads_tpu.io.bam import FREVERSE
+        from bsseqconsensusreads_tpu.pipeline.record_ops import zipper_bams
+
+        src = BamRecord(
+            qname="t", flag=0x4 | 0x1 | 0x8, ref_id=-1, pos=-1, mapq=0,
+            cigar=[], next_ref_id=-1, next_pos=-1, tlen=0,
+            seq="ACGT", qual=b"\x1e" * 4,
+        )
+        src.tags["cd"] = ("B", ("S", [1, 2, 3, 4]))
+        src.tags["cB"] = ("B", ("S", list(range(16))))
+        src.tags["ac"] = ("Z", "ACGN")
+        aligned = BamRecord(
+            qname="t", flag=0x1 | FREVERSE, ref_id=0, pos=10, mapq=60,
+            cigar=[(CMATCH, 4)], next_ref_id=0, next_pos=10, tlen=4,
+            seq="ACGT", qual=b"\x1e" * 4,
+        )
+        out = zipper_bams([aligned], [src])[0]
+        assert list(out.get_tag("cd")[1]) == [4, 3, 2, 1]
+        # cB: planes complemented (A<->T, C<->G) + columns reversed
+        got = list(out.get_tag("cB")[1])
+        want = [
+            v
+            for p in (3, 2, 1, 0)
+            for v in list(range(16))[p * 4 : (p + 1) * 4][::-1]
+        ]
+        assert got == want
+        assert str(out.get_tag("ac")) == "NCGT"
+
+    def test_forward_graft_untouched(self):
+        from bsseqconsensusreads_tpu.pipeline.record_ops import zipper_bams
+
+        src = BamRecord(
+            qname="t", flag=0x4 | 0x1 | 0x8, ref_id=-1, pos=-1, mapq=0,
+            cigar=[], next_ref_id=-1, next_pos=-1, tlen=0,
+            seq="ACGT", qual=b"\x1e" * 4,
+        )
+        src.tags["cd"] = ("B", ("S", [1, 2, 3, 4]))
+        aligned = BamRecord(
+            qname="t", flag=0x1, ref_id=0, pos=10, mapq=60,
+            cigar=[(CMATCH, 4)], next_ref_id=0, next_pos=10, tlen=4,
+            seq="ACGT", qual=b"\x1e" * 4,
+        )
+        out = zipper_bams([aligned], [src])[0]
+        assert list(out.get_tag("cd")[1]) == [1, 2, 3, 4]
+
+
+class TestFilterProbe:
+    def test_probe_raises_before_write(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader as BH
+        from bsseqconsensusreads_tpu.pipeline.filter import (
+            probe_strand_tag_support,
+        )
+
+        genome, header, recs, _k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs, strand_tags=False)
+        p = str(tmp_path / "noac.bam")
+        with BamWriter(p, header, engine="python") as w:
+            write_items(w, out)
+        params = FilterParams(
+            min_reads=(1,), require_single_strand_agreement=True
+        )
+        with pytest.raises(ValueError, match="ac/bc"):
+            probe_strand_tag_support(p, params)
+        # without -s the probe is a no-op
+        probe_strand_tag_support(p, FilterParams(min_reads=(1,)))
+
+
+class TestSingleStrandAgreementFilter:
+    def _duplex_records(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path)
+        return _run_duplex(genome, recs), k
+
+    def test_disagreeing_column_masked(self, tmp_path):
+        out, k = self._duplex_records(tmp_path)
+        params = FilterParams(
+            min_reads=(1,), max_base_error_rate=1.0,
+            max_read_error_rate=1.0, max_no_call_fraction=1.0,
+            require_single_strand_agreement=True,
+        )
+        kept = list(filter_consensus(iter(out), params))
+        assert kept, "template unexpectedly dropped"
+        rec = [r for r in kept if r.flag & 0x40][0]
+        assert rec.seq[k] == "N" and rec.qual[k] == 2
+
+    def test_agreement_not_masked_without_flag(self, tmp_path):
+        out, k = self._duplex_records(tmp_path)
+        params = FilterParams(
+            min_reads=(1,), max_base_error_rate=1.0,
+            max_read_error_rate=1.0, max_no_call_fraction=1.0,
+        )
+        kept = list(filter_consensus(iter(out), params))
+        rec = [r for r in kept if r.flag & 0x40][0]
+        assert rec.seq[k] == "T"
+
+    def test_missing_tags_raise(self, tmp_path):
+        genome, _header, recs, _k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs, strand_tags=False)
+        params = FilterParams(
+            min_reads=(1,), require_single_strand_agreement=True,
+            max_read_error_rate=1.0, max_no_call_fraction=1.0,
+        )
+        with pytest.raises(ValueError, match="ac/bc"):
+            list(filter_consensus(iter(out), params))

@@ -162,9 +162,11 @@ class TestParamsValidation:
         with pytest.raises(ValueError, match="1-3 values"):
             FilterParams(min_reads=(1, 1, 1, 1))
 
-    def test_single_strand_agreement_unsupported(self):
-        with pytest.raises(ValueError, match="per-strand consensus"):
-            FilterParams(require_single_strand_agreement=True)
+    def test_single_strand_agreement_accepted(self):
+        # r5: -s is supported via the duplex emitters' ac/bc strand-call
+        # tags (behavior pinned in tests/test_exact_ce.py)
+        p = FilterParams(require_single_strand_agreement=True)
+        assert p.require_single_strand_agreement
 
     def test_missing_cd_raises(self):
         rec = BamRecord(qname="x", flag=0, seq="ACGT", qual=b"\x1e" * 4,

@@ -1,0 +1,117 @@
+"""ops.hosttwin numpy twins vs the jit window transforms.
+
+The duplex raw-unit accounting trusts these twins to reproduce the device
+transform exactly (strand call planes for ac/bc tags, the raw->converted
+base map for exact ce). Any drift is silent tag corruption, so equality is
+pinned bit-for-bit on randomized batches covering prepends, CpG pair
+context, trailing trims, missing rows, and ineligible families.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.ops import hosttwin
+from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
+from bsseqconsensusreads_tpu.ops.extend import extend_gap
+
+
+def _random_batch(rng, f=40, w=48):
+    bases = np.full((f, 4, w), NBASE, np.int8)
+    cover = np.zeros((f, 4, w), bool)
+    quals = np.zeros((f, 4, w), np.uint8)
+    for fi in range(f):
+        for r in range(4):
+            if rng.random() < 0.12:
+                continue  # missing row
+            start = int(rng.integers(0, w // 2))
+            length = int(rng.integers(1, w - start))
+            bases[fi, r, start : start + length] = rng.integers(
+                0, 4, size=length
+            )
+            quals[fi, r, start : start + length] = rng.integers(
+                2, 41, size=length
+            )
+            cover[fi, r, start : start + length] = True
+    ref = rng.integers(0, 4, size=(f, w + 1)).astype(np.int8)
+    convert_mask = np.zeros((f, 4), bool)
+    convert_mask[:, 1] = convert_mask[:, 2] = True
+    eligible = rng.random(f) < 0.8
+    return bases, quals, cover, ref, convert_mask, eligible
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return _random_batch(np.random.default_rng(77))
+
+
+class TestHostTwins:
+    def test_convert_twin_matches_jit(self, batch):
+        bases, quals, cover, ref, cmask, _ = batch
+        jb, _jq, jc, jla, jrd = (
+            np.asarray(x)
+            for x in convert_ag_to_ct(bases, quals, cover, ref, cmask)
+        )
+        tb, tc, tla, trd = hosttwin.convert_np(bases, cover, ref, cmask)
+        np.testing.assert_array_equal(tc, jc)
+        np.testing.assert_array_equal(
+            np.where(tc, tb, NBASE), np.where(jc, jb, NBASE)
+        )
+        np.testing.assert_array_equal(tla, jla)
+        np.testing.assert_array_equal(trd, jrd)
+
+    def test_extend_twin_matches_jit(self, batch):
+        bases, quals, cover, ref, cmask, eligible = batch
+        jb, jq, jc, jla, jrd = convert_ag_to_ct(bases, quals, cover, ref, cmask)
+        eb, _eq, ec = (
+            np.asarray(x) for x in extend_gap(jb, jq, jc, jla, jrd, eligible)
+        )
+        tb0, tc0, tla, trd = hosttwin.convert_np(bases, cover, ref, cmask)
+        tb, tc = hosttwin.extend_np(tb0, tc0, tla, trd, eligible)
+        np.testing.assert_array_equal(tc, ec)
+        np.testing.assert_array_equal(
+            np.where(tc, tb, NBASE), np.where(ec, eb, NBASE)
+        )
+
+    def test_strand_call_planes_compose(self, batch):
+        bases, quals, cover, ref, cmask, eligible = batch
+        jb, jq, jc, jla, jrd = convert_ag_to_ct(bases, quals, cover, ref, cmask)
+        eb, _eq, ec = (
+            np.asarray(x) for x in extend_gap(jb, jq, jc, jla, jrd, eligible)
+        )
+        calls, ccov = hosttwin.strand_call_planes(
+            bases, cover, ref, cmask, eligible
+        )
+        np.testing.assert_array_equal(ccov, ec)
+        np.testing.assert_array_equal(calls, np.where(ec, eb, NBASE))
+
+    def test_conv_base_map_agrees_with_transform(self, batch):
+        """For every covered column, pushing the ACTUAL raw base through
+        the map must equal the converted base the transform produced
+        (pre-extend, pre-trim: the map models the rewrite rule only)."""
+        bases, quals, cover, ref, cmask, _ = batch
+        m = hosttwin.conv_base_map(bases, cover, ref, cmask)
+        jb, _jq, jc, _la, _rd = (
+            np.asarray(x)
+            for x in convert_ag_to_ct(bases, quals, cover, ref, cmask)
+        )
+        f, r, w = bases.shape
+        mapped = np.take_along_axis(
+            m.transpose(1, 2, 3, 0),  # [F, R, W, 4]
+            np.clip(bases, 0, 3)[..., None].astype(np.int64),
+            axis=-1,
+        )[..., 0]
+        # compare on raw covered columns that survived (not trimmed) —
+        # prepend columns are synthetic (no raw base to map)
+        keep = cover & jc & (bases != NBASE)
+        np.testing.assert_array_equal(mapped[keep], jb[keep])
+
+    def test_conv_base_map_identity_off_convert_rows(self, batch):
+        bases, _quals, cover, ref, cmask, _ = batch
+        m = hosttwin.conv_base_map(bases, cover, ref, cmask)
+        for x in range(4):
+            np.testing.assert_array_equal(
+                m[x][:, [0, 3], :], np.full_like(m[x][:, [0, 3], :], x)
+            )
