@@ -449,6 +449,30 @@ def molecular_base_counts(bases, quals, params: ConsensusParams) -> "np.ndarray"
     return _base_histogram(b, observed)
 
 
+def sparsify_base_counts(counts, base) -> "np.ndarray":
+    """Zero the CONSENSUS-CALL plane of the cB histogram (new array).
+
+    The call plane is derivable (cd - ce at called columns) and carries
+    ~all of the histogram's mass; storing it zero makes the cB tag a
+    sparse DISSENT histogram that deflates to almost nothing in the
+    intermediate BAM (the dense form doubled the molecular stage output
+    at scale). Columns whose consensus is masked (NBASE) keep all four
+    planes — nothing is derivable there. The duplex exact-ce consumer
+    (pipeline.calling._exact_strand_errors) only ever reads dissent
+    cells, so no reconstruction is needed downstream."""
+    import numpy as np
+
+    counts = np.asarray(counts).copy()  # [F, 2, 4, W]
+    base = np.asarray(base)  # [F, 2, W]
+    called = base != NBASE
+    sel = np.clip(base, 0, 3)[:, :, None, :].astype(np.int64)
+    plane = np.take_along_axis(counts, sel, axis=2)
+    np.put_along_axis(
+        counts, sel, np.where(called[:, :, None, :], 0, plane), axis=2
+    )
+    return counts
+
+
 @lru_cache(maxsize=64)
 def _wire_kernel_cached(kernel_fn):
     @partial(jax.jit, static_argnames=("f", "t", "w", "params", "qual_mode"))

@@ -140,6 +140,24 @@ def strand_call_planes(bases, cover, ref, convert_mask, eligible=None):
     return np.where(c, b, NBASE).astype(np.int8), c
 
 
+def convert_cell(x, act, refc, refn, nxt, nxtcov):
+    """THE elementwise conversion rule, broadcastable over any shape:
+    what base x becomes at a column with reference base refc, next
+    reference base refn, the read's own raw next base nxt (coverage
+    nxtcov), on a convert row (act). Shared by conv_base_map (plane
+    domain) and the duplex exact-ce dissent pass
+    (pipeline.calling._exact_strand_errors, gather domain) so the rule
+    exists ONCE — a drifted copy would silently desynchronize the
+    exact-ce counts from the pinned twin."""
+    m = np.where(act & (x == A) & (refc == G), G, x)
+    conv_c = np.where(
+        (refc == C) & (refn == G),
+        np.where(nxtcov & (nxt == A), _T, C),
+        _T,
+    )
+    return np.where(act & (x == C), conv_c, m).astype(np.int8)
+
+
 def conv_base_map(bases, cover, ref, convert_mask):
     """Per-column raw->converted base map M: int8 [4, ..., R, W].
 
@@ -160,24 +178,18 @@ def conv_base_map(bases, cover, ref, convert_mask):
     cover = np.asarray(cover, bool)
     ref = np.asarray(ref)
     w = bases.shape[-1]
-    ref_w = ref[..., :w]
-    ref_next = ref[..., 1 : w + 1]
+    ref_w = np.broadcast_to(ref[..., None, :w], bases.shape)
+    ref_next = np.broadcast_to(ref[..., None, 1 : w + 1], bases.shape)
     read_next = np.concatenate(
         [bases[..., 1:], np.full_like(bases[..., :1], NBASE)], axis=-1
     )
     next_cov = np.concatenate(
         [cover[..., 1:], np.zeros_like(cover[..., :1])], axis=-1
     )
-    is_cpg = (ref_w == C) & (ref_next == G)
-    cpg_here = np.broadcast_to(is_cpg[..., None, :], bases.shape)
-    pair_ctx = cpg_here & next_cov & (read_next == A)
     act = np.asarray(convert_mask, bool)[..., None]
     out = np.empty((4,) + bases.shape, np.int8)
     for x in range(4):
-        m = np.full(bases.shape, x, np.int8)
-        if x == A:
-            m = np.where(ref_w[..., None, :] == G, G, m)
-        elif x == C:
-            m = np.where(cpg_here, np.where(pair_ctx, _T, C), _T)
-        out[x] = np.where(act, m, x)
+        out[x] = convert_cell(
+            np.int8(x), act, ref_w, ref_next, read_next, next_cov
+        )
     return out

@@ -643,7 +643,11 @@ def _consensus_tags(depth_arr, err_arr, mi, rx, bcount=None,
         "ce": ("B", ("S", err_arr.tolist())),
     }
     if bcount is not None:
-        tags["cB"] = ("B", ("S", bcount.reshape(-1).tolist()))
+        flat = np.asarray(bcount).reshape(-1)
+        # uint8 subtype when every count fits (the overwhelmingly common
+        # case; deep families fall back to u16) — half the tag bytes
+        sub = "C" if (flat.size == 0 or int(flat.max()) < 256) else "S"
+        tags["cB"] = ("B", (sub, flat.tolist()))
     if rx:
         tags["RX"] = ("Z", rx)
     return tags
@@ -761,13 +765,15 @@ def _emit_molecular_batch_raw(batch, out, params, mode, stats,
                               base_counts: bool = False) -> RawRecords:
     bcount = None
     if base_counts:
+        from bsseqconsensusreads_tpu.models.molecular import (
+            molecular_base_counts,
+            sparsify_base_counts,
+        )
+
         bcount = out.get("bcount")  # slim-wire retire computed it already
         if bcount is None:
-            from bsseqconsensusreads_tpu.models.molecular import (
-                molecular_base_counts,
-            )
-
             bcount = molecular_base_counts(batch.bases, batch.quals, params)
+        bcount = sparsify_base_counts(bcount, out["base"])
     return _emit_batch_raw(
         batch, out, params, mode, stats,
         n_reads=(batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
@@ -808,13 +814,15 @@ def _emit_molecular_batch(batch, out, params, mode, stats,
     errors = np.asarray(out["errors"])
     bcounts = None
     if base_counts:
+        from bsseqconsensusreads_tpu.models.molecular import (
+            molecular_base_counts,
+            sparsify_base_counts,
+        )
+
         bcounts = out.get("bcount")  # slim-wire retire computed it already
         if bcounts is None:
-            from bsseqconsensusreads_tpu.models.molecular import (
-                molecular_base_counts,
-            )
-
             bcounts = molecular_base_counts(batch.bases, batch.quals, params)
+        bcounts = sparsify_base_counts(bcounts, out["base"])
     emitted: list[BamRecord] = []
     for fi, meta in enumerate(batch.meta):
         stats.families += 1
@@ -1597,7 +1605,15 @@ def call_duplex_batches(
     stats.wall_seconds += time.monotonic() - t0
 
 
-def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
+class _Sidecar(dict):
+    """{mi: [occurrence rows]} with one chunk-level fact precomputed:
+    whether ANY captured row carries the cB histogram (saves a full
+    sidecar rescan per kernel batch in _duplex_rawize)."""
+
+    has_cb: bool = False
+
+
+def _duplex_sidecar(chunk, pos0: str = "skip") -> "_Sidecar":
     """Raw per-strand depth/error arrays for the duplex emitters.
 
     The duplex stage's input records are molecular consensus reads whose
@@ -1619,18 +1635,38 @@ def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
         DUPLEX_ROW_OF_FLAG,
     )
 
-    side: dict = {}
+    side = _Sidecar()
     for mi, records in chunk:
         rows: dict = {}
         for rec in records:
             row = DUPLEX_ROW_OF_FLAG.get(rec.flag)
             if row is None or row in rows:
                 continue
-            try:
-                _sub, cd = rec.get_tag("cd")
-                _sub, ce = rec.get_tag("ce")
-            except (KeyError, TypeError, ValueError):
-                continue
+            # zero-copy fast path for columnar views (one aux decode);
+            # BamRecord objects take the tag surface
+            aux_fn = getattr(rec, "consensus_aux", None)
+            if aux_fn is not None:
+                trip = aux_fn()
+                if trip is None:
+                    continue
+                cd, ce, cbflat = trip
+            else:
+                try:
+                    _sub, cd = rec.get_tag("cd")
+                    _sub, ce = rec.get_tag("ce")
+                except (KeyError, TypeError, ValueError):
+                    continue
+                # uint16 matches the native decoder's aux planes, so the
+                # native rawize's flat buffer assembles with one
+                # concatenate
+                cd = np.asarray(cd, dtype=np.uint16)
+                ce = np.asarray(ce, dtype=np.uint16)
+                cbflat = None
+                try:
+                    _sub, cbv = rec.get_tag("cB")
+                    cbflat = np.asarray(cbv, dtype=np.uint16)
+                except (KeyError, TypeError, ValueError):
+                    pass
             info = getattr(rec, "clip_info", None)
             if info is not None:
                 lead, trail, _indel, hard = info
@@ -1646,28 +1682,20 @@ def _duplex_sidecar(chunk, pos0: str = "skip") -> dict:
                     if len(cigar) > 1 and cigar[-1][0] == CSOFT_CLIP
                     else 0
                 )
-            # uint16 matches the native decoder's aux planes, so columnar
-            # views pass through copy-free and the native rawize's flat
-            # buffer assembles with one concatenate
-            cd = np.asarray(cd, dtype=np.uint16)
-            ce = np.asarray(ce, dtype=np.uint16)
-            if len(cd) != len(ce) or len(cd) <= lead + trail:
+            n = len(cd)
+            if len(ce) != n or n <= lead + trail:
                 continue
             pos = rec.pos
             if pos0 == "shift" and pos == 0 and row in CONVERT_ROWS:
                 pos = 1  # mirror the encoder's register-shift placement
-            end = len(cd) - trail
-            # cB raw base histogram (4 plane-major runs): the exact-ce
-            # input. Absent/malformed -> None: that row keeps the r4
-            # err-bit split rule.
+            end = n - trail
+            # cB raw base DISSENT histogram (4 plane-major runs, call
+            # plane zero): the exact-ce input. Absent/malformed -> None:
+            # that row keeps the r4 err-bit split rule.
             cb = None
-            try:
-                _sub, cbv = rec.get_tag("cB")
-                cbv = np.asarray(cbv, dtype=np.uint16)
-                if cbv.size == 4 * len(cd):
-                    cb = cbv.reshape(4, len(cd))[:, lead:end]
-            except (KeyError, TypeError, ValueError):
-                pass
+            if cbflat is not None and cbflat.size == 4 * n:
+                cb = cbflat.reshape(4, n)[:, lead:end]
+                side.has_cb = True
             rows[row] = (pos, cd[lead:end], ce[lead:end], cb)
         if rows:
             side.setdefault(mi, []).append(rows)
@@ -1747,14 +1775,21 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
     f, _, w = np.asarray(out["a_depth"]).shape
     a_pres = np.asarray(out["a_depth"]) > 0
     b_pres = np.asarray(out["b_depth"]) > 0
-    need_exact = bool(sidecar) and any(
-        entry[3] is not None
-        for occs in sidecar.values()
-        for rows in occs
-        for entry in rows.values()
-    )
+    a_errbit = np.asarray(out["a_err"]) > 0
+    b_errbit = np.asarray(out["b_err"]) > 0
+    # _Sidecar precomputes the flag at capture; plain-dict callers (tests)
+    # fall back to the scan
+    if isinstance(sidecar, _Sidecar):
+        need_exact = sidecar.has_cb
+    else:
+        need_exact = bool(sidecar) and any(
+            entry[3] is not None
+            for occs in sidecar.values()
+            for rows in occs
+            for entry in rows.values()
+        )
     calls = None
-    if strand_tags and ref is not None:
+    if (strand_tags or need_exact) and ref is not None:
         from bsseqconsensusreads_tpu.ops import hosttwin
 
         calls, _ccov = hosttwin.strand_call_planes(
@@ -1774,6 +1809,23 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
     if not sidecar:
         return out
 
+    # exact-pass entry collection rides the SAME family walk as the
+    # rawize assembly (one _sidecar_rows_for per family)
+    ex_has = np.zeros((f, 4), bool)
+    ex_fi: list[int] = []
+    ex_row: list[int] = []
+    ex_off: list[int] = []
+    ex_cbs: list[np.ndarray] = []
+
+    def collect_exact(fi, row, pos, wstart, cb) -> None:
+        if cb is None:
+            return
+        ex_has[fi, row] = True
+        ex_fi.append(fi)
+        ex_row.append(row)
+        ex_off.append(pos - wstart)
+        ex_cbs.append(cb)
+
     if wirepack.available():
         row_pos = np.full(f * 4, -1, np.int64)
         row_off = np.zeros(f * 4, np.int64)
@@ -1786,7 +1838,7 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
             rows = _sidecar_rows_for(meta, sidecar, w)
             if not rows:
                 continue
-            for row, (pos, cd, ce, _cb) in rows.items():
+            for row, (pos, cd, ce, cb) in rows.items():
                 k = fi * 4 + row
                 row_pos[k] = pos
                 row_off[k] = cursor
@@ -1794,6 +1846,7 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
                 chunks.append(cd)
                 chunks.append(ce)
                 cursor += 2 * len(cd)
+                collect_exact(fi, row, pos, meta.window_start, cb)
         aux = (
             np.concatenate(chunks) if chunks else np.zeros(0, np.uint16)
         )
@@ -1822,6 +1875,9 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
                     entry = rows.get(row)
                     if entry is None:
                         continue
+                    collect_exact(
+                        fi, row, entry[0], meta.window_start, entry[3]
+                    )
                     pres = dplane[fi, role] > 0
                     raw_d = _place_raw(
                         entry[:2], pres, meta.window_start, w
@@ -1844,115 +1900,113 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
         raw["a_err"], raw["b_err"] = ae.astype(np.int16), be.astype(np.int16)
         raw["depth"] = (ad + bd).astype(np.int16)
         raw["errors"] = (ae + be).astype(np.int16)
-    if need_exact and ref is not None:
+    if calls is not None and ex_has.any():
         raw = _exact_strand_errors(
-            raw, batch, sidecar, ref, (a_pres, b_pres), w
+            raw, batch, (a_pres, b_pres), (a_errbit, b_errbit), calls, ref,
+            w, ex_has, ex_fi, ex_row, ex_off, ex_cbs,
         )
     return raw
 
 
-def _exact_strand_errors(out: dict, batch, sidecar: dict, ref,
-                         presence, w: int) -> dict:
+def _exact_strand_errors(out: dict, batch, presence, errbits, calls, ref,
+                         w: int, has, e_fi, e_row, e_off, cbs) -> dict:
     """Pass 3 of _duplex_rawize: exact per-strand raw error counts.
 
-    For every sidecar row carrying the molecular cB histogram:
-    ae = ad - (raw reads whose conversion-mapped base equals the duplex
-    call), per column, halo-filled/masked with the same rules as the
-    raw depth placement (nearest raw column for the synthetic
-    prepend/extend boundary columns, zero outside presence). Fully
-    vectorized over the batch — per-family Python touches only the
-    ragged index assembly."""
-    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
-    from bsseqconsensusreads_tpu.ops import hosttwin
+    For every sidecar row carrying the molecular cB DISSENT histogram
+    (call plane zero — models.molecular.sparsify_base_counts), per
+    column: ae = ad - cnt_match, where
 
-    f = np.asarray(out["base"]).shape[0]
-    e_fi: list[int] = []
-    e_row: list[int] = []
-    e_off: list[int] = []
-    e_len: list[int] = []
-    cbs: list[np.ndarray] = []
-    for fi, meta in enumerate(batch.meta):
-        rows = _sidecar_rows_for(meta, sidecar, w)
-        if not rows:
-            continue
-        for row, (pos, _cd, _ce, cb) in rows.items():
-            if cb is None:
-                continue
-            e_fi.append(fi)
-            e_row.append(row)
-            e_off.append(pos - meta.window_start)
-            e_len.append(cb.shape[1])
-            cbs.append(cb)
-    if not e_fi:
-        return out
-    e_fi_a = np.asarray(e_fi)
-    e_row_a = np.asarray(e_row)
-    off = np.asarray(e_off)
-    lens = np.asarray(e_len)
-    cb_all = np.concatenate(cbs, axis=1)  # [4, total]
-    tot = int(lens.sum())
-    ent = np.repeat(np.arange(len(lens)), lens)
-    cum0 = np.concatenate([[0], np.cumsum(lens)[:-1]])
-    j = np.arange(tot) - np.repeat(cum0, lens)
-    col = off[ent] + j
-    inw = (col >= 0) & (col < w)
-    fi_e, row_e, col_e = e_fi_a[ent][inw], e_row_a[ent][inw], col[inw]
-    role_of_row = np.empty(4, np.int64)
-    for role, (ar, br) in enumerate(ROLE_STRAND_ROWS):
-        role_of_row[ar] = role
-        role_of_row[br] = role
-    role_e = role_of_row[row_e]
+      cnt_match = [strand's converted call == duplex call] * (ad -
+                  placed_ce)               <- the call-plane mass
+                + sum of dissent cells whose conversion-mapped base
+                  equals the duplex call   <- sparse scatter
+
+    placed_ce is recovered from the r4 rawize output (it is ad - ae
+    where the err bit was set, ae otherwise), and the strand's converted
+    call is the already-computed ac/bc plane (ops.hosttwin twin of the
+    device transform) — so the hot path is a handful of [F, 2, W] plane
+    ops plus work proportional to the number of DISSENT cells, not to
+    batch volume. Synthetic boundary columns (prepend/extend halo) carry
+    no dissent cells and take the call-plane formula, whose operands are
+    halo-placed upstream.
+
+    Entry arrays (has/e_fi/e_row/e_off/cbs) were collected by
+    _duplex_rawize's single family walk; the dissent coordinates come
+    from ONE np.nonzero over the concatenated histograms."""
+    from bsseqconsensusreads_tpu.models.duplex import ROLE_STRAND_ROWS
+
     base = np.asarray(out["base"])
-    callv = base[fi_e, role_e, col_e]
-    conv = hosttwin.conv_base_map(
-        batch.bases, batch.cover, ref, batch.convert_mask
+    f = base.shape[0]
+    bases_raw = np.asarray(batch.bases)
+    cover_raw = np.asarray(batch.cover)
+    cmask = np.asarray(batch.convert_mask, bool)
+    ref = np.asarray(ref)
+    dissent = np.zeros((f, 4, w), np.int32)
+    cb_all = (
+        np.concatenate(cbs, axis=1) if cbs else np.zeros((4, 0), np.uint16)
     )
-    cnt = np.zeros(len(col_e), np.int64)
-    for x in range(4):
-        cnt += cb_all[x][inw].astype(np.int64) * (
-            conv[x][fi_e, row_e, col_e] == callv
+    pl_nz, el_nz = np.nonzero(cb_all)  # dissent cells are sparse
+    if len(pl_nz):
+        lens = np.fromiter((cb.shape[1] for cb in cbs), np.int64, len(cbs))
+        cum = np.cumsum(lens)
+        ent = np.searchsorted(cum, el_nz, side="right")
+        fi_e = np.asarray(e_fi, dtype=np.int64)[ent]
+        row_e = np.asarray(e_row, dtype=np.int64)[ent]
+        col_e = np.asarray(e_off, dtype=np.int64)[ent] + (
+            el_nz - (cum - lens)[ent]
         )
-    # scatter counts + per-row window-clipped spans for the clamp halo
-    plane = np.zeros((f, 4, w), np.int64)
-    plane[fi_e, row_e, col_e] = cnt
-    lo_all = np.full((f, 4), w, np.int64)
-    hi_all = np.zeros((f, 4), np.int64)
-    has = np.zeros((f, 4), bool)
-    lo_entry = np.clip(off, 0, w)
-    hi_entry = np.clip(off + lens, 0, w)
-    lo_all[e_fi_a, e_row_a] = lo_entry
-    hi_all[e_fi_a, e_row_a] = hi_entry
-    has[e_fi_a, e_row_a] = hi_entry > lo_entry
+        x_e = pl_nz.astype(np.int8)
+        v_e = cb_all[pl_nz, el_nz].astype(np.int32)
+        inw = (col_e >= 0) & (col_e < w)
+        fi_e, row_e, col_e = fi_e[inw], row_e[inw], col_e[inw]
+        x_e, v_e = x_e[inw], v_e[inw]
+        # conversion of the dissent base under the strand read's own
+        # context — THE shared rule (ops.hosttwin.convert_cell), applied
+        # only at dissent cells
+        from bsseqconsensusreads_tpu.ops.hosttwin import convert_cell
+
+        act = cmask[fi_e, row_e]
+        refc = ref[fi_e, col_e]
+        refn = ref[fi_e, col_e + 1]  # ref is [F, W+1]
+        nxt_ok = col_e + 1 < w
+        safe_n = np.minimum(col_e + 1, w - 1)
+        nxt = np.where(nxt_ok, bases_raw[fi_e, row_e, safe_n], NBASE)
+        nxtcov = np.where(nxt_ok, cover_raw[fi_e, row_e, safe_n], False)
+        m = convert_cell(x_e, act, refc, refn, nxt, nxtcov)
+        role_of_row = np.empty(4, np.int64)
+        for role, (ar, br) in enumerate(ROLE_STRAND_ROWS):
+            role_of_row[ar] = role
+            role_of_row[br] = role
+        role_e = role_of_row[row_e]
+        callv = base[fi_e, role_e, col_e]
+        match = (m == callv) & (callv != NBASE)
+        np.add.at(
+            dissent,
+            (fi_e[match], row_e[match], col_e[match]),
+            v_e[match],
+        )
     a_pres, b_pres = presence
-    colw = np.arange(w)[None, :]
+    a_eb, b_eb = errbits
     for role, (a_row, b_row) in enumerate(ROLE_STRAND_ROWS):
-        for srow, dkey, ekey, pres in (
-            (a_row, "a_depth", "a_err", a_pres),
-            (b_row, "b_depth", "b_err", b_pres),
+        for srow, dkey, ekey, pres, ebit in (
+            (a_row, "a_depth", "a_err", a_pres, a_eb),
+            (b_row, "b_depth", "b_err", b_pres, b_eb),
         ):
             hb = has[:, srow]
             if not hb.any():
                 continue
-            # entry-less families keep their init spans (w, 0): substitute
-            # a safe in-bounds span for the gather — their columns are
-            # discarded by the hb gate in `upd` below, but out-of-range
-            # indices would crash take_along_axis regardless
-            lo = np.where(hb, lo_all[:, srow], 0)[:, None]
-            hi = np.where(hb, hi_all[:, srow], 1)[:, None]
-            p = plane[:, srow, :]
-            clamped = np.clip(colw, lo, np.maximum(hi - 1, lo))
-            halo = np.take_along_axis(p, clamped, axis=1)
-            direct = (colw >= lo) & (colw < hi)
-            cntw = np.where(direct, p, halo)
+            ad = np.asarray(out[dkey])[:, role, :].astype(np.int32)
+            ae_c = np.asarray(out[ekey])[:, role, :].astype(np.int32)
+            eb = ebit[:, role, :]
+            placed_ce = np.where(eb, ad - ae_c, ae_c)
+            agree = calls[:, srow, :] == base[:, role, :]
+            cnt = np.where(agree, ad - placed_ce, 0) + dissent[:, srow, :]
             prole = pres[:, role, :]
-            cntw = np.where(prole, cntw, 0)
-            ad_plane = np.asarray(out[dkey])[:, role, :].astype(np.int64)
-            callp = base[:, role, :]
-            upd = hb[:, None] & prole & (callp != NBASE)
-            ae_new = np.clip(ad_plane - cntw, 0, None)
-            cur = np.asarray(out[ekey])[:, role, :]
-            out[ekey][:, role, :] = np.where(upd, ae_new, cur).astype(
-                out[ekey].dtype
+            upd = hb[:, None] & prole & (base[:, role, :] != NBASE)
+            ae_new = np.clip(ad - cnt, 0, None)
+            cur = np.asarray(out[ekey])
+            cur[:, role, :] = np.where(upd, ae_new, cur[:, role, :]).astype(
+                cur.dtype
             )
     out["errors"] = (
         np.asarray(out["a_err"]).astype(np.int32)

@@ -184,6 +184,12 @@ class ColumnarRecordView:
         )
         return aux[off : off + n], aux[off + n : off + 2 * n], cb
 
+    def consensus_aux(self):
+        """(cd, ce, cB|None) u16 views or None — the duplex sidecar's
+        zero-copy fast path (pipeline.calling._duplex_sidecar): one aux
+        decode instead of three get_tag round trips per record."""
+        return self._aux_arrays()
+
     def has_tag(self, name: str) -> bool:
         if name in ("cd", "ce"):
             return self._aux_arrays() is not None

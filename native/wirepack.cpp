@@ -416,23 +416,35 @@ int wirepack_emit_consensus_records_v2(
       put_arr_tag(c, "cd", drow, n, flip);
       put_arr_tag(c, "ce", erow, n, flip);
       if (bcount != nullptr) {
-        // cB: 4 plane-major runs (A,C,G,T) of per-column raw counts —
-        // one B:S tag of 4n entries (pipeline.calling._consensus_tags).
-        // Flipped records complement the plane order (3-p) and reverse
-        // columns: a window-space A count is a T count on the emitted
-        // strand.
+        // cB: 4 plane-major runs (A,C,G,T) of per-column raw DISSENT
+        // counts (the call plane arrives zeroed —
+        // models.molecular.sparsify_base_counts). Flipped records
+        // complement the plane order (3-p) and reverse columns. The
+        // subtype is 'C' (u8) when every count fits — half the bytes,
+        // same decision as pipeline.calling._consensus_tags — else 'S'.
+        uint16_t cbmax = 0;
+        for (int plane = 0; plane < 4; ++plane) {
+          const uint16_t* src =
+              bcount + ((fi * 2 + role) * 4 + plane) * w + lo0;
+          for (int64_t i = 0; i < n; ++i)
+            if (src[i] > cbmax) cbmax = src[i];
+        }
+        const bool cb_u8 = cbmax < 256;
         c.put_bytes("cB", 2);
         c.put_u8('B');
-        c.put_u8('S');
+        c.put_u8(cb_u8 ? 'C' : 'S');
         c.put_u32(uint32_t(4 * n));
         for (int plane = 0; plane < 4; ++plane) {
           const int src_plane = flip ? 3 - plane : plane;
           const uint16_t* src =
               bcount + ((fi * 2 + role) * 4 + src_plane) * w + lo0;
-          if (flip) {
-            for (int64_t i = n - 1; i >= 0; --i) c.put_u16(src[i]);
-          } else {
-            for (int64_t i = 0; i < n; ++i) c.put_u16(src[i]);
+          for (int64_t i = 0; i < n; ++i) {
+            const int64_t si = flip ? n - 1 - i : i;
+            if (cb_u8) {
+              c.put_u8(uint8_t(src[si]));
+            } else {
+              c.put_u16(src[si]);
+            }
           }
         }
       }

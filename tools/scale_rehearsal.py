@@ -156,7 +156,10 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         tmp=workdir,
         aligner="self",
         grouping="coordinate",
-        sort_buffer_records=100_000,
+        # 200k-record spill runs keep the 8M-record molecular intermediate
+        # under the 64-run merge fan-in: one merge pass instead of two
+        # (the pre-merge pass re-reads/re-writes the whole stage output)
+        sort_buffer_records=200_000,
         batch_families=2048,
     )
     t0 = time.monotonic()
