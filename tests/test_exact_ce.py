@@ -135,6 +135,40 @@ def _run_duplex(genome, records, strand_tags=True, emit="python"):
     return out
 
 
+class TestDeepFamilySubtype:
+    def test_cb_u16_subtype_past_255(self):
+        """A family deep enough that dissent counts exceed 255 must emit
+        cB with the u16 ('S') subtype — and shallow families use 'C'."""
+        rng = np.random.default_rng(77)
+        name, genome = random_genome(rng, 6000)
+        _header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=1, reads_per_strand=(1200, 1200),
+            read_len=30, error_rate=0.9,
+        )
+        out = _run_molecular(records, "deep")
+        assert out
+        subs = {rec.get_tag("cB")[0] for rec in out}
+        assert "S" in subs
+        for rec in out:
+            sub, cb = rec.get_tag("cB")
+            _s, cd = rec.get_tag("cd")
+            _s, ce = rec.get_tag("ce")
+            cb = np.asarray(cb, np.int64).reshape(4, len(cd))
+            called = np.asarray([ch != "N" for ch in rec.seq])
+            np.testing.assert_array_equal(
+                cb.sum(axis=0)[called], np.asarray(ce)[called]
+            )
+
+    def test_cb_u8_subtype_shallow(self):
+        rng = np.random.default_rng(78)
+        name, genome = random_genome(rng, 6000)
+        _header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=2, reads_per_strand=(2, 3),
+        )
+        out = _run_molecular(records, "shallow")
+        assert out and all(rec.get_tag("cB")[0] == "C" for rec in out)
+
+
 class TestExactDuplexCe:
     def test_third_base_dissenter_counted_exactly(self, tmp_path):
         genome, _header, recs, k = _duplex_family(tmp_path, third_base=True)
