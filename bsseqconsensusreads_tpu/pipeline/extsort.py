@@ -53,6 +53,7 @@ def _external_sort_core(
     write_item: Callable,
     read_run: Callable,
     write_run: Callable | None = None,
+    metrics=None,
 ) -> Iterator:
     """Shared spill/merge machinery behind external_sort (BamRecord
     objects) and external_sort_raw (encoded blobs): runs of
@@ -65,31 +66,47 @@ def _external_sort_core(
 
     write_item(writer, item) appends one item to a run; read_run(reader)
     yields a run's items back in order.
+
+    metrics (observe.Metrics or None): in-stream spill sort+write time
+    accumulates under 'sort_write' — these spills happen BETWEEN the
+    producer's yields, inside the consensus stage's stream-active wall,
+    and were the wall's largest unattributed share at scale.
     """
     if buffer_records < 1:
         raise ValueError(f"buffer_records must be >= 1, got {buffer_records}")
+    import contextlib
+
     buf: list = []
     run_paths: list[str] = []
     tmpdir: tempfile.TemporaryDirectory | None = None
 
+    def timed():
+        return (
+            metrics.timed("sort_write")
+            if metrics is not None
+            else contextlib.nullcontext()
+        )
+
     def spill() -> None:
         nonlocal tmpdir
-        buf.sort(key=key)
-        if tmpdir is None:
-            tmpdir = tempfile.TemporaryDirectory(
-                prefix="bsseq_extsort_", dir=workdir
-            )
-        path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
-        # spill shards are deleted after the merge: fast compression (the
-        # BGZF container is identical, only the deflate effort drops)
-        with BamWriter(path, header, level=1) as w:
-            if write_run is not None:  # coalesced (raw-blob) writes
-                write_run(w, buf)
-            else:
-                for item in buf:
-                    write_item(w, item)
-        run_paths.append(path)
-        buf.clear()
+        with timed():
+            buf.sort(key=key)
+            if tmpdir is None:
+                tmpdir = tempfile.TemporaryDirectory(
+                    prefix="bsseq_extsort_", dir=workdir
+                )
+            path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
+            # spill shards are deleted after the merge: fast compression
+            # (the BGZF container is identical, only the deflate effort
+            # drops)
+            with BamWriter(path, header, level=1) as w:
+                if write_run is not None:  # coalesced (raw-blob) writes
+                    write_run(w, buf)
+                else:
+                    for item in buf:
+                        write_item(w, item)
+            run_paths.append(path)
+            buf.clear()
 
     for item in items:
         buf.append(item)
@@ -205,6 +222,7 @@ def external_sort_raw(
     workdir: str | None = None,
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
     key: Callable[[bytes], tuple] = raw_coordinate_key,
+    metrics=None,
 ) -> Iterator[bytes]:
     """external_sort over encoded record blobs: same spill/merge core, but
     records never decode — keys read at fixed offsets (raw_coordinate_key)
@@ -215,6 +233,7 @@ def external_sort_raw(
         write_item=lambda w, blob: w.write_raw(blob),
         read_run=lambda r: r.raw_records(),
         write_run=lambda w, items: w.write_raw_many(items),
+        metrics=metrics,
     )
 
 
@@ -226,13 +245,16 @@ def write_batch_stream(
     workdir: str | None = None,
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
     level: int = 6,
+    metrics=None,
 ) -> None:
     """Write a consensus batch stream (lists of BamRecord / RawRecords) to
     a BAM: straight through when order-preserving, or via the raw-blob
     external coordinate sort in 'self' mode — never the whole output in
     RAM. Shared by the pipeline stage runner and the CLI subcommands.
     `level` is the BGZF deflate level (stage intermediates pass a fast
-    level; see FrameworkConfig.intermediate_level)."""
+    level; see FrameworkConfig.intermediate_level). `metrics` attributes
+    the sort's in-stream spill time ('sort_write' — see
+    _external_sort_core)."""
     with BamWriter(out_path, header, level=level) as writer:
         if mode == "self":
             blobs = iter_record_blobs(
@@ -242,6 +264,7 @@ def write_batch_stream(
                 external_sort_raw(
                     blobs, header, workdir=workdir,
                     buffer_records=buffer_records,
+                    metrics=metrics,
                 )
             )
         else:

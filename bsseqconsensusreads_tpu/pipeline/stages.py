@@ -188,7 +188,7 @@ class PipelineBuilder:
             h.text = "@HD\tVN:1.6\tSO:unsorted\n" + h.text
         return h
 
-    def _sorted_raw(self, blobs, header):
+    def _sorted_raw(self, blobs, header, metrics=None):
         """Bounded-memory coordinate sort over encoded record blobs (same
         ordering as the object-key external_sort; keys read at fixed
         offsets, no decode/re-encode round trip)."""
@@ -196,6 +196,7 @@ class PipelineBuilder:
             blobs, header,
             workdir=self.cfg.tmp or None,
             buffer_records=self.cfg.sort_buffer_records,
+            metrics=metrics,
         )
 
     def _write_stage_output(self, batches, out_path: str, header, mode: str,
@@ -216,11 +217,32 @@ class PipelineBuilder:
         import time as _time
 
         w0 = stats.wall_seconds if stats is not None else 0.0
+        metrics = stats.metrics if stats is not None else None
+        s0 = (
+            metrics.seconds.get("sort_write", 0.0)
+            if metrics is not None else 0.0
+        )
+        # snapshot the spill timer the moment the batch stream is
+        # exhausted: spills BEFORE that point are inside the stage's
+        # stream-active wall, spills after (the trailing partial buffer,
+        # the whole checkpointed-resume sort) are inside the elapsed -
+        # stream_active remainder — the split keeps the two sort_write
+        # shares disjoint in every mode instead of by luck of position
+        box: dict = {"at_end": None}
+
+        def snapshotted(src):
+            for item in src:
+                yield item
+            if metrics is not None:
+                box["at_end"] = metrics.seconds.get("sort_write", 0.0)
+
+        if stats is not None:
+            batches = snapshotted(batches)
         t0 = _time.monotonic()
         if ck is not None:
             ck.write_batches(batches)
             ck.finalize(
-                self._sorted_raw(ck.iter_raw_records(), header)
+                self._sorted_raw(ck.iter_raw_records(), header, metrics)
                 if mode == "self" else None  # None = raw shard concatenation
             )
         else:
@@ -229,11 +251,21 @@ class PipelineBuilder:
                 workdir=self.cfg.tmp or None,
                 buffer_records=self.cfg.sort_buffer_records,
                 level=self._out_level(out_path),
+                metrics=metrics,
             )
         if stats is not None:
+            # the remainder: post-stream merge + writer finalize, with
+            # post-stream SPILLS (already timed directly) subtracted so
+            # they are not double-counted
             stream_active = stats.wall_seconds - w0
+            at_end = box["at_end"] if box["at_end"] is not None else s0
+            post_spills = stats.metrics.seconds.get("sort_write", 0.0) - at_end
             stats.metrics.add_seconds(
-                "sort_write", max(_time.monotonic() - t0 - stream_active, 0.0)
+                "sort_write",
+                max(
+                    _time.monotonic() - t0 - stream_active - post_spills,
+                    0.0,
+                ),
             )
 
     def _checkpointed(self, stage: str, rule, header) -> BatchCheckpoint | None:
