@@ -1,0 +1,223 @@
+"""End-to-end exact-ce integration: raw grouped reads -> molecular ->
+duplex, then every duplex ce column re-derived INDEPENDENTLY from the raw
+observations.
+
+The unit tests pin the exact-ce formula on hand-built families; this test
+pins the whole chain on a random corpus — placement registers, the cB tag
+round trip through real BAM records, the strand/role row mapping, and the
+conversion context — by mapping EVERY raw observation's base through the
+strand read's conversion context and counting mismatches with the duplex
+call directly (a per-column scalar recomputation structured nothing like
+the production plane/scatter pass; shared building blocks are only the
+pinned twins: _overlap_cocall_np and hosttwin.convert_cell).
+
+Boundary columns (conversion prepend, extend-gap copies, trailing trim)
+use documented halo rules and are excluded: assertions cover the interior
+of each strand's raw span.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+from bsseqconsensusreads_tpu.models.molecular import _overlap_cocall_np
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.hosttwin import convert_cell
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+)
+
+#: duplex-input flag -> (family strand suffix, merged role, is_convert_row)
+_FLAG_INFO = {99: ("A", 0, False), 163: ("B", 0, True),
+              83: ("B", 1, True), 147: ("A", 1, False)}
+
+
+@pytest.fixture(scope="module")
+def pipeline_outputs():
+    rng = np.random.default_rng(91)
+    name, genome = random_genome(rng, 20000)
+    _header, raw = make_grouped_bam_records(
+        rng, name, genome, n_families=16, reads_per_strand=(1, 4),
+        read_len=60, error_rate=0.06,
+    )
+    params = ConsensusParams(min_reads=1)
+    mol = []
+    for batch in call_molecular_batches(
+        iter(list(raw)), params=params, mode="self", batch_families=5,
+        grouping="coordinate", stats=StageStats(), mesh=None,
+    ):
+        mol.extend(batch)
+    dup = []
+    for batch in call_duplex_batches(
+        iter([r.copy() for r in mol]), lambda n, s, e: genome[s:e], [name],
+        params=ConsensusParams(min_reads=0), mode="self", batch_families=6,
+        grouping="coordinate", stats=StageStats(), mesh=None,
+    ):
+        dup.extend(batch)
+    return genome, raw, mol, dup, params
+
+
+def _raw_obs(raw, fam, strand, role, params):
+    """Post-cocall observations (base, col) of one strand's raw reads for
+    one role, in reference coordinates — the molecular stage's
+    observation units, derived per template with the pinned cocall twin."""
+    by_template: dict = {}
+    for rec in raw:
+        if str(rec.get_tag("MI")) != f"{fam}/{strand}":
+            continue
+        # role 0 merges the forward-mapped pair (99/163), role 1 the
+        # reverse pair (83/147): pick this template's read of that role
+        want = {("A", 0): 99, ("B", 0): 163, ("B", 1): 83, ("A", 1): 147}[
+            (strand, role)
+        ]
+        if rec.flag != want:
+            continue
+        by_template.setdefault(rec.qname, []).append(rec)
+    obs = []
+    for qname, recs in by_template.items():
+        # a template contributes its R1/R2 of the SAME role... the raw
+        # corpus has exactly one read per (template, flag)
+        for rec in recs:
+            codes = np.asarray(
+                ["ACGTN".index(c) for c in rec.seq], np.int8
+            )
+            quals = np.frombuffer(rec.qual, np.uint8)
+            obs.append((rec.pos, codes, quals, qname))
+    return obs
+
+
+def _cocalled_family_obs(raw, fam, strand, params):
+    """All observations of one strand family after the R1/R2 overlap
+    co-call, keyed by (role, refcol) -> list of base codes."""
+    # collect per template: role0 read + role1 read, co-call the overlap
+    templates: dict = {}
+    for rec in raw:
+        if str(rec.get_tag("MI")) != f"{fam}/{strand}":
+            continue
+        info = _FLAG_INFO.get(rec.flag)
+        if info is None or info[0] != strand:
+            continue
+        templates.setdefault(rec.qname, {})[info[1]] = rec
+    out: dict = {}
+    for qname, pair in templates.items():
+        if len(pair) != 2:
+            continue
+        lo = min(r.pos for r in pair.values())
+        hi = max(r.pos + len(r.seq) for r in pair.values())
+        w = hi - lo
+        b = np.full((1, 2, w), NBASE, np.int8)
+        q = np.zeros((1, 2, w), np.int16)
+        for role, rec in pair.items():
+            s = rec.pos - lo
+            b[0, role, s : s + len(rec.seq)] = [
+                "ACGTN".index(c) for c in rec.seq
+            ]
+            q[0, role, s : s + len(rec.seq)] = np.frombuffer(
+                rec.qual, np.uint8
+            )
+        if params.consensus_call_overlapping_bases:
+            b, q = _overlap_cocall_np(b, q)
+        observed = (b != NBASE) & (q >= params.min_input_base_quality)
+        for role in range(2):
+            for j in range(w):
+                if observed[0, role, j]:
+                    out.setdefault((role, lo + j), []).append(
+                        int(b[0, role, j])
+                    )
+    return out
+
+
+class TestExactCeEndToEnd:
+    def test_duplex_ce_matches_raw_recomputation(self, pipeline_outputs):
+        genome, raw, mol, dup, params = pipeline_outputs
+        gcodes = np.asarray(["ACGTN".index(c) for c in genome], np.int8)
+        # strand-consensus (molecular) records by (fam, strand, role):
+        # their seq is the strand read the duplex stage transforms
+        mol_by = {}
+        for rec in mol:
+            info = _FLAG_INFO.get(rec.flag)
+            if info is None:
+                continue
+            fam = str(rec.get_tag("MI")).split("/")[0]
+            mol_by[(fam, info[0], info[1])] = rec
+        checked = 0
+        for rec in dup:
+            fam = str(rec.get_tag("MI"))
+            role = 1 if rec.flag & 0x80 else 0
+            _s, cd = rec.get_tag("cd")
+            _s, ce = rec.get_tag("ce")
+            for strand in ("A", "B"):
+                srec = mol_by.get((fam, strand, role))
+                if srec is None:
+                    continue
+                obs = _cocalled_family_obs(raw, fam, strand, params)
+                convert_row = strand == "B"
+                # interior columns of the strand's raw span only
+                # (boundary columns use documented halo rules)
+                for i in range(2, len(rec.seq) - 2):
+                    col = rec.pos + i
+                    key_obs = obs.get((role, col))
+                    if key_obs is None:
+                        continue
+                    if rec.seq[i] == "N":
+                        continue
+                    j = col - srec.pos
+                    if not (0 <= j < len(srec.seq) - 1):
+                        continue
+                    call = "ACGTN".index(rec.seq[i])
+                    # conversion context of the strand consensus read
+                    nxt = np.int8("ACGTN".index(srec.seq[j + 1]))
+                    mapped = [
+                        int(
+                            convert_cell(
+                                np.int8(x), np.bool_(convert_row),
+                                gcodes[col], gcodes[col + 1], nxt,
+                                np.bool_(True),
+                            )
+                        )
+                        for x in key_obs
+                    ]
+                    want_err = sum(1 for m in mapped if m != call)
+                    # the OTHER strand contributes the rest of ce[i]:
+                    # accumulate both strands before comparing
+                    checked += 1
+                    setattr(
+                        rec, "_expect",
+                        getattr(rec, "_expect", {}),
+                    )
+                    rec._expect.setdefault(i, 0)
+                    rec._expect[i] += want_err
+        assert checked > 200
+        mismatches = []
+        for rec in dup:
+            exp = getattr(rec, "_expect", None)
+            if not exp:
+                continue
+            _s, cd = rec.get_tag("cd")
+            _s, ce = rec.get_tag("ce")
+            fam = str(rec.get_tag("MI"))
+            role = 1 if rec.flag & 0x80 else 0
+            for i, want in exp.items():
+                # only compare when BOTH strands were recomputed (a
+                # missing strand keeps its production value)
+                n_strands = sum(
+                    1
+                    for s in ("A", "B")
+                    if (fam, s, role) in
+                    {(str(m.get_tag("MI")).split("/")[0],
+                      _FLAG_INFO[m.flag][0], _FLAG_INFO[m.flag][1])
+                     for m in mol if m.flag in _FLAG_INFO}
+                )
+                if n_strands != 2:
+                    continue
+                if int(ce[i]) != want:
+                    mismatches.append((fam, role, i, int(ce[i]), want))
+        assert not mismatches, mismatches[:10]
