@@ -65,35 +65,6 @@ def pipeline_outputs():
     return genome, raw, mol, dup, params
 
 
-def _raw_obs(raw, fam, strand, role, params):
-    """Post-cocall observations (base, col) of one strand's raw reads for
-    one role, in reference coordinates — the molecular stage's
-    observation units, derived per template with the pinned cocall twin."""
-    by_template: dict = {}
-    for rec in raw:
-        if str(rec.get_tag("MI")) != f"{fam}/{strand}":
-            continue
-        # role 0 merges the forward-mapped pair (99/163), role 1 the
-        # reverse pair (83/147): pick this template's read of that role
-        want = {("A", 0): 99, ("B", 0): 163, ("B", 1): 83, ("A", 1): 147}[
-            (strand, role)
-        ]
-        if rec.flag != want:
-            continue
-        by_template.setdefault(rec.qname, []).append(rec)
-    obs = []
-    for qname, recs in by_template.items():
-        # a template contributes its R1/R2 of the SAME role... the raw
-        # corpus has exactly one read per (template, flag)
-        for rec in recs:
-            codes = np.asarray(
-                ["ACGTN".index(c) for c in rec.seq], np.int8
-            )
-            quals = np.frombuffer(rec.qual, np.uint8)
-            obs.append((rec.pos, codes, quals, qname))
-    return obs
-
-
 def _cocalled_family_obs(raw, fam, strand, params):
     """All observations of one strand family after the R1/R2 overlap
     co-call, keyed by (role, refcol) -> list of base codes."""
@@ -149,6 +120,7 @@ class TestExactCeEndToEnd:
             fam = str(rec.get_tag("MI")).split("/")[0]
             mol_by[(fam, info[0], info[1])] = rec
         checked = 0
+        expect: dict = {}  # id(duplex rec) -> {col_index: expected ce}
         for rec in dup:
             fam = str(rec.get_tag("MI"))
             role = 1 if rec.flag & 0x80 else 0
@@ -189,16 +161,12 @@ class TestExactCeEndToEnd:
                     # the OTHER strand contributes the rest of ce[i]:
                     # accumulate both strands before comparing
                     checked += 1
-                    setattr(
-                        rec, "_expect",
-                        getattr(rec, "_expect", {}),
-                    )
-                    rec._expect.setdefault(i, 0)
-                    rec._expect[i] += want_err
+                    cols = expect.setdefault(id(rec), {})
+                    cols[i] = cols.get(i, 0) + want_err
         assert checked > 200
         mismatches = []
         for rec in dup:
-            exp = getattr(rec, "_expect", None)
+            exp = expect.get(id(rec))
             if not exp:
                 continue
             _s, cd = rec.get_tag("cd")
