@@ -736,7 +736,7 @@ def _resolve_emit(emit: str, mode: str) -> str:
 
 def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
                     role_reverse, duplex, bcount=None,
-                    strand_calls=None) -> RawRecords:
+                    strand_calls=None, strand_err=None) -> RawRecords:
     """Native batch emit (io.wirepack) — byte-identical to the Python
     emit + encode_record path, minus the per-record Python."""
     from bsseqconsensusreads_tpu.io import wirepack
@@ -754,6 +754,7 @@ def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
         duplex=duplex,
         bcount=bcount,
         strand_calls=strand_calls,
+        strand_err=strand_err,
     )
     stats.families += len(batch.meta)
     stats.skipped_families += skipped
@@ -795,12 +796,16 @@ def _emit_duplex_batch_raw(batch, out, params, mode, stats) -> RawRecords:
     (+ ac/bc strand-call strings when the rawize pass derived them);
     roles are (forward, reverse) by construction."""
     sc = (out["a_call"], out["b_call"]) if "a_call" in out else None
+    se = (
+        (out["a_ss_err"], out["b_ss_err"]) if "a_ss_err" in out else None
+    )
     return _emit_batch_raw(
         batch, out, params, mode, stats,
         n_reads=np.array([m.n_templates for m in batch.meta], np.int32),
         role_reverse=np.tile(np.array([0, 1], np.uint8), (len(batch.meta), 1)),
         duplex=True,
         strand_calls=sc,
+        strand_err=se,
     )
 
 
@@ -1900,15 +1905,29 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
         raw["a_err"], raw["b_err"] = ae.astype(np.int16), be.astype(np.int16)
         raw["depth"] = (ad + bd).astype(np.int16)
         raw["errors"] = (ae + be).astype(np.int16)
+    # fgbio's ae/be tag surface: per-base STRAND-consensus error counts
+    # (raw reads disagreeing with the strand's OWN call — the placed
+    # molecular ce). Recovered from the r4 rawize mix by one formula that
+    # is also right for presence-unit rows (ad=ae=errbit there -> 0: no
+    # raw info, no claimed dissent). Computed BEFORE the exact pass
+    # overwrites a_err/b_err with errors-vs-the-DUPLEX-call.
+    for pk, ek, eb in (
+        ("a_depth", "a_err", a_errbit), ("b_depth", "b_err", b_errbit)
+    ):
+        ad_p = np.asarray(raw[pk]).astype(np.int32)
+        ae_p = np.asarray(raw[ek]).astype(np.int32)
+        raw["a_ss_err" if pk[0] == "a" else "b_ss_err"] = np.clip(
+            np.where(eb, ad_p - ae_p, ae_p), 0, None
+        ).astype(np.int16)
     if calls is not None and ex_has.any():
         raw = _exact_strand_errors(
-            raw, batch, (a_pres, b_pres), (a_errbit, b_errbit), calls, ref,
+            raw, batch, (a_pres, b_pres), calls, ref,
             w, ex_has, ex_fi, ex_row, ex_off, ex_cbs,
         )
     return raw
 
 
-def _exact_strand_errors(out: dict, batch, presence, errbits, calls, ref,
+def _exact_strand_errors(out: dict, batch, presence, calls, ref,
                          w: int, has, e_fi, e_row, e_off, cbs) -> dict:
     """Pass 3 of _duplex_rawize: exact per-strand raw error counts.
 
@@ -1921,8 +1940,9 @@ def _exact_strand_errors(out: dict, batch, presence, errbits, calls, ref,
                 + sum of dissent cells whose conversion-mapped base
                   equals the duplex call   <- sparse scatter
 
-    placed_ce is recovered from the r4 rawize output (it is ad - ae
-    where the err bit was set, ae otherwise), and the strand's converted
+    placed_ce is the a_ss_err/b_ss_err plane _duplex_rawize stored just
+    before this pass (the same quantity the ae/be tags emit — ONE
+    derivation of the r4 err-bit inversion), and the strand's converted
     call is the already-computed ac/bc plane (ops.hosttwin twin of the
     device transform) — so the hot path is a handful of [F, 2, W] plane
     ops plus work proportional to the number of DISSENT cells, not to
@@ -1986,19 +2006,16 @@ def _exact_strand_errors(out: dict, batch, presence, errbits, calls, ref,
             v_e[match],
         )
     a_pres, b_pres = presence
-    a_eb, b_eb = errbits
     for role, (a_row, b_row) in enumerate(ROLE_STRAND_ROWS):
-        for srow, dkey, ekey, pres, ebit in (
-            (a_row, "a_depth", "a_err", a_pres, a_eb),
-            (b_row, "b_depth", "b_err", b_pres, b_eb),
+        for srow, dkey, ekey, sskey, pres in (
+            (a_row, "a_depth", "a_err", "a_ss_err", a_pres),
+            (b_row, "b_depth", "b_err", "b_ss_err", b_pres),
         ):
             hb = has[:, srow]
             if not hb.any():
                 continue
             ad = np.asarray(out[dkey])[:, role, :].astype(np.int32)
-            ae_c = np.asarray(out[ekey])[:, role, :].astype(np.int32)
-            eb = ebit[:, role, :]
-            placed_ce = np.where(eb, ad - ae_c, ae_c)
+            placed_ce = np.asarray(out[sskey])[:, role, :].astype(np.int32)
             agree = calls[:, srow, :] == base[:, role, :]
             cnt = np.where(agree, ad - placed_ce, 0) + dissent[:, srow, :]
             prole = pres[:, role, :]
@@ -2064,8 +2081,27 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             tags["bD"] = ("i", int(b_cov.max()))
             tags["aM"] = ("i", int(a_cov.min()))
             tags["bM"] = ("i", int(b_cov.min()))
+            if "a_ss_err" in out:
+                # fgbio's per-strand error surface: aE/bE read-level
+                # rates + ae/be per-base counts, in STRAND-vs-own-call
+                # units (the placed molecular ce — _duplex_rawize)
+                a_se = np.asarray(out["a_ss_err"])[fi, role, sl]
+                b_se = np.asarray(out["b_ss_err"])[fi, role, sl]
+                if flip:
+                    a_se, b_se = a_se[::-1], b_se[::-1]
+                a_tot = int(a_cov.sum(dtype=np.int64))
+                b_tot = int(b_cov.sum(dtype=np.int64))
+                tags["aE"] = (
+                    "f", int(a_se.sum(dtype=np.int64)) / a_tot if a_tot else 0.0
+                )
+                tags["bE"] = (
+                    "f", int(b_se.sum(dtype=np.int64)) / b_tot if b_tot else 0.0
+                )
             tags["ad"] = ("B", ("S", a_cov.tolist()))
             tags["bd"] = ("B", ("S", b_cov.tolist()))
+            if "a_ss_err" in out:
+                tags["ae"] = ("B", ("S", a_se.tolist()))
+                tags["be"] = ("B", ("S", b_se.tolist()))
             if "a_call" in out:
                 # per-strand consensus call strings (fgbio's ac/bc surface):
                 # what each strand actually voted in the merge, N where the

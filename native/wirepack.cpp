@@ -245,6 +245,8 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 //   base int8 (framework codes), qual uint8, depth int16, errors int16,
 //   a_depth/b_depth int16 or NULL (duplex per-strand tags when present —
 //   int16 because raw strand depths from _duplex_rawize exceed int8),
+//   a_ss_err/b_ss_err int16 or NULL (per-strand errors vs the strand's
+//   OWN call -> aE/bE float rates + ae/be B:S arrays),
 //   bcount uint16 [f, 2, 4, w] or NULL (molecular cB raw base histogram,
 //   4 plane-major runs per record), a_call/b_call int8 [f, 2, w] or NULL
 //   (duplex per-strand consensus call codes -> ac/bc Z tags).
@@ -259,11 +261,13 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 // raises for the same input — silent truncation would corrupt the record
 // stream). n_records/n_skipped report emitted records and
 // min_reads-skipped families for StageStats.
-// (Symbol versioned _v2: the cB/ac/bc tag surface — a stale built library
-// must fail symbol lookup and rebuild, not silently emit the old tags.)
-int wirepack_emit_consensus_records_v2(
+// (Symbol versioned _v3: v2 added the cB/ac/bc tag surface, v3 the
+// aE/bE/ae/be strand-error surface — a stale built library must fail
+// symbol lookup and rebuild, not silently emit the old tags.)
+int wirepack_emit_consensus_records_v3(
     const int8_t* base, const uint8_t* qual, const int16_t* depth,
     const int16_t* errors, const int16_t* a_depth, const int16_t* b_depth,
+    const int16_t* a_ss_err, const int16_t* b_ss_err,
     const uint16_t* bcount, const int8_t* a_call, const int8_t* b_call,
     int64_t f, int64_t w, const int32_t* ref_id, const int64_t* window_start,
     const int32_t* n_reads, const uint8_t* role_reverse,
@@ -470,8 +474,32 @@ int wirepack_emit_consensus_records_v2(
         put_int_tag(c, "bD", bmax);
         put_int_tag(c, "aM", amin);
         put_int_tag(c, "bM", bmin);
+        if (a_ss_err != nullptr && b_ss_err != nullptr) {
+          // aE/bE: strand error RATES vs the strand's own call (sum of
+          // the ae/be arrays over the span / strand depth), mirroring
+          // pipeline.calling._emit_duplex_batch
+          const int16_t* aser = a_ss_err + row + lo0;
+          const int16_t* bser = b_ss_err + row + lo0;
+          int64_t atot = 0, btot = 0, asum = 0, bsum = 0;
+          for (int64_t i = 0; i < n; ++i) {
+            atot += arow[i];
+            btot += brow[i];
+            asum += aser[i];
+            bsum += bser[i];
+          }
+          c.put_bytes("aE", 2);
+          c.put_u8('f');
+          c.put_f32(atot ? float(double(asum) / double(atot)) : 0.0f);
+          c.put_bytes("bE", 2);
+          c.put_u8('f');
+          c.put_f32(btot ? float(double(bsum) / double(btot)) : 0.0f);
+        }
         put_arr_tag(c, "ad", arow, n, flip);
         put_arr_tag(c, "bd", brow, n, flip);
+        if (a_ss_err != nullptr && b_ss_err != nullptr) {
+          put_arr_tag(c, "ae", a_ss_err + row + lo0, n, flip);
+          put_arr_tag(c, "be", b_ss_err + row + lo0, n, flip);
+        }
         if (a_call != nullptr && b_call != nullptr) {
           // ac/bc: per-strand consensus call strings (fgbio surface);
           // codes -> ACGTN, mirroring ops.encode.codes_to_seq —

@@ -199,6 +199,22 @@ class TestExactDuplexCe:
         _s, ce = rec.get_tag("ce")
         assert int(ce[k]) == 2  # cd_A - ce_A = 3 - 1 (documented fallback)
 
+    def test_strand_error_tags(self, tmp_path):
+        """fgbio's ae/be per-base arrays carry STRAND-vs-own-call units
+        (the placed molecular ce), distinct from the duplex-level ce:
+        strand A's dissenter is 1 error vs the A call everywhere, strand
+        B none; aE/bE are the corresponding read-level rates."""
+        genome, _header, recs, k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs)
+        rec = [r for r in out if r.flag & 0x40][0]
+        _s, ae = rec.get_tag("ae")
+        _s, be = rec.get_tag("be")
+        _s, ad = rec.get_tag("ad")
+        assert int(ae[k]) == 1 and int(be[k]) == 0
+        a_rate = float(rec.get_tag("aE"))
+        assert abs(a_rate - sum(ae) / sum(ad)) < 1e-6
+        assert float(rec.get_tag("bE")) == 0.0
+
     def test_strand_call_tags(self, tmp_path):
         genome, _header, recs, k = _duplex_family(tmp_path)
         out = _run_duplex(genome, recs)
