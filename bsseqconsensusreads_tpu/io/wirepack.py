@@ -41,7 +41,7 @@ def _try_load():
             "wirepack_unpack_duplex_b0",
             "wirepack_duplex_rawize",
             "wirepack_duplex_retire",
-            "wirepack_emit_consensus_records_v3",
+            "wirepack_emit_consensus_records_v4",
         ),
     )
     if lib is None:
@@ -80,10 +80,10 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_void_p,
     ]
-    lib.wirepack_emit_consensus_records_v3.restype = C.c_int
-    lib.wirepack_emit_consensus_records_v3.argtypes = (
-        # planes: base..b_depth, a/b_ss_err, bcount, a_call, b_call
-        [C.c_void_p] * 11
+    lib.wirepack_emit_consensus_records_v4.restype = C.c_int
+    lib.wirepack_emit_consensus_records_v4.argtypes = (
+        # planes: base..b_depth, a/b_ss_err, ss_valid, bcount, a/b_call
+        [C.c_void_p] * 12
         + [C.c_int64, C.c_int64]
         + [C.c_void_p] * 10
         + [C.c_int, C.c_int, C.c_void_p, C.c_int64]
@@ -359,12 +359,13 @@ def emit_consensus_records(
 
     out: dict of [f, 2, w] arrays (base int8, qual uint8, depth/errors
     int16, plus a_depth/b_depth int16 when duplex). Per-family metadata as
-    documented on wirepack_emit_consensus_records_v3 (native/wirepack.cpp).
+    documented on wirepack_emit_consensus_records_v4 (native/wirepack.cpp).
     rx entries may be "" (no RX tag). bcount (uint16 [f, 2, 4, w]) adds
     the molecular cB histogram tag; strand_calls ((a_call, b_call) int8
     [f, 2, w]) adds the duplex ac/bc strand-call string tags; strand_err
-    ((a_ss_err, b_ss_err) int16 [f, 2, w]) adds the fgbio aE/bE rates +
-    ae/be per-base strand-error arrays. Returns
+    ((a_ss_err, b_ss_err, ss_valid) — int16 [f, 2, w] x2 + bool [f, 2])
+    adds the fgbio aE/bE rates + ae/be per-base strand-error arrays on
+    records whose ss_valid gate is set. Returns
     (record bytes, n_records, n_families_skipped); the bytes are ready
     for BamWriter.write_raw — byte-identical to the Python emit +
     encode_record path
@@ -400,10 +401,12 @@ def emit_consensus_records(
     if strand_err is not None:
         a_se = np.ascontiguousarray(strand_err[0], dtype=np.int16)
         b_se = np.ascontiguousarray(strand_err[1], dtype=np.int16)
+        ss_valid = np.ascontiguousarray(strand_err[2], dtype=np.uint8)
         ase_ptr = a_se.ctypes.data_as(C.c_void_p)
         bse_ptr = b_se.ctypes.data_as(C.c_void_p)
+        ssv_ptr = ss_valid.ctypes.data_as(C.c_void_p)
     else:
-        ase_ptr = bse_ptr = None
+        ase_ptr = bse_ptr = ssv_ptr = None
     ref_id = np.ascontiguousarray(ref_id, dtype=np.int32)
     window_start = np.ascontiguousarray(window_start, dtype=np.int64)
     n_reads = np.ascontiguousarray(n_reads, dtype=np.int32)
@@ -424,12 +427,13 @@ def emit_consensus_records(
     out_len = C.c_int64(0)
     n_records = C.c_int64(0)
     n_skipped = C.c_int64(0)
-    rc = _lib.wirepack_emit_consensus_records_v3(
+    rc = _lib.wirepack_emit_consensus_records_v4(
         base.ctypes.data_as(C.c_void_p),
         qual.ctypes.data_as(C.c_void_p),
         depth.ctypes.data_as(C.c_void_p),
         errors.ctypes.data_as(C.c_void_p),
-        a_ptr, b_ptr, ase_ptr, bse_ptr, bc_ptr, ac_ptr, bcall_ptr, f, w,
+        a_ptr, b_ptr, ase_ptr, bse_ptr, ssv_ptr, bc_ptr, ac_ptr, bcall_ptr,
+        f, w,
         ref_id.ctypes.data_as(C.c_void_p),
         window_start.ctypes.data_as(C.c_void_p),
         n_reads.ctypes.data_as(C.c_void_p),

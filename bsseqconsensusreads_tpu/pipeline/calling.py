@@ -797,7 +797,8 @@ def _emit_duplex_batch_raw(batch, out, params, mode, stats) -> RawRecords:
     roles are (forward, reverse) by construction."""
     sc = (out["a_call"], out["b_call"]) if "a_call" in out else None
     se = (
-        (out["a_ss_err"], out["b_ss_err"]) if "a_ss_err" in out else None
+        (out["a_ss_err"], out["b_ss_err"], out["ss_valid"])
+        if "a_ss_err" in out else None
     )
     return _emit_batch_raw(
         batch, out, params, mode, stats,
@@ -1817,12 +1818,14 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
     # exact-pass entry collection rides the SAME family walk as the
     # rawize assembly (one _sidecar_rows_for per family)
     ex_has = np.zeros((f, 4), bool)
+    raw_rows = np.zeros((f, 4), bool)  # rows with sidecar cd (raw units)
     ex_fi: list[int] = []
     ex_row: list[int] = []
     ex_off: list[int] = []
     ex_cbs: list[np.ndarray] = []
 
     def collect_exact(fi, row, pos, wstart, cb) -> None:
+        raw_rows[fi, row] = True
         if cb is None:
             return
         ex_has[fi, row] = True
@@ -1907,10 +1910,12 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
         raw["errors"] = (ae + be).astype(np.int16)
     # fgbio's ae/be tag surface: per-base STRAND-consensus error counts
     # (raw reads disagreeing with the strand's OWN call — the placed
-    # molecular ce). Recovered from the r4 rawize mix by one formula that
-    # is also right for presence-unit rows (ad=ae=errbit there -> 0: no
-    # raw info, no claimed dissent). Computed BEFORE the exact pass
-    # overwrites a_err/b_err with errors-vs-the-DUPLEX-call.
+    # molecular ce), recovered from the r4 rawize mix. Computed BEFORE
+    # the exact pass overwrites a_err/b_err with errors-vs-the-DUPLEX-
+    # call. ss_valid gates emission per (family, role): a COVERED strand
+    # without sidecar cd (foreign presence-unit input) has no raw error
+    # information, and the tags are OMITTED there (PARITY.md row 5)
+    # rather than claiming a measured zero.
     for pk, ek, eb in (
         ("a_depth", "a_err", a_errbit), ("b_depth", "b_err", b_errbit)
     ):
@@ -1919,6 +1924,14 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
         raw["a_ss_err" if pk[0] == "a" else "b_ss_err"] = np.clip(
             np.where(eb, ad_p - ae_p, ae_p), 0, None
         ).astype(np.int16)
+    ss_valid = np.zeros((f, 2), bool)
+    for role, (a_row, b_row) in enumerate(ROLE_STRAND_ROWS):
+        a_any = a_pres[:, role, :].any(axis=1)
+        b_any = b_pres[:, role, :].any(axis=1)
+        ss_valid[:, role] = (raw_rows[:, a_row] | ~a_any) & (
+            raw_rows[:, b_row] | ~b_any
+        )
+    raw["ss_valid"] = ss_valid
     if calls is not None and ex_has.any():
         raw = _exact_strand_errors(
             raw, batch, (a_pres, b_pres), calls, ref,
@@ -2081,10 +2094,14 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             tags["bD"] = ("i", int(b_cov.max()))
             tags["aM"] = ("i", int(a_cov.min()))
             tags["bM"] = ("i", int(b_cov.min()))
-            if "a_ss_err" in out:
+            emit_ss = "a_ss_err" in out and bool(
+                np.asarray(out["ss_valid"])[fi, role]
+            )
+            if emit_ss:
                 # fgbio's per-strand error surface: aE/bE read-level
                 # rates + ae/be per-base counts, in STRAND-vs-own-call
-                # units (the placed molecular ce — _duplex_rawize)
+                # units (the placed molecular ce — _duplex_rawize);
+                # omitted when a covered strand lacks raw units
                 a_se = np.asarray(out["a_ss_err"])[fi, role, sl]
                 b_se = np.asarray(out["b_ss_err"])[fi, role, sl]
                 if flip:
@@ -2099,7 +2116,7 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
                 )
             tags["ad"] = ("B", ("S", a_cov.tolist()))
             tags["bd"] = ("B", ("S", b_cov.tolist()))
-            if "a_ss_err" in out:
+            if emit_ss:
                 tags["ae"] = ("B", ("S", a_se.tolist()))
                 tags["be"] = ("B", ("S", b_se.tolist()))
             if "a_call" in out:

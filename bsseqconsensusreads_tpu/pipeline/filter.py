@@ -14,16 +14,18 @@ Semantics (from the fgbio tool's published docs, not its source):
   ``min_reads`` (a 1-3 value triplet ``M [A B]``; for duplex reads the
   total, larger-strand, and smaller-strand depths are tested against
   M/A/B respectively, using the cD / aD / bD tags) or its error rate
-  (cE) exceeds ``max_read_error_rate``; optionally when its mean base
-  quality is below ``min_mean_base_quality``.  If any read of a
-  template fails, the WHOLE template is dropped — consensus BAMs must
-  stay pair-complete.
+  exceeds ``max_read_error_rate`` — tested against the duplex cE AND
+  each single-strand rate (aE/bE) when present, as fgbio does;
+  optionally when its mean base quality is below
+  ``min_mean_base_quality``.  If any read of a template fails, the
+  WHOLE template is dropped — consensus BAMs must stay pair-complete.
 * base-level: a base is MASKED to N (qual 2) when its per-base depth
   (cd, and ad/bd for duplex, against the same M/A/B triplet) falls
-  short, its per-base error rate (ce/cd) exceeds
-  ``max_base_error_rate``, or its quality is below
-  ``min_base_quality``.  After masking, reads whose no-call fraction
-  exceeds ``max_no_call_fraction`` are dropped (with their mates).
+  short, its per-base error rate (ce/cd, and the per-strand ae/ad,
+  be/bd on duplex input) exceeds ``max_base_error_rate``, or its
+  quality is below ``min_base_quality``.  After masking, reads whose
+  no-call fraction exceeds ``max_no_call_fraction`` are dropped (with
+  their mates).
 
 Deviations (documented per the §7.3 mandate):
 
@@ -130,6 +132,7 @@ def _evaluate(
             "consensus output (CallMolecular/CallDuplex equivalents)"
         )
     ad, bd = _tag_array(rec, "ad"), _tag_array(rec, "bd")
+    ad_lab, bd_lab = ad, bd  # strand-LABELED refs (pre depth-swap)
     duplex = ad is not None and bd is not None
     if duplex and int(bd.sum()) > int(ad.sum()):
         # fgbio assigns the A threshold to the deeper strand PER READ
@@ -148,6 +151,15 @@ def _evaluate(
         return False, "depth", None
     if rec.has_tag("cE") and float(rec.get_tag("cE")) > params.max_read_error_rate:
         return False, "error_rate", None
+    # fgbio applies the read-level error threshold to the duplex AND each
+    # single-strand consensus (aE/bE — emitted by this framework's duplex
+    # stage in strand-vs-own-call units, r5)
+    if duplex:
+        for key in ("aE", "bE"):
+            if rec.has_tag(key) and (
+                float(rec.get_tag(key)) > params.max_read_error_rate
+            ):
+                return False, "error_rate", None
     qual = np.frombuffer(rec.qual, dtype=np.uint8) if rec.qual else np.zeros(0, np.uint8)
     if (
         params.min_mean_base_quality is not None
@@ -170,6 +182,24 @@ def _evaluate(
         with np.errstate(divide="ignore", invalid="ignore"):
             rate = np.where(cd[:Le] > 0, ce[:Le] / np.maximum(cd[:Le], 1), 1.0)
         mask[:Le] |= rate > params.max_base_error_rate
+    if duplex:
+        # per-strand base error rates (ae/ad, be/bd) against the same
+        # threshold — fgbio masks a base when EITHER strand's consensus
+        # exceeds it (positions a strand does not cover pass: no rate).
+        # Paired by STRAND LABEL (the depth-floor ad/bd above may have
+        # been swapped deeper-strand-first).
+        for ekey, darr in (("ae", ad_lab), ("be", bd_lab)):
+            earr = _tag_array(rec, ekey)
+            if earr is None or darr is None:
+                continue
+            Ls = min(n, len(earr), len(darr))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                srate = np.where(
+                    darr[:Ls] > 0,
+                    earr[:Ls] / np.maximum(darr[:Ls], 1),
+                    0.0,
+                )
+            mask[:Ls] |= srate > params.max_base_error_rate
     if params.require_single_strand_agreement and duplex:
         # fgbio -s: mask duplex bases where the two single-strand
         # consensus calls disagree. The ac/bc strand-call strings are the

@@ -246,7 +246,9 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 //   a_depth/b_depth int16 or NULL (duplex per-strand tags when present —
 //   int16 because raw strand depths from _duplex_rawize exceed int8),
 //   a_ss_err/b_ss_err int16 or NULL (per-strand errors vs the strand's
-//   OWN call -> aE/bE float rates + ae/be B:S arrays),
+//   OWN call -> aE/bE float rates + ae/be B:S arrays), ss_valid uint8
+//   [f, 2] or NULL (per-record gate: covered strands without raw units
+//   OMIT the quartet instead of claiming zero errors),
 //   bcount uint16 [f, 2, 4, w] or NULL (molecular cB raw base histogram,
 //   4 plane-major runs per record), a_call/b_call int8 [f, 2, w] or NULL
 //   (duplex per-strand consensus call codes -> ac/bc Z tags).
@@ -261,13 +263,15 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
 // raises for the same input — silent truncation would corrupt the record
 // stream). n_records/n_skipped report emitted records and
 // min_reads-skipped families for StageStats.
-// (Symbol versioned _v3: v2 added the cB/ac/bc tag surface, v3 the
-// aE/bE/ae/be strand-error surface — a stale built library must fail
-// symbol lookup and rebuild, not silently emit the old tags.)
-int wirepack_emit_consensus_records_v3(
+// (Symbol versioned _v4: v2 added the cB/ac/bc tag surface, v3 the
+// aE/bE/ae/be strand-error surface, v4 its ss_valid gate — a stale built
+// library must fail symbol lookup and rebuild, not silently emit the old
+// tags.)
+int wirepack_emit_consensus_records_v4(
     const int8_t* base, const uint8_t* qual, const int16_t* depth,
     const int16_t* errors, const int16_t* a_depth, const int16_t* b_depth,
     const int16_t* a_ss_err, const int16_t* b_ss_err,
+    const uint8_t* ss_valid,
     const uint16_t* bcount, const int8_t* a_call, const int8_t* b_call,
     int64_t f, int64_t w, const int32_t* ref_id, const int64_t* window_start,
     const int32_t* n_reads, const uint8_t* role_reverse,
@@ -474,7 +478,10 @@ int wirepack_emit_consensus_records_v3(
         put_int_tag(c, "bD", bmax);
         put_int_tag(c, "aM", amin);
         put_int_tag(c, "bM", bmin);
-        if (a_ss_err != nullptr && b_ss_err != nullptr) {
+        const bool emit_ss =
+            a_ss_err != nullptr && b_ss_err != nullptr &&
+            (ss_valid == nullptr || ss_valid[fi * 2 + role] != 0);
+        if (emit_ss) {
           // aE/bE: strand error RATES vs the strand's own call (sum of
           // the ae/be arrays over the span / strand depth), mirroring
           // pipeline.calling._emit_duplex_batch
@@ -496,7 +503,7 @@ int wirepack_emit_consensus_records_v3(
         }
         put_arr_tag(c, "ad", arow, n, flip);
         put_arr_tag(c, "bd", brow, n, flip);
-        if (a_ss_err != nullptr && b_ss_err != nullptr) {
+        if (emit_ss) {
           put_arr_tag(c, "ae", a_ss_err + row + lo0, n, flip);
           put_arr_tag(c, "be", b_ss_err + row + lo0, n, flip);
         }

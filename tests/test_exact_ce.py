@@ -315,6 +315,12 @@ class TestMixedBatches:
         assert int(ce8[k]) == 2  # r4 rule (no cB)
         _s, cd9 = by_mi["9"].get_tag("cd")
         assert int(cd9[k]) == 2  # presence units (no tags at all)
+        # strand-error quartet: present on raw-unit families, OMITTED on
+        # the presence-unit family (no raw info — claiming aE=0 would
+        # pass fgbio error filters it never measured against)
+        assert by_mi["7"].has_tag("ae") and by_mi["7"].has_tag("aE")
+        assert not by_mi["9"].has_tag("ae")
+        assert not by_mi["9"].has_tag("aE")
 
 
 class TestUnalignedOrientation:
@@ -505,6 +511,34 @@ class TestSingleStrandAgreementFilter:
         kept = list(filter_consensus(iter(out), params))
         rec = [r for r in kept if r.flag & 0x40][0]
         assert rec.seq[k] == "T"
+
+    def test_strand_error_rate_drop(self, tmp_path):
+        """fgbio applies --max-read-error-rate to each single-strand
+        consensus too: strand A's aE (1 dissenter of 3 raw reads per
+        column) trips a threshold the duplex-level cE would pass."""
+        genome, _header, recs, _k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs)
+        rec = [r for r in out if r.flag & 0x40][0]
+        a_rate = float(rec.get_tag("aE"))
+        assert a_rate > 0.25  # 1/3 dissent on every strand-A column
+        params = FilterParams(
+            min_reads=(1,), max_read_error_rate=0.25,
+            max_base_error_rate=1.0, max_no_call_fraction=1.0,
+        )
+        kept = list(filter_consensus(iter(out), params))
+        assert not kept  # strand-level rate drops the template
+
+    def test_strand_base_error_rate_masks(self, tmp_path):
+        genome, _header, recs, k = _duplex_family(tmp_path)
+        out = _run_duplex(genome, recs)
+        params = FilterParams(
+            min_reads=(1,), max_read_error_rate=1.0,
+            max_base_error_rate=0.3,  # strand A: ae/ad = 1/3 > 0.3
+            max_no_call_fraction=1.0,
+        )
+        kept = list(filter_consensus(iter(out), params))
+        rec = [r for r in kept if r.flag & 0x40][0]
+        assert rec.seq[k] == "N"  # masked by the strand-A base rate
 
     def test_missing_tags_raise(self, tmp_path):
         genome, _header, recs, _k = _duplex_family(tmp_path)
