@@ -20,16 +20,20 @@ the steps its users run around it:
 * sort / zipper / sam-to-fastq / filter-mapped — the standalone
               fgbio SortBam / ZipperBams / Picard SamToFastq /
               `samtools view -F 4` equivalents
+* observe   — run-ledger consumer (utils.ledger_tools): `summarize` a
+              BSSEQ_TPU_STATS ledger into per-stage host/device/stall
+              tables, `diff` two ledgers, `check` schema + the
+              ledger-closure invariant (non-zero exit on violation)
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import sys
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.utils import observe
 
 
 def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
@@ -119,7 +123,7 @@ def cmd_run(args) -> int:
     )
     for r in results:
         status = "ran" if r.ran else "skip"
-        print(f"[{status}] {r.name} ({r.seconds:.2f}s) {r.reason}", file=sys.stderr)
+        observe.stderr_line(f"[{status}] {r.name} ({r.seconds:.2f}s) {r.reason}")
     print(
         json.dumps(
             {
@@ -139,7 +143,8 @@ def cmd_molecular(args) -> int:
     )
     from bsseqconsensusreads_tpu.pipeline.stages import molecular_ingest_stream
 
-    stats = StageStats()
+    observe.open_ledger(component="molecular-cli")
+    stats = StageStats(stage="molecular")
     with BamReader(args.input) as reader:
         batches = call_molecular_batches(
             molecular_ingest_stream(
@@ -162,7 +167,9 @@ def cmd_molecular(args) -> int:
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
         write_batch_stream(batches, args.output, reader.header, args.mode)
-    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    observe.emit_stage_stats({"molecular": stats})
+    observe.flush_sinks()
+    observe.stderr_line(json.dumps(stats.as_dict()))
     return 0
 
 
@@ -176,7 +183,8 @@ def cmd_duplex(args) -> int:
 
     from bsseqconsensusreads_tpu.pipeline.stages import duplex_ingest_stream
 
-    stats = StageStats()
+    observe.open_ledger(component="duplex-cli")
+    stats = StageStats(stage="duplex")
     fasta = FastaFile(args.reference)
     with BamReader(args.input) as reader:
         names = [n for n, _ in reader.header.references]
@@ -204,7 +212,9 @@ def cmd_duplex(args) -> int:
         from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
         write_batch_stream(batches, args.output, reader.header, args.mode)
-    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    observe.emit_stage_stats({"duplex": stats})
+    observe.flush_sinks()
+    observe.stderr_line(json.dumps(stats.as_dict()))
     return 0
 
 
@@ -230,7 +240,7 @@ def cmd_sort(args) -> int:
     with BamReader(args.input) as reader:
         header = reader.header.with_sort_order(so, ss)
         n = sorted_write(reader, key, args.output, header)
-    print(json.dumps({"records": n, "order": args.order}), file=sys.stderr)
+    observe.stderr_line(json.dumps({"records": n, "order": args.order}))
     return 0
 
 
@@ -258,7 +268,7 @@ def cmd_group(args) -> int:
                     stats=stats,
                 )
             )
-    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    observe.stderr_line(json.dumps(stats.as_dict()))
     return 0
 
 
@@ -309,7 +319,7 @@ def cmd_filter_consensus(args) -> int:
         with BamWriter(args.output, header) as w:
             for rec in filter_consensus(reader, params, stats=stats):
                 w.write(rec)
-    print(json.dumps(stats.as_dict()), file=sys.stderr)
+    observe.stderr_line(json.dumps(stats.as_dict()))
     return 0
 
 
@@ -327,7 +337,7 @@ def cmd_zipper(args) -> int:
             for rec in zipper_bams_stream(aligned, unaligned, header):
                 w.write(rec)
                 n += 1
-    print(json.dumps({"records": n}), file=sys.stderr)
+    observe.stderr_line(json.dumps({"records": n}))
     return 0
 
 
@@ -348,7 +358,7 @@ def cmd_sam_to_fastq(args) -> int:
             external_sort(reader, name_key, reader.header),
             args.fq1, args.fq2,
         )
-    print(json.dumps({"r1": n1, "r2": n2}), file=sys.stderr)
+    observe.stderr_line(json.dumps({"r1": n1, "r2": n2}))
     return 0
 
 
@@ -363,7 +373,41 @@ def cmd_filter_mapped(args) -> int:
             for rec in filter_mapped(reader):
                 w.write(rec)
                 n += 1
-    print(json.dumps({"records": n}), file=sys.stderr)
+    observe.stderr_line(json.dumps({"records": n}))
+    return 0
+
+
+def cmd_observe(args) -> int:
+    """Run-ledger consumer (utils.ledger_tools): summarize / diff / check
+    over BSSEQ_TPU_STATS JSONL ledgers. `check` exits non-zero on any
+    schema or closure-invariant violation so CI and round verdicts can
+    gate on ledger integrity instead of re-deriving the numbers."""
+    from bsseqconsensusreads_tpu.utils import ledger_tools
+
+    try:
+        if args.op == "summarize":
+            s = ledger_tools.summarize_ledger(
+                args.ledger, rel_tol=args.tolerance
+            )
+            print(ledger_tools.format_summary(s))
+            return 0 if s.ok else 1
+        if args.op == "diff":
+            a = ledger_tools.summarize_ledger(args.ledger_a)
+            b = ledger_tools.summarize_ledger(args.ledger_b)
+            print(ledger_tools.format_diff(a, b))
+            return 0
+        problems = ledger_tools.check_ledger(
+            args.ledger, rel_tol=args.tolerance
+        )
+    except ledger_tools.LedgerError as exc:
+        observe.stderr_line(f"observe {args.op}: {exc}")
+        return 2
+    if problems:
+        for p in problems:
+            observe.stderr_line(f"observe check: {p}")
+        print(json.dumps({"ok": False, "problems": len(problems)}))
+        return 1
+    print(json.dumps({"ok": True, "problems": 0}))
     return 0
 
 
@@ -497,6 +541,38 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-i", "--input", required=True)
     p.add_argument("-o", "--output", required=True)
     p.set_defaults(fn=cmd_filter_mapped)
+
+    p = sub.add_parser(
+        "observe",
+        help="run-ledger tools: summarize / diff / check a "
+        "BSSEQ_TPU_STATS JSONL ledger",
+    )
+    op = p.add_subparsers(dest="op", required=True)
+    s = op.add_parser(
+        "summarize",
+        help="per-stage host/device/stall/chip_busy table + rule walls "
+        "+ closure verdict",
+    )
+    s.add_argument("ledger", help="ledger JSONL path")
+    s.add_argument(
+        "--tolerance", type=float, default=0.15,
+        help="relative closure tolerance (unattributed share of the wall)",
+    )
+    s.set_defaults(fn=cmd_observe)
+    d = op.add_parser(
+        "diff", help="two ledgers side by side with B/A ratios"
+    )
+    d.add_argument("ledger_a")
+    d.add_argument("ledger_b")
+    d.set_defaults(fn=cmd_observe)
+    c = op.add_parser(
+        "check",
+        help="schema + ledger-closure validation; non-zero exit on "
+        "violation",
+    )
+    c.add_argument("ledger")
+    c.add_argument("--tolerance", type=float, default=0.15)
+    c.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
     return args.fn(args)

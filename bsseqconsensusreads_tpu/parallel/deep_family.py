@@ -31,7 +31,11 @@ from bsseqconsensusreads_tpu.models.molecular import (
     vote_partials,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
-from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+from bsseqconsensusreads_tpu.parallel.mesh import (
+    DATA_AXIS,
+    READS_AXIS,
+    shard_map,
+)
 
 
 @functools.lru_cache(maxsize=16)
@@ -44,7 +48,7 @@ def deep_family_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams(
     out_spec = P(DATA_AXIS)
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=(in_spec, in_spec), out_specs=out_spec)
+    @shard_map(mesh=mesh, in_specs=(in_spec, in_spec), out_specs=out_spec)
     def fn(bases, quals):
         quals = quals.astype(jnp.float32)
         if params.consensus_call_overlapping_bases:

@@ -13,6 +13,26 @@ DATA_AXIS = "data"
 READS_AXIS = "reads"
 
 
+def shard_map(mesh, in_specs, out_specs, check_vma: bool | None = None):
+    """Version-portable `jax.shard_map` decorator.
+
+    jax moved shard_map out of jax.experimental (and renamed check_rep to
+    check_vma) across the versions this framework targets; this is the ONE
+    resolution both the family-sharding wrappers and the deep-family
+    reduction decorate through."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return lambda f: _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
 def make_mesh(
     n_data: int | None = None,
     n_reads: int = 1,

@@ -24,12 +24,96 @@ makes every helper a thin alias of the parallel.mesh equivalents.
 
 from __future__ import annotations
 
+import os
+import threading
+import time
+
 import numpy as np
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+from bsseqconsensusreads_tpu.utils import observe
+
+
+class WorkerHeartbeat:
+    """Per-process liveness for multi-host runs: 'worker_heartbeat' ledger
+    events carrying (process_index, process_count, seq, phase).
+
+    A stalled host in a multi-host job is invisible from the other hosts'
+    logs — the coordinator only notices at the next collective. beat() is
+    called at the cross-host synchronization points (distributed init,
+    per-batch global assembly); start() adds a daemon thread beating every
+    BSSEQ_TPU_HEARTBEAT_S seconds (default 30) so even a host wedged
+    outside the batch loop keeps announcing itself. All emission rides the
+    run ledger: free when BSSEQ_TPU_STATS is unset."""
+
+    def __init__(self, component: str = "multihost"):
+        self.component = component
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @staticmethod
+    def _process_info() -> tuple[int, int]:
+        try:
+            return jax.process_index(), jax.process_count()
+        except Exception:  # noqa: BLE001 — liveness must never crash a run
+            return 0, 1
+
+    def beat(self, phase: str = "alive", **extra) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        pi, pc = self._process_info()
+        observe.emit(
+            "worker_heartbeat",
+            {
+                "component": self.component,
+                "process_index": pi,
+                "process_count": pc,
+                "seq": seq,
+                "phase": phase,
+                **extra,
+            },
+        )
+
+    def start(self, interval_s: float | None = None) -> None:
+        if self._thread is not None:
+            return
+        if interval_s is None:
+            try:
+                interval_s = float(os.environ.get("BSSEQ_TPU_HEARTBEAT_S", 30))
+            except ValueError:
+                interval_s = 30.0
+
+        def run() -> None:
+            while not self._stop.wait(interval_s):
+                self.beat("alive")
+
+        self._thread = threading.Thread(
+            target=run, name="bsseq-heartbeat", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._stop = threading.Event()
+
+
+#: Module-level heartbeat the multihost helpers beat through; jobs wanting
+#: the periodic announcer call heartbeat().start() after init_distributed.
+_HEARTBEAT = WorkerHeartbeat()
+
+
+def heartbeat() -> WorkerHeartbeat:
+    """This process's multihost heartbeat (ledger-backed liveness)."""
+    return _HEARTBEAT
 
 
 def init_distributed(
@@ -49,6 +133,7 @@ def init_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+    _HEARTBEAT.beat("distributed_init")
 
 
 def multihost_family_mesh() -> Mesh:
@@ -92,11 +177,19 @@ def global_family_batch(local_arrays, n_global_families: int, mesh: Mesh):
     over the mesh's data axis, each shard resident on its own host."""
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     out = []
+    t0 = time.monotonic()
     for a in local_arrays:
         global_shape = (n_global_families,) + a.shape[1:]
         out.append(
             jax.make_array_from_process_local_data(sharding, a, global_shape)
         )
+    # the per-batch cross-host sync point: a host that stops beating here
+    # is the one wedging the job
+    _HEARTBEAT.beat(
+        "batch_assembled",
+        families=n_global_families,
+        assemble_s=round(time.monotonic() - t0, 4),
+    )
     return tuple(out)
 
 

@@ -22,7 +22,11 @@ from bsseqconsensusreads_tpu.models.molecular import (
     pack_molecular_outputs,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
-from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
+from bsseqconsensusreads_tpu.parallel.mesh import (
+    DATA_AXIS,
+    READS_AXIS,
+    shard_map,
+)
 
 
 def family_sharding(mesh: Mesh) -> NamedSharding:
@@ -46,7 +50,7 @@ def sharded_molecular_consensus(
     # check_vma=False: the map is collective-free (each shard independent),
     # and pallas_call outputs don't carry vma metadata for the checker.
     @jax.jit
-    @jax.shard_map(
+    @shard_map(
         mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )
     def fn(bases, quals):
@@ -70,7 +74,7 @@ def sharded_molecular_packed(
 
     # check_vma=False: same rationale as sharded_molecular_consensus
     @jax.jit
-    @jax.shard_map(
+    @shard_map(
         mesh=mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
     )
     def fn(bases, quals):
@@ -94,7 +98,7 @@ def sharded_duplex_packed(
     # check_vma=False: collective-free map; pallas_call outputs carry no
     # vma metadata for the checker (same rationale as the molecular wrap)
     @jax.jit
-    @jax.shard_map(
+    @shard_map(
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=(spec, spec, spec),
@@ -116,7 +120,7 @@ def sharded_duplex_pipeline(
     spec = P(DATA_AXIS)
 
     @jax.jit
-    @jax.shard_map(
+    @shard_map(
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec, spec),
         out_specs=spec,

@@ -30,6 +30,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     encode_record,
     write_items,
 )
+from bsseqconsensusreads_tpu.utils import observe
 
 #: Default spill threshold. ~100k BamRecords of a 150 bp library is a few
 #: hundred MB of Python objects — far under the <16 GB budget while keeping
@@ -70,7 +71,11 @@ def _external_sort_core(
     metrics (observe.Metrics or None): in-stream spill sort+write time
     accumulates under 'sort_write' — these spills happen BETWEEN the
     producer's yields, inside the consensus stage's stream-active wall,
-    and were the wall's largest unattributed share at scale.
+    and were the wall's largest unattributed share at scale. Each spill
+    run and merge pass also lands in the run ledger ('spill' /
+    'merge_pass' events with record counts and seconds) plus the
+    'spill_runs' / 'spill_records' counters, so a sort-bound stage is
+    attributable from the ledger alone.
     """
     if buffer_records < 1:
         raise ValueError(f"buffer_records must be >= 1, got {buffer_records}")
@@ -89,6 +94,10 @@ def _external_sort_core(
 
     def spill() -> None:
         nonlocal tmpdir
+        import time as _time
+
+        n = len(buf)
+        t0 = _time.monotonic()
         with timed():
             buf.sort(key=key)
             if tmpdir is None:
@@ -107,6 +116,17 @@ def _external_sort_core(
                         write_item(w, item)
             run_paths.append(path)
             buf.clear()
+        if metrics is not None:
+            metrics.count("spill_runs")
+            metrics.count("spill_records", n)
+        observe.emit(
+            "spill",
+            {
+                "run": len(run_paths) - 1,
+                "records": n,
+                "seconds": round(_time.monotonic() - t0, 3),
+            },
+        )
 
     for item in items:
         buf.append(item)
@@ -134,6 +154,9 @@ def _external_sort_core(
 
     pass_index = 0
     while len(run_paths) > MERGE_FANIN:
+        observe.emit(
+            "merge_pass", {"pass": pass_index, "runs": len(run_paths)}
+        )
         merged_paths: list[str] = []
         for gi in range(0, len(run_paths), MERGE_FANIN):
             group = run_paths[gi : gi + MERGE_FANIN]

@@ -423,7 +423,7 @@ class PipelineBuilder:
             )
 
     def run_molecular(self, rule, mode: str) -> None:
-        stats = self.stats.setdefault("molecular", StageStats())
+        stats = self.stats.setdefault("molecular", StageStats(stage="molecular"))
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
             header = self._pg(reader.header, "molecular")
             ck = self._checkpointed("molecular", rule, header)
@@ -450,7 +450,7 @@ class PipelineBuilder:
             self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
 
     def run_duplex(self, rule, mode: str) -> None:
-        stats = self.stats.setdefault("duplex", StageStats())
+        stats = self.stats.setdefault("duplex", StageStats(stage="duplex"))
         fasta = FastaFile(self.cfg.genome_fasta)
         with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("duplex"):
             names = [n for n, _ in reader.header.references]
@@ -701,11 +701,46 @@ def run_pipeline(
     cfg: FrameworkConfig, bam_path: str, outdir: str = "output", force: bool = False
 ):
     """Build and run the pipeline; returns (target, rule results, stats).
-    Per-stage stats are emitted as JSON lines when BSSEQ_TPU_STATS is set
-    (utils.observe)."""
+
+    When BSSEQ_TPU_STATS is set (utils.observe) the run writes a full
+    ledger: a run_manifest line (git rev, backend, device count, config
+    digest, env flags) first, one 'rule_complete' line per workflow rule,
+    one 'stage_stats' line per stage (with the host_s/device_s/stall_s/
+    chip_busy phase summary), and a closing 'pipeline_complete' line whose
+    pipeline_s the rule seconds must sum to — the ledger-closure
+    invariant `observe check` enforces."""
+    import time
+
     _apply_backend(cfg.backend)
     builder = PipelineBuilder(cfg, bam_path, outdir)
     wf, target = builder.build()
+    observe.open_ledger(
+        config_digest=observe.config_digest(cfg),
+        component="pipeline",
+        sample=builder.sample,
+    )
+    t0 = time.monotonic()
     results = wf.run([target], force=force)
+    pipeline_s = time.monotonic() - t0
+    for r in results:
+        observe.emit(
+            "rule_complete",
+            {
+                "rule": r.name,
+                "ran": r.ran,
+                "seconds": round(r.seconds, 3),
+                "reason": r.reason,
+            },
+        )
     observe.emit_stage_stats(builder.stats, sample=builder.sample)
+    observe.emit(
+        "pipeline_complete",
+        {
+            "pipeline_s": round(pipeline_s, 3),
+            "target": target,
+            "rules": len(results),
+            "sample": builder.sample,
+        },
+    )
+    observe.flush_sinks()
     return target, results, builder.stats

@@ -1,0 +1,299 @@
+"""Run-ledger consumers: summarize / diff / check (the `observe` CLI).
+
+A ledger is the JSONL stream utils.observe writes when BSSEQ_TPU_STATS is
+set: a run_manifest line first, then events (stage_stats, rule_complete,
+pipeline_complete, spill, overlap_pool_disabled, worker_heartbeat, ...).
+This module turns ledgers back into the numbers round verdicts kept
+re-deriving by hand:
+
+* summarize — per-stage host_s / device_s / stall_s / chip_busy table,
+  the rule wall table, and the closure verdict;
+* diff      — two summaries side by side (e.g. a cpu-backend run vs an
+  on-chip run of the same config);
+* check     — schema + invariant validation, non-zero exit on violation,
+  so CI can gate on ledger integrity.
+
+The ledger-closure invariant: per-rule wall seconds must sum to the
+pipeline wall (pipeline_complete.pipeline_s) within tolerance, and each
+stage's owner-thread timeline must be attributed to phases
+(stage_stats.unattributed_s small relative to wall_seconds) — together
+they prove no share of the run is hiding outside the ledger's numbers.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+#: Required keys per known event type (unknown events only need ts+event).
+EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
+    "run_manifest": ("git_rev", "version", "backend", "device_count"),
+    "stage_stats": ("stage",),
+    "rule_complete": ("rule", "seconds", "ran"),
+    "pipeline_complete": ("pipeline_s",),
+    "spill": ("records", "seconds"),
+    "merge_pass": ("pass", "runs"),
+    "overlap_pool_disabled": ("reason",),
+    "overlap_pool_enabled": ("workers",),
+    "worker_heartbeat": ("process_index", "seq", "phase"),
+}
+
+#: Default closure tolerance: relative share of the wall allowed to go
+#: unattributed (plus a small absolute floor for sub-second runs).
+CLOSURE_REL_TOL = 0.15
+CLOSURE_ABS_TOL = 0.75
+
+
+class LedgerError(RuntimeError):
+    pass
+
+
+@dataclass
+class LedgerSummary:
+    path: str = ""
+    manifest: dict = field(default_factory=dict)
+    stages: dict = field(default_factory=dict)  # stage -> stage_stats line
+    rules: list = field(default_factory=list)  # rule_complete lines
+    pipeline: dict = field(default_factory=dict)  # pipeline_complete line
+    events: dict = field(default_factory=dict)  # event -> count
+    notes: list = field(default_factory=list)  # overlap disables etc.
+    problems: list = field(default_factory=list)  # schema/invariant breaks
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+
+def parse_ledger(path: str) -> tuple[list[dict], list[str]]:
+    """(lines, problems): every syntactically valid line, plus a problem
+    string per malformed one. An unreadable file raises LedgerError."""
+    try:
+        raw = open(path).read()
+    except OSError as exc:
+        raise LedgerError(f"cannot read ledger {path}: {exc}") from exc
+    lines: list[dict] = []
+    problems: list[str] = []
+    for i, text in enumerate(raw.splitlines(), 1):
+        if not text.strip():
+            continue
+        try:
+            d = json.loads(text)
+        except json.JSONDecodeError as exc:
+            problems.append(f"line {i}: not JSON ({exc.msg})")
+            continue
+        if not isinstance(d, dict):
+            problems.append(f"line {i}: not a JSON object")
+            continue
+        lines.append(d)
+    return lines, problems
+
+
+def _schema_problems(lines: list[dict]) -> list[str]:
+    problems: list[str] = []
+    if not lines:
+        problems.append("empty ledger")
+        return problems
+    if lines[0].get("event") != "run_manifest":
+        problems.append(
+            "first event is "
+            f"{lines[0].get('event')!r}, expected 'run_manifest' "
+            "(every ledger opens with the run manifest)"
+        )
+    for i, d in enumerate(lines, 1):
+        ev = d.get("event")
+        if not isinstance(ev, str):
+            problems.append(f"event {i}: missing 'event'")
+            continue
+        if not isinstance(d.get("ts"), (int, float)):
+            problems.append(f"event {i} ({ev}): missing numeric 'ts'")
+        for key in EVENT_SCHEMA.get(ev, ()):
+            if key not in d:
+                problems.append(f"event {i} ({ev}): missing required {key!r}")
+    return problems
+
+
+def _closure_problems(
+    summary: "LedgerSummary",
+    rel_tol: float = CLOSURE_REL_TOL,
+    abs_tol: float = CLOSURE_ABS_TOL,
+) -> list[str]:
+    problems: list[str] = []
+    pipeline_s = summary.pipeline.get("pipeline_s")
+    if isinstance(pipeline_s, (int, float)) and summary.rules:
+        rule_sum = sum(
+            r.get("seconds", 0.0)
+            for r in summary.rules
+            if isinstance(r.get("seconds"), (int, float))
+        )
+        gap = abs(pipeline_s - rule_sum)
+        if gap > max(rel_tol * pipeline_s, abs_tol):
+            problems.append(
+                f"closure: rule seconds sum to {rule_sum:.3f}s but "
+                f"pipeline_s is {pipeline_s:.3f}s (gap {gap:.3f}s > "
+                f"tolerance)"
+            )
+    for stage, st in summary.stages.items():
+        wall = st.get("wall_seconds")
+        unatt = st.get("unattributed_s")
+        if not isinstance(wall, (int, float)) or not isinstance(
+            unatt, (int, float)
+        ):
+            continue
+        if unatt > max(rel_tol * wall, abs_tol):
+            problems.append(
+                f"closure: stage {stage!r} has {unatt:.3f}s unattributed "
+                f"of a {wall:.3f}s wall (> tolerance) — phases do not "
+                "cover the stage"
+            )
+    return problems
+
+
+def summarize_ledger(
+    path: str,
+    rel_tol: float = CLOSURE_REL_TOL,
+    abs_tol: float = CLOSURE_ABS_TOL,
+) -> LedgerSummary:
+    lines, problems = parse_ledger(path)
+    s = LedgerSummary(path=path, problems=problems)
+    s.problems.extend(_schema_problems(lines))
+    for d in lines:
+        ev = d.get("event")
+        if not isinstance(ev, str):
+            continue
+        s.events[ev] = s.events.get(ev, 0) + 1
+        if ev == "run_manifest" and not s.manifest:
+            s.manifest = d
+        elif ev == "stage_stats":
+            s.stages[str(d.get("stage"))] = d
+        elif ev == "rule_complete":
+            s.rules.append(d)
+        elif ev == "pipeline_complete":
+            s.pipeline = d
+        elif ev == "overlap_pool_disabled":
+            s.notes.append(
+                f"overlap pool disabled ({d.get('stage', '?')}): "
+                f"{d.get('reason', '?')}"
+            )
+    s.problems.extend(_closure_problems(s, rel_tol, abs_tol))
+    return s
+
+
+def check_ledger(
+    path: str,
+    rel_tol: float = CLOSURE_REL_TOL,
+    abs_tol: float = CLOSURE_ABS_TOL,
+) -> list[str]:
+    """All schema + invariant problems for one ledger (empty = valid)."""
+    return summarize_ledger(path, rel_tol, abs_tol).problems
+
+
+# ---------------------------------------------------------------------------
+# Formatting.
+
+_STAGE_COLS = (
+    ("wall_seconds", "wall_s"),
+    ("host_s", "host_s"),
+    ("device_s", "device_s"),
+    ("stall_s", "stall_s"),
+    ("chip_busy", "chip_busy"),
+    ("unattributed_s", "unattr_s"),
+    ("families_per_second", "fam/s"),
+)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    if v is None:
+        return "-"
+    return str(v)
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> str:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(headers), line(["-" * w for w in widths])]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def format_summary(s: LedgerSummary) -> str:
+    out: list[str] = []
+    m = s.manifest
+    if m:
+        out.append(
+            f"run: rev={m.get('git_rev', '?')} backend={m.get('backend', '?')}"
+            f" devices={m.get('device_count', '?')}"
+            f" config={m.get('config_digest') or '-'}"
+            f" component={m.get('component') or '-'}"
+        )
+    if s.stages:
+        rows = []
+        for stage, st in sorted(s.stages.items()):
+            rows.append(
+                [stage] + [_fmt(st.get(k)) for k, _ in _STAGE_COLS]
+            )
+        out.append("")
+        out.append(_table(["stage"] + [h for _, h in _STAGE_COLS], rows))
+    if s.rules:
+        rows = [
+            [
+                r.get("rule", "?"),
+                _fmt(r.get("seconds")),
+                "ran" if r.get("ran") else "skip",
+            ]
+            for r in s.rules
+        ]
+        out.append("")
+        out.append(_table(["rule", "seconds", "status"], rows))
+        if s.pipeline:
+            out.append(f"pipeline_s: {_fmt(s.pipeline.get('pipeline_s'))}")
+    for note in s.notes:
+        out.append(f"note: {note}")
+    out.append("")
+    if s.problems:
+        out.append(f"INVALID: {len(s.problems)} problem(s)")
+        out.extend(f"  - {p}" for p in s.problems)
+    else:
+        out.append("ledger OK (schema valid, closure invariant holds)")
+    return "\n".join(out)
+
+
+def format_diff(a: LedgerSummary, b: LedgerSummary) -> str:
+    """Two ledgers side by side, per stage and phase, with the B/A ratio —
+    the shape of the SCALECPU-vs-SCALE_TPU comparison the verdicts make."""
+    out = [
+        f"A: {a.path} (backend={a.manifest.get('backend', '?')})",
+        f"B: {b.path} (backend={b.manifest.get('backend', '?')})",
+        "",
+    ]
+    stages = sorted(set(a.stages) | set(b.stages))
+    rows = []
+    for stage in stages:
+        sa, sb = a.stages.get(stage, {}), b.stages.get(stage, {})
+        for key, label in _STAGE_COLS:
+            va, vb = sa.get(key), sb.get(key)
+            if va is None and vb is None:
+                continue
+            ratio = "-"
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+                ratio = f"{vb / va:.2f}x" if va else "-"
+            rows.append([f"{stage}.{label}", _fmt(va), _fmt(vb), ratio])
+    pa = a.pipeline.get("pipeline_s")
+    pb = b.pipeline.get("pipeline_s")
+    if pa is not None or pb is not None:
+        ratio = (
+            f"{pb / pa:.2f}x"
+            if isinstance(pa, (int, float))
+            and isinstance(pb, (int, float))
+            and pa
+            else "-"
+        )
+        rows.append(["pipeline_s", _fmt(pa), _fmt(pb), ratio])
+    out.append(_table(["metric", "A", "B", "B/A"], rows))
+    return "\n".join(out)
