@@ -1,0 +1,32 @@
+"""graftlint — first-party static analysis for TPU-hostile and
+thread-unsafe code.
+
+The run ledger (PR 1) can *measure* a stalled chip; this package exists
+to *prevent* the code classes that stall it. Eight AST-based checkers
+target the failure modes this codebase actually has (host syncs hiding
+outside accounted ledger spans, jit recompile hazards, tracer leaks,
+unlocked shared mutation in the overlap pool's worker callables,
+blocking I/O inside device spans, set-order-dependent shapes, bare
+stderr prints, swallowed worker exceptions).
+
+Entry points:
+  * `python -m bsseqconsensusreads_tpu.cli lint [paths...]` — CLI
+  * run_lint(paths, rules=...) -> list[Finding]            — library
+  * tests/test_graftlint.py                                — per-rule
+    seeded-violation fixtures + the tier-1 self-application gate
+
+Suppression syntax (inline, rule name mandatory):
+    x = float(out)  # graftlint: disable=host-sync -- singleton batch,
+                    # value is host numpy by construction
+A standalone `# graftlint: disable=<rule>` comment line applies to the
+next code line. `# graftlint: disable-file=<rule>` anywhere disables a
+rule for the whole file. Unknown rule names are a hard error — a typo'd
+suppression must not silently disable nothing.
+"""
+
+from bsseqconsensusreads_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    LintError,
+    all_rules,
+    run_lint,
+)

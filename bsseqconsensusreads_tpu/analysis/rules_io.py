@@ -1,0 +1,144 @@
+"""graftlint I/O-discipline rules: blocking I/O inside device spans and
+bare stderr prints.
+
+`io-in-device-span` keeps the ledger's DEVICE_PHASES honest: a span
+named kernel/device_wait/fetch is *defined* as chip/tunnel time
+(utils.observe phase classification), so a file write or sleep inside
+one silently inflates chip_busy. `stderr-print` is the AST successor of
+the PR-1 regex guard in tests/test_observe.py — package diagnostics go
+through the run ledger or observe.stderr_line, never raw stderr.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from bsseqconsensusreads_tpu.analysis.engine import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    call_basename,
+    timed_span_name,
+)
+
+#: Spans classified as device/tunnel time by the ledger
+#: (utils.observe.DEVICE_PHASES) — blocking host I/O in these corrupts
+#: the chip_busy accounting.
+DEVICE_SPANS = frozenset({"kernel", "device_wait", "fetch"})
+
+_BLOCKING_NAMES = frozenset({"open", "input", "print"})
+_BLOCKING_ATTRS = frozenset(
+    {
+        "write",
+        "read",
+        "readline",
+        "readlines",
+        "flush",
+        "sleep",
+        "system",
+        "popen",
+        "communicate",
+        "check_call",
+        "check_output",
+        "sendall",
+        "recv",
+    }
+)
+
+#: The one module allowed to touch sys.stderr directly — it *is* the
+#: routing layer (observe.stderr_line and the ledger mirror).
+_STDERR_ALLOWED_BASENAME = "observe.py"
+
+
+def _innermost_device_span(sf: SourceFile, node: ast.AST) -> str | None:
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                name = timed_span_name(item.context_expr)
+                if name is not None and name in DEVICE_SPANS:
+                    return name
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None  # spans don't cross function boundaries lexically
+        cur = sf.parents.get(cur)
+    return None
+
+
+def check_io_in_device_span(
+    sf: SourceFile, index: PackageIndex
+) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        span = _innermost_device_span(sf, node)
+        if span is None:
+            continue
+        base = call_basename(node)
+        hit = None
+        if isinstance(node.func, ast.Name) and base in _BLOCKING_NAMES:
+            hit = f"{base}()"
+        elif isinstance(node.func, ast.Attribute) and base in _BLOCKING_ATTRS:
+            hit = f".{base}()"
+        if hit:
+            yield Finding(
+                rule="io-in-device-span",
+                path=sf.display,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"blocking call {hit} inside the {span!r} device span "
+                    "— DEVICE_PHASES seconds are chip/tunnel time by "
+                    "definition (observe.phase_summary); host I/O here "
+                    "inflates chip_busy. Move it outside the span or "
+                    "into its own host-phase timer"
+                ),
+            )
+
+
+def check_stderr_print(sf: SourceFile, index: PackageIndex) -> Iterator[Finding]:
+    if os.path.basename(sf.display) == _STDERR_ALLOWED_BASENAME:
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = None
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            for kw in node.keywords:
+                if kw.arg == "file" and ast.unparse(kw.value) == "sys.stderr":
+                    hit = "print(..., file=sys.stderr)"
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("write", "flush")
+            and ast.unparse(node.func.value) == "sys.stderr"
+        ):
+            hit = f"sys.stderr.{node.func.attr}(...)"
+        if hit:
+            yield Finding(
+                rule="stderr-print",
+                path=sf.display,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"bare {hit} — route diagnostics through the run "
+                    "ledger (observe.emit) or observe.stderr_line so "
+                    "multi-thread output stays line-atomic and "
+                    "ledger-mirrored"
+                ),
+            )
+
+
+RULES = [
+    Rule(
+        name="io-in-device-span",
+        summary="blocking I/O inside a kernel/device_wait/fetch span",
+        check=check_io_in_device_span,
+    ),
+    Rule(
+        name="stderr-print",
+        summary="bare stderr print outside utils/observe.py",
+        check=check_stderr_print,
+    ),
+]

@@ -1,0 +1,430 @@
+"""graftlint rules for TPU/jax-hostile code: unaccounted host syncs on
+the hot path, jit recompile hazards, tracer leaks, and set-order shapes.
+
+All four rules work from the same premise as the run ledger: the batch
+loop's time must be attributable. A host sync the ledger can't see
+(`host-sync`), a silent recompile (`jit-recompile`), a trace-time crash
+(`tracer-leak`), or a shape that changes with hash seed
+(`unordered-shape-iter`) each breaks that in a different way.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from bsseqconsensusreads_tpu.analysis.engine import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    call_basename,
+    is_jit_expr,
+)
+
+#: Call basenames that force a device->host synchronization when handed
+#: a device value.
+SYNC_CONVERTERS = frozenset(
+    {"asarray", "array", "device_get", "float", "int", "bool"}
+)
+
+#: Expression markers that make a derived value host/static (shapes,
+#: dtypes and lengths are Python ints even on tracers).
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+
+
+def _names_in(expr: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _assign_targets(node: ast.AST) -> list[str]:
+    """Plain-name targets of an Assign/AugAssign/For/comprehension/with."""
+    out: list[str] = []
+
+    def grab(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                grab(e)
+        elif isinstance(t, ast.Starred):
+            grab(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            grab(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        grab(node.target)
+    elif isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+        grab(node.target)
+    elif isinstance(node, ast.withitem) and node.optional_vars is not None:
+        grab(node.optional_vars)
+    return out
+
+
+def _walk_tree(func: ast.AST) -> Iterator[ast.AST]:
+    """Whole nested tree of a function (closures share its scope)."""
+    yield from ast.walk(func)
+
+
+def _device_names(func: ast.AST, index: PackageIndex) -> set[str]:
+    """Names in `func`'s scope (closures included) bound to device
+    values: results of calls to jit-decorated functions, to locals bound
+    from jit-callable factories, or to jax.device_put. Propagates
+    through assignments, tuple packs, and iteration (``for v in
+    out.items()`` taints v), but stops at .shape/len()-style reads."""
+    jit_defs = index.jit_def_basenames
+    factories = index.factory_basenames
+
+    jit_callables: set[str] = set()
+    device: set[str] = set()
+    # two passes: callable bindings settle first, then value taint flows
+    # through straight-line and (second pass) loop-carried assignments
+    for _ in range(2):
+        for node in _walk_tree(func):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = node.value
+                targets = _assign_targets(node)
+                if isinstance(value, ast.Name) and value.id in (
+                    jit_defs | jit_callables
+                ):
+                    jit_callables.update(targets)
+                    continue
+                if isinstance(value, ast.Call):
+                    base = call_basename(value)
+                    if base in factories:
+                        jit_callables.update(targets)
+                        continue
+                produces = False
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call):
+                        base = call_basename(sub)
+                        if base in jit_defs or base in jit_callables or (
+                            base == "device_put"
+                        ):
+                            produces = True
+                if produces or (_names_in(value) & device and not _is_static_read(value)):
+                    device.update(targets)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _names_in(node.iter) & device:
+                    device.update(_assign_targets(node))
+            elif isinstance(node, ast.comprehension):
+                if _names_in(node.iter) & device:
+                    device.update(_assign_targets(node))
+    return device
+
+
+def _is_static_read(expr: ast.AST) -> bool:
+    """True when expr only reads host/static facts off a value: shapes,
+    dtypes, len(), isinstance()."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call):
+            if call_basename(sub) in ("len", "isinstance"):
+                return True
+    return False
+
+
+def check_host_sync(sf: SourceFile, index: PackageIndex) -> Iterator[Finding]:
+    """host-sync: device->host synchronization on a batch-loop-reachable
+    path outside an accounted ledger span."""
+    seen_funcs: set[ast.AST] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = index.info(node)
+        if fi is None or fi.qualname not in index.hot_reachable:
+            continue
+        # analyze at top-level-function granularity: nested defs share
+        # the enclosing scope's bindings
+        if sf.enclosing_functions(node):
+            continue
+        if node in seen_funcs:
+            continue
+        seen_funcs.add(node)
+        device = _device_names(node, index)
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if sf.in_accounted_span(sub):
+                continue
+            base = call_basename(sub)
+            flagged = None
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "block_until_ready"
+            ):
+                flagged = "block_until_ready() outside a ledger span"
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "item"
+                and _names_in(sub.func.value) & device
+            ):
+                flagged = ".item() on a device value"
+            elif base in SYNC_CONVERTERS and sub.args and (
+                _names_in(sub.args[0]) & device
+            ):
+                flagged = f"{base}() on a device value"
+            if flagged:
+                yield Finding(
+                    rule="host-sync",
+                    path=sf.display,
+                    line=sub.lineno,
+                    col=sub.col_offset,
+                    message=(
+                        f"{flagged} in batch-loop-reachable code — the "
+                        "chip stalls here invisibly; move it under "
+                        "`with metrics.timed(\"device_wait\")` (or "
+                        "another accounted span) or off the hot path"
+                    ),
+                )
+
+
+def check_jit_recompile(sf: SourceFile, index: PackageIndex) -> Iterator[Finding]:
+    """jit-recompile: per-iteration jax.jit, closures over mutated
+    Python values, unhashable static args."""
+    for node in ast.walk(sf.tree):
+        # (a) jax.jit(...) lexically inside a loop: a fresh callable (and
+        # compile cache entry) per iteration
+        if isinstance(node, ast.Call) and is_jit_expr(node):
+            cur = sf.parents.get(node)
+            while cur is not None:
+                if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                    yield Finding(
+                        rule="jit-recompile",
+                        path=sf.display,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            "jax.jit called inside a loop — every "
+                            "iteration builds a fresh callable and "
+                            "recompiles; hoist the jit (or cache it, cf. "
+                            "models.molecular._packed_kernel_cached)"
+                        ),
+                    )
+                    break
+                cur = sf.parents.get(cur)
+
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = index.info(node)
+        if fi is None or not fi.is_jit:
+            continue
+
+        # (c) static param with an unhashable default
+        args = node.args
+        defaults = dict(
+            zip([a.arg for a in args.args][len(args.args) - len(args.defaults):],
+                args.defaults)
+        )
+        defaults.update(
+            {a.arg: d for a, d in zip(args.kwonlyargs, args.kw_defaults)
+             if d is not None}
+        )
+        for name in fi.static_names:
+            d = defaults.get(name)
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                yield Finding(
+                    rule="jit-recompile",
+                    path=sf.display,
+                    line=d.lineno,
+                    col=d.col_offset,
+                    message=(
+                        f"static arg {name!r} defaults to an unhashable "
+                        f"{type(d).__name__.lower()} — jit static args "
+                        "must hash; use a tuple/frozen value"
+                    ),
+                )
+
+        # (b) jitted closure over a name the enclosing scope mutates
+        enclosing = sf.enclosing_functions(node)
+        if not enclosing:
+            continue
+        outer = enclosing[0]
+        bound = set()
+        for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs:
+            bound.add(a.arg)
+        for sub in ast.walk(node):
+            bound.update(_assign_targets(sub))
+        free = {
+            n.id
+            for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        } - bound
+        for sub in ast.walk(outer):
+            if sub is node:
+                continue
+            if isinstance(sub, ast.AugAssign):
+                hits = set(_assign_targets(sub)) & free
+                for name in hits:
+                    yield Finding(
+                        rule="jit-recompile",
+                        path=sf.display,
+                        line=node.lineno,
+                        col=node.col_offset,
+                        message=(
+                            f"jitted function closes over {name!r}, which "
+                            "the enclosing scope mutates — the traced "
+                            "value is baked at first call (stale results, "
+                            "or a retrace per cache miss); pass it as an "
+                            "argument instead"
+                        ),
+                    )
+
+
+def _annotation_is_hostlike(ann: ast.AST | None) -> bool:
+    """Annotated params are treated as non-traced unless the annotation
+    names an array type — config objects, ints and strs under jit are
+    (or must be) static."""
+    if ann is None:
+        return False
+    src = ast.unparse(ann)
+    return not ("Array" in src or "ndarray" in src or "Tensor" in src)
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` (and and/or/not combinations of
+    them) test argument *structure*, not traced values — the standard
+    jax idiom for optional operands (cf. ops.extend.extend_gap's
+    `eligible` gate)."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_none_check(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_none_check(test.operand)
+    return False
+
+
+def check_tracer_leak(sf: SourceFile, index: PackageIndex) -> Iterator[Finding]:
+    """tracer-leak: Python control flow / bool coercion on traced values
+    inside jit-decorated functions."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = index.info(node)
+        if fi is None or not fi.is_jit:
+            continue
+        traced: set[str] = set()
+        for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs:
+            if a.arg in fi.static_names or _annotation_is_hostlike(a.annotation):
+                continue
+            traced.add(a.arg)
+        # propagate through assignments, stopping at static reads
+        for _ in range(2):
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    if _names_in(sub.value) & traced and not _is_static_read(
+                        sub.value
+                    ):
+                        traced.update(_assign_targets(sub))
+        for sub in ast.walk(node):
+            test = None
+            what = None
+            if isinstance(sub, (ast.If, ast.While)):
+                test, what = sub.test, type(sub).__name__.lower()
+            elif isinstance(sub, ast.Assert):
+                test, what = sub.test, "assert"
+            elif isinstance(sub, ast.Call) and call_basename(sub) == "bool":
+                test, what = sub, "bool()"
+            if test is None:
+                continue
+            if _is_static_read(test) or _is_none_check(test):
+                continue
+            hits = _names_in(test) & traced
+            if hits:
+                yield Finding(
+                    rule="tracer-leak",
+                    path=sf.display,
+                    line=sub.lineno,
+                    col=sub.col_offset,
+                    message=(
+                        f"Python {what} on traced value(s) "
+                        f"{sorted(hits)} inside a jitted function — "
+                        "this raises TracerBoolConversionError at trace "
+                        "time (or silently bakes one branch); use "
+                        "jnp.where / lax.cond"
+                    ),
+                )
+
+
+def _setish(expr: ast.AST, set_names: set[str]) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call) and call_basename(expr) in ("set", "frozenset"):
+        return True
+    if isinstance(expr, ast.Name) and expr.id in set_names:
+        return True
+    if isinstance(expr, ast.BinOp):  # s1 | s2 unions
+        return _setish(expr.left, set_names) or _setish(expr.right, set_names)
+    return False
+
+
+def check_unordered_iter(sf: SourceFile, index: PackageIndex) -> Iterator[Finding]:
+    """unordered-shape-iter: iterating a set on a hot/jit-reachable path
+    — order varies with hash seed, so anything shape-bearing downstream
+    (bucket boundaries, pad widths, device placement) recompiles or
+    diverges between hosts of a multi-host job."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = index.info(node)
+        if fi is None:
+            continue
+        if (
+            fi.qualname not in index.hot_reachable
+            and fi.qualname not in index.jit_reachable
+        ):
+            continue
+        set_names: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and _setish(sub.value, set_names):
+                set_names.update(_assign_targets(sub))
+        for sub in ast.walk(node):
+            iters = []
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                iters = [sub.iter]
+            elif isinstance(sub, ast.comprehension):
+                iters = [sub.iter]
+            for it in iters:
+                if _setish(it, set_names):
+                    yield Finding(
+                        rule="unordered-shape-iter",
+                        path=sf.display,
+                        line=it.lineno,
+                        col=it.col_offset,
+                        message=(
+                            "iterating a set on a hot/jit-reachable path "
+                            "— iteration order follows the hash seed, so "
+                            "downstream batch shapes and device placement "
+                            "become run-dependent; iterate "
+                            "sorted(...) instead"
+                        ),
+                    )
+
+
+RULES = [
+    Rule(
+        name="host-sync",
+        summary="device->host sync on the batch loop outside an "
+        "accounted ledger span",
+        check=check_host_sync,
+    ),
+    Rule(
+        name="jit-recompile",
+        summary="per-iteration jax.jit, mutated closure, or unhashable "
+        "static arg",
+        check=check_jit_recompile,
+    ),
+    Rule(
+        name="tracer-leak",
+        summary="Python control flow or bool() on a traced value under jit",
+        check=check_tracer_leak,
+    ),
+    Rule(
+        name="unordered-shape-iter",
+        summary="set iteration feeding shapes on a hot/jit path",
+        check=check_unordered_iter,
+    ),
+]

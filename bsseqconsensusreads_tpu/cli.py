@@ -24,6 +24,10 @@ the steps its users run around it:
               BSSEQ_TPU_STATS ledger into per-stage host/device/stall
               tables, `diff` two ledgers, `check` schema + the
               ledger-closure invariant (non-zero exit on violation)
+* lint      — graftlint static analysis (analysis/): eight AST checkers
+              for TPU-hostile and thread-unsafe code; exit 1 on any
+              unsuppressed finding, so the tier-1 suite gates every PR
+              on a clean self-application
 """
 
 from __future__ import annotations
@@ -411,6 +415,59 @@ def cmd_observe(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """graftlint driver: lint the package (default) or the given paths.
+
+    Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error
+    (unknown rule name — in --rules or a suppression comment — or an
+    unparseable file). The tier-1 self-application test shells exactly
+    `... lint --json` and asserts exit 0."""
+    import os
+
+    from bsseqconsensusreads_tpu import analysis
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(analysis.__file__)))
+    paths = args.paths or [pkg_dir]
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    registry = analysis.all_rules()
+    if args.list_rules:
+        if args.json:
+            print(json.dumps(
+                {name: rule.summary for name, rule in sorted(registry.items())}
+            ))
+        else:
+            for name, rule in sorted(registry.items()):
+                print(f"{name}: {rule.summary}")
+        return 0
+    try:
+        findings = analysis.run_lint(
+            paths, rules=rules, include_suppressed=args.include_suppressed
+        )
+    except analysis.LintError as exc:
+        if args.json:
+            print(json.dumps({"error": str(exc)}))
+        else:
+            observe.stderr_line(f"lint: {exc}")
+        return 2
+    if args.json:
+        print(json.dumps(
+            {
+                "findings": [f.as_dict() for f in findings],
+                "count": len(findings),
+                "rules": sorted(r.name for r in registry.values()
+                                if rules is None or r.name in rules),
+                "paths": [str(p) for p in paths],
+            }
+        ))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"{len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="bsseqconsensusreads_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -541,6 +598,31 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-i", "--input", required=True)
     p.add_argument("-o", "--output", required=True)
     p.set_defaults(fn=cmd_filter_mapped)
+
+    p = sub.add_parser(
+        "lint",
+        help="graftlint static analysis: TPU-hostile / thread-unsafe "
+        "code checkers over the package (or given paths)",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the installed "
+        "bsseqconsensusreads_tpu package)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument(
+        "--rules", default="",
+        help="comma-separated rule subset (default: all; see --list-rules)",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    p.add_argument(
+        "--include-suppressed", action="store_true",
+        help="report findings even where a graftlint disable comment "
+        "covers them (audit mode)",
+    )
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser(
         "observe",

@@ -146,6 +146,9 @@ class BgzfWriter:
         return cls(open(path, "wb"), level=level)
 
     def write(self, data: bytes) -> None:
+        # graftlint: disable=thread-unsafe-mutation -- writer objects are
+        # thread-confined (one per writing thread); the shared-writer
+        # variant is native MtWriter, covered by the TSan/ASan harnesses
         self._buf += data
         while len(self._buf) >= MAX_BLOCK_SIZE:
             self._flush_block(bytes(self._buf[:MAX_BLOCK_SIZE]))

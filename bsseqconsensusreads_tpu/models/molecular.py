@@ -38,6 +38,12 @@ from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops import phred
 from bsseqconsensusreads_tpu.ops.phred import NO_CALL_QUAL
 
+#: Absolute log-LL band treated as a vote tie (see vote_finalize): above
+#: float32 one-ulp summation noise at working magnitudes, below the
+#: 3e-6 likelihood-ratio margin the golden suites treat as distinct.
+#: ops.pallas_vote shares it so both kernels break ties identically.
+ARGMAX_TIE_TOL = 2.5e-6
+
 
 def overlap_cocall(bases, quals):
     """Co-call overlapping R1/R2 bases within each template.
@@ -103,10 +109,26 @@ def vote_finalize(ll, depth, params: ConsensusParams):
     the property ops.reconstruct's (qa, qb, agreement)-indexed qual tables
     rely on — and slightly more accurate (small-to-large summation).
     utils.oracle.oracle_column_vote mirrors the same canonical order.
+
+    Tied columns call the LOWEST base index (fgbio semantics, the
+    oracle's `max(range(4), key=...)`): two candidates with identical
+    observation multisets are an exact LL tie in real arithmetic, but
+    float32 summation order can leave them ulps apart — so the argmax
+    runs over a small band below the max rather than raw values. The
+    band is an ABSOLUTE log-LL width (a log difference d is a
+    likelihood ratio e^-d — the tie criterion is scale-free): 2.5e-6
+    sits above one-ulp summation noise at the vote's operating
+    magnitudes (ulp(|ll|~20) ~ 1.9e-6, the observed exact-tie wobble)
+    and below the 3e-6 ratio the differential suites certify as a
+    genuine distinction (tests/fgbio_second_opinion.tied_candidates).
+    Columns whose |ll| is large enough that one ulp exceeds the band
+    (very deep families) keep the raw argmax — on a true tie there,
+    either pick is a correct call; only the canonical choice is
+    best-effort.
     """
     called = depth > 0
-    cons = jnp.argmax(ll, axis=-1)  # [W]
     m = jnp.max(ll, axis=-1, keepdims=True)
+    cons = jnp.argmax(ll >= m - ARGMAX_TIE_TOL, axis=-1)  # first near-max [W]
     e = jnp.sort(jnp.exp(ll - m), axis=-1)  # ascending
     denom = ((e[..., 0] + e[..., 1]) + e[..., 2]) + e[..., 3]
     # exp(ll[cons] - m) == 1 exactly (cons is the argmax), so the posterior

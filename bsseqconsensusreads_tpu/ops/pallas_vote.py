@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from bsseqconsensusreads_tpu.alphabet import NBASE, NUM_BASES
+from bsseqconsensusreads_tpu.models.molecular import ARGMAX_TIE_TOL
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops import phred
 
@@ -100,7 +101,14 @@ def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
             cnt = cnt_acc[rows, :]  # [4, W] f32 (exact: counts < 2^24)
             depth = jnp.sum(cnt, axis=0, keepdims=True)  # [1, W]
             called = depth > 0
-            cons = jnp.argmax(ll, axis=0, keepdims=True)  # [1, W]
+            # Tie-canonical argmax (models/molecular.vote_finalize): the
+            # lowest base index within ARGMAX_TIE_TOL of the max wins,
+            # so exact-tie columns call identically to the XLA kernel and
+            # the fgbio-semantics oracle regardless of summation order.
+            mx = jnp.max(ll, axis=0, keepdims=True)
+            cons = jnp.argmax(
+                ll >= mx - ARGMAX_TIE_TOL, axis=0, keepdims=True
+            )  # [1, W]
 
             def pick(arr, idx):
                 out = jnp.zeros_like(arr[0:1, :])

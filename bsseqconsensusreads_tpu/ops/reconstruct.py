@@ -152,8 +152,10 @@ def _qual_tables_cached(params: ConsensusParams, vote_kernel: str):
         from bsseqconsensusreads_tpu.models.duplex import duplex_consensus
 
         out = duplex_consensus(jnp.asarray(bases), jnp.asarray(quals), params)
+    # graftlint: disable=host-sync -- one-time table build (lru_cached by
+    # caller): the sync happens once per params set at startup, not per batch
     qual = np.asarray(out["qual"])[:, 0, :]  # [256, w]
-    base = np.asarray(out["base"])[:, 0, :]
+    base = np.asarray(out["base"])[:, 0, :]  # graftlint: disable=host-sync -- same one-time table build
     single_base = base[:, 512]  # observation was base A (0)
     return (
         np.ascontiguousarray(qual[:, 512].astype(np.uint8)),

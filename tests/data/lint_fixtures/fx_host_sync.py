@@ -1,0 +1,21 @@
+"""graftlint fixture: host-sync — one seeded violation.
+
+`hot_` prefix marks the loop as a batch-loop root (engine.HOT_PATH_PREFIX);
+`float(out)` forces a device->host sync per iteration with no accounted
+ledger span around it.
+"""
+
+import jax
+
+
+@jax.jit
+def fx_kernel(x):
+    return x * 2
+
+
+def hot_fixture_loop(batches):
+    total = 0.0
+    for b in batches:
+        out = fx_kernel(b)
+        total += float(out)  # seeded: host-sync
+    return total

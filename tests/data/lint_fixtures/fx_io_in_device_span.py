@@ -1,0 +1,14 @@
+"""graftlint fixture: io-in-device-span — one seeded violation.
+
+A log write inside a `timed("device_wait")` block books host I/O as
+chip/tunnel time.
+"""
+
+
+def fx_device_loop(metrics, fn, batches, log):
+    out = None
+    for b in batches:
+        with metrics.timed("device_wait"):
+            out = fn(b)
+            log.write(str(out))  # seeded: io-in-device-span
+    return out

@@ -1,0 +1,15 @@
+"""graftlint fixture: jit-recompile — one seeded violation.
+
+jax.jit called inside the loop body builds a fresh callable (and compile
+cache entry) per iteration.
+"""
+
+import jax
+
+
+def fx_fresh_jits(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v + 1)  # seeded: jit-recompile
+        outs.append(f(x))
+    return outs

@@ -1,0 +1,14 @@
+"""graftlint fixture: tracer-leak — one seeded violation.
+
+Python `if` on a traced parameter inside a jitted function raises
+TracerBoolConversionError at trace time.
+"""
+
+import jax
+
+
+@jax.jit
+def fx_traced_branch(x):
+    if x > 0:  # seeded: tracer-leak
+        return x
+    return -x

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""ASan + UBSan stress run over the threaded native codec.
+
+Completes the sanitizer wiring tools/tsan_stress.py started: the same
+MtInflate / MtWriter stress surfaces (three concurrent mt readers over a
+shared BAM + one mt writer, driven by tsan_stress's --child entry) run
+under AddressSanitizer and UndefinedBehaviorSanitizer builds of
+native/bamio.cpp (make asan / make ubsan), and the verdicts land in ONE
+JSON artifact alongside the TSan one:
+
+    python tools/sanitize_native.py [--out SANITIZE_HEAD.json] [--rounds 2]
+
+Per sanitizer the child re-execs with the runtime LD_PRELOADed (the
+interpreter is uninstrumented, so the runtime must be first in the link
+order) and BSSEQ_TPU_BAMIO_SO pointing at the instrumented .so:
+
+* ASan: ASAN_OPTIONS=detect_leaks=0 — LeakSanitizer would report the
+  interpreter's own arena allocations at exit, drowning codec signal;
+  heap-buffer-overflow / use-after-free / double-free in the codec still
+  abort the child with "ERROR: AddressSanitizer" in the log.
+* UBSan: -fno-sanitize-recover means any "runtime error:" line (signed
+  overflow, misaligned load, bad shift, bad bool) aborts the child too.
+
+Artifact: {"ok": all clean, "asan": {...}, "ubsan": {...}} — each leg
+carrying child_rc, report count and the first report lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TSAN_STRESS = os.path.join(REPO, "tools", "tsan_stress.py")
+
+SANITIZERS = {
+    "asan": {
+        "target": "libbamio_asan.so",
+        "runtime": "libasan.so",
+        "opt_var": "ASAN_OPTIONS",
+        "opts": "detect_leaks=0",
+        "markers": ("ERROR: AddressSanitizer", "SUMMARY: AddressSanitizer"),
+    },
+    "ubsan": {
+        "target": "libbamio_ubsan.so",
+        "runtime": "libubsan.so",
+        "opt_var": "UBSAN_OPTIONS",
+        "opts": "print_stacktrace=1",
+        "markers": ("runtime error:", "SUMMARY: UndefinedBehaviorSanitizer"),
+    },
+}
+
+
+def _runtime_path(runtime: str) -> str:
+    out = subprocess.run(
+        ["g++", f"-print-file-name={runtime}"],
+        capture_output=True, text=True, check=True,
+    ).stdout.strip()
+    if out == runtime or not os.path.exists(out):
+        raise RuntimeError(f"g++ cannot locate {runtime}")
+    return out
+
+
+def _run_one(name: str, spec: dict, rounds: int, timeout: int) -> dict:
+    """Build + stress one sanitizer flavour; returns its report leg."""
+    leg: dict = {"ok": False, "sanitizer": name, "target": spec["target"]}
+    workdir = tempfile.mkdtemp(prefix=f"bsseq_{name}_")
+    try:
+        mk = subprocess.run(
+            ["make", "-C", os.path.join(REPO, "native"), spec["target"]],
+            capture_output=True, text=True, timeout=300,
+        )
+        if mk.returncode != 0:
+            leg["error"] = f"build failed: {mk.stderr[-500:]}"
+            return leg
+        log_base = os.path.join(workdir, name)
+        env = dict(
+            os.environ,
+            LD_PRELOAD=_runtime_path(spec["runtime"]),
+            BSSEQ_TPU_BAMIO_SO=spec["target"],
+            BSSEQ_TPU_BGZF_THREADS="4",
+            PYTHONPATH=REPO
+            + (os.pathsep + os.environ.get("PYTHONPATH", "")
+               if os.environ.get("PYTHONPATH") else ""),
+        )
+        env[spec["opt_var"]] = f"{spec['opts']} log_path={log_base}"
+        cp = subprocess.run(
+            [sys.executable, TSAN_STRESS, "--child", workdir, str(rounds)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        leg["child_rc"] = cp.returncode
+        leg["child_stdout"] = cp.stdout.strip()[-300:]
+        leg["child_stderr_tail"] = cp.stderr.strip()[-300:]
+        reports = []
+        for path in glob.glob(log_base + "*"):
+            for line in open(path, errors="replace"):
+                if any(m in line for m in spec["markers"]):
+                    reports.append(line.strip())
+        # uncaptured runtimes also print straight to the child's stderr
+        for line in cp.stderr.splitlines():
+            if any(m in line for m in spec["markers"]):
+                reports.append(line.strip())
+        leg["reports"] = len(reports)
+        leg["report_summaries"] = reports[:20]
+        leg["ok"] = cp.returncode == 0 and not reports
+    except subprocess.TimeoutExpired:
+        leg["error"] = "child timed out"
+    except RuntimeError as exc:
+        leg["error"] = str(exc)
+    finally:
+        import shutil
+
+        shutil.rmtree(workdir, ignore_errors=True)
+    return leg
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SANITIZE_HEAD.json")
+    ap.add_argument("--rounds", type=int, default=2)
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument(
+        "--only", choices=sorted(SANITIZERS), default=None,
+        help="run a single flavour (default: both)",
+    )
+    args = ap.parse_args()
+
+    t0 = time.monotonic()
+    report: dict = {
+        "tool": "AddressSanitizer + UndefinedBehaviorSanitizer (gcc)",
+        "rounds": args.rounds,
+        "surfaces": [
+            "MtInflate worker pool (3 concurrent readers x 4 workers)",
+            "columnar parser over mt-inflated stream",
+            "MtWriter deflate pool under concurrent readers",
+        ],
+    }
+    names = [args.only] if args.only else sorted(SANITIZERS)
+    for name in names:
+        report[name] = _run_one(
+            name, SANITIZERS[name], args.rounds, args.timeout
+        )
+    report["ok"] = all(report[name].get("ok") for name in names)
+    report["wall_s"] = round(time.monotonic() - t0, 1)
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=1)
+    print(json.dumps(
+        {"ok": report["ok"], "wall_s": report["wall_s"],
+         **{name: {"ok": report[name].get("ok"),
+                   "reports": report[name].get("reports"),
+                   "error": report[name].get("error")}
+            for name in names}}
+    ))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
