@@ -633,6 +633,38 @@ def _measure_xla_cpu_stage() -> dict | None:
     return None
 
 
+def _run_chaos_quick() -> dict | None:
+    """tools/chaos_drill.py --quick -> FAULTS_HEAD.json: the robustness
+    artifact riding the bench flow (fault injection + recovery over the
+    mini pipeline, byte-identity asserted per scenario). Best-effort and
+    cpu-pinned: a drill failure lands in the artifact as ok=False, never
+    fails the bench. BSSEQ_BENCH_CHAOS=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_CHAOS", "1") == "0":
+        return None
+    drill = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "chaos_drill.py"
+    )
+    out_path = os.path.join(os.getcwd(), "FAULTS_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, drill, "--quick", "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_CHAOS_TIMEOUT", 900),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "scenarios": sorted(data.get("scenarios") or {}),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -754,6 +786,14 @@ def main() -> None:
         out["error"] = "device benchmark failed on all attempts"
     if dev["failures"]:
         out["attempt_failures"] = dev["failures"]
+    faults = _run_chaos_quick()
+    if faults is not None:
+        out["faults"] = faults
+        observe.emit(
+            "bench_chaos_drill",
+            {"ok": faults.get("ok"), "path": faults.get("path")},
+            sink=ledger_sink,
+        )
     observe.flush_sinks()
     out["ledger"] = {
         "path": None if ledger_sink == "-" else ledger_sink,

@@ -28,11 +28,12 @@ SUPPRESS_TAG = "graftlint:"
 
 #: Ledger span names under which a host sync is *accounted* — the
 #: ledger's device/stall phases (utils.observe.DEVICE_PHASES) plus the
-#: two host-side spans the pipeline books synchronous waits under
+#: host-side spans the pipeline books synchronous waits under
 #: ('stall' = main-thread join on an overlapped batch, 'host_vote' =
-#: the T==1 path that never touches the device).
+#: the T==1 path that never touches the device, 'degrade' = the
+#: CPU-twin fallback of a persistently failing batch, faults.retry).
 ACCOUNTED_SPANS = frozenset(
-    {"kernel", "device_wait", "fetch", "stall", "host_vote"}
+    {"kernel", "device_wait", "fetch", "stall", "host_vote", "degrade"}
 )
 
 #: Functions treated as batch-loop roots for hot-path reachability: the
@@ -483,10 +484,15 @@ class PackageIndex:
 
 
 def all_rules() -> dict[str, Rule]:
-    from bsseqconsensusreads_tpu.analysis import rules_io, rules_jax, rules_thread
+    from bsseqconsensusreads_tpu.analysis import (
+        rules_io,
+        rules_jax,
+        rules_retry,
+        rules_thread,
+    )
 
     rules: dict[str, Rule] = {}
-    for mod in (rules_jax, rules_thread, rules_io):
+    for mod in (rules_jax, rules_thread, rules_io, rules_retry):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

@@ -93,6 +93,28 @@ def _add_params(p: argparse.ArgumentParser, min_reads_default: int) -> None:
         help="record emission: native C++ batch serializer vs per-record "
         "Python objects (auto = native when built)",
     )
+    _add_failpoints(p)
+
+
+def _add_failpoints(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--failpoints", default="",
+        help="fault-injection schedule, e.g. "
+        "'dispatch_kernel=raise:RuntimeError@batch=7;"
+        "extsort_spill=io_error:p=0.01:seed=42' (README Robustness; "
+        "overrides BSSEQ_TPU_FAILPOINTS)",
+    )
+
+
+def _arm_failpoints(args) -> None:
+    if getattr(args, "failpoints", ""):
+        from bsseqconsensusreads_tpu.faults import failpoints
+
+        try:
+            failpoints.arm(args.failpoints)
+        except failpoints.FailpointError as exc:
+            observe.stderr_line(f"--failpoints: {exc}")
+            raise SystemExit(2) from None
 
 
 def _params(args, **kw) -> ConsensusParams:
@@ -110,6 +132,7 @@ def _params(args, **kw) -> ConsensusParams:
 def cmd_run(args) -> int:
     from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
 
+    _arm_failpoints(args)
     cfg = (
         FrameworkConfig.from_yaml(args.config)
         if args.config
@@ -147,6 +170,7 @@ def cmd_molecular(args) -> int:
     )
     from bsseqconsensusreads_tpu.pipeline.stages import molecular_ingest_stream
 
+    _arm_failpoints(args)
     observe.open_ledger(component="molecular-cli")
     stats = StageStats(stage="molecular")
     with BamReader(args.input) as reader:
@@ -187,6 +211,7 @@ def cmd_duplex(args) -> int:
 
     from bsseqconsensusreads_tpu.pipeline.stages import duplex_ingest_stream
 
+    _arm_failpoints(args)
     observe.open_ledger(component="duplex-cli")
     stats = StageStats(stage="duplex")
     fasta = FastaFile(args.reference)
@@ -479,6 +504,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--aligner", choices=("self", "bwameth", "none"), default="")
     p.add_argument("--reference", default="", help="genome FASTA (overrides config)")
     p.add_argument("--force", action="store_true")
+    _add_failpoints(p)
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("molecular", help="molecular consensus stage only")

@@ -1,0 +1,43 @@
+"""Fault injection, bounded retries, and durable-state integrity.
+
+The crash-only contract (ROADMAP north-star: a 100M-read run on a v4-8
+must not lose hours to one flaky transfer) needs three things the rest
+of the framework provides hooks for but nothing exercises:
+
+* `failpoints` — named, deterministically-scheduled injection sites
+  threaded through the whole hot path (dispatch/fetch/retire, extsort
+  spill/merge, checkpoint shard/manifest/finalize, BGZF inflate/write,
+  native library load, multihost heartbeat/collective). Armed via
+  `BSSEQ_TPU_FAILPOINTS` / `--failpoints`; zero-cost when unarmed.
+* `retry` — the batch-level retry executor: bounded exponential backoff
+  for transient device/transfer errors, a stall watchdog for wedged
+  overlap-pool futures, and graceful degradation to the host XLA twin
+  on persistent kernel failure.
+* `integrity` — streaming CRC32 over durable artifacts (checkpoint
+  shards, extsort spill runs) so a corrupt file is quarantined and
+  recomputed instead of crashing the run or silently merging garbage.
+
+`tools/chaos_drill.py` drives the whole surface against a mini
+pipeline and asserts byte-identical output under every fault class.
+"""
+
+from bsseqconsensusreads_tpu.faults.failpoints import (  # noqa: F401
+    FailpointError,
+    arm,
+    arm_from_env,
+    disarm,
+    fire,
+    fired_counts,
+)
+from bsseqconsensusreads_tpu.faults.integrity import (  # noqa: F401
+    IntegrityError,
+    file_crc32,
+    verify_file_crc32,
+)
+from bsseqconsensusreads_tpu.faults.retry import (  # noqa: F401
+    RETRYABLE,
+    RetryPolicy,
+    guarded,
+    policy_from_env,
+    stall_timeout,
+)

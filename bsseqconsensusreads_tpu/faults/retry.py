@@ -1,0 +1,165 @@
+"""Batch-level retry executor: bounded backoff + graceful degradation.
+
+One recovery policy for the whole hot path (pipeline.calling wraps each
+dispatch+fetch unit; pipeline.extsort and pipeline.checkpoint wrap
+their durable writes):
+
+* transient failures (`RETRYABLE`: OSError — which covers BGZF/CRC
+  integrity errors — RuntimeError — which covers XLA runtime errors —
+  and TimeoutError) are retried with bounded exponential backoff;
+* a unit that keeps failing degrades to the caller-provided fallback
+  (the consensus stages pass the host-XLA CPU twin of the same kernel,
+  bit-identical output with no device in the loop) instead of killing
+  the run;
+* everything is ledgered ('batch_retry' / 'batch_recovered' /
+  'batch_degraded') and counted ('batches_retried' / 'batches_recovered'
+  / 'batches_degraded' / 'retry_attempts' on the stage metrics), so a
+  run that limped home says so — degraded batches are NOT free
+  (BASELINE.md: host-twin batches count against reads/sec/chip).
+
+Programming errors (ValueError, TypeError, KeyError, assertion
+failures) are deliberately NOT retryable: retrying a deterministic bug
+just burns the attempt budget before failing anyway.
+
+Env knobs:
+  BSSEQ_TPU_RETRY_MAX        total attempts per unit (default 3)
+  BSSEQ_TPU_RETRY_BACKOFF_S  first backoff, doubling per retry
+                             (default 0.05, capped at 2s)
+  BSSEQ_TPU_STALL_TIMEOUT_S  overlap-pool stall watchdog: main-thread
+                             seconds to wait on an in-flight future
+                             before cancelling and re-dispatching
+                             inline (default 0 = disabled)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from bsseqconsensusreads_tpu.utils import observe
+
+#: Exception classes the executor treats as transient.
+RETRYABLE = (OSError, RuntimeError, TimeoutError)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3
+    backoff_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def policy_from_env() -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=max(1, int(_env_float("BSSEQ_TPU_RETRY_MAX", 3))),
+        backoff_s=max(0.0, _env_float("BSSEQ_TPU_RETRY_BACKOFF_S", 0.05)),
+    )
+
+
+def stall_timeout() -> float:
+    """Watchdog timeout for overlap-pool futures; 0 disables."""
+    return max(0.0, _env_float("BSSEQ_TPU_STALL_TIMEOUT_S", 0.0))
+
+
+def _backoff(policy: RetryPolicy, attempt: int) -> float:
+    return min(
+        policy.backoff_s * (2 ** (attempt - 1)), policy.backoff_cap_s
+    )
+
+
+def _note_retry(exc, metrics, stage, batch, attempt: int) -> None:
+    if metrics is not None:
+        if attempt == 1:
+            metrics.count("batches_retried")
+        metrics.count("retry_attempts")
+    observe.emit(
+        "batch_retry",
+        {
+            "stage": stage,
+            "batch": batch,
+            "attempt": attempt,
+            "error": f"{type(exc).__name__}: {exc}",
+        },
+    )
+
+
+def _degrade_or_raise(exc, degrade, metrics, stage, batch, attempts: int):
+    if degrade is None:
+        raise exc
+    if metrics is not None:
+        metrics.count("batches_degraded")
+    observe.emit(
+        "batch_degraded",
+        {
+            "stage": stage,
+            "batch": batch,
+            "attempts": attempts,
+            "error": f"{type(exc).__name__}: {exc}",
+        },
+    )
+    return degrade()
+
+
+def guarded(
+    unit,
+    *,
+    degrade=None,
+    metrics=None,
+    stage: str = "",
+    batch: int | None = None,
+    policy: RetryPolicy | None = None,
+    sleep=time.sleep,
+    failed: BaseException | None = None,
+):
+    """Run `unit()` under the bounded retrier.
+
+    RETRYABLE failures re-run the unit after exponential backoff; the
+    policy's final failure degrades to `degrade()` (or re-raises when no
+    fallback exists). `failed` seeds the loop with a failure that
+    already happened elsewhere (the inline dispatch path catches the
+    dispatch exception itself, then hands recovery here — that failure
+    is attempt 1). `metrics` is an observe.Metrics (locked counters —
+    this runs on overlap-pool worker threads).
+    """
+    pol = policy or policy_from_env()
+    attempt = 0  # failed attempts so far
+    if failed is not None:
+        attempt = 1
+        if attempt >= pol.max_attempts:
+            _note_retry(failed, metrics, stage, batch, attempt)
+            return _degrade_or_raise(
+                failed, degrade, metrics, stage, batch, attempt
+            )
+        _note_retry(failed, metrics, stage, batch, attempt)
+        sleep(_backoff(pol, attempt))
+    while True:
+        try:
+            out = unit()
+        except RETRYABLE as exc:
+            attempt += 1
+            if attempt >= pol.max_attempts:
+                return _degrade_or_raise(
+                    exc, degrade, metrics, stage, batch, attempt
+                )
+            _note_retry(exc, metrics, stage, batch, attempt)
+            sleep(_backoff(pol, attempt))
+        else:
+            if attempt:
+                if metrics is not None:
+                    metrics.count("batches_recovered")
+                observe.emit(
+                    "batch_recovered",
+                    {"stage": stage, "batch": batch, "attempts": attempt + 1},
+                )
+            return out

@@ -13,6 +13,8 @@ import ctypes as C
 import os
 import subprocess
 
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -51,6 +53,12 @@ def load_library(
     """
     if env_flag and os.environ.get(env_flag, "1") == "0":
         return None, f"disabled via {env_flag}=0"
+    if _failpoints.ARMED:
+        try:
+            _failpoints.fire("native_load", so=so_name)
+        except Exception as exc:  # injected load failure: degrade to the
+            # pure-Python codec paths exactly like a missing compiler
+            return None, f"failpoint injected: {exc}"
     so_path = os.path.join(NATIVE_DIR, so_name)
     have_source = os.path.exists(os.path.join(NATIVE_DIR, source_name))
     if not os.path.exists(so_path):

@@ -18,6 +18,8 @@ import struct
 import zlib
 from typing import BinaryIO, Iterator
 
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+
 # Largest uncompressed payload per block (htslib convention: 64KiB minus slop).
 MAX_BLOCK_SIZE = 65280
 
@@ -80,6 +82,8 @@ class BgzfReader:
         if len(cdata) < cdata_len or len(tail) < 8:
             raise BgzfError("truncated BGZF block")
         crc, isize = struct.unpack("<II", tail)
+        if _failpoints.ARMED:  # guarded: this runs once per 64K block
+            _failpoints.fire("bgzf_inflate")
         data = zlib.decompress(cdata, wbits=-15)
         if len(data) != isize:
             raise BgzfError("BGZF ISIZE mismatch")
@@ -155,6 +159,8 @@ class BgzfWriter:
             del self._buf[:MAX_BLOCK_SIZE]
 
     def _flush_block(self, data: bytes) -> None:
+        if _failpoints.ARMED:  # guarded: this runs once per 64K block
+            _failpoints.fire("bgzf_write")
         co = zlib.compressobj(self._level, zlib.DEFLATED, -15)
         cdata = co.compress(data) + co.flush()
         bsize = len(cdata) + 12 + 6 + 8  # header + xtra + footer

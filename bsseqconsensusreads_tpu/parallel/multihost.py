@@ -33,6 +33,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, READS_AXIS
 from bsseqconsensusreads_tpu.utils import observe
 
@@ -68,6 +69,13 @@ class WorkerHeartbeat:
             self._seq += 1
             seq = self._seq
         pi, pc = self._process_info()
+        if _failpoints.ARMED:
+            try:
+                _failpoints.fire("multihost_heartbeat", phase=phase)
+            except Exception:  # injected heartbeat LOSS: the beat never
+                # reaches the ledger (the firing itself was ledgered) —
+                # what a wedged/partitioned host looks like from outside
+                return
         observe.emit(
             "worker_heartbeat",
             {
@@ -175,6 +183,10 @@ def global_family_batch(local_arrays, n_global_families: int, mesh: Mesh):
     process's family share (local_family_count rows, in global order).
     Returns jax Arrays with global shape [n_global_families, ...], sharded
     over the mesh's data axis, each shard resident on its own host."""
+    # the per-batch collective boundary: a stall here simulates a
+    # cross-host timeout, a raise a dead coordinator — recovery is the
+    # crash-only path (die, resume from the checkpoint layer)
+    _failpoints.fire("multihost_collective", families=n_global_families)
     sharding = NamedSharding(mesh, P(DATA_AXIS))
     out = []
     t0 = time.monotonic()

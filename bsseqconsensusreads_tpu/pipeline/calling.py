@@ -62,6 +62,8 @@ from bsseqconsensusreads_tpu.ops.encode import (
     encode_molecular_families,
     scan_matches,
 )
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import retry as _faultretry
 from bsseqconsensusreads_tpu.utils import observe
 
 from bsseqconsensusreads_tpu.io.fastq import reverse_complement as _revcomp
@@ -242,6 +244,32 @@ def _device_wait(dev, metrics: "observe.Metrics") -> None:
         wait()
 
 
+def _join_with_watchdog(fut, batch, bi, redispatch, stats, stage: str):
+    """Join one overlap-pool future, with the stall watchdog when
+    BSSEQ_TPU_STALL_TIMEOUT_S is set: a worker that has not produced the
+    batch by the deadline is cancelled/abandoned and the batch
+    re-dispatched inline under the retrier (`redispatch(batch, bi)`).
+    The wedged worker's eventual result — if it ever comes — is simply
+    discarded; the batch retires exactly once, from the re-dispatch.
+    Shared by the molecular and duplex retire paths."""
+    timeout = _faultretry.stall_timeout()
+    if timeout <= 0:
+        return fut.result()
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    try:
+        return fut.result(timeout=timeout)
+    except _FutTimeout:
+        fut.cancel()  # not-yet-started futures die here; running ones
+        # are abandoned (a thread cannot be killed) and their result dropped
+        stats.metrics.count("batches_stalled")
+        observe.emit(
+            "batch_stall_redispatch",
+            {"stage": stage, "batch": bi, "timeout_s": timeout},
+        )
+        return redispatch(batch, bi)
+
+
 def _split_deep(chunk, threshold: int, indel_policy: str = "drop"):
     """Partition (mi, records) groups by encodable template count: families
     whose count exceeds `threshold` go to the deep-family path (sharded
@@ -339,24 +367,33 @@ def _pipelined(events, depth: int = 1):
     one yield per event, in event order — the invariant checkpoint
     resume's skip_batches counting depends on (pipeline.checkpoint), kept
     in this one place for both the molecular and duplex stages.
+
+    Teardown: when the consumer abandons the generator (or a retire
+    raises), the pending retire closures — each pinning a dispatched
+    batch's device wire buffer and its in-flight future — are dropped
+    IMMEDIATELY in the finally, not at whenever-GC-runs, so a failing
+    stage cannot leak device allocations across its own cleanup.
     """
     from collections import deque
 
     depth = max(depth, 1)
     pending: deque = deque()
-    for kind, payload in events:
-        if kind == "deferred":
-            while len(pending) >= depth:
-                yield pending.popleft()()
-            pending.append(payload)
-        else:
-            # "now" results must still appear in event order: drain the
-            # older in-flight retires first
-            while pending:
-                yield pending.popleft()()
-            yield payload
-    while pending:
-        yield pending.popleft()()
+    try:
+        for kind, payload in events:
+            if kind == "deferred":
+                while len(pending) >= depth:
+                    yield pending.popleft()()
+                pending.append(payload)
+            else:
+                # "now" results must still appear in event order: drain the
+                # older in-flight retires first
+                while pending:
+                    yield pending.popleft()()
+                yield payload
+        while pending:
+            yield pending.popleft()()
+    finally:
+        pending.clear()
 
 
 def _resolve_vote_kernel(vote_kernel: str | None) -> str:
@@ -421,6 +458,28 @@ class StageStats:
     def families_per_second(self) -> float:
         return self.families / self.wall_seconds if self.wall_seconds else 0.0
 
+    # Recovery accounting (faults.retry) lives in the locked metrics
+    # counters — worker threads increment it — surfaced here as
+    # first-class stage fields so no run summary can hide that batches
+    # were retried, re-dispatched after a stall, or limped home on the
+    # host twin.
+
+    @property
+    def batches_retried(self) -> int:
+        return self.metrics.counters.get("batches_retried", 0)
+
+    @property
+    def batches_recovered(self) -> int:
+        return self.metrics.counters.get("batches_recovered", 0)
+
+    @property
+    def batches_degraded(self) -> int:
+        return self.metrics.counters.get("batches_degraded", 0)
+
+    @property
+    def batches_stalled(self) -> int:
+        return self.metrics.counters.get("batches_stalled", 0)
+
     def as_dict(self) -> dict:
         return {
             "records_in": self.records_in,
@@ -435,6 +494,10 @@ class StageStats:
             "wall_seconds": round(self.wall_seconds, 3),
             "indel_aligned": self.indel_aligned,
             "indel_dropped": self.indel_dropped,
+            "batches_retried": self.batches_retried,
+            "batches_recovered": self.batches_recovered,
+            "batches_degraded": self.batches_degraded,
+            "batches_stalled": self.batches_stalled,
             **self.metrics.as_dict(),
             **self.metrics.phase_summary(self.wall_seconds),
         }
@@ -1004,6 +1067,7 @@ def call_molecular_batches(
     from bsseqconsensusreads_tpu.ops import encode as encode_mod
 
     stats = stats if stats is not None else StageStats()
+    stage_label = stats.stage or "molecular"
     kernel_choice = _resolve_vote_kernel(vote_kernel)
     consensus_fn = _molecular_kernel(vote_kernel)
     emit_fn = partial(
@@ -1056,13 +1120,14 @@ def call_molecular_batches(
             and os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
         )
 
-    def dispatch_kernel(batch):
+    def dispatch_kernel(batch, bi=None):
         """Submit one batch; returns (device wire array, padded f). Outputs
         ride the packed planar wire (models.molecular.pack_molecular_outputs
         — one D2H array instead of four), and the copy is requested
         immediately so it streams while the host encodes the next chunk /
         emits the previous one (depth-1 software pipeline, same rationale
         as call_duplex_batches)."""
+        _failpoints.fire("dispatch_kernel", stage=stage_label, batch=bi)
         f = batch.bases.shape[0]
         if is_singleton_batch(batch):
             from bsseqconsensusreads_tpu.models.molecular import (
@@ -1103,10 +1168,11 @@ def call_molecular_batches(
             copy_async()
         return wire, pf
 
-    def fetch_out(wire, pf, batch) -> dict:
+    def fetch_out(wire, pf, batch, bi=None) -> dict:
         """Blocking device fetch + host-side count recompute for one
         dispatched batch — the worker-thread half of the retire path in
         overlap mode, the front of retire_and_emit inline."""
+        _failpoints.fire("fetch_out", stage=stage_label, batch=bi)
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         if isinstance(wire, tuple) and wire[0] == "host":
             return wire[1]  # singleton fast path: already host arrays
@@ -1145,26 +1211,74 @@ def call_molecular_batches(
             return [main] + deep_emitted
         return main + deep_emitted
 
-    def retire_and_emit(wire, pf, batch, deep_emitted):
-        return emit_out(fetch_out(wire, pf, batch), batch, deep_emitted)
+    def retire_and_emit(wire, pf, batch, bi, deep_emitted):
+        try:
+            out = fetch_out(wire, pf, batch, bi)
+        except _faultretry.RETRYABLE as exc:
+            # the dispatched wire is lost with its failed fetch: recovery
+            # re-runs the whole dispatch+fetch unit under the retrier
+            out = _faultretry.guarded(
+                partial(dispatch_fetch, batch, bi),
+                degrade=partial(degrade_fetch, batch),
+                metrics=stats.metrics, stage=stage_label, batch=bi,
+                failed=exc,
+            )
+        return emit_out(out, batch, deep_emitted)
 
-    def dispatch_fetch(batch) -> dict:
+    def dispatch_fetch(batch, bi=None) -> dict:
         """Worker-side unit of the overlap pipeline: dispatch (H2D + kernel
         enqueue, or the T==1 host vote) and the blocking fetch, returning
         host arrays ready for emit. Runs OFF the main thread so the
         tunnel's waits and the singleton vote's CPU both hide under the
-        main thread's ingest/encode/emit of neighbouring batches."""
+        main thread's ingest/encode/emit of neighbouring batches. Also
+        the RECOVERY unit: a retry or a stall re-dispatch re-runs exactly
+        this (dispatch + fetch), never a half-retired batch."""
         phase = "host_vote" if is_singleton_batch(batch) else "kernel"
         with stats.metrics.timed(phase):
-            wire, pf = dispatch_kernel(batch)
-        return fetch_out(wire, pf, batch)
+            wire, pf = dispatch_kernel(batch, bi)
+        return fetch_out(wire, pf, batch, bi)
 
-    def retire_future(fut, batch, deep_emitted):
+    def degrade_fetch(batch) -> dict:
+        """Persistent-failure fallback: the same vote kernel on the host
+        XLA backend — the CPU twin of the device path, bit-identical
+        output with no device (or tunnel) in the loop, so the run
+        completes correct instead of dying. Counted per batch
+        ('batches_degraded'); the 'degrade' span is host time."""
+        cpu = jax.local_devices(backend="cpu")[0]
+        with stats.metrics.timed("degrade"), jax.default_device(cpu):
+            out = consensus_fn(batch.bases, batch.quals, params)
+            return {k: np.asarray(v) for k, v in out.items()}
+
+    def dispatch_fetch_guarded(batch, bi):
+        """dispatch_fetch under the bounded retrier + CPU-twin degrade —
+        what the overlap pool actually runs per batch."""
+        return _faultretry.guarded(
+            partial(dispatch_fetch, batch, bi),
+            degrade=partial(degrade_fetch, batch),
+            metrics=stats.metrics, stage=stage_label, batch=bi,
+        )
+
+    def retire_future(fut, batch, bi, deep_emitted):
         """Main-thread retire of one overlapped batch: join the worker
         ('stall' = main-thread seconds actually blocked on it — the
-        pipeline's unhidden remainder), then emit in event order."""
-        with stats.metrics.timed("stall"):
-            out = fut.result()
+        pipeline's unhidden remainder), then emit in event order. With
+        BSSEQ_TPU_STALL_TIMEOUT_S set, a wedged worker is abandoned at
+        the deadline and the batch re-dispatched inline (the watchdog
+        half of the self-healing contract)."""
+        try:
+            _failpoints.fire("retire_future", stage=stage_label, batch=bi)
+            with stats.metrics.timed("stall"):
+                out = _join_with_watchdog(
+                    fut, batch, bi, dispatch_fetch_guarded, stats,
+                    stage_label,
+                )
+        except _faultretry.RETRYABLE as exc:
+            out = _faultretry.guarded(
+                partial(dispatch_fetch, batch, bi),
+                degrade=partial(degrade_fetch, batch),
+                metrics=stats.metrics, stage=stage_label, batch=bi,
+                failed=exc,
+            )
         return emit_out(out, batch, deep_emitted)
 
     def run_deep_kernel(batch):
@@ -1268,15 +1382,29 @@ def call_molecular_batches(
             stats.used_cells += used
             if pool is not None:
                 yield "deferred", partial(
-                    retire_future, pool.submit(dispatch_fetch, batch),
-                    batch, deep_emitted,
+                    retire_future,
+                    pool.submit(dispatch_fetch_guarded, batch, batch_index),
+                    batch, batch_index, deep_emitted,
                 )
                 continue
             phase = "host_vote" if is_singleton_batch(batch) else "kernel"
-            with stats.metrics.timed(phase):
-                out_dev, trim = dispatch_kernel(batch)
+            try:
+                with stats.metrics.timed(phase):
+                    out_dev, trim = dispatch_kernel(batch, batch_index)
+            except _faultretry.RETRYABLE as exc:
+                # dispatch itself failed: recover the whole unit now (the
+                # pipelined D2H overlap is already lost for this batch)
+                out = _faultretry.guarded(
+                    partial(dispatch_fetch, batch, batch_index),
+                    degrade=partial(degrade_fetch, batch),
+                    metrics=stats.metrics, stage=stage_label,
+                    batch=batch_index, failed=exc,
+                )
+                yield "deferred", partial(emit_out, out, batch, deep_emitted)
+                continue
             yield "deferred", partial(
-                retire_and_emit, out_dev, trim, batch, deep_emitted
+                retire_and_emit, out_dev, trim, batch, batch_index,
+                deep_emitted,
             )
 
     depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)
@@ -1433,6 +1561,7 @@ def call_duplex_batches(
     import os
 
     stats = stats if stats is not None else StageStats()
+    stage_label = stats.stage or "duplex"
     kernel = _resolve_vote_kernel(vote_kernel)
     emit_fn = (
         _emit_duplex_batch_raw
@@ -1516,12 +1645,13 @@ def call_duplex_batches(
             g = genome_per_dev[dev.id] = jax.device_put(refstore.codes, dev)
         return jax.device_put(words, dev), g
 
-    def dispatch_kernel(batch):
+    def dispatch_kernel(batch, bi=None):
         """Submit one batch; returns (device wire array, padded f). The D2H
         copy is requested immediately so it streams while the host encodes
         the next chunk / emits the previous one (software pipeline, depth =
         in-flight devices — on tunneled TPU hosts the transfer, not
         compute, bounds the stage)."""
+        _failpoints.fire("dispatch_kernel", stage=stage_label, batch=bi)
         f = batch.bases.shape[0]
         if use_wire:
             # one packed u32 array up; windows gathered from the
@@ -1563,11 +1693,12 @@ def call_duplex_batches(
             copy_async()
         return packed, pf
 
-    def fetch_out(packed, pf, batch, sidecar) -> dict:
+    def fetch_out(packed, pf, batch, sidecar, bi=None) -> dict:
         """Blocking fetch + host-side reconstruction for one dispatched
         duplex batch — worker-thread half of the retire path in overlap
         mode. 'rawize' (the presence→raw-unit conversion) is timed apart
         from 'fetch' so the artifact shows transfer vs host compute."""
+        _failpoints.fire("fetch_out", stage=stage_label, batch=bi)
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         _device_wait(packed, stats.metrics)
         with stats.metrics.timed("fetch"):
@@ -1602,21 +1733,73 @@ def call_duplex_batches(
             return [main] + passed
         return main + passed
 
-    def retire_and_emit(packed, pf, batch, passed, sidecar):
-        return emit_out(fetch_out(packed, pf, batch, sidecar), batch, passed)
+    def retire_and_emit(packed, pf, batch, passed, sidecar, bi):
+        try:
+            out = fetch_out(packed, pf, batch, sidecar, bi)
+        except _faultretry.RETRYABLE as exc:
+            out = _faultretry.guarded(
+                partial(dispatch_fetch, batch, sidecar, bi),
+                degrade=partial(degrade_fetch, batch, sidecar),
+                metrics=stats.metrics, stage=stage_label, batch=bi,
+                failed=exc,
+            )
+        return emit_out(out, batch, passed)
 
-    def dispatch_fetch(batch, sidecar) -> dict:
+    def dispatch_fetch(batch, sidecar, bi=None) -> dict:
         """Worker-side unit of the overlap pipeline (see the molecular
         stage's twin): dispatch + blocking fetch + rawize off the main
         thread, hiding tunnel waits and retire compute under ingest/
-        encode/emit of neighbouring batches."""
+        encode/emit of neighbouring batches. Also the recovery unit."""
         with stats.metrics.timed("kernel"):
-            packed, pf = dispatch_kernel(batch)
-        return fetch_out(packed, pf, batch, sidecar)
+            packed, pf = dispatch_kernel(batch, bi)
+        return fetch_out(packed, pf, batch, sidecar, bi)
 
-    def retire_future(fut, batch, passed):
-        with stats.metrics.timed("stall"):
-            out = fut.result()
+    def degrade_fetch(batch, sidecar) -> dict:
+        """Persistent-failure fallback: the fused duplex pipeline on the
+        host XLA backend (the CPU twin of the device path, unpacked
+        tensors + host-fetched reference windows) — bit-identical output
+        with no device in the loop, then the same rawize passes the
+        normal retire runs."""
+        f, w = batch.bases.shape[0], batch.bases.shape[-1]
+        ref = host_ref(batch)
+        cpu = jax.local_devices(backend="cpu")[0]
+        with stats.metrics.timed("degrade"), jax.default_device(cpu):
+            packed, _la, _rd = duplex_call_pipeline_packed(
+                batch.bases, batch.quals, batch.cover, ref,
+                batch.convert_mask, batch.extend_eligible,
+                params=params, vote_kernel=kernel,
+            )
+            out = unpack_duplex_outputs(jax.device_get(packed), f=f, w=w)
+        with stats.metrics.timed("rawize"):
+            return _duplex_rawize(
+                out, batch, sidecar,
+                ref=ref if (strand_tags or sidecar) else None,
+                strand_tags=strand_tags,
+            )
+
+    def dispatch_fetch_guarded(batch, sidecar, bi):
+        return _faultretry.guarded(
+            partial(dispatch_fetch, batch, sidecar, bi),
+            degrade=partial(degrade_fetch, batch, sidecar),
+            metrics=stats.metrics, stage=stage_label, batch=bi,
+        )
+
+    def retire_future(fut, batch, bi, passed, sidecar):
+        try:
+            _failpoints.fire("retire_future", stage=stage_label, batch=bi)
+            with stats.metrics.timed("stall"):
+                out = _join_with_watchdog(
+                    fut, batch, bi,
+                    lambda b, i: dispatch_fetch_guarded(b, sidecar, i),
+                    stats, stage_label,
+                )
+        except _faultretry.RETRYABLE as exc:
+            out = _faultretry.guarded(
+                partial(dispatch_fetch, batch, sidecar, bi),
+                degrade=partial(degrade_fetch, batch, sidecar),
+                metrics=stats.metrics, stage=stage_label, batch=bi,
+                failed=exc,
+            )
         return emit_out(out, batch, passed)
 
     groups = _timed_groups(
@@ -1658,14 +1841,28 @@ def call_duplex_batches(
             stats.used_cells += used
             if pool is not None:
                 yield "deferred", partial(
-                    retire_future, pool.submit(dispatch_fetch, batch, sidecar),
-                    batch, passed,
+                    retire_future,
+                    pool.submit(
+                        dispatch_fetch_guarded, batch, sidecar, batch_index
+                    ),
+                    batch, batch_index, passed, sidecar,
                 )
                 continue
-            with stats.metrics.timed("kernel"):
-                packed, pf = dispatch_kernel(batch)
+            try:
+                with stats.metrics.timed("kernel"):
+                    packed, pf = dispatch_kernel(batch, batch_index)
+            except _faultretry.RETRYABLE as exc:
+                out = _faultretry.guarded(
+                    partial(dispatch_fetch, batch, sidecar, batch_index),
+                    degrade=partial(degrade_fetch, batch, sidecar),
+                    metrics=stats.metrics, stage=stage_label,
+                    batch=batch_index, failed=exc,
+                )
+                yield "deferred", partial(emit_out, out, batch, passed)
+                continue
             yield "deferred", partial(
-                retire_and_emit, packed, pf, batch, passed, sidecar
+                retire_and_emit, packed, pf, batch, passed, sidecar,
+                batch_index,
             )
 
     depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)

@@ -20,15 +20,30 @@ removes the scratch files; a crash mid-finalize resumes by re-finalizing.
 
 A partially-written shard (crash before its manifest rename) is simply
 overwritten on resume — the manifest is the single source of truth.
+
+Integrity (faults.integrity): every registered shard carries a CRC32
+over its file bytes, verified on resume. A shard that fails its CRC is
+QUARANTINED (renamed `*.quarantined`, ledgered) and the manifest
+truncated to the valid prefix — its batches (and every later shard's,
+to keep the replay contiguous) are recomputed instead of crashing or,
+worse, silently splicing garbage into the output. A stale-fingerprint
+manifest is likewise discarded LOUDLY ('checkpoint_discarded' with both
+fingerprints), so an operator can tell "resumed fresh on purpose" from
+"params drifted".
 """
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
+from functools import partial
 from typing import Iterable, Iterator
 
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import integrity as _integrity
+from bsseqconsensusreads_tpu.faults import retry as _faultretry
 from bsseqconsensusreads_tpu.io.bam import (
     BamHeader,
     BamReader,
@@ -36,6 +51,7 @@ from bsseqconsensusreads_tpu.io.bam import (
     BamWriter,
     write_items,
 )
+from bsseqconsensusreads_tpu.utils import observe
 
 
 @dataclasses.dataclass
@@ -44,6 +60,12 @@ class _Manifest:
     shards: list[str] = dataclasses.field(default_factory=list)
     records: int = 0
     fingerprint: dict = dataclasses.field(default_factory=dict)
+    #: per-shard integrity + replay bookkeeping, parallel to `shards`:
+    #: CRC32 of the shard file bytes, batches per shard, records per
+    #: shard — what lets a corrupt shard be truncated out exactly.
+    shard_crcs: list[int] = dataclasses.field(default_factory=list)
+    shard_batches: list[int] = dataclasses.field(default_factory=list)
+    shard_records: list[int] = dataclasses.field(default_factory=list)
 
     @classmethod
     def load(cls, path: str) -> "_Manifest":
@@ -52,10 +74,23 @@ class _Manifest:
         with open(path) as fh:
             d = json.load(fh)
         return cls(
-            d["batches_done"], d["shards"], d["records"], d.get("fingerprint", {})
+            d["batches_done"], d["shards"], d["records"],
+            d.get("fingerprint", {}),
+            d.get("shard_crcs", []),
+            d.get("shard_batches", []),
+            d.get("shard_records", []),
+        )
+
+    def consistent(self) -> bool:
+        n = len(self.shards)
+        return (
+            len(self.shard_crcs) == n
+            and len(self.shard_batches) == n
+            and len(self.shard_records) == n
         )
 
     def save(self, path: str) -> None:
+        _failpoints.fire("ckpt_manifest_rename")
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(dataclasses.asdict(self), fh)
@@ -73,7 +108,8 @@ class BatchCheckpoint:
     fingerprint: anything identifying the (input, batching parameters) the
     shards were computed from — e.g. input path+size+mtime, batch_families,
     params repr. A stale manifest whose fingerprint mismatches is discarded
-    (with its shards) instead of splicing old-input shards into a new run.
+    (with its shards) instead of splicing old-input shards into a new run —
+    and the discard is ledgered with both fingerprints.
     """
 
     def __init__(self, target: str, header: BamHeader, every: int = 16,
@@ -87,22 +123,102 @@ class BatchCheckpoint:
         self.manifest_path = target + ".ckpt.json"
         self.manifest = _Manifest.load(self.manifest_path)
         fingerprint = fingerprint or {}
+        if self.manifest.shards and not self.manifest.consistent():
+            # a manifest from before the integrity fields (or a mangled
+            # one): its per-shard bookkeeping cannot be trusted, so
+            # recompute rather than resume
+            self._discard(reason="manifest_format")
         if self.manifest.shards and self.manifest.fingerprint != fingerprint:
+            # LOUD discard: an operator must be able to tell "resumed
+            # fresh on purpose" from "params drifted" after the fact
+            observe.emit(
+                "checkpoint_discarded",
+                {
+                    "target": self.target,
+                    "reason": "fingerprint_mismatch",
+                    "manifest_fingerprint": self.manifest.fingerprint,
+                    "run_fingerprint": fingerprint,
+                    "dropped_batches": self.manifest.batches_done,
+                    "dropped_shards": len(self.manifest.shards),
+                },
+            )
             self._discard_scratch()
             self.manifest = _Manifest()
         self.manifest.fingerprint = fingerprint
+        self._verify_shards()
+
+    def _discard(self, reason: str) -> None:
+        observe.emit(
+            "checkpoint_discarded",
+            {
+                "target": self.target,
+                "reason": reason,
+                "dropped_batches": self.manifest.batches_done,
+                "dropped_shards": len(self.manifest.shards),
+            },
+        )
+        self._discard_scratch()
+        self.manifest = _Manifest()
 
     def _discard_scratch(self) -> None:
-        d = os.path.dirname(self.target)
-        for shard in self.manifest.shards:
+        # glob rather than the manifest list: catches orphaned partials
+        # (crash before registration) and quarantined shards too
+        for path in glob.glob(self.target + ".part*"):
             try:
-                os.remove(os.path.join(d, shard))
+                os.remove(path)
             except FileNotFoundError:
                 pass
         try:
             os.remove(self.manifest_path)
         except FileNotFoundError:
             pass
+
+    def _verify_shards(self) -> None:
+        """Resume-time integrity pass: verify every registered shard's
+        CRC; quarantine the first corrupt/missing one and truncate the
+        manifest to the valid prefix (later shards are dropped too —
+        batch replay must stay contiguous)."""
+        m = self.manifest
+        if not m.shards or not m.consistent():
+            return
+        d = os.path.dirname(self.target)
+        keep = len(m.shards)
+        for i, shard in enumerate(m.shards):
+            path = os.path.join(d, shard)
+            try:
+                _integrity.verify_file_crc32(
+                    path, m.shard_crcs[i], what=f"checkpoint shard {shard}"
+                )
+            except OSError as exc:
+                keep = i
+                observe.emit(
+                    "shard_quarantined",
+                    {
+                        "target": self.target,
+                        "shard": shard,
+                        "error": str(exc),
+                        "dropped_batches": sum(m.shard_batches[i:]),
+                        "dropped_shards": len(m.shards) - i,
+                    },
+                )
+                if os.path.exists(path):
+                    os.replace(path, path + ".quarantined")
+                break
+        if keep == len(m.shards):
+            return
+        for shard in m.shards[keep + 1:]:
+            # valid but orphaned by the gap: their batches recompute
+            try:
+                os.remove(os.path.join(d, shard))
+            except FileNotFoundError:
+                pass
+        m.shards = m.shards[:keep]
+        m.shard_crcs = m.shard_crcs[:keep]
+        m.shard_records = m.shard_records[:keep]
+        m.shard_batches = m.shard_batches[:keep]
+        m.batches_done = sum(m.shard_batches)
+        m.records = sum(m.shard_records)
+        m.save(self.manifest_path)
 
     @property
     def batches_done(self) -> int:
@@ -128,8 +244,11 @@ class BatchCheckpoint:
         if pending:
             self._flush(buf, pending)
 
-    def _flush(self, items: list, n_batches: int) -> None:
-        path = self._shard_path(len(self.manifest.shards))
+    def _write_shard(self, path: str, items: list) -> int:
+        """One shard write attempt — the retry unit for transient I/O
+        errors (the batch items are still in memory, so a failed attempt
+        rewrites the whole shard)."""
+        _failpoints.fire("ckpt_shard_write", shard=os.path.basename(path))
         # shards are scratch (re-read once at finalize, then deleted):
         # always deflate fast, like the external-sort spills
         with BamWriter(path, self.header, level=1) as w:
@@ -137,9 +256,20 @@ class BatchCheckpoint:
         # the shard must hit disk BEFORE the manifest claims it durable
         with open(path, "rb") as fh:
             os.fsync(fh.fileno())
+        return n
+
+    def _flush(self, items: list, n_batches: int) -> None:
+        path = self._shard_path(len(self.manifest.shards))
+        n = _faultretry.guarded(
+            partial(self._write_shard, path, items),
+            stage="checkpoint", batch=len(self.manifest.shards),
+        )
         self.manifest.batches_done += n_batches
         self.manifest.shards.append(os.path.basename(path))
         self.manifest.records += n
+        self.manifest.shard_crcs.append(_integrity.file_crc32(path))
+        self.manifest.shard_batches.append(n_batches)
+        self.manifest.shard_records.append(n)
         self.manifest.save(self.manifest_path)
 
     def iter_records(self) -> Iterator[BamRecord]:
@@ -172,6 +302,7 @@ class BatchCheckpoint:
         for a completed rule — the manifest survives and the rerun
         re-finalizes from the durable shards.
         """
+        _failpoints.fire("ckpt_finalize", target=self.target)
         n = 0
         tmp = self.target + ".finalize.tmp"
         with BamWriter(tmp, self.header, level=self.level) as w:

@@ -21,6 +21,9 @@ import struct
 import tempfile
 from typing import Callable, Iterable, Iterator
 
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import integrity as _integrity
+from bsseqconsensusreads_tpu.faults import retry as _faultretry
 from bsseqconsensusreads_tpu.io.bam import (
     BamHeader,
     BamReader,
@@ -31,6 +34,15 @@ from bsseqconsensusreads_tpu.io.bam import (
     write_items,
 )
 from bsseqconsensusreads_tpu.utils import observe
+
+
+def _verify_spills() -> bool:
+    """Whether spill runs carry a CRC32 verified before every merge open
+    (BSSEQ_TPU_VERIFY_SPILLS, default on): a run corrupted between spill
+    and merge is a hard IntegrityError instead of silently merged
+    garbage. One extra sequential read per run; disable with =0 when the
+    spill volume makes that matter more than the guarantee."""
+    return os.environ.get("BSSEQ_TPU_VERIFY_SPILLS", "1") != "0"
 
 #: Default spill threshold. ~100k BamRecords of a 150 bp library is a few
 #: hundred MB of Python objects — far under the <16 GB budget while keeping
@@ -83,6 +95,8 @@ def _external_sort_core(
 
     buf: list = []
     run_paths: list[str] = []
+    run_crcs: dict[str, int] = {}
+    verify = _verify_spills()
     tmpdir: tempfile.TemporaryDirectory | None = None
 
     def timed():
@@ -92,9 +106,27 @@ def _external_sort_core(
             else contextlib.nullcontext()
         )
 
+    def write_run_file(path: str, items) -> None:
+        """One run write attempt — the retry unit for transient spill
+        I/O errors (a failed attempt rewrites the same path whole; the
+        sorted buffer is still in memory)."""
+        _failpoints.fire("extsort_spill", run=len(run_paths))
+        # spill shards are deleted after the merge: fast compression
+        # (the BGZF container is identical, only the deflate effort
+        # drops)
+        with BamWriter(path, header, level=1) as w:
+            if write_run is not None:  # coalesced (raw-blob) writes
+                write_run(w, items)
+            else:
+                for item in items:
+                    write_item(w, item)
+        if verify:
+            run_crcs[path] = _integrity.file_crc32(path)
+
     def spill() -> None:
         nonlocal tmpdir
         import time as _time
+        from functools import partial
 
         n = len(buf)
         t0 = _time.monotonic()
@@ -105,15 +137,11 @@ def _external_sort_core(
                     prefix="bsseq_extsort_", dir=workdir
                 )
             path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
-            # spill shards are deleted after the merge: fast compression
-            # (the BGZF container is identical, only the deflate effort
-            # drops)
-            with BamWriter(path, header, level=1) as w:
-                if write_run is not None:  # coalesced (raw-blob) writes
-                    write_run(w, buf)
-                else:
-                    for item in buf:
-                        write_item(w, item)
+            _faultretry.guarded(
+                partial(write_run_file, path, buf),
+                metrics=metrics, stage="extsort_spill",
+                batch=len(run_paths),
+            )
             run_paths.append(path)
             buf.clear()
         if metrics is not None:
@@ -144,6 +172,12 @@ def _external_sort_core(
     def open_runs(paths: list[str], readers: list):
         streams = []
         for p in paths:
+            # a corrupt run must fail HERE, before a single record of it
+            # is merged — silently merging garbage is the one outcome
+            # worse than crashing (faults.integrity)
+            want = run_crcs.get(p)
+            if want is not None:
+                _integrity.verify_file_crc32(p, want, what=f"spill run {p}")
             # single-thread inflate: up to MERGE_FANIN of these are open at
             # once, each consumed a record at a time — MT prefetch per
             # reader would multiply threads and readahead by the fan-in
@@ -154,6 +188,7 @@ def _external_sort_core(
 
     pass_index = 0
     while len(run_paths) > MERGE_FANIN:
+        _failpoints.fire("extsort_merge", runs=len(run_paths))
         observe.emit(
             "merge_pass", {"pass": pass_index, "runs": len(run_paths)}
         )
@@ -177,10 +212,14 @@ def _external_sort_core(
                     r.close()
             for p in group:
                 os.remove(p)
+                run_crcs.pop(p, None)
+            if verify:  # merged runs are durable state like spills
+                run_crcs[out] = _integrity.file_crc32(out)
             merged_paths.append(out)
         run_paths = merged_paths
         pass_index += 1
 
+    _failpoints.fire("extsort_merge", runs=len(run_paths))
     readers = []
     try:
         yield from heapq.merge(*open_runs(run_paths, readers), key=key)

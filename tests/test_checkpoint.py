@@ -139,6 +139,80 @@ def test_stale_fingerprint_discards_shards(grouped, tmp_path):
     assert not list(tmp_path.glob("fp.bam.part*"))
 
 
+def test_fingerprint_mismatch_is_ledgered(grouped, tmp_path, monkeypatch):
+    """Discarding a stale manifest must leave ledger evidence carrying
+    BOTH fingerprints, so an operator can tell 'resumed fresh on
+    purpose' from 'params drifted'."""
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "fpw.bam")
+    ck = BatchCheckpoint(target, uh, every=2, fingerprint={"input": "A"})
+    batches = call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES)
+    ck.write_batches(batch for i, batch in enumerate(batches) if i < 4)
+
+    sink = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+    try:
+        BatchCheckpoint(target, uh, every=2, fingerprint={"input": "B"})
+    finally:
+        observe.close_sinks()
+    events = [json.loads(l) for l in open(sink)]
+    (ev,) = [e for e in events if e["event"] == "checkpoint_discarded"]
+    assert ev["reason"] == "fingerprint_mismatch"
+    assert ev["manifest_fingerprint"] == {"input": "A"}
+    assert ev["run_fingerprint"] == {"input": "B"}
+    assert ev["dropped_batches"] == 4
+
+
+def test_corrupt_shard_quarantined_and_recomputed(grouped, tmp_path, monkeypatch):
+    """A shard failing its manifest CRC on resume is quarantined (not
+    silently merged, not a crash): the manifest truncates to the valid
+    prefix, the lost batches recompute, and the finalized output is
+    identical to an uninterrupted run's."""
+    import os
+
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "crc.bam")
+    ck = BatchCheckpoint(target, uh, every=2)
+    ck.write_batches(call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES))
+    manifest = json.loads((tmp_path / "crc.bam.ckpt.json").read_text())
+    assert len(manifest["shard_crcs"]) == len(manifest["shards"])
+    assert sum(manifest["shard_batches"]) == manifest["batches_done"]
+    victim = str(tmp_path / manifest["shards"][1])
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+
+    sink = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+    try:
+        ck2 = BatchCheckpoint(target, uh, every=2)
+    finally:
+        observe.close_sinks()
+    events = [json.loads(l) for l in open(sink)]
+    (ev,) = [e for e in events if e["event"] == "shard_quarantined"]
+    assert ev["shard"] == manifest["shards"][1]
+    # truncated to the valid prefix: shard 0 only (2 batches)
+    assert ck2.batches_done == 2
+    assert os.path.exists(victim + ".quarantined")
+
+    ck2.write_batches(
+        call_molecular_batches(
+            iter(records), batch_families=BATCH_FAMILIES,
+            skip_batches=ck2.batches_done,
+        )
+    )
+    ck2.finalize()
+    want = [
+        (x.qname, x.flag, x.seq, x.qual)
+        for x in call_molecular(iter(records), batch_families=BATCH_FAMILIES)
+    ]
+    assert _canon(target) == want
+    # quarantined shard cleaned up with the rest of the scratch
+    assert not list(tmp_path.glob("crc.bam.part*"))
+
+
 def test_finalize_is_atomic(grouped, tmp_path):
     """finalize writes tmp + rename: no partial target file exists at any
     point, so a crash mid-finalize cannot fake rule completion."""

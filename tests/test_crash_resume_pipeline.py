@@ -1,16 +1,23 @@
 """Full-pipeline crash/resume under the round-3 machinery.
 
-A REAL subprocess runs the self-aligned pipeline with intra-stage
+REAL subprocesses run the self-aligned pipeline with intra-stage
 checkpoints over the current default engines (C-grouped columnar ingest,
-depth-bucketed batching, native batch emit) and hard-crashes (os._exit)
-mid-molecular-stage; a fresh process resumes from the durable shards. The
+depth-bucketed batching, native batch emit) and hard-crash (os._exit)
+at scripted points; fresh processes resume from the durable shards. The
 final BAM must be byte-identical to an uninterrupted run — the combined
 determinism contract of skip_batches replay across the grouped stream,
 bucketed chunk composition, and raw-blob sort finalize (SURVEY.md §5.4).
+
+Crash coverage (ISSUE 3): mid-MOLECULAR (the original wrapper-based
+kill), mid-DUPLEX (failpoint `exit` at a duplex batch), and
+mid-FINALIZE with a corrupt partial shard present (failpoint `exit`
+inside the duplex finalize + a flipped byte — resume must quarantine
+and recompute, verified byte-identical).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -53,20 +60,27 @@ cfg = FrameworkConfig(
     aligner="self", grouping="coordinate", batch_families=8,
     checkpoint_every=2,
 )
-target, _, _ = run_pipeline(cfg, bam, outdir=outdir)
+target, _, stats = run_pipeline(cfg, bam, outdir=outdir)
+import json
+print(json.dumps({
+    "target": target,
+    "batches": {k: s.as_dict().get("batches", 0) for k, s in stats.items()},
+}))
 print(target)
 """
 
 
-@pytest.mark.slow
-def test_subprocess_crash_resume_byte_identical(tmp_path):
+@pytest.fixture(scope="module")
+def crash_env(tmp_path_factory):
+    """Shared input + worker + an uninterrupted reference run."""
+    wd = tmp_path_factory.mktemp("crash_resume")
     rng = np.random.default_rng(88)
     codes = rng.integers(0, 4, size=40_000).astype(np.int8)
     from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
 
-    write_fasta(str(tmp_path / "genome.fa"), "chr1", codes_to_seq(codes))
+    write_fasta(str(wd / "genome.fa"), "chr1", codes_to_seq(codes))
     header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 40_000)])
-    bam = str(tmp_path / "input" / "in.bam")
+    bam = str(wd / "input" / "in.bam")
     os.makedirs(os.path.dirname(bam))
     with BamWriter(bam, header) as w:
         for rec in stream_duplex_families(
@@ -74,36 +88,109 @@ def test_subprocess_crash_resume_byte_identical(tmp_path):
             templates_for=lambda f: 1 if f % 3 else 2,
         ):
             w.write(rec)
-    worker = tmp_path / "worker.py"
+    worker = wd / "worker.py"
     worker.write_text(WORKER)
     env = dict(os.environ, PYTHONPATH=REPO, BSSEQ_TPU_BACKEND="cpu")
+    env.pop("BSSEQ_TPU_FAILPOINTS", None)
 
-    def run(outdir, crash_after=0):
+    def run(outdir, crash_after=0, failpoints=""):
         e = dict(env, CRASH_AFTER=str(crash_after))
+        if failpoints:
+            e["BSSEQ_TPU_FAILPOINTS"] = failpoints
         return subprocess.run(
-            [sys.executable, str(worker), str(tmp_path), bam, outdir],
+            [sys.executable, str(worker), str(wd), bam, outdir],
             env=e, capture_output=True, text=True, timeout=600,
         )
 
-    # uninterrupted reference
-    cp = run(str(tmp_path / "out_plain"))
+    cp = run(str(wd / "out_plain"))
     assert cp.returncode == 0, cp.stderr[-2000:]
-    plain_target = cp.stdout.strip().splitlines()[-1]
+    payload = json.loads(cp.stdout.strip().splitlines()[0])
+    return {
+        "wd": wd,
+        "run": run,
+        "plain_bytes": open(payload["target"], "rb").read(),
+        "plain_batches": payload["batches"],
+    }
 
+
+def _payload(cp) -> dict:
+    return json.loads(cp.stdout.strip().splitlines()[0])
+
+
+def _scraps(outdir) -> list[str]:
+    return [f for f in os.listdir(outdir) if ".ckpt" in f or ".part" in f]
+
+
+@pytest.mark.slow
+def test_subprocess_crash_resume_byte_identical(crash_env):
     # crash after 3 chunks (checkpoint_every=2 -> 2 durable batches)
-    out_crash = str(tmp_path / "out_crash")
-    cp = run(out_crash, crash_after=3)
+    out_crash = str(crash_env["wd"] / "out_crash")
+    cp = crash_env["run"](out_crash, crash_after=3)
     assert cp.returncode == 9
     # durable evidence of the partial run
-    scraps = [f for f in os.listdir(out_crash) if ".ckpt" in f or ".part" in f]
-    assert scraps, os.listdir(out_crash)
+    assert _scraps(out_crash), os.listdir(out_crash)
 
     # resume in a fresh process
-    cp = run(out_crash)
+    cp = crash_env["run"](out_crash)
     assert cp.returncode == 0, cp.stderr[-2000:]
-    resumed_target = cp.stdout.strip().splitlines()[-1]
+    resumed = _payload(cp)
 
-    assert open(resumed_target, "rb").read() == open(plain_target, "rb").read()
+    assert open(resumed["target"], "rb").read() == crash_env["plain_bytes"]
     # scratch cleaned up after finalize
-    scraps = [f for f in os.listdir(out_crash) if ".ckpt" in f or ".part" in f]
-    assert scraps == []
+    assert _scraps(out_crash) == []
+
+
+@pytest.mark.slow
+def test_subprocess_duplex_crash_resume_byte_identical(crash_env):
+    """Crash/resume coverage for the DUPLEX caller (molecular-only before
+    ISSUE 3): a failpoint hard-kills the run at a duplex batch; the
+    resume skips the molecular stage entirely (its target is final) and
+    re-executes only the duplex suffix."""
+    out_crash = str(crash_env["wd"] / "out_crash_duplex")
+    cp = crash_env["run"](
+        out_crash,
+        # batch 5: with the depth-1 retire pipeline and checkpoint_every=2
+        # at least one duplex shard is durable before the kill
+        failpoints="dispatch_kernel=exit:9@batch=5@stage=duplex",
+    )
+    assert cp.returncode == 9, cp.stderr[-2000:]
+    scraps = _scraps(out_crash)
+    assert any("_duplex_" in f for f in scraps), scraps
+
+    cp = crash_env["run"](out_crash)
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    resumed = _payload(cp)
+    assert open(resumed["target"], "rb").read() == crash_env["plain_bytes"]
+    # only the undone duplex suffix re-ran through the kernel
+    assert 0 < resumed["batches"]["duplex"] < crash_env["plain_batches"]["duplex"]
+    assert "molecular" not in resumed["batches"]  # rule skipped whole
+    assert _scraps(out_crash) == []
+
+
+@pytest.mark.slow
+def test_subprocess_crash_in_finalize_with_corrupt_shard(crash_env):
+    """Hard crash INSIDE the duplex finalize (hit=2: the molecular
+    finalize is hit 1) leaves all duplex shards durable plus a partial
+    .finalize.tmp; one shard is then corrupted on disk. The resume must
+    quarantine it, recompute its batches, and still reproduce the
+    reference bytes."""
+    out_crash = str(crash_env["wd"] / "out_crash_finalize")
+    cp = crash_env["run"](out_crash, failpoints="ckpt_finalize=exit:9@hit=2")
+    assert cp.returncode == 9, cp.stderr[-2000:]
+    shards = sorted(
+        f for f in os.listdir(out_crash)
+        if "_duplex_" in f and ".part" in f and f.endswith(".bam")
+    )
+    assert len(shards) >= 2, os.listdir(out_crash)
+    victim = os.path.join(out_crash, shards[-2])
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(victim, "wb").write(bytes(blob))
+
+    cp = crash_env["run"](out_crash)
+    assert cp.returncode == 0, cp.stderr[-2000:]
+    resumed = _payload(cp)
+    assert open(resumed["target"], "rb").read() == crash_env["plain_bytes"]
+    # the corrupt shard's batches (and the orphaned suffix) re-executed
+    assert resumed["batches"]["duplex"] > 0
+    assert _scraps(out_crash) == []

@@ -1,0 +1,596 @@
+"""faults/ subsystem: failpoint grammar + registry, the batch retry
+executor with CPU-twin degrade, the stall watchdog, durable-state
+integrity, the overlap-pool teardown contract, and the tier-1 chaos
+smoke (a scheduled-fault mini pipeline whose output must be
+byte-identical to a fault-free run, with non-zero recovery counters in
+the ledger).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.faults import failpoints, integrity, retry
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_molecular,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.utils import observe
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    """Every test starts and ends unarmed, with fast retry backoff."""
+    monkeypatch.setenv("BSSEQ_TPU_RETRY_BACKOFF_S", "0.001")
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+
+
+@pytest.fixture()
+def ledger(tmp_path, monkeypatch):
+    sink = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+    yield sink
+    observe.close_sinks()
+
+
+def ledger_events(sink: str, event: str | None = None) -> list[dict]:
+    if not os.path.exists(sink):
+        return []
+    out = []
+    with open(sink) as fh:
+        for line in fh:
+            d = json.loads(line)
+            if event is None or d.get("event") == event:
+                out.append(d)
+    return out
+
+
+@pytest.fixture(scope="module")
+def grouped():
+    rng = np.random.default_rng(91)
+    gname, genome = random_genome(rng, 3000)
+    header, records = make_grouped_bam_records(
+        rng, gname, genome, n_families=24
+    )
+    return header, records
+
+
+def canon(recs) -> list:
+    return [(x.qname, x.flag, x.seq, x.qual) for x in recs]
+
+
+def run_single_device(records, stats=None, **kw):
+    """Molecular stage pinned to mesh=None: the conftest forces an
+    8-device virtual mesh, whose sharded path disables the overlap pool
+    — the pool/watchdog tests need the single-device path."""
+    return canon(
+        x
+        for b in call_molecular_batches(
+            iter(records), batch_families=4, mesh=None, stats=stats, **kw
+        )
+        for x in b
+    )
+
+
+# ---------------------------------------------------------------------------
+# grammar + registry
+
+
+class TestGrammar:
+    def test_full_grammar(self):
+        pts = failpoints.parse_schedule(
+            "dispatch_kernel=raise:RuntimeError:times=1@batch=7;"
+            "extsort_spill=io_error:p=0.01:seed=42,"
+            "fetch_out=stall:30s@batch=3,"
+            "ckpt_finalize=exit:9@hit=2@stage=duplex"
+        )
+        assert [(p.site, p.action) for p in pts] == [
+            ("dispatch_kernel", "raise"),
+            ("extsort_spill", "io_error"),
+            ("fetch_out", "stall"),
+            ("ckpt_finalize", "exit"),
+        ]
+        assert pts[0].times == 1 and pts[0].batch == 7
+        assert pts[1].prob == 0.01 and pts[1].seed == 42
+        assert pts[2].duration_s == 30.0
+        assert pts[3].exit_code == 9 and pts[3].hit == 2
+        assert pts[3].stage == "duplex"
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "no_such_site=raise",
+            "dispatch_kernel=frobnicate",
+            "dispatch_kernel",
+            "dispatch_kernel=raise:NoSuchError",
+            "dispatch_kernel=raise@planet=mars",
+            "dispatch_kernel=raise:p=xyz",
+        ],
+    )
+    def test_bad_schedules_error(self, bad):
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.parse_schedule(bad)
+
+    def test_every_site_is_registered(self):
+        for site in failpoints.SITES:
+            failpoints.parse_schedule(f"{site}=raise")
+
+    def test_batch_predicate_and_times(self):
+        failpoints.arm("dispatch_kernel=raise:times=1@batch=2")
+        failpoints.fire("dispatch_kernel", batch=1)  # predicate mismatch
+        with pytest.raises(RuntimeError):
+            failpoints.fire("dispatch_kernel", batch=2)
+        failpoints.fire("dispatch_kernel", batch=2)  # times exhausted
+        assert failpoints.fired_counts() == {"dispatch_kernel": 1}
+
+    def test_hit_predicate(self):
+        failpoints.arm("ckpt_finalize=raise@hit=2")
+        failpoints.fire("ckpt_finalize")
+        with pytest.raises(RuntimeError):
+            failpoints.fire("ckpt_finalize")
+        failpoints.fire("ckpt_finalize")  # hit 3 != 2
+
+    def test_probability_is_seed_deterministic(self):
+        def fires(seed):
+            failpoints.arm(f"bgzf_write=raise:p=0.5:seed={seed}")
+            got = []
+            for _ in range(32):
+                try:
+                    failpoints.fire("bgzf_write")
+                    got.append(0)
+                except RuntimeError:
+                    got.append(1)
+            return got
+
+        a, b = fires(42), fires(42)
+        assert a == b
+        assert 0 < sum(a) < 32
+        assert fires(43) != a
+
+    def test_io_error_action_raises_oserror(self):
+        failpoints.arm("extsort_spill=io_error")
+        with pytest.raises(OSError):
+            failpoints.fire("extsort_spill")
+
+    def test_unarmed_is_silent_and_eventless(self, ledger):
+        failpoints.fire("dispatch_kernel", batch=1)
+        assert failpoints.fired_counts() == {}
+        assert ledger_events(ledger) == []
+
+    def test_fired_failpoint_is_ledgered(self, ledger):
+        failpoints.arm("dispatch_kernel=raise:times=1")
+        with pytest.raises(RuntimeError):
+            failpoints.fire("dispatch_kernel", batch=4, stage="molecular")
+        (ev,) = ledger_events(ledger, "failpoint_fired")
+        assert ev["site"] == "dispatch_kernel"
+        assert ev["batch"] == 4 and ev["stage"] == "molecular"
+
+
+# ---------------------------------------------------------------------------
+# retry executor
+
+
+class TestRetryExecutor:
+    def test_transient_failure_recovers(self, ledger):
+        m = observe.Metrics()
+        calls = []
+
+        def unit():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        out = retry.guarded(unit, metrics=m, stage="s", batch=7,
+                            sleep=lambda s: None)
+        assert out == "ok" and len(calls) == 3
+        assert m.counters["batches_retried"] == 1
+        assert m.counters["retry_attempts"] == 2
+        assert m.counters["batches_recovered"] == 1
+        assert len(ledger_events(ledger, "batch_retry")) == 2
+        assert len(ledger_events(ledger, "batch_recovered")) == 1
+
+    def test_persistent_failure_degrades(self, ledger):
+        m = observe.Metrics()
+
+        def unit():
+            raise RuntimeError("persistent")
+
+        out = retry.guarded(unit, degrade=lambda: "twin", metrics=m,
+                            sleep=lambda s: None)
+        assert out == "twin"
+        assert m.counters["batches_degraded"] == 1
+        assert len(ledger_events(ledger, "batch_degraded")) == 1
+
+    def test_no_degrade_reraises_after_bound(self):
+        calls = []
+
+        def unit():
+            calls.append(1)
+            raise OSError("disk")
+
+        with pytest.raises(OSError):
+            retry.guarded(unit, sleep=lambda s: None)
+        assert len(calls) == retry.policy_from_env().max_attempts
+
+    def test_programming_errors_are_not_retried(self):
+        calls = []
+
+        def unit():
+            calls.append(1)
+            raise ValueError("bug")
+
+        with pytest.raises(ValueError):
+            retry.guarded(unit, degrade=lambda: "no", sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_failed_seed_counts_as_first_attempt(self):
+        calls = []
+        retry.guarded(
+            lambda: calls.append(1), failed=RuntimeError("pre"),
+            sleep=lambda s: None,
+        )
+        assert len(calls) == 1
+
+    def test_backoff_grows_and_caps(self):
+        slept = []
+        calls = []
+
+        def unit():
+            calls.append(1)
+            if len(calls) < 4:
+                raise RuntimeError("x")
+
+        retry.guarded(
+            unit, sleep=slept.append,
+            policy=retry.RetryPolicy(max_attempts=5, backoff_s=0.5,
+                                     backoff_cap_s=1.0),
+        )
+        assert slept == [0.5, 1.0, 1.0]
+
+
+# ---------------------------------------------------------------------------
+# stage-level recovery (the batch loop heals itself)
+
+
+class TestStageRecovery:
+    def test_transient_dispatch_failure_output_identical(self, grouped):
+        _, records = grouped
+        want = canon(call_molecular(iter(records), batch_families=4))
+        failpoints.arm("dispatch_kernel=raise:RuntimeError:times=1@batch=2")
+        stats = StageStats()
+        got = canon(
+            call_molecular(iter(records), batch_families=4, stats=stats)
+        )
+        assert got == want
+        assert stats.batches_retried == 1
+        assert stats.batches_recovered == 1
+        assert stats.batches_degraded == 0
+        assert stats.as_dict()["batches_retried"] == 1
+
+    def test_fetch_failure_redispatches_whole_unit(self, grouped):
+        _, records = grouped
+        want = canon(call_molecular(iter(records), batch_families=4))
+        failpoints.arm("fetch_out=io_error:times=1@batch=3")
+        stats = StageStats()
+        got = canon(
+            call_molecular(iter(records), batch_families=4, stats=stats)
+        )
+        assert got == want and stats.batches_retried == 1
+
+    def test_persistent_failure_degrades_to_host_twin(self, grouped, ledger):
+        _, records = grouped
+        want = canon(call_molecular(iter(records), batch_families=4))
+        failpoints.arm("dispatch_kernel=raise:RuntimeError@batch=2")
+        stats = StageStats()
+        got = canon(
+            call_molecular(iter(records), batch_families=4, stats=stats)
+        )
+        assert got == want
+        assert stats.batches_degraded == 1
+        assert ledger_events(ledger, "batch_degraded")
+        assert stats.metrics.seconds.get("degrade", 0) > 0
+
+    def test_stall_watchdog_redispatches(self, grouped, monkeypatch, ledger):
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "1")
+        monkeypatch.setenv("BSSEQ_TPU_STALL_TIMEOUT_S", "0.2")
+        _, records = grouped
+        failpoints.arm("fetch_out=stall:1.5s:times=1@batch=1")
+        stats = StageStats()
+        got = run_single_device(records, stats)
+        failpoints.disarm()
+        monkeypatch.delenv("BSSEQ_TPU_OVERLAP_THREADS")
+        monkeypatch.delenv("BSSEQ_TPU_STALL_TIMEOUT_S")
+        want = run_single_device(records)
+        assert got == want
+        assert stats.batches_stalled >= 1
+        assert ledger_events(ledger, "batch_stall_redispatch")
+
+    def test_retire_future_failure_recovers(self, grouped, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "1")
+        _, records = grouped
+        failpoints.arm("retire_future=raise:RuntimeError:times=1")
+        stats = StageStats()
+        got = run_single_device(records, stats)
+        failpoints.disarm()
+        monkeypatch.delenv("BSSEQ_TPU_OVERLAP_THREADS")
+        want = run_single_device(records)
+        assert got == want and stats.batches_retried == 1
+
+
+# ---------------------------------------------------------------------------
+# overlap-pool / round-robin teardown (ISSUE 3 satellite): a batch that
+# raises mid-flight must not leak its device allocation or wedge the pool
+
+
+class TestTeardown:
+    def test_wire_roundrobin_dispatch_failure_no_leak(self, grouped):
+        """Injected dispatch failure on the multi-device round-robin wire
+        path: the batch retires exactly once (byte-identical stream) and
+        the failed attempt's device wire buffer does not outlive the
+        stage. The round-robin advance consumed by the failed attempt is
+        benign — the ring is cyclic, the retry just lands on the next
+        device."""
+        import jax
+
+        _, records = grouped
+
+        def run(stats=None):
+            return canon(
+                x
+                for b in call_molecular_batches(
+                    iter(records), batch_families=4, transport="wire",
+                    mesh="auto", stats=stats,
+                )
+                for x in b
+            )
+
+        want = run()  # warm jit/device caches
+        gc.collect()
+        baseline = len(jax.live_arrays())
+        failpoints.arm("dispatch_kernel=raise:RuntimeError:times=1@batch=2")
+        stats = StageStats()
+        got = run(stats)
+        assert got == want and stats.batches_retried == 1
+        gc.collect()
+        # the failed dispatch's wire buffer must not survive the stage
+        assert len(jax.live_arrays()) <= baseline
+
+    def test_abandoned_stream_shuts_pool_down(self, grouped, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        _, records = grouped
+        gen = call_molecular_batches(
+            iter(records), batch_families=4, mesh=None
+        )
+        next(gen)
+        gen.close()  # consumer abandons mid-stream
+        alive = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith("bsseq-ovl") and t.is_alive()
+        ]
+        assert alive == []
+
+
+# ---------------------------------------------------------------------------
+# io / native / multihost sites
+
+
+class TestIoSites:
+    def test_bgzf_inflate_fault_surfaces_as_io_error(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bgzf import BgzfReader, BgzfWriter
+
+        path = str(tmp_path / "x.bgzf")
+        with BgzfWriter.open(path) as w:
+            w.write(b"x" * 100)
+        failpoints.arm("bgzf_inflate=io_error:times=1")
+        with pytest.raises(OSError):
+            with BgzfReader.open(path) as r:
+                r.read_all()
+        # second read: the schedule is exhausted, decode is intact
+        with BgzfReader.open(path) as r:
+            assert r.read_all() == b"x" * 100
+
+    def test_bgzf_write_fault(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.bgzf import BgzfWriter
+
+        failpoints.arm("bgzf_write=io_error")
+        with pytest.raises(OSError):
+            w = BgzfWriter.open(str(tmp_path / "y.bgzf"))
+            w.write(b"y" * 100)
+            w.flush()
+
+    def test_native_load_fault_degrades_to_python(self):
+        from bsseqconsensusreads_tpu.io._nativelib import load_library
+
+        failpoints.arm("native_load=raise:RuntimeError")
+        lib, err = load_library("libbamio.so", "bamio.cpp")
+        assert lib is None and "failpoint injected" in err
+
+    def test_heartbeat_loss_drops_beat_but_leaves_evidence(self, ledger):
+        from bsseqconsensusreads_tpu.parallel.multihost import WorkerHeartbeat
+
+        hb = WorkerHeartbeat("t")
+        hb.beat()
+        assert len(ledger_events(ledger, "worker_heartbeat")) == 1
+        failpoints.arm("multihost_heartbeat=raise:times=1")
+        hb.beat()  # lost: no heartbeat event, but the firing is ledgered
+        assert len(ledger_events(ledger, "worker_heartbeat")) == 1
+        assert len(ledger_events(ledger, "failpoint_fired")) == 1
+        hb.beat()
+        assert len(ledger_events(ledger, "worker_heartbeat")) == 2
+
+    def test_collective_fault_propagates(self):
+        from bsseqconsensusreads_tpu.parallel.multihost import (
+            global_family_batch,
+            multihost_family_mesh,
+        )
+
+        mesh = multihost_family_mesh()
+        n = mesh.devices.size
+        arr = np.zeros((n, 4), np.int8)
+        failpoints.arm("multihost_collective=raise:RuntimeError:times=1")
+        with pytest.raises(RuntimeError):
+            global_family_batch((arr,), n, mesh)
+        (out,) = global_family_batch((arr,), n, mesh)  # healthy after
+        assert out.shape == (n, 4)
+
+
+# ---------------------------------------------------------------------------
+# integrity
+
+
+class TestIntegrity:
+    def test_crc_roundtrip_and_mismatch(self, tmp_path, ledger):
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"abc" * 1000)
+        crc = integrity.file_crc32(str(p))
+        integrity.verify_file_crc32(str(p), crc)
+        p.write_bytes(b"abd" * 1000)
+        with pytest.raises(integrity.IntegrityError):
+            integrity.verify_file_crc32(str(p), crc)
+        assert ledger_events(ledger, "integrity_mismatch")
+
+    def test_missing_file_is_integrity_error(self, tmp_path):
+        with pytest.raises(integrity.IntegrityError):
+            integrity.verify_file_crc32(str(tmp_path / "gone"), 0)
+
+    def test_spill_run_corruption_fails_merge(self, grouped, tmp_path):
+        """A spill run corrupted on disk between spill and merge is an
+        IntegrityError at merge open — never silently merged. The
+        corruption happens mid-iteration (after the first run spilled,
+        before the merge opens it), like a bad disk would do it."""
+        import glob
+
+        from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
+        from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+        header, records = grouped
+
+        def corrupting(recs):
+            for i, rec in enumerate(recs):
+                if i == 25:  # first run (buffer 10) is on disk by now
+                    (run0,) = glob.glob(
+                        str(tmp_path / "bsseq_extsort_*" / "run00000.bam")
+                    )
+                    blob = bytearray(open(run0, "rb").read())
+                    blob[len(blob) // 2] ^= 0xFF
+                    open(run0, "wb").write(bytes(blob))
+                yield rec
+
+        gen = external_sort(
+            corrupting(iter(records)), coordinate_key, header,
+            workdir=str(tmp_path), buffer_records=10,
+        )
+        with pytest.raises(integrity.IntegrityError):
+            list(gen)
+
+    def test_spill_io_error_retried(self, grouped):
+        from bsseqconsensusreads_tpu.pipeline.extsort import external_sort
+        from bsseqconsensusreads_tpu.pipeline.record_ops import coordinate_key
+
+        header, records = grouped
+        want = [
+            r.qname
+            for r in external_sort(
+                iter(records), coordinate_key, header, buffer_records=10
+            )
+        ]
+        failpoints.arm("extsort_spill=io_error:times=1")
+        m = observe.Metrics()
+        got = [
+            r.qname
+            for r in external_sort(
+                iter(records), coordinate_key, header, buffer_records=10,
+            )
+        ]
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# tier-1 chaos smoke: scheduled faults over the mini pipeline, output
+# byte-identical, recovery counters non-zero in the ledger
+
+
+class TestChaosSmoke:
+    def _run(self, tmp_path, outdir, monkeypatch, sink):
+        from bsseqconsensusreads_tpu.config import FrameworkConfig
+        from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        cfg = FrameworkConfig(
+            genome_dir=str(tmp_path), genome_fasta_file_name="genome.fa",
+            tmp=str(tmp_path), aligner="self", backend="cpu",
+            grouping="coordinate", batch_families=8, checkpoint_every=2,
+            sort_buffer_records=32,
+        )
+        target, _, stats = run_pipeline(
+            cfg, str(tmp_path / "input" / "in.bam"), outdir=outdir
+        )
+        observe.flush_sinks()
+        observe.close_sinks()
+        return target, stats
+
+    def test_scheduled_faults_byte_identical(self, tmp_path, monkeypatch):
+        from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+        from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+        from bsseqconsensusreads_tpu.utils.testing import (
+            stream_duplex_families,
+            write_fasta,
+        )
+
+        rng = np.random.default_rng(88)
+        codes = rng.integers(0, 4, size=12_000).astype(np.int8)
+        write_fasta(str(tmp_path / "genome.fa"), "chr1", codes_to_seq(codes))
+        header = BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 12_000)]
+        )
+        os.makedirs(tmp_path / "input")
+        with BamWriter(str(tmp_path / "input" / "in.bam"), header) as w:
+            for rec in stream_duplex_families(
+                codes, 40, read_len=60, bisulfite=True,
+                templates_for=lambda f: 1 if f % 3 else 2,
+            ):
+                w.write(rec)
+
+        plain_sink = str(tmp_path / "plain.jsonl")
+        target, _ = self._run(
+            tmp_path, str(tmp_path / "out_plain"), monkeypatch, plain_sink
+        )
+        want = open(target, "rb").read()
+        # an unarmed run emits no fault/recovery events at all
+        assert ledger_events(plain_sink, "failpoint_fired") == []
+        assert ledger_events(plain_sink, "batch_retry") == []
+
+        failpoints.arm(
+            "dispatch_kernel=raise:RuntimeError:times=1@stage=molecular;"
+            "fetch_out=io_error:times=1@stage=duplex;"
+            "extsort_spill=io_error:times=1"
+        )
+        sink = str(tmp_path / "chaos.jsonl")
+        target2, stats = self._run(
+            tmp_path, str(tmp_path / "out_chaos"), monkeypatch, sink
+        )
+        failpoints.disarm()
+        assert open(target2, "rb").read() == want
+        assert len(ledger_events(sink, "failpoint_fired")) == 3
+        assert stats["molecular"].batches_retried >= 1
+        assert stats["duplex"].batches_retried >= 1
+        # the stage_stats ledger lines carry the recovery counters
+        mol = [
+            e for e in ledger_events(sink, "stage_stats")
+            if e["stage"] == "molecular"
+        ]
+        assert mol and mol[0]["batches_retried"] >= 1

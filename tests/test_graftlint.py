@@ -33,6 +33,7 @@ FIXTURES = {
     "unordered-shape-iter": "fx_unordered_iter.py",
     "stderr-print": "fx_stderr_print.py",
     "swallowed-exception": "fx_swallowed_exception.py",
+    "unbounded-retry": "fx_unbounded_retry.py",
 }
 
 

@@ -1,0 +1,334 @@
+#!/usr/bin/env python
+"""Chaos drill: prove the self-healing batch pipeline heals.
+
+Runs the mini self-aligned pipeline (the same shape as
+tests/test_crash_resume_pipeline.py) in child processes under scripted
+fault schedules (faults.failpoints) and asserts, for EVERY registered
+site class the batch loop owns:
+
+* raise / io_error / stall faults at dispatch, fetch, spill and shard
+  write are retried / re-dispatched / degraded and the final BAM is
+  BYTE-IDENTICAL to a fault-free run (every family retired exactly
+  once);
+* a hard kill at batch N (failpoint action `exit`) plus a resume
+  re-executes only the non-durable suffix (ledger batch counts) and
+  still reproduces the reference bytes;
+* a corrupt checkpoint shard on resume is quarantined and its batches
+  recomputed — never spliced into the output.
+
+Writes FAULTS_HEAD.json (wired into bench.py's artifact flow). `--quick`
+shrinks the input for the CI/bench ride-along; the scenarios are the
+same.
+
+Usage:
+    python tools/chaos_drill.py [--quick] [--out FAULTS_HEAD.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHILD_TIMEOUT = 600
+
+
+def _child(wd: str, bam: str, outdir: str) -> None:
+    """One pipeline run (invoked as `chaos_drill.py --child wd bam out`):
+    env carries the fault schedule + ledger sink."""
+    os.environ["BSSEQ_TPU_BACKEND"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    cfg = FrameworkConfig(
+        genome_dir=wd, genome_fasta_file_name="genome.fa", tmp=wd,
+        aligner="self", grouping="coordinate", batch_families=8,
+        checkpoint_every=2,
+        sort_buffer_records=64,  # small: the raw sort must actually spill
+    )
+    target, _, stats = run_pipeline(cfg, bam, outdir=outdir)
+    print(json.dumps({
+        "target": target,
+        "stages": {k: s.as_dict() for k, s in stats.items()},
+    }))
+
+
+def _build_input(wd: str, n_families: int, genome_len: int) -> str:
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+    from bsseqconsensusreads_tpu.utils.testing import (
+        stream_duplex_families,
+        write_fasta,
+    )
+
+    rng = np.random.default_rng(88)
+    codes = rng.integers(0, 4, size=genome_len).astype(np.int8)
+    write_fasta(os.path.join(wd, "genome.fa"), "chr1", codes_to_seq(codes))
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", genome_len)])
+    bam = os.path.join(wd, "input", "in.bam")
+    os.makedirs(os.path.dirname(bam), exist_ok=True)
+    with BamWriter(bam, header) as w:
+        for rec in stream_duplex_families(
+            codes, n_families, read_len=60, bisulfite=True,
+            templates_for=lambda f: 1 if f % 3 else 2,
+        ):
+            w.write(rec)
+    return bam
+
+
+def _run_child(wd: str, bam: str, outdir: str, ledger: str,
+               failpoints: str = "", env_extra: dict | None = None):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BSSEQ_TPU_BACKEND="cpu",
+        BSSEQ_TPU_STATS=ledger,
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+        BSSEQ_TPU_FAILPOINTS=failpoints,
+    )
+    if not failpoints:
+        env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", wd, bam, outdir],
+        env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT,
+    )
+
+
+def _ledger_counts(path: str) -> dict:
+    counts: dict[str, int] = {}
+    if not os.path.exists(path):
+        return counts
+    with open(path) as fh:
+        for line in fh:
+            try:
+                ev = json.loads(line).get("event")
+            except json.JSONDecodeError:
+                continue
+            counts[ev] = counts.get(ev, 0) + 1
+    return counts
+
+
+def _child_payload(cp) -> dict:
+    for line in reversed(cp.stdout.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"child produced no payload: {cp.stderr[-2000:]}")
+
+
+def _stage_counter(payload: dict, stage: str, key: str) -> int:
+    return int(payload["stages"].get(stage, {}).get(key, 0) or 0)
+
+
+#: Scenario table: fault schedule + what must have happened (beyond the
+#: universal byte-identity check). `expect` maps to (source, key, min):
+#: source 'stage:<name>' reads the child's stage stats, 'ledger' the
+#: run-ledger event counts.
+SCENARIOS = [
+    {
+        "name": "transient_raise_dispatch",
+        "failpoints": "dispatch_kernel=raise:RuntimeError:times=1@batch=2@stage=molecular",
+        "expect": [("stage:molecular", "batches_retried", 1)],
+    },
+    {
+        "name": "io_error_fetch_duplex",
+        "failpoints": "fetch_out=io_error:times=1@stage=duplex",
+        "expect": [("stage:duplex", "batches_retried", 1)],
+    },
+    {
+        "name": "stall_watchdog_redispatch",
+        "failpoints": "fetch_out=stall:2s:times=1@stage=molecular",
+        "env": {
+            "BSSEQ_TPU_OVERLAP_THREADS": "1",
+            "BSSEQ_TPU_STALL_TIMEOUT_S": "0.3",
+        },
+        "expect": [("stage:molecular", "batches_stalled", 1)],
+    },
+    {
+        "name": "persistent_raise_degrades_to_host_twin",
+        "failpoints": "dispatch_kernel=raise:RuntimeError@batch=1@stage=duplex",
+        "expect": [("stage:duplex", "batches_degraded", 1)],
+    },
+    {
+        "name": "io_error_extsort_spill",
+        "failpoints": "extsort_spill=io_error:times=1",
+        "expect": [("ledger", "batch_retry", 1)],
+    },
+    {
+        "name": "io_error_ckpt_shard_write",
+        "failpoints": "ckpt_shard_write=io_error:times=1",
+        "expect": [("ledger", "batch_retry", 1)],
+    },
+]
+
+
+def run_drill(quick: bool, out_path: str) -> dict:
+    import tempfile
+
+    n_families, genome_len = (60, 20_000) if quick else (150, 40_000)
+    results: dict[str, dict] = {}
+    with tempfile.TemporaryDirectory(prefix="bsseq_chaos_") as wd:
+        bam = _build_input(wd, n_families, genome_len)
+
+        # fault-free reference
+        t0 = time.monotonic()
+        cp = _run_child(wd, bam, os.path.join(wd, "out_ref"),
+                        os.path.join(wd, "ref.jsonl"))
+        if cp.returncode != 0:
+            raise RuntimeError(f"reference run failed: {cp.stderr[-2000:]}")
+        ref = _child_payload(cp)
+        ref_bytes = open(ref["target"], "rb").read()
+        ref_batches = _stage_counter(ref, "molecular", "batches")
+        results["reference"] = {
+            "ok": True,
+            "seconds": round(time.monotonic() - t0, 1),
+            "consensus_out": _stage_counter(ref, "duplex", "consensus_out"),
+        }
+
+        def check(name, cp, ledger, expect):
+            entry: dict = {"ok": False}
+            try:
+                if cp.returncode != 0:
+                    entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+                    return entry
+                payload = _child_payload(cp)
+                counts = _ledger_counts(ledger)
+                entry["faults_fired"] = counts.get("failpoint_fired", 0)
+                identical = open(payload["target"], "rb").read() == ref_bytes
+                entry["byte_identical"] = identical
+                ok = identical and entry["faults_fired"] > 0
+                for source, key, floor in expect:
+                    if source == "ledger":
+                        got = counts.get(key, 0)
+                    else:
+                        got = _stage_counter(
+                            payload, source.split(":", 1)[1], key
+                        )
+                    entry[key] = got
+                    ok = ok and got >= floor
+                entry["ok"] = ok
+                return entry
+            finally:
+                results[name] = entry
+
+        for sc in SCENARIOS:
+            outdir = os.path.join(wd, "out_" + sc["name"])
+            ledger = os.path.join(wd, sc["name"] + ".jsonl")
+            t0 = time.monotonic()
+            cp = _run_child(wd, bam, outdir, ledger, sc["failpoints"],
+                            sc.get("env"))
+            entry = check(sc["name"], cp, ledger, sc["expect"])
+            entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # kill-at-batch-N + resume: only the undone batches re-execute
+        outdir = os.path.join(wd, "out_kill")
+        ledger = os.path.join(wd, "kill.jsonl")
+        cp = _run_child(wd, bam, outdir, ledger,
+                        "dispatch_kernel=exit:9@batch=4@stage=molecular")
+        entry: dict = {"ok": False, "kill_rc": cp.returncode}
+        results["kill_at_batch_and_resume"] = entry
+        if cp.returncode == 9:
+            entry["faults_fired"] = _ledger_counts(ledger).get(
+                "failpoint_fired", 0
+            )
+            scraps = [
+                f for f in os.listdir(outdir)
+                if ".ckpt" in f or ".part" in f
+            ]
+            entry["durable_scraps"] = len(scraps)
+            cp2 = _run_child(wd, bam, outdir,
+                             os.path.join(wd, "resume.jsonl"))
+            if cp2.returncode == 0:
+                resumed = _child_payload(cp2)
+                entry["byte_identical"] = (
+                    open(resumed["target"], "rb").read() == ref_bytes
+                )
+                entry["resumed_batches"] = _stage_counter(
+                    resumed, "molecular", "batches"
+                )
+                entry["reference_batches"] = ref_batches
+                entry["ok"] = (
+                    entry["byte_identical"]
+                    and entry["durable_scraps"] > 0
+                    and entry["faults_fired"] > 0
+                    and entry["resumed_batches"] < ref_batches
+                )
+            else:
+                entry["error"] = f"resume rc={cp2.returncode}: " + cp2.stderr[-500:]
+
+        # kill during duplex finalize + a corrupt partial shard: resume
+        # quarantines and recomputes instead of splicing garbage
+        outdir = os.path.join(wd, "out_corrupt")
+        cp = _run_child(wd, bam, outdir, os.path.join(wd, "cr0.jsonl"),
+                        "ckpt_finalize=exit:9@hit=2")
+        entry = {"ok": False, "kill_rc": cp.returncode}
+        results["corrupt_shard_quarantine"] = entry
+        if cp.returncode == 9:
+            shards = sorted(
+                f for f in os.listdir(outdir)
+                if "_duplex_" in f and ".part" in f and f.endswith(".bam")
+            )
+            entry["duplex_shards"] = len(shards)
+            if shards:
+                victim = os.path.join(outdir, shards[-1])
+                blob = bytearray(open(victim, "rb").read())
+                blob[len(blob) // 2] ^= 0xFF
+                open(victim, "wb").write(bytes(blob))
+            ledger = os.path.join(wd, "cr1.jsonl")
+            cp2 = _run_child(wd, bam, outdir, ledger)
+            if cp2.returncode == 0 and shards:
+                resumed = _child_payload(cp2)
+                counts = _ledger_counts(ledger)
+                entry["quarantined"] = counts.get("shard_quarantined", 0)
+                entry["byte_identical"] = (
+                    open(resumed["target"], "rb").read() == ref_bytes
+                )
+                entry["ok"] = (
+                    entry["byte_identical"] and entry["quarantined"] >= 1
+                )
+            else:
+                entry["error"] = (
+                    f"resume rc={cp2.returncode}: " + cp2.stderr[-500:]
+                )
+
+    ok = all(v.get("ok") for v in results.values())
+    out = {
+        "metric": "chaos drill (fault injection + recovery)",
+        "ok": ok,
+        "quick": quick,
+        "families": n_families,
+        "scenarios": results,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    return out
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        _child(*sys.argv[2:5])
+        return 0
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller input (the bench.py ride-along)")
+    ap.add_argument("--out", default=os.path.join(REPO, "FAULTS_HEAD.json"))
+    args = ap.parse_args()
+    out = run_drill(args.quick, args.out)
+    print(json.dumps(out, indent=1))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
