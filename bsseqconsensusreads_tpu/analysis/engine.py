@@ -485,6 +485,7 @@ class PackageIndex:
 
 def all_rules() -> dict[str, Rule]:
     from bsseqconsensusreads_tpu.analysis import (
+        rules_hostphase,
         rules_io,
         rules_jax,
         rules_retry,
@@ -492,7 +493,8 @@ def all_rules() -> dict[str, Rule]:
     )
 
     rules: dict[str, Rule] = {}
-    for mod in (rules_jax, rules_thread, rules_io, rules_retry):
+    for mod in (rules_jax, rules_thread, rules_io, rules_retry,
+                rules_hostphase):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

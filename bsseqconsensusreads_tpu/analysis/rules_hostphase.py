@@ -1,0 +1,125 @@
+"""graftlint host-phase-discipline rule: serialized-host-phase.
+
+The failure class the PR-6 host-parallel review named (ROADMAP open
+item: grow a rule per failure class found in review): a host-phase
+ledger span — `timed('rawize')`, `timed('emit')`, any span the phase
+summary books as host time — executed inline BETWEEN a batch's
+`dispatch_kernel` and its `fetch_out` on a batch-loop-reachable path.
+That host work serializes against the in-flight device batch: the chip
+(or tunnel) finishes and then WAITS while the host grinds, which is
+exactly the wall the round-5 scale artifacts measured (the rawize pass
+alone was 242-277 s of the duplex stage). When a host pool is available
+(`parallel/hostpool.py` — or any linted file defining `host_workers`),
+such work belongs in a host-pool task retired in batch order, not on
+the dispatch thread mid-flight.
+
+The rule is lexical within one function: a host-phase `with ...timed()`
+whose line falls after a `dispatch_kernel(...)` call and before a later
+`fetch_out(...)` call. Host phases that run AFTER the fetch (the
+sanctioned worker-side retire shape) or before the dispatch (pipelined
+encode of the next batch) never match.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from bsseqconsensusreads_tpu.analysis.engine import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    call_basename,
+    timed_span_name,
+)
+
+#: Call basenames that put a batch in flight / retire it.
+_DISPATCH_CALLS = frozenset({"dispatch_kernel"})
+_FETCH_CALLS = frozenset({"fetch_out"})
+
+#: Span names that are NOT host phases: device/tunnel time plus the
+#: main-thread join on an overlapped batch (utils.observe DEVICE_PHASES
+#: / STALL_PHASES). Everything else a timed() block names is host work.
+_NON_HOST_SPANS = frozenset({"kernel", "device_wait", "fetch", "stall"})
+
+
+def _host_pool_available(index: PackageIndex) -> bool:
+    """Whether the linted file set ships a host pool to move the work
+    to — parallel/hostpool.py itself, or any definition of its
+    `host_workers` knob (fixtures seed the latter)."""
+    if "host_workers" in index.functions:
+        return True
+    return any(
+        os.path.basename(sf.display) == "hostpool.py" for sf in index.files
+    )
+
+
+def check_serialized_host_phase(
+    sf: SourceFile, index: PackageIndex
+) -> Iterator[Finding]:
+    if not _host_pool_available(index):
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = index.info(node)
+        if fi is None or fi.qualname not in index.hot_reachable:
+            continue
+        events: list[tuple[int, int, str, str | None]] = []
+        for sub in PackageIndex._own_nodes(node):
+            if isinstance(sub, ast.Call):
+                base = call_basename(sub)
+                if base in _DISPATCH_CALLS:
+                    events.append(
+                        (sub.lineno, sub.col_offset, "dispatch", None)
+                    )
+                elif base in _FETCH_CALLS:
+                    events.append((sub.lineno, sub.col_offset, "fetch", None))
+            elif isinstance(sub, ast.With):
+                for item in sub.items:
+                    name = timed_span_name(item.context_expr)
+                    if name is not None and name not in _NON_HOST_SPANS:
+                        events.append(
+                            (sub.lineno, sub.col_offset, "host", name)
+                        )
+        events.sort()
+        fetch_lines = [ln for ln, _, kind, _ in events if kind == "fetch"]
+        dispatched_at: int | None = None
+        for line, col, kind, name in events:
+            if kind == "dispatch":
+                dispatched_at = line
+            elif kind == "fetch":
+                dispatched_at = None
+            elif (
+                kind == "host"
+                and dispatched_at is not None
+                and any(fl > line for fl in fetch_lines)
+            ):
+                yield Finding(
+                    rule="serialized-host-phase",
+                    path=sf.display,
+                    line=line,
+                    col=col,
+                    message=(
+                        f"host phase timed({name!r}) runs inline between "
+                        "dispatch_kernel (line "
+                        f"{dispatched_at}) and fetch_out on a batch-loop "
+                        "path — it serializes host work against the "
+                        "in-flight device batch. A host pool is available "
+                        "(parallel.hostpool): submit the phase as a "
+                        "host-pool task retired in batch order, or move "
+                        "it after the fetch"
+                    ),
+                )
+
+
+RULES = [
+    Rule(
+        name="serialized-host-phase",
+        summary="host-phase timed() span inline between dispatch_kernel "
+        "and fetch_out when a host pool is available",
+        check=check_serialized_host_phase,
+    ),
+]

@@ -5,10 +5,11 @@ must not lose hours to one flaky transfer) needs three things the rest
 of the framework provides hooks for but nothing exercises:
 
 * `failpoints` — named, deterministically-scheduled injection sites
-  threaded through the whole hot path (dispatch/fetch/retire, extsort
-  spill/merge, checkpoint shard/manifest/finalize, BGZF inflate/write,
-  native library load, multihost heartbeat/collective). Armed via
-  `BSSEQ_TPU_FAILPOINTS` / `--failpoints`; zero-cost when unarmed.
+  threaded through the whole hot path (dispatch/fetch/retire, host-pool
+  tasks, extsort spill/merge, checkpoint shard/manifest/finalize, BGZF
+  inflate/write, native library load, multihost heartbeat/collective).
+  Armed via `BSSEQ_TPU_FAILPOINTS` / `--failpoints`; zero-cost when
+  unarmed.
 * `retry` — the batch-level retry executor: bounded exponential backoff
   for transient device/transfer errors, a stall watchdog for wedged
   overlap-pool futures, and graceful degradation to the host XLA twin

@@ -59,6 +59,8 @@ SITES = frozenset(
         "dispatch_kernel",
         "fetch_out",
         "retire_future",
+        # parallel.hostpool — host-parallel encode/rawize/emit tasks
+        "hostpool_task",
         # pipeline.extsort — spill runs + merge passes
         "extsort_spill",
         "extsort_merge",

@@ -89,6 +89,10 @@ class BgzfReader:
             raise BgzfError("BGZF ISIZE mismatch")
         if zlib.crc32(data) != crc:
             raise BgzfError("BGZF CRC mismatch")
+        # graftlint: disable=thread-unsafe-mutation -- reader state is
+        # thread-confined: every BgzfReader is created and consumed by
+        # one thread (the extsort background writer's CRC pass opens
+        # its own reader inside the task — faults.integrity.file_crc32)
         self._last_block_empty = len(data) == 0
         return data
 
@@ -103,13 +107,18 @@ class BgzfReader:
                     break
                 block = self._read_block()
                 if block is None:
+                    # graftlint: disable=thread-unsafe-mutation -- see
+                    # _read_block: readers are thread-confined
                     self._eof = True
                     break
+                # graftlint: disable=thread-unsafe-mutation -- confined
                 self._buf = block
+                # graftlint: disable=thread-unsafe-mutation -- confined
                 self._buf_off = 0
                 continue
             take = min(avail, need)
             parts.append(self._buf[self._buf_off : self._buf_off + take])
+            # graftlint: disable=thread-unsafe-mutation -- confined
             self._buf_off += take
             need -= take
         return b"".join(parts)

@@ -181,7 +181,11 @@ class NativeBgzfReader:
             raise IOError(_lib.bamio_error(self._h).decode())
         if got == 0:
             return False
+        # graftlint: disable=thread-unsafe-mutation -- reader state is
+        # thread-confined (one reader per thread; the extsort background
+        # writer's CRC pass opens its own — faults.integrity.file_crc32)
         self._buf = buf.raw[:got]
+        # graftlint: disable=thread-unsafe-mutation -- confined
         self._off = 0
         return True
 
@@ -189,6 +193,7 @@ class NativeBgzfReader:
         avail = len(self._buf) - self._off
         if avail >= n:  # fast path: serve from buffer
             out = self._buf[self._off : self._off + n]
+            # graftlint: disable=thread-unsafe-mutation -- confined reader
             self._off += n
             return out
         parts = [self._buf[self._off :]]
@@ -199,6 +204,7 @@ class NativeBgzfReader:
                 break
             take = min(need, len(self._buf))
             parts.append(self._buf[:take])
+            # graftlint: disable=thread-unsafe-mutation -- confined reader
             self._off = take
             need -= take
         return b"".join(parts)

@@ -24,3 +24,7 @@ from bsseqconsensusreads_tpu.parallel.deep_family import (  # noqa: F401
     deep_family_consensus,
 )
 from bsseqconsensusreads_tpu.parallel import multihost  # noqa: F401
+from bsseqconsensusreads_tpu.parallel.hostpool import (  # noqa: F401
+    HostPool,
+    host_workers,
+)

@@ -64,6 +64,7 @@ from bsseqconsensusreads_tpu.ops.encode import (
 )
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.faults import retry as _faultretry
+from bsseqconsensusreads_tpu.parallel import hostpool as _hostpool
 from bsseqconsensusreads_tpu.utils import observe
 
 from bsseqconsensusreads_tpu.io.fastq import reverse_complement as _revcomp
@@ -190,29 +191,45 @@ def _overlap_workers() -> int:
 def _make_overlap_pool(wire_rr, sharded_fn, stats=None, stage: str = ""):
     """(executor, pipeline_depth) for the overlap pipeline, or (None, 0)
     when inline dispatch is the right call (host backend, an explicit
-    disable, or the multi-device paths, which pipeline by device count
-    instead and whose round-robin state is not thread-safe). Depth is
-    workers + 1: every worker holds one batch, one more sits queued.
+    disable, or the sharded mesh path, which pipelines by device count).
+    Depth is workers + 1: every worker holds one batch, one more sits
+    queued.
+
+    The multi-device wire round-robin now COMPOSES with the pool instead
+    of mutually excluding it (the PR-2 lock made `next_device` safe from
+    worker threads): workers are raised to at least the device count so
+    every device keeps one batch in flight, dispatch/fetch ride the
+    workers, and the deepened retire queue keeps exactly-once,
+    batch-ordered retirement. Composition is ledgered
+    ('overlap_pool_composed' + the `overlap_rr_composed` counter).
 
     A disabled pool is LOUD: the reason lands in the ledger
     ('overlap_pool_disabled') and in the stage's named counter of the same
     name, so no run summary can hide that the stage dispatched inline
-    (VERDICT r5 weak #6: the multi-device paths switched it off silently)."""
+    (VERDICT r5 weak #6: the multi-device paths switched it off silently).
+    The one remaining round-robin fallback — zero overlap workers on a
+    multi-device wire path — reports reason 'round_robin_conflict'."""
     import os
 
     reason = None
-    if wire_rr is not None:
-        reason = "multi-device wire round-robin pipelines by device count"
-    elif sharded_fn is not None:
+    if sharded_fn is not None:
         reason = "sharded mesh path pipelines by device count"
     else:
         n = _overlap_workers()
         if n <= 0:
-            reason = (
-                "BSSEQ_TPU_OVERLAP_THREADS explicit disable"
-                if os.environ.get("BSSEQ_TPU_OVERLAP_THREADS") is not None
-                else "host backend: no device waits to hide"
-            )
+            if wire_rr is not None:
+                # weak-#6 closure: never a silent (None, 0) on a
+                # multi-device path — this branch only pipelines by
+                # device count, and says so
+                reason = (
+                    "round_robin_conflict: no overlap workers on this "
+                    "backend/config; the multi-device wire round-robin "
+                    "pipelines by device count alone"
+                )
+            elif os.environ.get("BSSEQ_TPU_OVERLAP_THREADS") is not None:
+                reason = "BSSEQ_TPU_OVERLAP_THREADS explicit disable"
+            else:
+                reason = "host backend: no device waits to hide"
     if reason is not None:
         if stats is not None:
             stats.metrics.count("overlap_pool_disabled")
@@ -220,6 +237,14 @@ def _make_overlap_pool(wire_rr, sharded_fn, stats=None, stage: str = ""):
             "overlap_pool_disabled", {"stage": stage, "reason": reason}
         )
         return None, 0
+    if wire_rr is not None:
+        n = max(n, len(wire_rr))
+        if stats is not None:
+            stats.metrics.count("overlap_rr_composed")
+        observe.emit(
+            "overlap_pool_composed",
+            {"stage": stage, "workers": n, "devices": len(wire_rr)},
+        )
     from concurrent.futures import ThreadPoolExecutor
 
     pool = ThreadPoolExecutor(max_workers=n, thread_name_prefix="bsseq-ovl")
@@ -394,6 +419,62 @@ def _pipelined(events, depth: int = 1):
             yield pending.popleft()()
     finally:
         pending.clear()
+
+
+#: StageStats integer fields the host-pool shadow/merge protocol carries
+#: (everything a worker-side emit/encode may increment; metrics is shared
+#: and lock-protected, so it is NOT shadowed).
+_HP_MERGE_FIELDS = (
+    "families",
+    "consensus_out",
+    "skipped_families",
+    "leftover_records",
+    "indel_aligned",
+    "indel_dropped",
+    "pad_cells",
+    "used_cells",
+)
+
+
+def _hp_stats_shadow(stats: "StageStats") -> "StageStats":
+    """A per-task StageStats for host-pool work: SHARES the stage's
+    locked Metrics (phase seconds and counters accumulate thread-safely,
+    keeping host_s attribution under parallelism) but has private
+    integer fields, so worker-side emit math never races the stage's
+    counts — the ints merge at the ordered main-thread retire
+    (_hp_stats_merge), making every count deterministic for any
+    BSSEQ_TPU_HOST_WORKERS value."""
+    return StageStats(stage=stats.stage, metrics=stats.metrics)
+
+
+def _hp_stats_merge(dst: "StageStats", src: "StageStats") -> None:
+    """Fold one retired host-pool task's shadow counts into the stage
+    stats (main thread, batch order)."""
+    for name in _HP_MERGE_FIELDS:
+        setattr(dst, name, getattr(dst, name) + getattr(src, name))
+
+
+def _hp_prefetch(items, pool: "object", task_fn):
+    """Double-buffered host-pool map: task_fn(item N+1) runs on a worker
+    while the caller consumes task_fn(item N)'s result. Results yield
+    strictly in input order, and at most ONE task is in flight — enough
+    to hide encode behind dispatch/retire without ever running two
+    encodes (and thus two `ref_fetch` callers, which the io layer does
+    not promise to support) concurrently. `items` is still pulled on the
+    caller's thread, so record ingest stays main-thread."""
+    pending = None
+    try:
+        for item in items:
+            fut = pool.submit(task_fn, item)
+            if pending is not None:
+                yield pending.result()
+            pending = fut
+        if pending is not None:
+            fut, pending = pending, None
+            yield fut.result()
+    finally:
+        if pending is not None:
+            pending.cancel()
 
 
 def _resolve_vote_kernel(vote_kernel: str | None) -> str:
@@ -1106,6 +1187,7 @@ def call_molecular_batches(
     pool, pool_depth = _make_overlap_pool(
         wire_rr, sharded_fn, stats, stats.stage or "molecular"
     )
+    hpool = _hostpool.make_pool(stats.metrics, stage_label)
 
     def is_singleton_batch(batch) -> bool:
         """T == 1 batches (the cfDNA majority at scale) never touch the
@@ -1204,12 +1286,17 @@ def call_molecular_batches(
             )
             return {k: v[:f] for k, v in out.items()}
 
-    def emit_out(out, batch, deep_emitted):
+    def emit_out(out, batch, deep_emitted, st=None):
+        """Record emit for one retired batch. `st` selects the stats the
+        emit math mutates: the stage stats inline, a per-task shadow on
+        the host pool (counts merge at the ordered retire — see
+        _hp_stats_shadow)."""
+        st = stats if st is None else st
         with stats.metrics.timed("emit"):
-            main = emit_fn(batch, out, params, mode, stats)
-        if isinstance(main, RawRecords):
-            return [main] + deep_emitted
-        return main + deep_emitted
+            recs = emit_fn(batch, out, params, mode, st)
+        if isinstance(recs, RawRecords):
+            return [recs] + deep_emitted
+        return recs + deep_emitted
 
     def retire_and_emit(wire, pf, batch, bi, deep_emitted):
         try:
@@ -1217,12 +1304,7 @@ def call_molecular_batches(
         except _faultretry.RETRYABLE as exc:
             # the dispatched wire is lost with its failed fetch: recovery
             # re-runs the whole dispatch+fetch unit under the retrier
-            out = _faultretry.guarded(
-                partial(dispatch_fetch, batch, bi),
-                degrade=partial(degrade_fetch, batch),
-                metrics=stats.metrics, stage=stage_label, batch=bi,
-                failed=exc,
-            )
+            out = recover_fetch(batch, bi, exc)
         return emit_out(out, batch, deep_emitted)
 
     def dispatch_fetch(batch, bi=None) -> dict:
@@ -1258,6 +1340,75 @@ def call_molecular_batches(
             metrics=stats.metrics, stage=stage_label, batch=bi,
         )
 
+    def recover_fetch(batch, bi, exc):
+        """Re-run the whole dispatch+fetch unit under the retrier after
+        `exc` — the ONE recovery entry the retire paths share."""
+        return _faultretry.guarded(
+            partial(dispatch_fetch, batch, bi),
+            degrade=partial(degrade_fetch, batch),
+            metrics=stats.metrics, stage=stage_label, batch=bi,
+            failed=exc,
+        )
+
+    def hp_retire(wire, pf, batch, bi, deep_emitted):
+        """Host-pool task for an inline-dispatched batch: blocking fetch
+        + record emit against a shadow stats, off the main thread.
+        Returns (emitted, shadow); the ordered main-thread join merges
+        the shadow (retire_host_future). Idempotent — the hostpool
+        retry wrapper may run it again after an injected fault."""
+        shadow = _hp_stats_shadow(stats)
+        try:
+            out = fetch_out(wire, pf, batch, bi)
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, bi, exc)
+        return emit_out(out, batch, deep_emitted, shadow), shadow
+
+    def hp_join_retire(fut, batch, bi, deep_emitted):
+        """Host-pool task for an overlap-dispatched batch: join the
+        device worker's (already guarded) future, then emit against a
+        shadow — so with both pools active the device pipeline and the
+        host phases each have their own workers."""
+        shadow = _hp_stats_shadow(stats)
+        try:
+            out = fut.result()
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, bi, exc)
+        return emit_out(out, batch, deep_emitted, shadow), shadow
+
+    def hp_vote_emit(batch, bi, deep_emitted):
+        """Host-pool task for a T==1 singleton batch: the whole host
+        vote + emit (the cfDNA-majority path never touches the device —
+        the dominant pure-host share at scale)."""
+        shadow = _hp_stats_shadow(stats)
+        out = dispatch_fetch_guarded(batch, bi)
+        return emit_out(out, batch, deep_emitted, shadow), shadow
+
+    def retire_host_future(hfut, batch, bi, deep_emitted):
+        """Ordered main-thread retire of one host-pool task: 'stall' is
+        the unhidden remainder, the watchdog abandons a wedged task and
+        recomputes the batch inline (exactly-once retire — the wedged
+        task's result is discarded), and the shadow counts merge HERE,
+        in batch order, so every stat is deterministic for any
+        BSSEQ_TPU_HOST_WORKERS."""
+
+        def redispatch(b, i):
+            out = dispatch_fetch_guarded(b, i)
+            sh = _hp_stats_shadow(stats)
+            return emit_out(out, b, deep_emitted, sh), sh
+
+        try:
+            _failpoints.fire("retire_future", stage=stage_label, batch=bi)
+            with stats.metrics.timed("stall"):
+                emitted, shadow = _join_with_watchdog(
+                    hfut, batch, bi, redispatch, stats, stage_label
+                )
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, bi, exc)
+            shadow = _hp_stats_shadow(stats)
+            emitted = emit_out(out, batch, deep_emitted, shadow)
+        _hp_stats_merge(stats, shadow)
+        return emitted
+
     def retire_future(fut, batch, bi, deep_emitted):
         """Main-thread retire of one overlapped batch: join the worker
         ('stall' = main-thread seconds actually blocked on it — the
@@ -1273,12 +1424,7 @@ def call_molecular_batches(
                     stage_label,
                 )
         except _faultretry.RETRYABLE as exc:
-            out = _faultretry.guarded(
-                partial(dispatch_fetch, batch, bi),
-                degrade=partial(degrade_fetch, batch),
-                metrics=stats.metrics, stage=stage_label, batch=bi,
-                failed=exc,
-            )
+            out = recover_fetch(batch, bi, exc)
         return emit_out(out, batch, deep_emitted)
 
     def run_deep_kernel(batch):
@@ -1326,25 +1472,44 @@ def call_molecular_batches(
             f"unknown batching {batching!r} (want 'bucketed'|'sequential')"
         )
 
-    def events():
+    def encode_chunk(item):
+        """Pure-host encode/pack of one chunk — a host-pool task when
+        the engine is on (double-buffered via _hp_prefetch, so chunk
+        N+1 encodes while batch N dispatches/retires). Pure function of
+        the chunk: all stat counts apply on the main thread, in batch
+        order."""
+        bi, chunk = item
+        normal, deep = _split_deep(chunk, deep_threshold, indel_policy)
+        with stats.metrics.timed("encode"):
+            # cap must track the routing threshold: a family the
+            # splitter classified 'normal' (<= deep_threshold
+            # templates) must never hit encode's default cap and be
+            # silently skipped
+            batch, skipped = encode_molecular_families(
+                normal, max_window=max_window,
+                max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
+                indel_policy=indel_policy,
+            )
+        return bi, batch, skipped, deep
+
+    def numbered_chunks():
         batch_index = 0
         for chunk in chunks:
             batch_index += 1
             if batch_index <= skip_batches:
+                # resume replay: skipped batches never encode at all
                 continue
-            normal, deep = _split_deep(chunk, deep_threshold, indel_policy)
+            yield batch_index, chunk
+
+    def events():
+        encoded = (
+            _hp_prefetch(numbered_chunks(), hpool, encode_chunk)
+            if hpool is not None
+            else map(encode_chunk, numbered_chunks())
+        )
+        for batch_index, batch, skipped, deep in encoded:
             if deep:  # deep-family routing is rare enough to ledger
                 stats.metrics.count("deep_routed_families", len(deep))
-            with stats.metrics.timed("encode"):
-                # cap must track the routing threshold: a family the
-                # splitter classified 'normal' (<= deep_threshold
-                # templates) must never hit encode's default cap and be
-                # silently skipped
-                batch, skipped = encode_molecular_families(
-                    normal, max_window=max_window,
-                    max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
-                    indel_policy=indel_policy,
-                )
             stats.skipped_families += len(skipped)
             stats.indel_aligned += batch.indel_aligned
             stats.indel_dropped += batch.indel_dropped
@@ -1381,9 +1546,30 @@ def call_molecular_batches(
             stats.pad_cells += batch.bases.size - used
             stats.used_cells += used
             if pool is not None:
+                fut = pool.submit(dispatch_fetch_guarded, batch, batch_index)
+                if hpool is not None:
+                    yield "deferred", partial(
+                        retire_host_future,
+                        hpool.submit(
+                            hp_join_retire, fut, batch, batch_index,
+                            deep_emitted, batch=batch_index,
+                        ),
+                        batch, batch_index, deep_emitted,
+                    )
+                    continue
                 yield "deferred", partial(
-                    retire_future,
-                    pool.submit(dispatch_fetch_guarded, batch, batch_index),
+                    retire_future, fut, batch, batch_index, deep_emitted,
+                )
+                continue
+            if hpool is not None and is_singleton_batch(batch):
+                # the T==1 host vote is pure host work: the whole unit
+                # rides a worker
+                yield "deferred", partial(
+                    retire_host_future,
+                    hpool.submit(
+                        hp_vote_emit, batch, batch_index, deep_emitted,
+                        batch=batch_index,
+                    ),
                     batch, batch_index, deep_emitted,
                 )
                 continue
@@ -1394,13 +1580,21 @@ def call_molecular_batches(
             except _faultretry.RETRYABLE as exc:
                 # dispatch itself failed: recover the whole unit now (the
                 # pipelined D2H overlap is already lost for this batch)
-                out = _faultretry.guarded(
-                    partial(dispatch_fetch, batch, batch_index),
-                    degrade=partial(degrade_fetch, batch),
-                    metrics=stats.metrics, stage=stage_label,
-                    batch=batch_index, failed=exc,
-                )
+                out = recover_fetch(batch, batch_index, exc)
                 yield "deferred", partial(emit_out, out, batch, deep_emitted)
+                continue
+            if hpool is not None:
+                # fetch + emit ride the host pool, overlapping the next
+                # batch's dispatch (the tentpole: host phases off the
+                # critical path)
+                yield "deferred", partial(
+                    retire_host_future,
+                    hpool.submit(
+                        hp_retire, out_dev, trim, batch, batch_index,
+                        deep_emitted, batch=batch_index,
+                    ),
+                    batch, batch_index, deep_emitted,
+                )
                 continue
             yield "deferred", partial(
                 retire_and_emit, out_dev, trim, batch, batch_index,
@@ -1408,11 +1602,15 @@ def call_molecular_batches(
             )
 
     depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)
+    if hpool is not None:
+        depth += hpool.workers
     try:
         yield from _pipelined(events(), depth=depth)
     finally:
         if pool is not None:
             pool.shutdown(wait=True, cancel_futures=True)
+        if hpool is not None:
+            hpool.shutdown()
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -1597,12 +1795,16 @@ def call_duplex_batches(
     pool, pool_depth = _make_overlap_pool(
         wire_rr, sharded_fn, stats, stats.stage or "duplex"
     )
+    hpool = _hostpool.make_pool(stats.metrics, stage_label)
     if use_wire and pool is not None:
         # pre-warm the one-time genome upload on the main thread (the lazy
         # property is lock-guarded, but warming here keeps the first two
         # worker dispatches from queueing behind a genome-sized transfer)
         refstore.device_codes
     genome_per_dev: dict = {}
+    # round-robin dispatch now runs on overlap workers (pool × wire_rr
+    # composition): the per-device genome cache needs its own lock
+    genome_lock = threading.Lock()
 
     def wire_window_offsets(batch):
         """(starts, limits) uint32 global offsets for one wire batch —
@@ -1636,13 +1838,17 @@ def call_duplex_batches(
     def _wire_device_args(words):
         """(words, genome) placed on this dispatch's device: the default
         device for single-device wire, else the round-robin target (the
-        genome is uploaded once per device and cached)."""
+        genome is uploaded once per device and cached — under the lock,
+        since composed overlap workers dispatch concurrently)."""
         if wire_rr is None:
             return words, refstore.device_codes
         dev = wire_rr.next_device()
-        g = genome_per_dev.get(dev.id)
-        if g is None:
-            g = genome_per_dev[dev.id] = jax.device_put(refstore.codes, dev)
+        with genome_lock:
+            g = genome_per_dev.get(dev.id)
+            if g is None:
+                g = genome_per_dev[dev.id] = jax.device_put(
+                    refstore.codes, dev
+                )
         return jax.device_put(words, dev), g
 
     def dispatch_kernel(batch, bi=None):
@@ -1726,24 +1932,79 @@ def call_duplex_batches(
                 strand_tags=strand_tags,
             )
 
-    def emit_out(out, batch, passed):
+    def emit_out(out, batch, passed, st=None):
+        """Record emit for one retired batch; `st` is the stage stats
+        inline, a per-task shadow on the host pool (_hp_stats_shadow)."""
+        st = stats if st is None else st
         with stats.metrics.timed("emit"):
-            main = emit_fn(batch, out, params, mode, stats)
-        if isinstance(main, RawRecords):
-            return [main] + passed
-        return main + passed
+            recs = emit_fn(batch, out, params, mode, st)
+        if isinstance(recs, RawRecords):
+            return [recs] + passed
+        return recs + passed
+
+    def recover_fetch(batch, sidecar, bi, exc):
+        """Re-run the whole dispatch+fetch+rawize unit under the retrier
+        after `exc` — the ONE recovery entry the retire paths share."""
+        return _faultretry.guarded(
+            partial(dispatch_fetch, batch, sidecar, bi),
+            degrade=partial(degrade_fetch, batch, sidecar),
+            metrics=stats.metrics, stage=stage_label, batch=bi,
+            failed=exc,
+        )
 
     def retire_and_emit(packed, pf, batch, passed, sidecar, bi):
         try:
             out = fetch_out(packed, pf, batch, sidecar, bi)
         except _faultretry.RETRYABLE as exc:
-            out = _faultretry.guarded(
-                partial(dispatch_fetch, batch, sidecar, bi),
-                degrade=partial(degrade_fetch, batch, sidecar),
-                metrics=stats.metrics, stage=stage_label, batch=bi,
-                failed=exc,
-            )
+            out = recover_fetch(batch, sidecar, bi, exc)
         return emit_out(out, batch, passed)
+
+    def hp_retire(packed, pf, batch, sidecar, bi, passed):
+        """Host-pool task for an inline-dispatched duplex batch: the
+        blocking fetch, the rawize tag passes (the round-5 host wall —
+        SCALERAWCPU_r05), and the record emit all run off the main
+        thread against a shadow stats. Returns (emitted, shadow);
+        idempotent for the hostpool retry wrapper."""
+        shadow = _hp_stats_shadow(stats)
+        try:
+            out = fetch_out(packed, pf, batch, sidecar, bi)
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, sidecar, bi, exc)
+        return emit_out(out, batch, passed, shadow), shadow
+
+    def hp_join_retire(fut, batch, sidecar, bi, passed):
+        """Host-pool task for an overlap-dispatched duplex batch: join
+        the device worker's (already guarded) future, then emit against
+        a shadow."""
+        shadow = _hp_stats_shadow(stats)
+        try:
+            out = fut.result()
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, sidecar, bi, exc)
+        return emit_out(out, batch, passed, shadow), shadow
+
+    def retire_host_future(hfut, batch, sidecar, bi, passed):
+        """Ordered main-thread retire of one host-pool task (see the
+        molecular twin): watchdog redispatch recomputes the whole batch
+        inline, shadow counts merge here in batch order."""
+
+        def redispatch(b, i):
+            out = dispatch_fetch_guarded(b, sidecar, i)
+            sh = _hp_stats_shadow(stats)
+            return emit_out(out, b, passed, sh), sh
+
+        try:
+            _failpoints.fire("retire_future", stage=stage_label, batch=bi)
+            with stats.metrics.timed("stall"):
+                emitted, shadow = _join_with_watchdog(
+                    hfut, batch, bi, redispatch, stats, stage_label
+                )
+        except _faultretry.RETRYABLE as exc:
+            out = recover_fetch(batch, sidecar, bi, exc)
+            shadow = _hp_stats_shadow(stats)
+            emitted = emit_out(out, batch, passed, shadow)
+        _hp_stats_merge(stats, shadow)
+        return emitted
 
     def dispatch_fetch(batch, sidecar, bi=None) -> dict:
         """Worker-side unit of the overlap pipeline (see the molecular
@@ -1794,12 +2055,7 @@ def call_duplex_batches(
                     stats, stage_label,
                 )
         except _faultretry.RETRYABLE as exc:
-            out = _faultretry.guarded(
-                partial(dispatch_fetch, batch, sidecar, bi),
-                degrade=partial(degrade_fetch, batch, sidecar),
-                metrics=stats.metrics, stage=stage_label, batch=bi,
-                failed=exc,
-            )
+            out = recover_fetch(batch, sidecar, bi, exc)
         return emit_out(out, batch, passed)
 
     groups = _timed_groups(
@@ -1809,56 +2065,96 @@ def call_duplex_batches(
         stats.metrics,
     )
 
-    def events():
+    def encode_chunk(item):
+        """Pure-host encode of one duplex chunk — encode/pack, the
+        sidecar capture, and the reference-parity passthrough run as ONE
+        host-pool task (double-buffered via _hp_prefetch) so `ref_fetch`
+        is only ever called from the single-flight encode context.
+        Stat counts apply on the main thread, in batch order."""
+        bi, chunk = item
+        with stats.metrics.timed("encode"):
+            # wire transport: the kernel gathers reference windows from
+            # the device genome, so encode skips the per-family host
+            # fetch (batch.ref stays all-N and unused)
+            batch, leftovers, skipped = encode_duplex_families(
+                chunk, ref_fetch, ref_names, max_window=max_window,
+                fetch_ref=not use_wire, pos0=pos0,
+            )
+        passed: list[BamRecord] = []
+        if passthrough and leftovers:
+            passed = _passthrough_records(
+                leftovers, ref_fetch, ref_names, pos0=pos0
+            )
+        sidecar = None
+        if batch.meta:
+            with stats.metrics.timed("encode"):
+                sidecar = _duplex_sidecar(chunk, pos0=pos0)
+        return bi, batch, leftovers, skipped, passed, sidecar
+
+    def numbered_chunks():
         batch_index = 0
         for chunk in _group_batches(groups, batch_families):
             batch_index += 1
             if batch_index <= skip_batches:
+                # resume replay: skipped batches never encode at all
                 continue
-            with stats.metrics.timed("encode"):
-                # wire transport: the kernel gathers reference windows from
-                # the device genome, so encode skips the per-family host
-                # fetch (batch.ref stays all-N and unused)
-                batch, leftovers, skipped = encode_duplex_families(
-                    chunk, ref_fetch, ref_names, max_window=max_window,
-                    fetch_ref=not use_wire, pos0=pos0,
-                )
+            yield batch_index, chunk
+
+    def events():
+        encoded = (
+            _hp_prefetch(numbered_chunks(), hpool, encode_chunk)
+            if hpool is not None
+            else map(encode_chunk, numbered_chunks())
+        )
+        for batch_index, batch, leftovers, skipped, passed, sidecar in (
+            encoded
+        ):
             stats.skipped_families += len(skipped)
             stats.leftover_records += len(leftovers)
-            passed: list[BamRecord] = []
-            if passthrough and leftovers:
-                passed = _passthrough_records(
-                    leftovers, ref_fetch, ref_names, pos0=pos0
-                )
             if not batch.meta:
                 yield "now", passed
                 continue
-            with stats.metrics.timed("encode"):
-                sidecar = _duplex_sidecar(chunk, pos0=pos0)
             stats.batches += 1
             used = int(batch.cover.sum())
             stats.pad_cells += batch.cover.size - used
             stats.used_cells += used
             if pool is not None:
+                fut = pool.submit(
+                    dispatch_fetch_guarded, batch, sidecar, batch_index
+                )
+                if hpool is not None:
+                    yield "deferred", partial(
+                        retire_host_future,
+                        hpool.submit(
+                            hp_join_retire, fut, batch, sidecar,
+                            batch_index, passed, batch=batch_index,
+                        ),
+                        batch, sidecar, batch_index, passed,
+                    )
+                    continue
                 yield "deferred", partial(
-                    retire_future,
-                    pool.submit(
-                        dispatch_fetch_guarded, batch, sidecar, batch_index
-                    ),
-                    batch, batch_index, passed, sidecar,
+                    retire_future, fut, batch, batch_index, passed, sidecar,
                 )
                 continue
             try:
                 with stats.metrics.timed("kernel"):
                     packed, pf = dispatch_kernel(batch, batch_index)
             except _faultretry.RETRYABLE as exc:
-                out = _faultretry.guarded(
-                    partial(dispatch_fetch, batch, sidecar, batch_index),
-                    degrade=partial(degrade_fetch, batch, sidecar),
-                    metrics=stats.metrics, stage=stage_label,
-                    batch=batch_index, failed=exc,
-                )
+                out = recover_fetch(batch, sidecar, batch_index, exc)
                 yield "deferred", partial(emit_out, out, batch, passed)
+                continue
+            if hpool is not None:
+                # fetch + rawize + emit ride the host pool, overlapping
+                # the next batch's dispatch — rawize (the round-5 host
+                # wall) leaves the critical path
+                yield "deferred", partial(
+                    retire_host_future,
+                    hpool.submit(
+                        hp_retire, packed, pf, batch, sidecar,
+                        batch_index, passed, batch=batch_index,
+                    ),
+                    batch, sidecar, batch_index, passed,
+                )
                 continue
             yield "deferred", partial(
                 retire_and_emit, packed, pf, batch, passed, sidecar,
@@ -1866,11 +2162,15 @@ def call_duplex_batches(
             )
 
     depth = pool_depth if pool is not None else _pipeline_depth(wire_rr)
+    if hpool is not None:
+        depth += hpool.workers
     try:
         yield from _pipelined(events(), depth=depth)
     finally:
         if pool is not None:
             pool.shutdown(wait=True, cancel_futures=True)
+        if hpool is not None:
+            hpool.shutdown()
     stats.wall_seconds += time.monotonic() - t0
 
 

@@ -92,82 +92,130 @@ def _external_sort_core(
     if buffer_records < 1:
         raise ValueError(f"buffer_records must be >= 1, got {buffer_records}")
     import contextlib
+    import time as _time
+    from functools import partial
+
+    from bsseqconsensusreads_tpu.parallel import hostpool as _hostpool
 
     buf: list = []
     run_paths: list[str] = []
     run_crcs: dict[str, int] = {}
     verify = _verify_spills()
     tmpdir: tempfile.TemporaryDirectory | None = None
+    # Double-buffered background spill writer, gated on the same worker
+    # knob as the host-parallel batch engine (BSSEQ_TPU_HOST_WORKERS):
+    # run N compresses/writes on ONE background thread while run N+1
+    # sorts and accumulates in-stream — at scale the spill write share
+    # (~245 s of 'sort_write' in SCALECPU_r05) overlaps compute instead
+    # of serializing with it. At most one write is in flight (the next
+    # spill joins the previous first), bounding memory at two detached
+    # runs; run_paths order — and thus merge order and output bytes —
+    # is fixed at submit time on the caller's thread, so output is
+    # byte-identical with the writer on or off.
+    bg_pool = None
+    bg_pending = None
+    use_bg = _hostpool.host_workers() >= 1
 
-    def timed():
+    def timed(name: str = "sort_write"):
         return (
-            metrics.timed("sort_write")
+            metrics.timed(name)
             if metrics is not None
             else contextlib.nullcontext()
         )
 
-    def write_run_file(path: str, items) -> None:
+    def write_run_file(path: str, run_items, run_index: int) -> None:
         """One run write attempt — the retry unit for transient spill
         I/O errors (a failed attempt rewrites the same path whole; the
-        sorted buffer is still in memory)."""
-        _failpoints.fire("extsort_spill", run=len(run_paths))
+        sorted run is still in memory)."""
+        _failpoints.fire("extsort_spill", run=run_index)
         # spill shards are deleted after the merge: fast compression
         # (the BGZF container is identical, only the deflate effort
         # drops)
         with BamWriter(path, header, level=1) as w:
             if write_run is not None:  # coalesced (raw-blob) writes
-                write_run(w, items)
+                write_run(w, run_items)
             else:
-                for item in items:
+                for item in run_items:
                     write_item(w, item)
         if verify:
             run_crcs[path] = _integrity.file_crc32(path)
 
-    def spill() -> None:
-        nonlocal tmpdir
-        import time as _time
-        from functools import partial
+    def write_run_guarded(path: str, run_items, run_index: int,
+                          t0: float) -> None:
+        """Write one spill run under the bounded retrier — inline, or on
+        the background writer thread ('spill_write' seconds then accrue
+        off the stream's critical path)."""
+        with timed("spill_write"):
+            _faultretry.guarded(
+                partial(write_run_file, path, run_items, run_index),
+                metrics=metrics, stage="extsort_spill", batch=run_index,
+            )
+        if metrics is not None:
+            metrics.count("spill_runs")
+            metrics.count("spill_records", len(run_items))
+        observe.emit(
+            "spill",
+            {
+                "run": run_index,
+                "records": len(run_items),
+                "seconds": round(_time.monotonic() - t0, 3),
+            },
+        )
 
-        n = len(buf)
+    def drain() -> None:
+        """Join the in-flight background write (its CRC must be in
+        run_crcs before any merge opens the run; its error must surface
+        on the stream, not in a dropped future)."""
+        nonlocal bg_pending
+        if bg_pending is not None:
+            fut, bg_pending = bg_pending, None
+            fut.result()
+
+    def spill() -> None:
+        nonlocal tmpdir, buf, bg_pool, bg_pending
         t0 = _time.monotonic()
+        if use_bg:
+            drain()  # double buffer: write N-1 lands before N detaches
         with timed():
             buf.sort(key=key)
             if tmpdir is None:
                 tmpdir = tempfile.TemporaryDirectory(
                     prefix="bsseq_extsort_", dir=workdir
                 )
-            path = os.path.join(tmpdir.name, f"run{len(run_paths):05d}.bam")
-            _faultretry.guarded(
-                partial(write_run_file, path, buf),
-                metrics=metrics, stage="extsort_spill",
-                batch=len(run_paths),
-            )
+            run_index = len(run_paths)
+            path = os.path.join(tmpdir.name, f"run{run_index:05d}.bam")
             run_paths.append(path)
-            buf.clear()
-        if metrics is not None:
-            metrics.count("spill_runs")
-            metrics.count("spill_records", n)
-        observe.emit(
-            "spill",
-            {
-                "run": len(run_paths) - 1,
-                "records": n,
-                "seconds": round(_time.monotonic() - t0, 3),
-            },
-        )
+            run_items, buf = buf, []
+            if use_bg:
+                if bg_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-    for item in items:
-        buf.append(item)
-        if len(buf) >= buffer_records:
+                    bg_pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="bsseq-spill"
+                    )
+                bg_pending = bg_pool.submit(
+                    write_run_guarded, path, run_items, run_index, t0
+                )
+            else:
+                write_run_guarded(path, run_items, run_index, t0)
+
+    try:
+        for item in items:
+            buf.append(item)
+            if len(buf) >= buffer_records:
+                spill()
+
+        if not run_paths:  # everything fit in one buffer: no disk round-trip
+            buf.sort(key=key)
+            yield from buf
+            return
+
+        if buf:
             spill()
-
-    if not run_paths:  # everything fit in one buffer: no disk round-trip
-        buf.sort(key=key)
-        yield from buf
-        return
-
-    if buf:
-        spill()
+        drain()  # every run durable + CRC'd before the first merge open
+    finally:
+        if bg_pool is not None:
+            bg_pool.shutdown(wait=True, cancel_futures=True)
 
     def open_runs(paths: list[str], readers: list):
         streams = []

@@ -34,6 +34,7 @@ FIXTURES = {
     "stderr-print": "fx_stderr_print.py",
     "swallowed-exception": "fx_swallowed_exception.py",
     "unbounded-retry": "fx_unbounded_retry.py",
+    "serialized-host-phase": "fx_serialized_host_phase.py",
 }
 
 
@@ -63,8 +64,8 @@ class TestSeededFixtures:
         ]
 
     def test_directory_sweep_is_one_finding_per_rule(self):
-        """All rules over all fixtures: exactly the 8 seeds fire — no
-        cross-talk between fixtures, and fx_suppressed.py contributes
+        """All rules over all fixtures: exactly one seed per rule fires —
+        no cross-talk between fixtures, and fx_suppressed.py contributes
         nothing."""
         findings = run_lint([FIXDIR])
         assert sorted(f.rule for f in findings) == sorted(FIXTURES)

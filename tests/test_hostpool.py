@@ -1,0 +1,513 @@
+"""Host-parallel batch engine (parallel.hostpool + pipeline.calling):
+byte-identical output for any BSSEQ_TPU_HOST_WORKERS, graftfault
+semantics inside host-pool tasks, the overlap-pool × wire-round-robin
+composition (MULTICHIP-style, on the multi-device dryrun path the
+conftest forces), the loud round_robin_conflict fallback, and the
+extsort background spill writer.
+
+The engine exists for multi-core TPU-attached hosts (the round-5 scale
+artifacts measured the rawize pass serializing the duplex stage); on
+this suite's CPU backend it is forced via BSSEQ_TPU_HOST_WORKERS and
+asserted for pure equivalence — the determinism guarantee IS the
+feature under test.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.faults import failpoints
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamWriter,
+    write_items,
+)
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.parallel import hostpool
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    make_grouped_bam_records,
+    random_genome,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    failpoints.disarm()
+
+
+@pytest.fixture(scope="module")
+def molecular_corpus():
+    rng = np.random.default_rng(41)
+    name, genome = random_genome(rng, 20000)
+    # reads_per_strand from 1 exercises the T==1 singleton path, which
+    # rides the host pool whole (hp_vote_emit)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=36, reads_per_strand=(1, 3)
+    )
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return header, records
+
+
+@pytest.fixture(scope="module")
+def duplex_corpus():
+    rng = np.random.default_rng(43)
+    name, genome = random_genome(rng, 18000)
+    records = []
+    for fam in range(32):
+        records.extend(
+            make_aligned_duplex_group(
+                rng, name, genome, fam, 60 + fam * 120, 70,
+                softclip=2 if fam % 4 == 0 else 0,
+            )
+        )
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    return name, genome, records
+
+
+def _mol_bytes(corpus, tmp_path, tag, stats=None, **kw):
+    header, records = corpus
+    stats = stats if stats is not None else StageStats()
+    out = str(tmp_path / f"mol_{tag}.bam")
+    kw.setdefault("mesh", None)
+    batches = call_molecular_batches(
+        iter(list(records)), params=ConsensusParams(min_reads=1),
+        mode="self", batch_families=7, grouping="coordinate",
+        stats=stats, **kw,
+    )
+    with BamWriter(out, header, engine="python") as w:
+        for b in batches:
+            write_items(w, b)
+    return open(out, "rb").read(), stats
+
+
+def _dup_bytes(corpus, tmp_path, tag, stats=None, **kw):
+    name, genome, records = corpus
+    stats = stats if stats is not None else StageStats()
+    out = str(tmp_path / f"dup_{tag}.bam")
+    kw.setdefault("mesh", None)
+    batches = call_duplex_batches(
+        iter(list(records)), lambda n, s, e: genome[s:e], [name],
+        mode="self", batch_families=8, grouping="coordinate",
+        stats=stats, **kw,
+    )
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [(name, len(genome))])
+    with BamWriter(out, header, engine="python") as w:
+        for b in batches:
+            write_items(w, b)
+    return open(out, "rb").read(), stats
+
+
+class TestHostWorkers:
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "3")
+        assert hostpool.host_workers() == 3
+
+    def test_env_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        assert hostpool.host_workers() == 0
+        assert hostpool.make_pool() is None
+
+    def test_env_negative_clamps_to_zero(self, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "-2")
+        assert hostpool.host_workers() == 0
+
+    def test_bad_env_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "lots")
+        cores = os.cpu_count() or 1
+        assert hostpool.host_workers() == min(4, max(0, cores - 1))
+
+    def test_default_is_min_4_cores_minus_1(self, monkeypatch):
+        monkeypatch.delenv("BSSEQ_TPU_HOST_WORKERS", raising=False)
+        cores = os.cpu_count() or 1
+        assert hostpool.host_workers() == min(4, max(0, cores - 1))
+
+    def test_pool_decision_is_ledgered(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        pool = hostpool.make_pool(stage="molecular")
+        assert pool is not None and pool.workers == 2
+        pool.shutdown()
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        assert hostpool.make_pool(stage="molecular") is None
+        events = [json.loads(line) for line in open(sink)]
+        kinds = [e["event"] for e in events]
+        assert "host_pool_enabled" in kinds
+        disabled = [e for e in events if e["event"] == "host_pool_disabled"]
+        assert disabled and "explicit disable" in disabled[0]["reason"]
+
+
+class TestByteIdentity:
+    """The acceptance bar: output bytes identical under
+    BSSEQ_TPU_HOST_WORKERS in {0, 1, 4} for both mini pipelines —
+    ordered retirement + shadow-stat merge proven end to end."""
+
+    @pytest.mark.parametrize("workers", ["1", "4"])
+    def test_molecular_matches_inline(
+        self, molecular_corpus, tmp_path, monkeypatch, workers
+    ):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        inline, st0 = _mol_bytes(molecular_corpus, tmp_path, "w0")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", workers)
+        got, st = _mol_bytes(molecular_corpus, tmp_path, f"w{workers}")
+        assert got == inline and len(inline) > 200
+        assert st.batches == st0.batches
+        assert st.consensus_out == st0.consensus_out
+        assert st.families == st0.families
+        assert st.skipped_families == st0.skipped_families
+        assert st.metrics.counters.get("host_pool_workers") == int(workers)
+
+    @pytest.mark.parametrize("workers", ["1", "4"])
+    def test_duplex_matches_inline(
+        self, duplex_corpus, tmp_path, monkeypatch, workers
+    ):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        inline, st0 = _dup_bytes(duplex_corpus, tmp_path, "w0")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", workers)
+        got, st = _dup_bytes(duplex_corpus, tmp_path, f"w{workers}")
+        assert got == inline and len(inline) > 200
+        assert st.batches == st0.batches
+        assert st.consensus_out == st0.consensus_out
+        assert st.families == st0.families
+
+    def test_molecular_wire_transport_matches(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        """Worker-side slim-wire fetch + count recompute + emit must
+        still be byte-identical when the whole retire rides the host
+        pool."""
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        inline, _ = _mol_bytes(
+            molecular_corpus, tmp_path, "wire0", transport="wire"
+        )
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "3")
+        got, _ = _mol_bytes(
+            molecular_corpus, tmp_path, "wire3", transport="wire"
+        )
+        assert got == inline
+
+    def test_composes_with_overlap_pool(
+        self, duplex_corpus, tmp_path, monkeypatch
+    ):
+        """Overlap workers (device dispatch/fetch) + host workers (emit)
+        stacked: still byte-identical, and the host pool's join path is
+        the one retiring ('stall' accounted on the main thread)."""
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "0")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        inline, _ = _dup_bytes(duplex_corpus, tmp_path, "ov0")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        got, st = _dup_bytes(duplex_corpus, tmp_path, "ov2")
+        assert got == inline
+        assert "stall" in st.metrics.seconds
+
+    def test_early_close_shuts_pool_down(self, duplex_corpus, monkeypatch):
+        """Abandoning the batch generator mid-stream must wind down the
+        host pool (no bsseq-host threads leaked)."""
+        name, genome, records = duplex_corpus
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        before = {t.name for t in threading.enumerate()}
+        batches = call_duplex_batches(
+            iter(list(records)), lambda n, s, e: genome[s:e], [name],
+            mode="self", batch_families=5, grouping="coordinate",
+            stats=StageStats(), mesh=None,
+        )
+        next(batches)
+        batches.close()
+        leaked = {
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith("bsseq-host") and t.is_alive()
+        } - before
+        assert not leaked
+
+
+class TestHostpoolFaults:
+    """graftfault semantics carry over into host-pool tasks: the
+    hostpool_task failpoint fires INSIDE the retried unit."""
+
+    def test_task_failpoint_retries_byte_identical(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        want, _ = _mol_bytes(molecular_corpus, tmp_path, "fp_ref")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        monkeypatch.setenv("BSSEQ_TPU_RETRY_BACKOFF_S", "0.01")
+        failpoints.arm("hostpool_task=raise:RuntimeError:times=1")
+        stats = StageStats()
+        got, _ = _mol_bytes(molecular_corpus, tmp_path, "fp", stats=stats)
+        assert got == want
+        assert stats.batches_retried >= 1
+        assert stats.batches_recovered >= 1
+
+    def test_persistent_dispatch_failure_degrades_under_hostpool(
+        self, duplex_corpus, tmp_path, monkeypatch
+    ):
+        """A persistently failing device dispatch still degrades to the
+        host twin with the host pool active — byte-identical."""
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        want, _ = _dup_bytes(duplex_corpus, tmp_path, "deg_ref")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        monkeypatch.setenv("BSSEQ_TPU_RETRY_BACKOFF_S", "0.01")
+        failpoints.arm("dispatch_kernel=raise:RuntimeError@batch=2")
+        stats = StageStats()
+        got, _ = _dup_bytes(duplex_corpus, tmp_path, "deg", stats=stats)
+        assert got == want
+        assert stats.batches_degraded >= 1
+
+    def test_io_error_in_task_retries(
+        self, duplex_corpus, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        want, _ = _dup_bytes(duplex_corpus, tmp_path, "io_ref")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "1")
+        monkeypatch.setenv("BSSEQ_TPU_RETRY_BACKOFF_S", "0.01")
+        failpoints.arm("hostpool_task=io_error:times=2")
+        stats = StageStats()
+        got, _ = _dup_bytes(duplex_corpus, tmp_path, "io", stats=stats)
+        assert got == want and stats.batches_retried >= 1
+
+
+class TestComposition:
+    """Overlap pool × _WireRoundRobin on the multi-device dryrun path
+    (MULTICHIP-style; conftest forces 8 host-platform devices): no
+    silent (None, 0) disable, exactly-once retire, no leaked wire
+    buffers."""
+
+    def _mesh(self):
+        import jax
+
+        if jax.device_count() < 2:
+            pytest.skip("needs >1 device")
+        from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(n_data=min(4, jax.device_count()), n_reads=1)
+
+    def test_composed_wire_mc_byte_identical_no_leak(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        import jax
+
+        mesh = self._mesh()
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        monkeypatch.delenv("BSSEQ_TPU_OVERLAP_THREADS", raising=False)
+        want, st0 = _mol_bytes(molecular_corpus, tmp_path, "cmp_ref")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+
+        def run(stats):
+            return _mol_bytes(
+                molecular_corpus, tmp_path, "cmp_mc", stats=stats,
+                transport="wire", mesh=mesh,
+            )[0]
+
+        run(StageStats())  # warm jit/device caches before the leak census
+        gc.collect()
+        baseline = len(jax.live_arrays())
+        stats = StageStats()
+        got = run(stats)
+        # byte-identical and stat-identical => every batch retired
+        # exactly once through the composed pipeline
+        assert got == want
+        assert stats.batches == st0.batches
+        assert stats.consensus_out == st0.consensus_out
+        assert stats.metrics.counters.get("overlap_rr_composed") == 1
+        assert stats.metrics.counters.get("overlap_pool_workers", 0) >= 2
+        assert "overlap_pool_disabled" not in stats.metrics.counters
+        gc.collect()
+        assert len(jax.live_arrays()) <= baseline
+
+    def test_composed_duplex_wire_mc_with_hostpool(
+        self, duplex_corpus, tmp_path, monkeypatch
+    ):
+        """All three engines stacked on the duplex stage: round-robin
+        wire dispatch on overlap workers, rawize/emit on host workers —
+        byte-identical to the fully inline run."""
+        from bsseqconsensusreads_tpu.ops.refstore import RefStore
+
+        mesh = self._mesh()
+        name, genome, _ = duplex_corpus
+        store = RefStore([name], seqs=[genome])
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        monkeypatch.delenv("BSSEQ_TPU_OVERLAP_THREADS", raising=False)
+        want, _ = _dup_bytes(duplex_corpus, tmp_path, "3x_ref")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        stats = StageStats()
+        got, _ = _dup_bytes(
+            duplex_corpus, tmp_path, "3x", stats=stats,
+            transport="wire", refstore=store, mesh=mesh,
+        )
+        assert got == want
+        assert stats.metrics.counters.get("overlap_rr_composed") == 1
+
+    def test_zero_worker_fallback_is_loud(
+        self, molecular_corpus, tmp_path, monkeypatch
+    ):
+        """The one remaining (None, 0) branch on a multi-device path
+        must report reason 'round_robin_conflict' — never silent
+        (ISSUE 4 satellite; VERDICT weak #6)."""
+        mesh = self._mesh()
+        sink = str(tmp_path / "rrc.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "0")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        stats = StageStats()
+        got, _ = _mol_bytes(
+            molecular_corpus, tmp_path, "rrc", stats=stats,
+            transport="wire", mesh=mesh,
+        )
+        monkeypatch.delenv("BSSEQ_TPU_STATS")
+        monkeypatch.delenv("BSSEQ_TPU_OVERLAP_THREADS")
+        want, _ = _mol_bytes(molecular_corpus, tmp_path, "rrc_ref")
+        assert got == want
+        assert stats.metrics.counters.get("overlap_pool_disabled") == 1
+        events = [json.loads(line) for line in open(sink)]
+        disabled = [
+            e for e in events if e["event"] == "overlap_pool_disabled"
+        ]
+        assert disabled
+        assert disabled[0]["reason"].startswith("round_robin_conflict")
+
+
+class TestSpillWriter:
+    """pipeline.extsort's double-buffered background spill writer
+    (gated on the same BSSEQ_TPU_HOST_WORKERS knob)."""
+
+    def _sorted_blobs(self, n=900, seed=5):
+        from bsseqconsensusreads_tpu.io.bam import encode_record
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+        )
+
+        rng = np.random.default_rng(seed)
+        name, genome = random_genome(rng, 30000)
+        header, records = make_grouped_bam_records(
+            rng, name, genome, n_families=n // 4
+        )
+        rng.shuffle(records)
+        return header, [encode_record(r) for r in records]
+
+    def test_background_writer_output_identical(
+        self, tmp_path, monkeypatch
+    ):
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort_raw,
+        )
+
+        header, blobs = self._sorted_blobs()
+        monkeypatch.setenv("BSSEQ_TPU_VERIFY_SPILLS", "1")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        want = list(external_sort_raw(
+            iter(blobs), header, workdir=str(tmp_path), buffer_records=64,
+        ))
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "2")
+        got = list(external_sort_raw(
+            iter(blobs), header, workdir=str(tmp_path), buffer_records=64,
+        ))
+        assert got == want and len(want) == len(blobs)
+
+    def test_background_writes_ride_the_writer_thread(
+        self, tmp_path, monkeypatch
+    ):
+        """Ledger 'spill' events must come from the bsseq-spill thread
+        (the writer actually moved off the stream), and the CRC verify
+        contract (PR 3) must hold at merge open."""
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort_raw,
+        )
+
+        sink = str(tmp_path / "spill.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv("BSSEQ_TPU_VERIFY_SPILLS", "1")
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "1")
+        header, blobs = self._sorted_blobs(seed=6)
+        out = list(external_sort_raw(
+            iter(blobs), header, workdir=str(tmp_path), buffer_records=64,
+        ))
+        assert len(out) == len(blobs)
+        spills = [
+            json.loads(line)
+            for line in open(sink)
+            if '"spill"' in line
+        ]
+        spills = [e for e in spills if e.get("event") == "spill"]
+        assert spills
+        assert all(
+            e.get("thread", "").startswith("bsseq-spill") for e in spills
+        )
+
+    def test_spill_io_error_retries_on_writer_thread(
+        self, tmp_path, monkeypatch
+    ):
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            external_sort_raw,
+        )
+        from bsseqconsensusreads_tpu.utils.observe import Metrics
+
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "1")
+        monkeypatch.setenv("BSSEQ_TPU_RETRY_BACKOFF_S", "0.01")
+        header, blobs = self._sorted_blobs(seed=7)
+        failpoints.arm("extsort_spill=io_error:times=1")
+        metrics = Metrics()
+        got = list(external_sort_raw(
+            iter(blobs), header, workdir=str(tmp_path), buffer_records=64,
+            metrics=metrics,
+        ))
+        failpoints.disarm()
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "0")
+        want = list(external_sort_raw(
+            iter(blobs), header, workdir=str(tmp_path), buffer_records=64,
+        ))
+        assert got == want
+        assert metrics.counters.get("batches_retried", 0) >= 1
+
+
+@pytest.mark.slow
+class TestScalingSmoke:
+    def test_two_workers_beat_serial_on_cpu_bound_synthetic(self):
+        """2-way host-scaling smoke: a GIL-releasing CPU-bound workload
+        (BLAS matmuls, the shape of the native emit/rawize passes) must
+        finish faster through a 2-worker HostPool than serially. Needs
+        real cores — skipped on single-core builders."""
+        import time
+
+        if (os.cpu_count() or 1) < 2:
+            pytest.skip("needs >=2 cores for host-parallel speedup")
+        rng = np.random.default_rng(0)
+        mats = [rng.standard_normal((700, 700)) for _ in range(2)]
+
+        def work(_i):
+            out = mats[0]
+            for _ in range(4):
+                out = out @ mats[1]
+            return float(out[0, 0])
+
+        n_tasks = 8
+        work(0)  # warm BLAS
+        t0 = time.monotonic()
+        serial = [work(i) for i in range(n_tasks)]
+        serial_s = time.monotonic() - t0
+
+        pool = hostpool.HostPool(2)
+        try:
+            t0 = time.monotonic()
+            futs = [pool.submit(work, i) for i in range(n_tasks)]
+            parallel = [f.result() for f in futs]
+            parallel_s = time.monotonic() - t0
+        finally:
+            pool.shutdown()
+        assert parallel == serial
+        assert parallel_s < serial_s, (parallel_s, serial_s)

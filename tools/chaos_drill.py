@@ -167,6 +167,24 @@ SCENARIOS = [
         "expect": [("ledger", "batch_retry", 1)],
     },
     {
+        # ISSUE 4: a fault INSIDE a host-pool task (worker-side
+        # fetch/rawize/emit) is retried by the task's own guarded
+        # wrapper — byte-identity proves the ordered retire replays it
+        # exactly once
+        "name": "hostpool_task_retry",
+        "failpoints": "hostpool_task=raise:RuntimeError:times=1@stage=duplex",
+        "env": {"BSSEQ_TPU_HOST_WORKERS": "2"},
+        "expect": [("stage:duplex", "batches_retried", 1)],
+    },
+    {
+        # persistent device failure with the host pool active: the
+        # CPU-twin degrade still runs under worker-side retirement
+        "name": "hostpool_degrade_to_host_twin",
+        "failpoints": "dispatch_kernel=raise:RuntimeError@batch=1@stage=duplex",
+        "env": {"BSSEQ_TPU_HOST_WORKERS": "2"},
+        "expect": [("stage:duplex", "batches_degraded", 1)],
+    },
+    {
         "name": "io_error_ckpt_shard_write",
         "failpoints": "ckpt_shard_write=io_error:times=1",
         "expect": [("ledger", "batch_retry", 1)],
