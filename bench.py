@@ -808,6 +808,42 @@ def _run_chaos_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_fuzz_quick() -> dict | None:
+    """tools/fuzz_ingest.py -> FUZZ_HEAD.json: the input-hardening
+    artifact riding the bench flow (seeded ingest mutations x input
+    policies, never-crash/never-silently-corrupt asserted per seed).
+    Best-effort and cpu-pinned like the chaos drill; a fuzz failure
+    lands in the artifact as ok=False, never fails the bench.
+    BSSEQ_BENCH_FUZZ=0 skips; BSSEQ_BENCH_FUZZ_SEEDS sizes the corpus
+    (default 50 — the committed FUZZ_HEAD.json is the full 200)."""
+    if os.environ.get("BSSEQ_BENCH_FUZZ", "1") == "0":
+        return None
+    fuzzer = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "fuzz_ingest.py"
+    )
+    out_path = os.path.join(os.getcwd(), "FUZZ_HEAD.json")
+    seeds = os.environ.get("BSSEQ_BENCH_FUZZ_SEEDS", "50")
+    try:
+        cp = subprocess.run(
+            [sys.executable, fuzzer, "--seeds", seeds, "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_FUZZ_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "seeds": data.get("seeds"),
+            "outcomes": data.get("outcomes"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -955,6 +991,14 @@ def main() -> None:
         observe.emit(
             "bench_chaos_drill",
             {"ok": faults.get("ok"), "path": faults.get("path")},
+            sink=ledger_sink,
+        )
+    fuzz = _run_fuzz_quick()
+    if fuzz is not None:
+        out["fuzz"] = fuzz
+        observe.emit(
+            "bench_ingest_fuzz",
+            {"ok": fuzz.get("ok"), "path": fuzz.get("path")},
             sink=ledger_sink,
         )
     observe.flush_sinks()

@@ -36,6 +36,7 @@ import argparse
 import json
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.faults import guard as _guard
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.utils import observe
 
@@ -163,38 +164,47 @@ def cmd_run(args) -> int:
 
 
 def cmd_molecular(args) -> int:
-    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.faults import guard as _guard
     from bsseqconsensusreads_tpu.pipeline.calling import (
         StageStats,
         call_molecular_batches,
     )
-    from bsseqconsensusreads_tpu.pipeline.stages import molecular_ingest_stream
+    from bsseqconsensusreads_tpu.pipeline.stages import (
+        molecular_ingest_stream,
+        open_guarded_reader,
+    )
 
     _arm_failpoints(args)
     observe.open_ledger(component="molecular-cli")
     stats = StageStats(stage="molecular")
-    with BamReader(args.input) as reader:
-        batches = call_molecular_batches(
-            molecular_ingest_stream(
-                args.input, reader, stats,
-                ingest_choice=args.ingest, grouping=args.grouping,
+    g = _guard.Guard.from_env(stats)
+    try:
+        with open_guarded_reader(args.input, g) as reader:
+            batches = call_molecular_batches(
+                molecular_ingest_stream(
+                    args.input, reader, stats,
+                    ingest_choice=args.ingest, grouping=args.grouping,
+                    indel_policy=args.indel_policy,
+                    guard=g,
+                ),
+                params=_params(args),
+                mode=args.mode,
+                batch_families=args.batch_families,
+                max_window=args.max_window,
+                grouping=args.grouping,
+                stats=stats,
+                emit=args.emit,
+                batching=args.batching,
+                transport=args.transport,
                 indel_policy=args.indel_policy,
-            ),
-            params=_params(args),
-            mode=args.mode,
-            batch_families=args.batch_families,
-            max_window=args.max_window,
-            grouping=args.grouping,
-            stats=stats,
-            emit=args.emit,
-            batching=args.batching,
-            transport=args.transport,
-            indel_policy=args.indel_policy,
-            vote_kernel=args.vote_kernel,
-        )
-        from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
+                vote_kernel=args.vote_kernel,
+                guard=g,
+            )
+            from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
-        write_batch_stream(batches, args.output, reader.header, args.mode)
+            write_batch_stream(batches, args.output, reader.header, args.mode)
+    finally:
+        g.close()
     observe.emit_stage_stats({"molecular": stats})
     observe.flush_sinks()
     observe.stderr_line(json.dumps(stats.as_dict()))
@@ -202,45 +212,54 @@ def cmd_molecular(args) -> int:
 
 
 def cmd_duplex(args) -> int:
-    from bsseqconsensusreads_tpu.io.bam import BamReader
+    from bsseqconsensusreads_tpu.faults import guard as _guard
     from bsseqconsensusreads_tpu.io.fasta import FastaFile
     from bsseqconsensusreads_tpu.pipeline.calling import (
         StageStats,
         call_duplex_batches,
     )
 
-    from bsseqconsensusreads_tpu.pipeline.stages import duplex_ingest_stream
+    from bsseqconsensusreads_tpu.pipeline.stages import (
+        duplex_ingest_stream,
+        open_guarded_reader,
+    )
 
     _arm_failpoints(args)
     observe.open_ledger(component="duplex-cli")
     stats = StageStats(stage="duplex")
     fasta = FastaFile(args.reference)
-    with BamReader(args.input) as reader:
-        names = [n for n, _ in reader.header.references]
-        batches = call_duplex_batches(
-            duplex_ingest_stream(
-                args.input, reader, stats,
-                ingest_choice=args.ingest, grouping=args.grouping,
+    g = _guard.Guard.from_env(stats)
+    try:
+        with open_guarded_reader(args.input, g) as reader:
+            names = [n for n, _ in reader.header.references]
+            batches = call_duplex_batches(
+                duplex_ingest_stream(
+                    args.input, reader, stats,
+                    ingest_choice=args.ingest, grouping=args.grouping,
+                    passthrough=args.passthrough,
+                    guard=g,
+                ),
+                fasta.fetch,
+                names,
+                params=_params(args),
+                mode=args.mode,
+                batch_families=args.batch_families,
+                max_window=args.max_window,
+                grouping=args.grouping,
+                stats=stats,
+                emit=args.emit,
+                refstore=args.reference,  # FASTA path; loaded only if wire engages
+                transport=args.transport,
                 passthrough=args.passthrough,
-            ),
-            fasta.fetch,
-            names,
-            params=_params(args),
-            mode=args.mode,
-            batch_families=args.batch_families,
-            max_window=args.max_window,
-            grouping=args.grouping,
-            stats=stats,
-            emit=args.emit,
-            refstore=args.reference,  # FASTA path; loaded only if wire engages
-            transport=args.transport,
-            passthrough=args.passthrough,
-            vote_kernel=args.vote_kernel,
-            pos0=args.pos0,
-        )
-        from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
+                vote_kernel=args.vote_kernel,
+                pos0=args.pos0,
+                guard=g,
+            )
+            from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
-        write_batch_stream(batches, args.output, reader.header, args.mode)
+            write_batch_stream(batches, args.output, reader.header, args.mode)
+    finally:
+        g.close()
     observe.emit_stage_stats({"duplex": stats})
     observe.flush_sinks()
     observe.stderr_line(json.dumps(stats.as_dict()))
@@ -683,7 +702,18 @@ def main(argv: list[str] | None = None) -> int:
     c.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except _guard.GuardError as e:
+        # typed input-hardening failure (strict policy fail-fast,
+        # refused checkpoint resume, ...): the diagnostic already
+        # carries record #N / block @voffset — a traceback would bury
+        # it and read as a crash, violating the fuzz contract's "clean
+        # typed error" leg
+        observe.stderr_line(
+            f"bsseqconsensusreads_tpu: input error [{e.reason}]: {e}"
+        )
+        return 3
 
 
 if __name__ == "__main__":

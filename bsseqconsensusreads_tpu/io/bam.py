@@ -19,9 +19,18 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
+from bsseqconsensusreads_tpu.faults.guard import (
+    StreamGuardError,
+    check_record_body,
+)
 from bsseqconsensusreads_tpu.io.bgzf import BgzfReader, BgzfWriter
 
 BAM_MAGIC = b"BAM\x01"
+
+#: block_size sanity bounds shared with native/bamio.cpp's
+#: read_record_body — an untrusted 32-bit field must never size a read.
+MIN_RECORD_SIZE = 32
+MAX_RECORD_SIZE = 1 << 28
 
 # CIGAR op codes and letters (SAM spec order).
 CIGAR_OPS = "MIDNSHP=X"
@@ -47,8 +56,10 @@ FREVERSE, FMREVERSE, FREAD1, FREAD2 = 0x10, 0x20, 0x40, 0x80
 FSECONDARY, FQCFAIL, FDUP, FSUPPLEMENTARY = 0x100, 0x200, 0x400, 0x800
 
 
-class BamError(IOError):
-    pass
+class BamError(StreamGuardError):
+    """BAM framing/format error. Subclasses the graftguard typed
+    stream error (itself an IOError, preserving ancestry) so input-
+    caused failures are always faults.guard.GuardError instances."""
 
 
 @dataclass
@@ -287,9 +298,22 @@ def tag_region_offset(blob: bytes) -> int:
 
 
 def _decode_tags(data: bytes, off: int) -> dict[str, tuple[str, Any]]:
+    try:
+        return _decode_tags_inner(data, off)
+    except (ValueError, struct.error, IndexError, UnicodeDecodeError) as exc:
+        # untrusted tag bytes: a lying count/unterminated Z string must
+        # surface as the typed stream error, not a bare struct.error
+        if isinstance(exc, BamError):
+            raise
+        raise BamError(f"corrupt record tags: {exc}") from None
+
+
+def _decode_tags_inner(data: bytes, off: int) -> dict[str, tuple[str, Any]]:
     tags: dict[str, tuple[str, Any]] = {}
     n = len(data)
     while off < n:
+        if off + 3 > n:
+            raise BamError("corrupt record tags: truncated tag header")
         key = data[off : off + 2].decode("ascii")
         tc = chr(data[off + 2])
         off += 3
@@ -380,11 +404,59 @@ def _create_bgzf(path: str, engine: str, level: int):
 _REC_FIXED = struct.Struct("<iiBBHHHIiii")  # refID..tlen after block_size (32 bytes)
 
 
+def read_bam_header(bgzf, path: str) -> BamHeader:
+    """Parse the BAM header from an open BGZF reader with every
+    untrusted length field bounds-checked — a lying l_text/n_ref must
+    raise a typed BamError, not size a giant read or escape as a bare
+    struct.error. Shared by BamReader and the native header skip
+    (io.native._skip_header reproduces the same bounds)."""
+
+    def _u32(what: str) -> int:
+        raw = bgzf.read(4)
+        if len(raw) < 4:
+            raise BamError(f"corrupt BAM header (truncated {what})")
+        return struct.unpack("<i", raw)[0]
+
+    magic = bgzf.read(4)
+    if magic != BAM_MAGIC:
+        raise BamError(f"{path}: not a BAM file")
+    l_text = _u32("l_text")
+    if l_text < 0 or l_text > MAX_RECORD_SIZE:
+        raise BamError("corrupt BAM header (bad l_text)")
+    text_raw = bgzf.read(l_text)
+    if len(text_raw) < l_text:
+        raise BamError("corrupt BAM header (truncated text)")
+    text = text_raw.decode("utf-8", "replace").rstrip("\x00")
+    n_ref = _u32("n_ref")
+    if n_ref < 0 or n_ref > (1 << 24):
+        raise BamError("corrupt BAM header (bad n_ref)")
+    refs = []
+    for _ in range(n_ref):
+        l_name = _u32("l_name")
+        if l_name < 1 or l_name > (1 << 16):
+            raise BamError("corrupt BAM header (bad l_name)")
+        name_raw = bgzf.read(l_name)
+        if len(name_raw) < l_name:
+            raise BamError("corrupt BAM header (truncated name)")
+        try:
+            name = name_raw[:-1].decode("ascii")
+        except UnicodeDecodeError:
+            raise BamError("corrupt BAM header (non-ASCII name)") from None
+        l_ref = _u32("l_ref")
+        if l_ref < 0:
+            raise BamError("corrupt BAM header (bad l_ref)")
+        refs.append((name, l_ref))
+    return BamHeader(text, refs)
+
+
 def decode_record(data: bytes) -> BamRecord:
     """Decode one alignment from its variable-size data (sans block_size)."""
     (ref_id, pos, l_qname, mapq, _bin, n_cigar, flag, l_seq, next_ref, next_pos, tlen) = _REC_FIXED.unpack_from(data, 0)
     off = 32
-    qname = data[off : off + l_qname - 1].decode("ascii")
+    try:
+        qname = data[off : off + l_qname - 1].decode("ascii")
+    except UnicodeDecodeError:
+        raise BamError("corrupt record qname (non-ASCII bytes)") from None
     off += l_qname
     cigar = []
     for _ in range(n_cigar):
@@ -454,48 +526,79 @@ class BamReader:
     def __init__(self, path: str, engine: str = "auto",
                  threads: int | None = None):
         self._bgzf = _open_bgzf(path, engine, threads=threads)
+        #: records handed out so far — the `record #N` of every typed
+        #: stream error (0-based index of the record that failed)
+        self.records_read = 0
         try:
-            magic = self._bgzf.read(4)
-            if magic != BAM_MAGIC:
-                raise BamError(f"{path}: not a BAM file")
-            (l_text,) = struct.unpack("<i", self._bgzf.read(4))
-            text = self._bgzf.read(l_text).decode("utf-8", "replace").rstrip("\x00")
-            (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
-            refs = []
-            for _ in range(n_ref):
-                (l_name,) = struct.unpack("<i", self._bgzf.read(4))
-                name = self._bgzf.read(l_name)[:-1].decode("ascii")
-                (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
-                refs.append((name, l_ref))
-            self.header = BamHeader(text, refs)
+            self.header = read_bam_header(self._bgzf, path)
         except BaseException:
             self._bgzf.close()
             raise
 
+    def _voffset(self) -> int | None:
+        return getattr(self._bgzf, "last_block_offset", None)
+
+    def _next_blob(self, validate: bool = True) -> bytes | None:
+        """Read one record body (sans prefix); None at clean EOF. Every
+        refusal is a typed BamError carrying the record index (and
+        block offset when the engine tracks one) — same rules, same
+        record index as native/bamio.cpp.
+
+        validate=False skips the structural body check (framing and
+        bounds stay): raw_records() replays internal streams — e.g. the
+        UMI grouper's composite-key spill blobs, which are NOT BAM
+        record bodies — whose integrity is the CRC layer's job
+        (faults.integrity), not input validation's."""
+        raw = self._bgzf.read(4)
+        if not raw:
+            return None
+        if len(raw) < 4:
+            raise BamError(
+                "truncated record size", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        (block_size,) = struct.unpack("<i", raw)
+        if block_size < MIN_RECORD_SIZE or block_size > MAX_RECORD_SIZE:
+            raise BamError(
+                "corrupt record size", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        data = self._bgzf.read(block_size)
+        if len(data) < block_size:
+            raise BamError(
+                "truncated record body", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        if validate:
+            reason = check_record_body(data)
+            if reason is not None:
+                raise BamError(
+                    reason, record_index=self.records_read,
+                    voffset=self._voffset(),
+                )
+        self.records_read += 1
+        return data
+
     def __iter__(self) -> Iterator[BamRecord]:
         while True:
-            raw = self._bgzf.read(4)
-            if len(raw) < 4:
+            data = self._next_blob()
+            if data is None:
                 return
-            (block_size,) = struct.unpack("<i", raw)
-            data = self._bgzf.read(block_size)
-            if len(data) < block_size:
-                raise BamError("truncated BAM record")
             yield decode_record(data)
 
-    def raw_records(self) -> Iterator[bytes]:
+    def raw_records(self, validate: bool = False) -> Iterator[bytes]:
         """Stream encoded record blocks (incl. their block_size prefix)
         WITHOUT decoding — for record-preserving copies (e.g. checkpoint
-        shard concatenation) where parse+re-encode is pure waste."""
+        shard concatenation) where parse+re-encode is pure waste.
+        Structural validation is off by default: raw streams include
+        internal non-BAM spill formats (the UMI grouper's composite
+        blobs) whose integrity the CRC layer owns; pass validate=True
+        when replaying actual record bytes from an untrusted source."""
         while True:
-            raw = self._bgzf.read(4)
-            if len(raw) < 4:
+            data = self._next_blob(validate=validate)
+            if data is None:
                 return
-            (block_size,) = struct.unpack("<i", raw)
-            data = self._bgzf.read(block_size)
-            if len(data) < block_size:
-                raise BamError("truncated BAM record")
-            yield raw + data
+            yield struct.pack("<i", len(data)) + data
 
     def get_reference_name(self, rid: int) -> str:
         return self.header.ref_name(rid)
@@ -504,6 +607,240 @@ class BamReader:
         self._bgzf.close()
 
     def __enter__(self) -> "BamReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _FramingGap(Exception):
+    """Internal: the BGZF layer resynced past corrupt blocks; record
+    framing must be re-found before reading on."""
+
+
+class GuardedBamReader:
+    """graftguard record reader: BamReader's surface (header + record
+    iteration) with the guard's policy applied per record.
+
+    * strict — every structural refusal is a typed BamError and every
+      semantic violation a RecordGuardError, both carrying `record #N`
+      (and the BGZF block offset on the python engine).
+    * quarantine/lenient — runs on the pure-python BGZF engine with
+      block resync armed: corrupt blocks are skipped (ledgered
+      `stream_gap`), record framing is re-found by scanning for the
+      next structurally-plausible record boundary, corrupt records go
+      to the sidecar, truncated tails end the stream cleanly
+      (`stream_truncated`). The iterator itself never raises for
+      anything past the header.
+
+    Records yielded are fully validated — the guard's
+    `records_prevalidated` flag tells the family-level pass
+    (faults.guard.guard_groups) not to re-check them.
+    """
+
+    #: decompressed bytes scanned for a plausible record boundary after
+    #: a framing gap before declaring the tail lost
+    FRAME_SCAN_LIMIT = 1 << 20
+
+    def __init__(self, path: str, guard, engine: str = "auto"):
+        self.guard = guard
+        if guard.resilient:
+            # resync needs the python block codec (seek + re-inflate)
+            self._bgzf = BgzfReader.open(
+                path, resync=True, on_event=self._stream_event
+            )
+        else:
+            self._bgzf = _open_bgzf(path, engine)
+        self.records_read = 0
+        self._pending = b""  # decompressed pushback from frame scans
+        try:
+            self.header = read_bam_header(self._bgzf, path)
+        except BaseException:
+            self._bgzf.close()
+            raise
+        guard.bind(path, self.header)
+        guard.records_prevalidated = True
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _stream_event(self, kind: str, payload: dict) -> None:
+        self.guard.stream_event(kind, payload)
+
+    def _voffset(self) -> int | None:
+        return getattr(self._bgzf, "last_block_offset", None)
+
+    def _read(self, n: int) -> bytes:
+        if self._pending:
+            take, self._pending = self._pending[:n], self._pending[n:]
+            if len(take) == n:
+                return take
+            return take + self._bgzf.read(n - len(take))
+        return self._bgzf.read(n)
+
+    def _gap_pending(self) -> bool:
+        return bool(getattr(self._bgzf, "gap_pending", False))
+
+    def _next_blob(self) -> bytes | None:
+        """One structurally-valid record body, or None at clean EOF.
+        Raises BamError (typed) on refusal and _FramingGap when the
+        BGZF layer resynced mid-record."""
+        raw = self._read(4)
+        if not raw:
+            if self._gap_pending():
+                raise _FramingGap()
+            return None
+        if len(raw) < 4:
+            if self._gap_pending():
+                raise _FramingGap()
+            raise BamError(
+                "truncated record size", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        (block_size,) = struct.unpack("<i", raw)
+        if block_size < MIN_RECORD_SIZE or block_size > MAX_RECORD_SIZE:
+            raise BamError(
+                "corrupt record size", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        data = self._read(block_size)
+        if len(data) < block_size:
+            if self._gap_pending():
+                raise _FramingGap()
+            raise BamError(
+                "truncated record body", record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+        reason = check_record_body(data)
+        if reason is not None:
+            exc = BamError(
+                reason, record_index=self.records_read,
+                voffset=self._voffset(),
+            )
+            exc.blob = data  # framing survives: the blob is quarantinable
+            raise exc
+        return data
+
+    def _find_frame(self) -> bool:
+        """Scan the post-gap decompressed stream for the next offset
+        where a structurally-valid record starts (its declared size
+        fits, its body checks out, and — when enough bytes are buffered
+        — the following record's size field is plausible too). Locks
+        the stream there; False when no boundary exists in
+        FRAME_SCAN_LIMIT bytes (tail lost)."""
+        if hasattr(self._bgzf, "ack_gap"):
+            self._bgzf.ack_gap()
+        buf = self._pending + self._bgzf.read(self.FRAME_SCAN_LIMIT)
+        self._pending = b""
+        for off in range(0, max(len(buf) - MIN_RECORD_SIZE - 4, 0)):
+            (bs,) = struct.unpack_from("<i", buf, off)
+            if bs < MIN_RECORD_SIZE or bs > MAX_RECORD_SIZE:
+                continue
+            end = off + 4 + bs
+            if end > len(buf):
+                continue
+            if check_record_body(buf[off + 4 : end]) is not None:
+                continue
+            if end + 4 <= len(buf):  # corroborate with the next size
+                (bs2,) = struct.unpack_from("<i", buf, end)
+                if bs2 != 0 and (
+                    bs2 < MIN_RECORD_SIZE or bs2 > MAX_RECORD_SIZE
+                ):
+                    continue
+            self.guard.stream_event("frame_resync", {
+                "discarded_bytes": off, "voffset": self._voffset(),
+            })
+            self._pending = buf[off:]
+            return True
+        self.guard.stream_event("stream_truncated", {
+            "error": "no record boundary after stream gap",
+            "scanned": len(buf),
+        })
+        return False
+
+    # -- iteration --------------------------------------------------------
+
+    def __iter__(self) -> Iterator[BamRecord]:
+        g = self.guard
+        while True:
+            try:
+                data = self._next_blob()
+            except _FramingGap:
+                if not self._find_frame():
+                    return
+                continue
+            except BamError as exc:
+                if not g.resilient:
+                    raise
+                blob = getattr(exc, "blob", None)
+                if blob is not None:
+                    # framing intact: quarantine this record, read on
+                    g.quarantine_blob(
+                        blob, self.records_read, exc.reason,
+                        voffset=self._voffset(),
+                    )
+                    self.records_read += 1
+                    g.count("records_seen")
+                    continue
+                if exc.reason == "record-truncated":
+                    g.stream_event(
+                        "stream_truncated", {"error": str(exc)}
+                    )
+                    return
+                # corrupt size field: framing lost, re-find a boundary
+                g.stream_event("frame_lost", {"error": str(exc)})
+                if not self._find_frame():
+                    return
+                continue
+            if data is None:
+                return
+            index = self.records_read
+            self.records_read += 1
+            g.count("records_seen")
+            try:
+                rec = decode_record(data)
+            except BamError as exc:
+                if not g.resilient:
+                    exc.record_index = index
+                    raise
+                g.quarantine_blob(
+                    data, index, exc.reason, voffset=self._voffset()
+                )
+                continue
+            rec = self._validate(rec, index)
+            if rec is not None:
+                yield rec
+
+    def _validate(self, rec: BamRecord, index: int) -> BamRecord | None:
+        from bsseqconsensusreads_tpu.faults import guard as _guard
+
+        g = self.guard
+        if g.resilient and not rec.has_tag("MI"):
+            g.quarantine_record(rec, index, "missing-mi")
+            return None
+        v = _guard.record_violation(
+            rec, n_ref=g.n_ref, ref_lens=g.ref_lens,
+            max_read_len=g.max_read_len,
+        )
+        if v is None:
+            return rec
+        reason, repairable = v
+        if g.strict:
+            raise _guard.RecordGuardError(
+                f"record failed input validation: {reason}",
+                reason=reason, record_index=index, qname=rec.qname,
+            )
+        if g.lenient and repairable:
+            fixed = _guard.repair_record(rec)
+            if fixed:
+                g.repaired(rec, index, fixed)
+                return rec
+        g.quarantine_record(rec, index, reason)
+        return None
+
+    def close(self) -> None:
+        self._bgzf.close()
+
+    def __enter__(self) -> "GuardedBamReader":
         return self
 
     def __exit__(self, *exc) -> None:

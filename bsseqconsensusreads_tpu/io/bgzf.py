@@ -19,6 +19,7 @@ import zlib
 from typing import BinaryIO, Iterator
 
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults.guard import StreamGuardError
 
 # Largest uncompressed payload per block (htslib convention: 64KiB minus slop).
 MAX_BLOCK_SIZE = 65280
@@ -31,8 +32,11 @@ BGZF_EOF = bytes.fromhex(
 _HEADER = struct.Struct("<4BI2BH")  # magic(2) CM FLG MTIME XFL OS XLEN — 12 bytes
 
 
-class BgzfError(IOError):
-    pass
+class BgzfError(StreamGuardError):
+    """BGZF framing/integrity error. Subclasses the graftguard typed
+    stream error (which is an IOError, preserving the historical
+    ancestry) so every corruption an input stream can cause is a
+    faults.guard.GuardError — the fuzz contract's 'clean typed error'."""
 
 
 def _parse_block_size(extra: bytes) -> int:
@@ -41,26 +45,125 @@ def _parse_block_size(extra: bytes) -> int:
     while off + 4 <= len(extra):
         si1, si2, slen = extra[off], extra[off + 1], struct.unpack_from("<H", extra, off + 2)[0]
         if si1 == 0x42 and si2 == 0x43 and slen == 2:  # 'B','C'
+            if off + 6 > len(extra):  # BSIZE itself truncated away
+                break
             return struct.unpack_from("<H", extra, off + 4)[0] + 1
         off += 4 + slen
     raise BgzfError("BGZF block missing BC extra subfield")
 
 
 class BgzfReader:
-    """Streaming BGZF decompressor with a file-like read() interface."""
+    """Streaming BGZF decompressor with a file-like read() interface.
 
-    def __init__(self, fileobj: BinaryIO):
+    resync=True arms the graftguard stream-resilience mode: a corrupt
+    or truncated block raises nothing — the reader scans forward for
+    the next block that parses AND inflates cleanly (CRC-checked),
+    resumes there, and flags the discontinuity via `gap_pending` (the
+    record layer must re-find a record boundary; io.bam's guarded
+    iterator does). A truncated tail (missing EOF marker / partial
+    final block with no later block) becomes a clean end-of-stream,
+    also flagged. `on_event(kind, payload)` receives one callback per
+    resync/truncation so the guard can ledger and count it.
+    """
+
+    #: bytes scanned forward for the next valid block before giving up
+    RESYNC_SCAN_LIMIT = 1 << 22
+
+    def __init__(self, fileobj: BinaryIO, resync: bool = False,
+                 on_event=None):
         self._fh = fileobj
         self._buf = b""
         self._buf_off = 0
         self._eof = False
         self._last_block_empty = False
+        self._resync = resync
+        self._on_event = on_event
+        #: file offset of the most recent block's first byte (None when
+        #: the underlying file object is not seekable)
+        self.last_block_offset: int | None = 0
+        #: a resync skipped bytes and record framing is lost; cleared
+        #: by the consumer via ack_gap()
+        self.gap_pending = False
+        self._gap_just = False
 
     @classmethod
-    def open(cls, path: str) -> "BgzfReader":
-        return cls(open(path, "rb"))
+    def open(cls, path: str, resync: bool = False,
+             on_event=None) -> "BgzfReader":
+        return cls(open(path, "rb"), resync=resync, on_event=on_event)
+
+    def _event(self, kind: str, payload: dict) -> None:
+        if self._on_event is not None:
+            self._on_event(kind, payload)
+
+    def _tell(self) -> int | None:
+        try:
+            return self._fh.tell()
+        except (OSError, AttributeError):
+            return None
 
     def _read_block(self) -> bytes | None:
+        if not self._resync:
+            return self._read_block_raw()
+        try:
+            return self._read_block_raw()
+        except BgzfError as exc:
+            return self._resync_block(exc)
+
+    def _resync_block(self, exc: BgzfError) -> bytes | None:
+        """Skip-to-next-block recovery: scan forward from just past the
+        corrupt block's start for the next gzip member that parses as
+        BGZF and inflates with a matching CRC. No candidate within
+        RESYNC_SCAN_LIMIT (or an unseekable stream) ends the stream as
+        a truncated tail instead."""
+        start = self.last_block_offset
+        if start is None or not self._fh.seekable():
+            self._event("stream_truncated", {"error": str(exc)})
+            # suppress the EOF-marker raise; reader state is confined to
+            # the one ingest thread that owns this reader
+            # graftlint: disable=thread-unsafe-mutation -- confined
+            self._last_block_empty = True
+            return None
+        scan_from = start + 1
+        self._fh.seek(scan_from)
+        window = self._fh.read(self.RESYNC_SCAN_LIMIT)
+        off = 0
+        while True:
+            hit = window.find(b"\x1f\x8b\x08\x04", off)
+            if hit < 0:
+                self._event("stream_truncated", {
+                    "error": str(exc), "scanned": len(window),
+                })
+                self._fh.seek(0, 2)  # consume: later reads see EOF
+                # graftlint: disable=thread-unsafe-mutation -- confined
+                self._last_block_empty = True
+                return None
+            self._fh.seek(scan_from + hit)
+            try:
+                data = self._read_block_raw()
+            except BgzfError:
+                off = hit + 1
+                continue
+            self._event("stream_gap", {
+                "error": str(exc),
+                "gap_start": start,
+                "resumed_at": scan_from + hit,
+                "skipped_bytes": scan_from + hit - start,
+            })
+            # graftlint: disable=thread-unsafe-mutation -- confined
+            self._gap_just = True
+            # graftlint: disable=thread-unsafe-mutation -- confined
+            self.gap_pending = True
+            return data
+
+    def ack_gap(self) -> None:
+        """Consumer acknowledges a framing gap (after re-finding a
+        record boundary in the post-gap bytes)."""
+        # graftlint: disable=thread-unsafe-mutation -- confined
+        self.gap_pending = False
+
+    def _read_block_raw(self) -> bytes | None:
+        # graftlint: disable=thread-unsafe-mutation -- confined
+        self.last_block_offset = self._tell()
         head = self._fh.read(12)
         if not head:
             # A well-formed BGZF stream ends with an empty block (the 28-byte
@@ -77,6 +180,8 @@ class BgzfReader:
         extra = self._fh.read(xlen)
         bsize = _parse_block_size(extra)
         cdata_len = bsize - 12 - xlen - 8
+        if cdata_len < 0:  # untrusted 16-bit field vs declared XLEN
+            raise BgzfError("corrupt BGZF BSIZE")
         cdata = self._fh.read(cdata_len)
         tail = self._fh.read(8)
         if len(cdata) < cdata_len or len(tail) < 8:
@@ -84,7 +189,10 @@ class BgzfReader:
         crc, isize = struct.unpack("<II", tail)
         if _failpoints.ARMED:  # guarded: this runs once per 64K block
             _failpoints.fire("bgzf_inflate")
-        data = zlib.decompress(cdata, wbits=-15)
+        try:
+            data = zlib.decompress(cdata, wbits=-15)
+        except zlib.error as exc:  # corrupt deflate stream, typed
+            raise BgzfError(f"BGZF inflate failed: {exc}") from None
         if len(data) != isize:
             raise BgzfError("BGZF ISIZE mismatch")
         if zlib.crc32(data) != crc:
@@ -115,6 +223,14 @@ class BgzfReader:
                 self._buf = block
                 # graftlint: disable=thread-unsafe-mutation -- confined
                 self._buf_off = 0
+                if self._gap_just:
+                    # a resync happened: never splice pre- and post-gap
+                    # bytes into one logical read — return short and
+                    # leave the post-gap block buffered for the record
+                    # layer's re-framing pass
+                    # graftlint: disable=thread-unsafe-mutation -- confined
+                    self._gap_just = False
+                    break
                 continue
             take = min(avail, need)
             parts.append(self._buf[self._buf_off : self._buf_off + take])

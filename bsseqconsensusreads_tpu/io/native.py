@@ -13,6 +13,11 @@ import os
 
 import numpy as np
 
+from bsseqconsensusreads_tpu.faults.guard import (
+    GuardError,
+    MissingTagError,
+    classify_stream_error,
+)
 from bsseqconsensusreads_tpu.io._nativelib import load_library
 
 _lib = None
@@ -178,7 +183,9 @@ class NativeBgzfReader:
         buf = C.create_string_buffer(self._CHUNK)
         got = _lib.bamio_read(self._h, buf, self._CHUNK)
         if got < 0:
-            raise IOError(_lib.bamio_error(self._h).decode())
+            # typed stream error (same canonical reason as io.bgzf's
+            # python wording — faults.guard pins the mapping)
+            raise classify_stream_error(_lib.bamio_error(self._h).decode())
         if got == 0:
             return False
         # graftlint: disable=thread-unsafe-mutation -- reader state is
@@ -213,11 +220,15 @@ class NativeBgzfReader:
         """Exact read through ctypes with NO Python-side buffering — required
         before handing self._h to bamio_parse_records (which reads from the
         native stream position and must not skip buffered bytes)."""
-        assert self._off == len(self._buf), "unbuffered read after buffered read"
+        if self._off != len(self._buf):
+            # a bare assert here would vanish under `python -O` and let
+            # buffered bytes silently vanish from the record stream
+            # (graftlint assert-on-input)
+            raise GuardError("unbuffered read after buffered read")
         buf = C.create_string_buffer(n)
         got = _lib.bamio_read(self._h, buf, n)
         if got < 0:
-            raise IOError(_lib.bamio_error(self._h).decode())
+            raise classify_stream_error(_lib.bamio_error(self._h).decode())
         return buf.raw[:got]
 
     def read_all(self, chunk: int = 1 << 22) -> bytes:
@@ -306,6 +317,9 @@ class ColumnarBatch:
         "cigar", "cigar_off", "qname", "mi", "rx",
         "ref_span", "left_clip", "right_clip", "cigar_flags",
         "aux", "aux_off", "aux_len",
+        # graftguard per-batch semantic-violation cache
+        # (faults.guard.batch_violations, computed at most once)
+        "guard_bad",
     )
 
     def __init__(self, n, **arrays):
@@ -315,17 +329,39 @@ class ColumnarBatch:
 
 
 def _skip_header(r: "NativeBgzfReader", path: str) -> None:
+    """Skip the BAM header on a fresh native stream, with the same
+    untrusted-length bounds as io.bam.read_bam_header (a lying l_text
+    must raise typed, not size a giant read)."""
     import struct
+
+    from bsseqconsensusreads_tpu.io.bam import (
+        MAX_RECORD_SIZE,
+        BamError,
+    )
+
+    def _i32(what: str) -> int:
+        raw = r.read_unbuffered(4)
+        if len(raw) < 4:
+            raise BamError(f"corrupt BAM header (truncated {what})")
+        return struct.unpack("<i", raw)[0]
 
     magic = r.read_unbuffered(4)
     if magic != b"BAM\x01":
-        raise IOError(f"{path}: not a BAM file")
-    (l_text,) = struct.unpack("<i", r.read_unbuffered(4))
-    r.read_unbuffered(l_text)
-    (n_ref,) = struct.unpack("<i", r.read_unbuffered(4))
+        raise BamError(f"{path}: not a BAM file")
+    l_text = _i32("l_text")
+    if l_text < 0 or l_text > MAX_RECORD_SIZE:
+        raise BamError("corrupt BAM header (bad l_text)")
+    if len(r.read_unbuffered(l_text)) < l_text:
+        raise BamError("corrupt BAM header (truncated text)")
+    n_ref = _i32("n_ref")
+    if n_ref < 0 or n_ref > (1 << 24):
+        raise BamError("corrupt BAM header (bad n_ref)")
     for _ in range(n_ref):
-        (l_name,) = struct.unpack("<i", r.read_unbuffered(4))
-        r.read_unbuffered(l_name + 4)
+        l_name = _i32("l_name")
+        if l_name < 1 or l_name > (1 << 16):
+            raise BamError("corrupt BAM header (bad l_name)")
+        if len(r.read_unbuffered(l_name + 4)) < l_name + 4:
+            raise BamError("corrupt BAM header (truncated name)")
 
 
 def _alloc_batch(n: int, var_bytes: int, qname_width: int, tag_width: int):
@@ -422,6 +458,7 @@ def read_columnar(
     BamReader — this starts from a fresh native stream and skips the header).
     """
     r = NativeBgzfReader(path)
+    total = 0
     try:
         _skip_header(r, path)
         while True:
@@ -429,11 +466,18 @@ def read_columnar(
                 batch_records, var_bytes, qname_width, tag_width
             )
             got = _lib.bamio_parse_records4(r._h, batch_records, *args)
-            if got < 0:
-                raise IOError(_lib.bamio_error(r._h).decode())
-            if got == 0:
+            # graftguard error protocol: a mid-batch corruption returns
+            # the already-parsed prefix with the error pending in
+            # bamio_error, so the typed raise carries the exact failing
+            # record index — the same index the python engine reports
+            msg = _lib.bamio_error(r._h).decode()
+            if got > 0:
+                total += got
+                yield _batch_from(bufs, got, qname_width, tag_width)
+            if msg:
+                raise classify_stream_error(msg, record_index=total)
+            if got <= 0:
                 return
-            yield _batch_from(bufs, got, qname_width, tag_width)
             # a short batch means either EOF or a capacity stop with a
             # pending record; the next parse call distinguishes (got==0 ends)
     finally:
@@ -465,6 +509,7 @@ def read_grouped_columnar(
     r = NativeBgzfReader(path)
     g = _lib.bamio_group_start(flush_margin, int(strip_suffix))
     refrag_prev = 0
+    records_seen = 0
     try:
         _skip_header(r, path)
         while True:
@@ -482,10 +527,13 @@ def read_grouped_columnar(
                 C.byref(n_fams),
             )
             if got == -1:
-                raise IOError(_lib.bamio_error(r._h).decode())
+                raise classify_stream_error(
+                    _lib.bamio_error(r._h).decode(),
+                    record_index=records_seen,
+                )
             if got == -2:
                 qn = _lib.bamio_group_error(g).decode()
-                raise ValueError(f"{qn} does not have MI tag.")
+                raise MissingTagError(qn)
             if got == -3:  # one family exceeds the buffers: grow and retry
                 batch_records *= 2
                 var_bytes *= 2
@@ -493,6 +541,7 @@ def read_grouped_columnar(
             if got == 0:
                 return
             nf = n_fams.value
+            records_seen += int(got)
             refrag = int(_lib.bamio_group_refragmented(g))
             delta, refrag_prev = refrag - refrag_prev, refrag
             yield (

@@ -101,6 +101,9 @@ class WorkerHeartbeat:
             while not self._stop.wait(interval_s):
                 self.beat("alive")
 
+        # armed once from the owning control thread before the worker
+        # starts; the worker only reads self._stop (an Event)
+        # graftlint: disable=thread-unsafe-mutation -- armed pre-start
         self._thread = threading.Thread(
             target=run, name="bsseq-heartbeat", daemon=True
         )

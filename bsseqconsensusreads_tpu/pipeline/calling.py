@@ -63,6 +63,7 @@ from bsseqconsensusreads_tpu.ops.encode import (
     scan_matches,
 )
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import guard as _guard_mod
 from bsseqconsensusreads_tpu.faults import retry as _faultretry
 from bsseqconsensusreads_tpu.parallel import hostpool as _hostpool
 from bsseqconsensusreads_tpu.utils import observe
@@ -561,9 +562,62 @@ class StageStats:
     def batches_stalled(self) -> int:
         return self.metrics.counters.get("batches_stalled", 0)
 
+    # graftguard accounting (faults.guard): every record the reader
+    # decoded, every record/family the guard refused, every lenient
+    # repair — first-class stage fields so a run summary can never hide
+    # that input was dropped or altered. Reconciliation invariants
+    # (asserted by tools/fuzz_ingest.py): records_seen = records_in +
+    # records_quarantined; records reaching consensus = records_in -
+    # family_records_quarantined.
+
+    @property
+    def records_seen(self) -> int:
+        return self.metrics.counters.get("records_seen", 0)
+
+    @property
+    def records_quarantined(self) -> int:
+        return self.metrics.counters.get("records_quarantined", 0)
+
+    @property
+    def records_repaired(self) -> int:
+        return self.metrics.counters.get("records_repaired", 0)
+
+    @property
+    def families_quarantined(self) -> int:
+        return self.metrics.counters.get("families_quarantined", 0)
+
+    @property
+    def family_records_quarantined(self) -> int:
+        return self.metrics.counters.get("family_records_quarantined", 0)
+
+    @property
+    def stream_gaps(self) -> int:
+        return self.metrics.counters.get("stream_gap", 0)
+
+    @property
+    def stream_truncations(self) -> int:
+        return self.metrics.counters.get("stream_truncated", 0)
+
+    @property
+    def frame_resyncs(self) -> int:
+        return self.metrics.counters.get("frame_resync", 0)
+
+    @property
+    def frames_lost(self) -> int:
+        return self.metrics.counters.get("frame_lost", 0)
+
     def as_dict(self) -> dict:
         return {
             "records_in": self.records_in,
+            "records_seen": self.records_seen,
+            "records_quarantined": self.records_quarantined,
+            "records_repaired": self.records_repaired,
+            "families_quarantined": self.families_quarantined,
+            "family_records_quarantined": self.family_records_quarantined,
+            "stream_gaps": self.stream_gaps,
+            "stream_truncations": self.stream_truncations,
+            "frame_resyncs": self.frame_resyncs,
+            "frames_lost": self.frames_lost,
             "families": self.families,
             "consensus_out": self.consensus_out,
             "skipped_families": self.skipped_families,
@@ -641,7 +695,9 @@ def stream_mi_groups(
         try:  # one tag parse per record, not a has_tag/get_tag pair
             mi = rec.get_tag("MI")
         except KeyError:
-            raise ValueError(f"{rec.qname} does not have MI tag.") from None
+            # typed (faults.guard.MissingTagError IS a ValueError with
+            # the identical reference-parity message)
+            raise _guard_mod.MissingTagError(rec.qname) from None
         mi = str(mi)
         return mi.split("/")[0] if strip_suffix else mi
 
@@ -1099,6 +1155,7 @@ def call_molecular_batches(
     batching: str = "bucketed",
     transport: str = "auto",
     base_counts: bool = True,
+    guard=None,
 ) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -1142,6 +1199,11 @@ def call_molecular_batches(
     (models.molecular.molecular_base_counts) — the duplex stage's input
     for EXACT raw-unit ce/cE (PARITY.md row 6 closure). Host-side integer
     tallies; disable to shave tag bytes when no duplex stage follows.
+
+    guard: a faults.guard.Guard — family-level admission control
+    (family-size bombs, read-length outliers, per-record semantic
+    validation when the reader did not pre-validate) applied to the
+    group stream before batching. None/off = pass-through.
     """
     import os
 
@@ -1460,7 +1522,10 @@ def call_molecular_batches(
         return {k: np.asarray(v) for k, v in out.items()}
 
     groups = _timed_groups(
-        stream_mi_groups(records, grouping=grouping, stats=stats),
+        _guard_mod.guard_groups(
+            stream_mi_groups(records, grouping=grouping, stats=stats),
+            guard,
+        ),
         stats.metrics,
     )
     if batching == "bucketed":
@@ -1709,6 +1774,7 @@ def call_duplex_batches(
     transport: str = "auto",
     pos0: str = "skip",
     strand_tags: bool = True,
+    guard=None,
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -2059,8 +2125,11 @@ def call_duplex_batches(
         return emit_out(out, batch, passed)
 
     groups = _timed_groups(
-        stream_mi_groups(
-            records, strip_suffix=True, grouping=grouping, stats=stats
+        _guard_mod.guard_groups(
+            stream_mi_groups(
+                records, strip_suffix=True, grouping=grouping, stats=stats
+            ),
+            guard,
         ),
         stats.metrics,
     )

@@ -60,6 +60,13 @@ class _Manifest:
     shards: list[str] = dataclasses.field(default_factory=list)
     records: int = 0
     fingerprint: dict = dataclasses.field(default_factory=dict)
+    #: identity of the INPUT the shards were computed from (path, size,
+    #: mtime) — kept apart from the config fingerprint because the two
+    #: mismatches demand different responses: config drift discards and
+    #: recomputes, input drift REFUSES (faults.guard.InputChangedError;
+    #: splicing consensus from two different inputs is silent corruption,
+    #: and silently recomputing would hide that the input was swapped)
+    input_fingerprint: dict = dataclasses.field(default_factory=dict)
     #: per-shard integrity + replay bookkeeping, parallel to `shards`:
     #: CRC32 of the shard file bytes, batches per shard, records per
     #: shard — what lets a corrupt shard be truncated out exactly.
@@ -76,6 +83,7 @@ class _Manifest:
         return cls(
             d["batches_done"], d["shards"], d["records"],
             d.get("fingerprint", {}),
+            d.get("input_fingerprint", {}),
             d.get("shard_crcs", []),
             d.get("shard_batches", []),
             d.get("shard_records", []),
@@ -105,15 +113,23 @@ class BatchCheckpoint:
     every: batches per shard file — the checkpoint interval. Larger values
     mean fewer files and fsyncs but more recomputation after a crash.
 
-    fingerprint: anything identifying the (input, batching parameters) the
-    shards were computed from — e.g. input path+size+mtime, batch_families,
-    params repr. A stale manifest whose fingerprint mismatches is discarded
-    (with its shards) instead of splicing old-input shards into a new run —
-    and the discard is ledgered with both fingerprints.
+    fingerprint: anything identifying the batching/model parameters the
+    shards were computed from — batch_families, params repr, kernel. A
+    stale manifest whose fingerprint mismatches is discarded (with its
+    shards) instead of splicing old-config shards into a new run — and
+    the discard is ledgered with both fingerprints.
+
+    input_fingerprint: identity of the input file (path/size/mtime). A
+    mismatch REFUSES to resume (faults.guard.InputChangedError) instead
+    of discarding: the operator must decide whether the input swap was
+    intentional (delete the manifest) — resuming would splice consensus
+    computed from two different inputs, and silently recomputing would
+    hide that the input changed under a checkpoint worth hours.
     """
 
     def __init__(self, target: str, header: BamHeader, every: int = 16,
-                 fingerprint: dict | None = None, level: int = 6):
+                 fingerprint: dict | None = None, level: int = 6,
+                 input_fingerprint: dict | None = None):
         if every < 1:
             raise ValueError(f"checkpoint interval must be >= 1, got {every}")
         self.target = target
@@ -123,11 +139,33 @@ class BatchCheckpoint:
         self.manifest_path = target + ".ckpt.json"
         self.manifest = _Manifest.load(self.manifest_path)
         fingerprint = fingerprint or {}
+        input_fingerprint = input_fingerprint or {}
         if self.manifest.shards and not self.manifest.consistent():
             # a manifest from before the integrity fields (or a mangled
             # one): its per-shard bookkeeping cannot be trusted, so
             # recompute rather than resume
             self._discard(reason="manifest_format")
+        if (
+            self.manifest.shards
+            and self.manifest.input_fingerprint
+            and input_fingerprint
+            and self.manifest.input_fingerprint != input_fingerprint
+        ):
+            from bsseqconsensusreads_tpu.faults.guard import InputChangedError
+
+            observe.emit(
+                "checkpoint_input_changed",
+                {
+                    "target": self.target,
+                    "manifest_input": self.manifest.input_fingerprint,
+                    "run_input": input_fingerprint,
+                    "batches_at_stake": self.manifest.batches_done,
+                },
+            )
+            raise InputChangedError(
+                self.target, self.manifest.input_fingerprint,
+                input_fingerprint,
+            )
         if self.manifest.shards and self.manifest.fingerprint != fingerprint:
             # LOUD discard: an operator must be able to tell "resumed
             # fresh on purpose" from "params drifted" after the fact
@@ -145,6 +183,7 @@ class BatchCheckpoint:
             self._discard_scratch()
             self.manifest = _Manifest()
         self.manifest.fingerprint = fingerprint
+        self.manifest.input_fingerprint = input_fingerprint
         self._verify_shards()
 
     def _discard(self, reason: str) -> None:

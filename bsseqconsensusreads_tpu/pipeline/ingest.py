@@ -294,12 +294,22 @@ class GroupedColumnarStream:
     scan (one call per batch) and yields FamilyRun objects instead of
     (mi, records) tuples; 'duplex' runs the duplex-shaped scan
     (io.native.duplex_scan, rows keyed by flag); None keeps the tuple
-    form."""
+    form.
+
+    `guard` (faults.guard.Guard, strict policy): every batch runs the
+    vectorized semantic check (faults.guard.batch_violations) ONCE as
+    it arrives — a record whose l_seq disagrees with its CIGAR, an
+    out-of-range qual/refID/pos, fails fast with the offending qname
+    before any family is encoded. The check result is cached on the
+    batch (`guard_bad`) so the family-level admission pass never
+    recomputes it. The resilient policies never see this stream —
+    pipeline.stages routes them through the guarded python reader."""
 
     def __init__(self, path: str, flush_margin: int = 10_000,
                  strip_suffix: bool = False,
                  scan_policy: str | None = None,
-                 grouping: str = "coordinate"):
+                 grouping: str = "coordinate",
+                 guard=None):
         if scan_policy not in (None, "drop", "align", "duplex"):
             raise ValueError(f"unknown scan_policy {scan_policy!r}")
         if grouping not in ("coordinate", "adjacent"):
@@ -311,6 +321,30 @@ class GroupedColumnarStream:
         self.strip_suffix = strip_suffix
         self.scan_policy = scan_policy
         self.grouping = grouping
+        self.guard = guard
+
+    def _guard_batch(self, batch) -> None:
+        """Strict-policy vectorized validation of one columnar batch;
+        populates the batch's guard_bad cache either way."""
+        from bsseqconsensusreads_tpu.faults import guard as _guard
+
+        g = self.guard
+        bad = _guard.batch_violations(
+            batch, n_ref=g.n_ref, ref_lens=g.ref_lens,
+            max_read_len=g.max_read_len,
+        )
+        batch.guard_bad = bad
+        g.count("records_seen", batch.n)
+        if bad and g.strict:
+            idx = min(bad)
+            reason, _ = bad[idx]
+            from bsseqconsensusreads_tpu.ops.encode import _decode_fixed
+
+            raise _guard.RecordGuardError(
+                f"record failed input validation: {reason}",
+                reason=reason, record_index=idx,
+                qname=_decode_fixed(batch.qname[idx]),
+            )
 
     def iter_groups(self, stats=None):
         from bsseqconsensusreads_tpu.ops.encode import INDEL_BAND
@@ -323,6 +357,8 @@ class GroupedColumnarStream:
             if stats is not None:
                 stats.records_in += batch.n
                 stats.refragmented_families += refrag
+            if self.guard is not None and self.guard.active:
+                self._guard_batch(batch)
             if self.scan_policy is not None:
                 fam_start = np.zeros(len(fam_nrec), np.int64)
                 fam_start[1:] = np.cumsum(fam_nrec[:-1], dtype=np.int64)

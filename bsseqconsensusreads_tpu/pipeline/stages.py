@@ -24,10 +24,12 @@ import shlex
 import subprocess
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.faults import guard as _guard
 from bsseqconsensusreads_tpu.io.bam import (
     BamHeader,
     BamReader,
     BamWriter,
+    GuardedBamReader,
     write_items,
 )
 from bsseqconsensusreads_tpu.io.fasta import FastaFile
@@ -56,13 +58,31 @@ def sample_name(bam_path: str) -> str:
     return os.path.basename(bam_path).replace(".bam", "")
 
 
+def open_guarded_reader(path: str, guard):
+    """The policy-appropriate record reader for one consensus stage's
+    input: the resilient policies (quarantine/lenient) read through
+    io.bam.GuardedBamReader — BGZF resync, record quarantine, per-record
+    validation — while strict/off keep the plain BamReader (strict's
+    structural checks are always-on in BamReader itself; its semantic
+    checks run vectorized in the native grouped stream or per family in
+    faults.guard.guard_groups). Binds the guard to the input either
+    way so sidecar paths and `record #N` diagnostics are anchored."""
+    if guard is not None and guard.resilient:
+        return GuardedBamReader(path, guard)
+    reader = BamReader(path)
+    if guard is not None:
+        guard.bind(path, reader.header)
+    return reader
+
+
 def ingest_records(path: str, reader, stats: StageStats,
                    ingest_choice: str = "auto",
                    grouping: str = "coordinate",
                    allow_native: bool = True,
                    strip_suffix: bool = False,
                    scan_policy: str | None = None,
-                   native_block_reason: str = "this stage disallows it"):
+                   native_block_reason: str = "this stage disallows it",
+                   guard=None):
     """Record stream for a consensus stage: the native columnar decoder
     (pipeline.ingest) when configured+built, else the BamReader. With
     grouping='coordinate' the native path also pre-groups families in
@@ -71,11 +91,27 @@ def ingest_records(path: str, reader, stats: StageStats,
     (scan_policy). The chosen engine lands in stats.metrics
     ('ingest_native'/'group_native' counters) so the ingest-phase
     records/sec (records_in / ingest_seconds) is attributable. Shared by
-    the pipeline stage runner and the CLI subcommands."""
+    the pipeline stage runner and the CLI subcommands.
+
+    `guard` (faults.guard.Guard) routes by policy: the resilient
+    policies (quarantine/lenient) need the python record reader — BGZF
+    block resync + per-record quarantine live there
+    (io.bam.GuardedBamReader, which `reader` must already be) — so the
+    native engine is disabled (loudly, if explicitly requested); the
+    strict policy keeps the native path and hands the guard to the
+    grouped stream for its vectorized per-batch validation."""
     from bsseqconsensusreads_tpu.pipeline import ingest
 
     if ingest_choice not in ("auto", "native", "python"):
         raise WorkflowError(f"unknown ingest {ingest_choice!r}")
+    if guard is not None and guard.resilient:
+        if ingest_choice == "native":
+            raise WorkflowError(
+                f"ingest 'native' is incompatible with "
+                f"{guard.policy!r} input policy (stream resync and "
+                "record quarantine need the python decode engine)"
+            )
+        allow_native = False
     # 'gather' grouping would pin every columnar batch's buffers for
     # the whole file; only the streaming groupings keep ingest bounded
     if grouping == "gather":
@@ -110,7 +146,7 @@ def ingest_records(path: str, reader, stats: StageStats,
     if use_grouped:
         return ingest.GroupedColumnarStream(
             path, strip_suffix=strip_suffix, scan_policy=scan_policy,
-            grouping=grouping,
+            grouping=grouping, guard=guard,
         )
     return ingest.columnar_records(path) if use_native else reader
 
@@ -118,20 +154,22 @@ def ingest_records(path: str, reader, stats: StageStats,
 def molecular_ingest_stream(path: str, reader, stats: StageStats,
                             ingest_choice: str = "auto",
                             grouping: str = "coordinate",
-                            indel_policy: str = "drop"):
+                            indel_policy: str = "drop",
+                            guard=None):
     """The molecular stage's ingest contract, shared by the CLI subcommand
     and PipelineBuilder: full-MI grouping, C encode digest computed under
     the stage's indel policy."""
     return ingest_records(
         path, reader, stats, ingest_choice=ingest_choice, grouping=grouping,
-        scan_policy=indel_policy,
+        scan_policy=indel_policy, guard=guard,
     )
 
 
 def duplex_ingest_stream(path: str, reader, stats: StageStats,
                          ingest_choice: str = "auto",
                          grouping: str = "coordinate",
-                         passthrough: bool = False):
+                         passthrough: bool = False,
+                         guard=None):
     """The duplex stage's ingest contract, shared by the CLI subcommand and
     PipelineBuilder: strand-suffix-stripped grouping (base MI), the
     duplex-shaped C scan, and Python records under passthrough (leftovers
@@ -145,6 +183,7 @@ def duplex_ingest_stream(path: str, reader, stats: StageStats,
             "duplex passthrough needs full-tag Python records "
             "(native views carry only MI/RX)"
         ),
+        guard=guard,
     )
 
 
@@ -275,10 +314,15 @@ class PipelineBuilder:
             return None
         src = rule.inputs[0]
         st = os.stat(src)
-        fingerprint = {
+        # input identity is carried SEPARATELY from the config
+        # fingerprint: config drift discards + recomputes, input drift
+        # refuses (faults.guard.InputChangedError via BatchCheckpoint)
+        input_fingerprint = {
             "input": os.path.abspath(src),
             "size": st.st_size,
             "mtime": st.st_mtime,
+        }
+        fingerprint = {
             "batch_families": self.cfg.batch_families,
             "max_window": self.cfg.max_window,
             "grouping": self.cfg.grouping,
@@ -298,6 +342,7 @@ class PipelineBuilder:
         return BatchCheckpoint(
             rule.outputs[0], header, every=self.cfg.checkpoint_every,
             fingerprint=fingerprint,
+            input_fingerprint=input_fingerprint,
             level=self._out_level(rule.outputs[0]),
         )
 
@@ -424,66 +469,80 @@ class PipelineBuilder:
 
     def run_molecular(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("molecular", StageStats(stage="molecular"))
-        with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("molecular"):
-            header = self._pg(reader.header, "molecular")
-            ck = self._checkpointed("molecular", rule, header)
-            batches = call_molecular_batches(
-                molecular_ingest_stream(
-                    rule.inputs[0], reader, stats,
-                    ingest_choice=self.cfg.ingest,
+        g = _guard.Guard.from_env(stats)
+        try:
+            with open_guarded_reader(rule.inputs[0], g) as reader, \
+                    observe.maybe_trace("molecular"):
+                header = self._pg(reader.header, "molecular")
+                ck = self._checkpointed("molecular", rule, header)
+                batches = call_molecular_batches(
+                    molecular_ingest_stream(
+                        rule.inputs[0], reader, stats,
+                        ingest_choice=self.cfg.ingest,
+                        grouping=self.molecular_grouping,
+                        indel_policy=self.cfg.indel_policy,
+                        guard=g,
+                    ),
+                    params=self.cfg.molecular,
+                    mode=mode,
+                    batch_families=self.cfg.batch_families,
+                    max_window=self.cfg.max_window,
                     grouping=self.molecular_grouping,
+                    stats=stats,
+                    skip_batches=ck.batches_done if ck else 0,
                     indel_policy=self.cfg.indel_policy,
-                ),
-                params=self.cfg.molecular,
-                mode=mode,
-                batch_families=self.cfg.batch_families,
-                max_window=self.cfg.max_window,
-                grouping=self.molecular_grouping,
-                stats=stats,
-                skip_batches=ck.batches_done if ck else 0,
-                indel_policy=self.cfg.indel_policy,
-                emit=self.cfg.emit,
-                transport=self.cfg.transport,
-                batching=self.cfg.batching,
-                base_counts=self.cfg.base_count_tags,
-            )
-            self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
+                    emit=self.cfg.emit,
+                    transport=self.cfg.transport,
+                    batching=self.cfg.batching,
+                    base_counts=self.cfg.base_count_tags,
+                    guard=g,
+                )
+                self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
+        finally:
+            g.close()
 
     def run_duplex(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("duplex", StageStats(stage="duplex"))
         fasta = FastaFile(self.cfg.genome_fasta)
-        with BamReader(rule.inputs[0]) as reader, observe.maybe_trace("duplex"):
-            names = [n for n, _ in reader.header.references]
-            header = self._pg(reader.header, "duplex")
-            if mode == "self":  # output leaves coordinate-sorted
-                header = header.with_sort_order("coordinate")
-            ck = self._checkpointed("duplex", rule, header)
-            batches = call_duplex_batches(
-                duplex_ingest_stream(
-                    rule.inputs[0], reader, stats,
-                    ingest_choice=self.cfg.ingest,
+        g = _guard.Guard.from_env(stats)
+        try:
+            with open_guarded_reader(rule.inputs[0], g) as reader, \
+                    observe.maybe_trace("duplex"):
+                names = [n for n, _ in reader.header.references]
+                header = self._pg(reader.header, "duplex")
+                if mode == "self":  # output leaves coordinate-sorted
+                    header = header.with_sort_order("coordinate")
+                ck = self._checkpointed("duplex", rule, header)
+                batches = call_duplex_batches(
+                    duplex_ingest_stream(
+                        rule.inputs[0], reader, stats,
+                        ingest_choice=self.cfg.ingest,
+                        grouping=self.cfg.grouping,
+                        passthrough=self.cfg.duplex_passthrough,
+                        guard=g,
+                    ),
+                    fasta.fetch,
+                    names,
+                    params=self.cfg.duplex,
+                    mode=mode,
+                    batch_families=self.cfg.batch_families,
+                    max_window=self.cfg.max_window,
                     grouping=self.cfg.grouping,
+                    stats=stats,
+                    skip_batches=ck.batches_done if ck else 0,
                     passthrough=self.cfg.duplex_passthrough,
-                ),
-                fasta.fetch,
-                names,
-                params=self.cfg.duplex,
-                mode=mode,
-                batch_families=self.cfg.batch_families,
-                max_window=self.cfg.max_window,
-                grouping=self.cfg.grouping,
-                stats=stats,
-                skip_batches=ck.batches_done if ck else 0,
-                passthrough=self.cfg.duplex_passthrough,
-                emit=self.cfg.emit,
-                # FASTA path, loaded into a device-resident genome only if
-                # the wire transport engages (call_duplex_batches decides)
-                refstore=self.cfg.genome_fasta,
-                transport=self.cfg.transport,
-                pos0=self.cfg.pos0,
-                strand_tags=self.cfg.duplex_strand_tags,
-            )
-            self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
+                    emit=self.cfg.emit,
+                    # FASTA path, loaded into a device-resident genome only if
+                    # the wire transport engages (call_duplex_batches decides)
+                    refstore=self.cfg.genome_fasta,
+                    transport=self.cfg.transport,
+                    pos0=self.cfg.pos0,
+                    strand_tags=self.cfg.duplex_strand_tags,
+                    guard=g,
+                )
+                self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
+        finally:
+            g.close()
 
     def run_sam_to_fastq(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:

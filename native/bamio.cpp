@@ -532,6 +532,15 @@ inline uint16_t rd_u16(const uint8_t* p) {
 }
 
 // Extract a Z-type tag's value into out (NUL-terminated, truncated to w-1).
+// graftguard: a tag that IS present but malformed — wrong type (not
+// Z/H), empty value, or non-printable bytes — must be distinguishable
+// from an absent tag, or the strict native path silently accepts
+// records the Python engine refuses (faults.guard record_violation
+// 'tag-shape'). Present-but-malformed writes this sentinel byte into
+// the fixed-width slot; absent stays "" (faults.guard.TAG_MALFORMED
+// mirrors the value).
+static const char kTagMalformed = '\x01';
+
 void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
                 int w) {
   out[0] = '\0';
@@ -539,6 +548,7 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
   while (off + 3 <= n) {
     char t0 = char(tags[off]), t1 = char(tags[off + 1]);
     char tc = char(tags[off + 2]);
+    bool hit = (t0 == key[0] && t1 == key[1]);
     off += 3;
     size_t len = 0;
     switch (tc) {
@@ -548,8 +558,16 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
       case 'Z': case 'H': {
         size_t e = off;
         while (e < n && tags[e] != 0) e++;
-        if (t0 == key[0] && t1 == key[1]) {
+        if (hit) {
           size_t cnt = e - off;
+          bool printable = cnt > 0;
+          for (size_t i = off; i < e && printable; i++)
+            printable = tags[i] >= 0x21 && tags[i] <= 0x7E;
+          if (!printable) {
+            out[0] = kTagMalformed;
+            out[1] = '\0';
+            return;
+          }
           if (cnt > size_t(w - 1)) cnt = w - 1;
           memcpy(out, tags + off, cnt);
           out[cnt] = '\0';
@@ -560,6 +578,11 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
       }
       case 'B': {
         if (off + 5 > n) return;
+        if (hit) {
+          out[0] = kTagMalformed;
+          out[1] = '\0';
+          return;
+        }
         char sub = char(tags[off]);
         uint32_t cnt = rd_u32(tags + off + 1);
         size_t esz = (sub == 'c' || sub == 'C') ? 1
@@ -569,6 +592,11 @@ void find_z_tag(const uint8_t* tags, size_t n, const char* key, char* out,
       }
       default:
         return;  // unknown tag type: stop scanning
+    }
+    if (hit) {  // present under a non-string type: malformed
+      out[0] = kTagMalformed;
+      out[1] = '\0';
+      return;
     }
     off += len;
   }
@@ -805,6 +833,26 @@ void emit_record_body(const uint8_t* p, size_t bs, ColumnarOut& o) {
   o.nrec++;
 }
 
+// graftguard structural validation: a record whose declared field
+// lengths cannot fit its block size must be refused HERE — every
+// downstream consumer (emit_record_body, ref_end_of_body, the tag
+// walkers) indexes the body by these fields and would read past the
+// buffer on a length-field lie. Byte-identical rule + message to the
+// Python mirror (faults.guard.check_record_body) so both decode
+// engines refuse the same record at the same index.
+const char* body_check(const uint8_t* p, size_t bs) {
+  static const char* kCorrupt = "corrupt record body (field/length mismatch)";
+  if (bs < 32) return kCorrupt;
+  uint8_t l_qname = p[8];
+  uint16_t ncig = rd_u16(p + 12);
+  int32_t lseq = rd_i32(p + 16);
+  if (l_qname < 1 || lseq < 0) return kCorrupt;
+  int64_t need = 32 + int64_t(l_qname) + 4 * int64_t(ncig) +
+                 (int64_t(lseq) + 1) / 2 + int64_t(lseq);
+  if (need > int64_t(bs)) return kCorrupt;
+  return nullptr;
+}
+
 // Read one raw record body (sans block_size) from the stream.
 // Returns 1 ok, 0 clean EOF, -1 error (r->err set).
 int read_record_body(Reader* r, std::vector<uint8_t>& body) {
@@ -823,6 +871,11 @@ int read_record_body(Reader* r, std::vector<uint8_t>& body) {
   body.resize(bs);
   if (bamio_read(r, body.data(), bs) != bs) {
     r->err = r->err.empty() ? "truncated record body" : r->err;
+    return -1;
+  }
+  const char* reason = body_check(body.data(), body.size());
+  if (reason != nullptr) {
+    r->err = reason;
     return -1;
   }
   return 1;
@@ -1122,7 +1175,12 @@ int64_t bamio_parse_records4(
     } else {
       int rc = read_record_body(r, body);
       if (rc == 0) break;
-      if (rc < 0) return -1;
+      if (rc < 0)
+        // mid-batch corruption: hand the already-parsed prefix back so
+        // the caller can account the exact failing record index; the
+        // pending error stays in r->err (bamio_error) and the caller
+        // must not parse again. A clean leading failure keeps -1.
+        return o.nrec > 0 ? o.nrec : -1;
     }
     if (!record_fits(body.data(), o)) {
       r->pending.swap(body);  // doesn't fit: hand back next call

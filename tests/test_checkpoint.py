@@ -139,6 +139,59 @@ def test_stale_fingerprint_discards_shards(grouped, tmp_path):
     assert not list(tmp_path.glob("fp.bam.part*"))
 
 
+def test_input_change_refuses_resume(grouped, tmp_path, monkeypatch):
+    """An input whose size/mtime changed since the manifest was written
+    must REFUSE to resume (faults.guard.InputChangedError) — not
+    silently splice consensus from two inputs, and not silently throw
+    away the checkpoint either. The refusal is ledgered with both
+    fingerprints; deleting the manifest (as the error instructs)
+    recomputes from scratch."""
+    import os
+
+    from bsseqconsensusreads_tpu.faults.guard import InputChangedError
+
+    header, records = grouped
+    uh = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", references=header.references)
+    target = str(tmp_path / "ifp.bam")
+    fp_a = {"input": "/data/in.bam", "size": 1000, "mtime": 1.0}
+    ck = BatchCheckpoint(target, uh, every=2, fingerprint={"p": 1},
+                         input_fingerprint=fp_a)
+    batches = call_molecular_batches(iter(records), batch_families=BATCH_FAMILIES)
+    ck.write_batches(batch for i, batch in enumerate(batches) if i < 4)
+    assert ck.batches_done == 4
+
+    # unchanged input resumes
+    assert BatchCheckpoint(
+        target, uh, every=2, fingerprint={"p": 1}, input_fingerprint=fp_a
+    ).batches_done == 4
+
+    # changed input refuses, with ledger evidence
+    fp_b = dict(fp_a, size=2000, mtime=2.0)
+    sink = str(tmp_path / "ledger.jsonl")
+    monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+    try:
+        with pytest.raises(InputChangedError, match="different\\s+input"):
+            BatchCheckpoint(target, uh, every=2, fingerprint={"p": 1},
+                            input_fingerprint=fp_b)
+    finally:
+        observe.close_sinks()
+    events = [json.loads(l) for l in open(sink)]
+    (ev,) = [e for e in events if e["event"] == "checkpoint_input_changed"]
+    assert ev["manifest_input"] == fp_a
+    assert ev["run_input"] == fp_b
+    assert ev["batches_at_stake"] == 4
+    # the refusal left the checkpoint intact (nothing discarded)
+    assert BatchCheckpoint(
+        target, uh, every=2, fingerprint={"p": 1}, input_fingerprint=fp_a
+    ).batches_done == 4
+
+    # the documented escape hatch: delete the manifest -> fresh start
+    os.remove(target + ".ckpt.json")
+    assert BatchCheckpoint(
+        target, uh, every=2, fingerprint={"p": 1}, input_fingerprint=fp_b
+    ).batches_done == 0
+
+
 def test_fingerprint_mismatch_is_ledgered(grouped, tmp_path, monkeypatch):
     """Discarding a stale manifest must leave ledger evidence carrying
     BOTH fingerprints, so an operator can tell 'resumed fresh on

@@ -35,6 +35,7 @@ FIXTURES = {
     "swallowed-exception": "fx_swallowed_exception.py",
     "unbounded-retry": "fx_unbounded_retry.py",
     "serialized-host-phase": "fx_serialized_host_phase.py",
+    "assert-on-input": "fx_assert_on_input.py",
 }
 
 

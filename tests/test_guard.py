@@ -1,0 +1,502 @@
+"""graftguard (faults.guard + io hardening) tests.
+
+Covers the PR-5 acceptance contract:
+
+* byte-identical output on well-formed input across every input policy
+  including 'off' (guards must be zero-cost-identical when nothing is
+  wrong);
+* python vs native decode engines raise the SAME typed error (same
+  canonical reason, and the same record index for record-plane
+  corruption) on the corrupt-input fixture set;
+* quarantine mode survives corruption: sidecar written with a qr
+  reason tag, counters reconcile (seen = in + quarantined), BGZF
+  resync and frame re-finding keep the stream alive;
+* family-level admission control (size bombs, read-length outliers);
+* lenient repair (qual clamp) is counted and ledgered;
+* checkpoint resume against a changed input refuses loudly
+  (InputChangedError) — see also tests/test_checkpoint.py;
+* a fast in-process subset of tools/fuzz_ingest.py runs as the tier-1
+  no-crash gate so every future PR exercises the contract.
+"""
+
+import importlib.util
+import os
+import struct
+import sys
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.faults import guard as guard_mod
+from bsseqconsensusreads_tpu.faults.guard import (
+    FamilyGuardError,
+    Guard,
+    GuardError,
+    MissingTagError,
+    RecordGuardError,
+    StreamGuardError,
+    canonical_reason,
+    check_record_body,
+    guard_groups,
+    record_violation,
+    resolve_policy,
+)
+from bsseqconsensusreads_tpu.io.bam import (
+    BamError,
+    BamReader,
+    BamWriter,
+    GuardedBamReader,
+    encode_record,
+)
+from bsseqconsensusreads_tpu.io.bgzf import BgzfError
+from bsseqconsensusreads_tpu.pipeline import ingest
+from bsseqconsensusreads_tpu.pipeline.calling import StageStats
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _load_fuzz():
+    spec = importlib.util.spec_from_file_location(
+        "fuzz_ingest", os.path.join(REPO, "tools", "fuzz_ingest.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+fuzz = _load_fuzz()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    wd = str(tmp_path_factory.mktemp("guard_corpus"))
+    return fuzz.Corpus(wd)
+
+
+@pytest.fixture(autouse=True)
+def _policy_env(monkeypatch):
+    """Each test starts from the default policy; mutator caps armed so
+    the admission tests can trip them."""
+    monkeypatch.delenv(guard_mod.ENV_POLICY, raising=False)
+    monkeypatch.setenv(guard_mod.ENV_MAX_FAMILY, str(fuzz.MAX_FAMILY_RECORDS))
+    monkeypatch.setenv(guard_mod.ENV_MAX_READ_LEN, str(fuzz.MAX_READ_LEN))
+
+
+def _native_available() -> bool:
+    return ingest.available()
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+
+
+class TestTaxonomy:
+    def test_resolve_policy(self, monkeypatch):
+        assert resolve_policy() == "strict"
+        monkeypatch.setenv(guard_mod.ENV_POLICY, "quarantine")
+        assert resolve_policy() == "quarantine"
+        assert resolve_policy("lenient") == "lenient"
+        with pytest.raises(ValueError, match="unknown BSSEQ_TPU_INPUT_POLICY"):
+            resolve_policy("qurantine")
+
+    def test_stream_errors_are_guard_and_io_errors(self):
+        """Existing callers catch IOError; the fuzz contract needs
+        GuardError — the taxonomy must satisfy both."""
+        for exc_type in (BamError, BgzfError, StreamGuardError):
+            assert issubclass(exc_type, GuardError)
+            assert issubclass(exc_type, IOError)
+        assert issubclass(RecordGuardError, ValueError)
+        assert issubclass(FamilyGuardError, ValueError)
+
+    def test_missing_tag_error_reference_parity(self):
+        """The historical message, byte for byte (tools/2:180)."""
+        exc = MissingTagError("read7")
+        assert str(exc) == "read7 does not have MI tag."
+        assert isinstance(exc, ValueError)
+        assert exc.reason == "missing-mi"
+
+    def test_canonical_reasons_shared_between_engines(self):
+        # python wording and native wording land on one reason
+        assert canonical_reason("BGZF CRC mismatch") == "bgzf-corrupt"
+        assert canonical_reason("BGZF inflate failed: x") == "bgzf-corrupt"
+        assert canonical_reason("truncated BGZF block") == "bgzf-truncated"
+        assert canonical_reason("corrupt record size") == "record-corrupt"
+        assert (
+            canonical_reason("corrupt record body (field/length mismatch)")
+            == "record-corrupt"
+        )
+        assert canonical_reason("truncated record body") == "record-truncated"
+
+    def test_record_diagnostic_carries_location(self):
+        exc = BamError("corrupt record size", record_index=17, voffset=4096)
+        assert "record #17" in str(exc)
+        assert "block @4096" in str(exc)
+
+
+# ---------------------------------------------------------------------------
+# structural validation (the shared body rule)
+
+
+class TestCheckRecordBody:
+    def _body(self, **kw):
+        from bsseqconsensusreads_tpu.io.bam import BamRecord
+
+        rec = BamRecord(qname="q", flag=4, seq="ACGT", qual=b"\x1e" * 4, **kw)
+        return encode_record(rec)[4:]
+
+    def test_well_formed_passes(self):
+        assert check_record_body(self._body()) is None
+
+    def test_short_body_refused(self):
+        assert check_record_body(b"\x00" * 16) is not None
+
+    def test_lying_l_seq_refused(self):
+        body = bytearray(self._body())
+        struct.pack_into("<i", body, 16, 1 << 20)
+        assert check_record_body(bytes(body)) == guard_mod.REASON_RECORD_CORRUPT
+
+    def test_lying_n_cigar_refused(self):
+        body = bytearray(self._body())
+        struct.pack_into("<H", body, 12, 0xFFFF)
+        assert check_record_body(bytes(body)) is not None
+
+    def test_zero_qname_refused(self):
+        body = bytearray(self._body())
+        body[8] = 0
+        assert check_record_body(bytes(body)) is not None
+
+
+# ---------------------------------------------------------------------------
+# python vs native engine parity on corrupt inputs
+
+
+def _python_failure(path):
+    """(canonical reason, failing record index) from the python engine."""
+    n = 0
+    try:
+        with BamReader(path) as r:
+            for _ in r:
+                n += 1
+    except GuardError as exc:
+        return exc.reason, getattr(exc, "record_index", None), n
+    return None, None, n
+
+
+def _native_failure(path):
+    """(canonical reason, record_index) from the native columnar engine."""
+    n = 0
+    try:
+        for batch in ingest.native.read_columnar(path):
+            n += batch.n
+    except GuardError as exc:
+        return exc.reason, getattr(exc, "record_index", None), n
+    return None, None, n
+
+
+@pytest.mark.skipif(not ingest.available(), reason="native codec not built")
+class TestEngineParity:
+    #: mutators whose failing record index must agree exactly (the
+    #: corruption is record-plane; framing survives up to the victim)
+    RECORD_PLANE = ("record_len_lie", "block_size_lie")
+    #: stream/header-plane mutators: reason parity only (the python
+    #: engine reports the BGZF block, the native engine the batch)
+    STREAM_PLANE = ("bitflip_stream", "truncate_stream", "header_lie")
+
+    @pytest.mark.parametrize("mutator", RECORD_PLANE)
+    def test_record_plane_reason_and_index_agree(self, corpus, tmp_path, mutator):
+        rng = np.random.default_rng(99)
+        fn = dict(fuzz.MUTATORS)[mutator]
+        path = fn(corpus, rng, str(tmp_path / f"{mutator}.bam"))
+        p_reason, p_index, _ = _python_failure(path)
+        n_reason, n_index, n_seen = _native_failure(path)
+        assert p_reason is not None, "python engine accepted corrupt input"
+        assert n_reason is not None, "native engine accepted corrupt input"
+        assert p_reason == n_reason
+        assert p_index == n_index
+        assert n_seen == p_index  # both engines kept every prior record
+
+    @pytest.mark.parametrize("seed", range(6))
+    @pytest.mark.parametrize("mutator", STREAM_PLANE)
+    def test_stream_plane_reasons_agree(self, corpus, tmp_path, mutator, seed):
+        rng = np.random.default_rng(1000 + seed)
+        fn = dict(fuzz.MUTATORS)[mutator]
+        path = fn(corpus, rng, str(tmp_path / f"{mutator}_{seed}.bam"))
+        p_reason, _, _ = _python_failure(path)
+        n_reason, _, _ = _native_failure(path)
+        assert p_reason == n_reason
+
+    def test_malformed_tag_sentinel_native(self, corpus, tmp_path):
+        """The native extractor must mark present-but-malformed MI/RX
+        (wrong type / empty / non-printable) with the sentinel so the
+        vectorized check refuses what record_violation refuses."""
+        records = [r.copy() for r in corpus.records]
+        records[3].set_tag("RX", 12345, "i")
+        records[5].set_tag("MI", "", "Z")
+        path = str(tmp_path / "tags.bam")
+        with BamWriter(path, corpus.header) as w:
+            w.write_all(records)
+        seen = []
+        offset = 0
+        for batch in ingest.native.read_columnar(path):
+            bad = guard_mod.batch_violations(batch)
+            seen.extend(
+                (int(i) + offset, reason) for i, (reason, _) in bad.items()
+            )
+            offset += batch.n
+        assert (3, "tag-shape") in seen
+        assert (5, "tag-shape") in seen
+        # python mirror agrees
+        with BamReader(path) as r:
+            for i, rec in enumerate(r):
+                v = record_violation(rec)
+                assert (v is not None) == (i in (3, 5))
+
+
+# ---------------------------------------------------------------------------
+# byte identity on well-formed input
+
+
+class TestByteIdentity:
+    def test_all_policies_identical_and_eventless(self, corpus, tmp_path):
+        outs = {}
+        for policy in ("off", "strict", "quarantine", "lenient"):
+            r = fuzz.run_once(
+                corpus.golden, policy, str(tmp_path / f"{policy}.bam")
+            )
+            assert r["outcome"] == "ok", r
+            if policy != "off":
+                assert r["events"] == 0
+                s = r["stats"]
+                assert s["records_seen"] == s["records_in"]
+            outs[policy] = r["output"]
+        assert len(set(outs.values())) == 1
+        # no sidecar for a clean run
+        assert not os.path.exists(corpus.golden + ".quarantined.bam")
+
+    @pytest.mark.skipif(not ingest.available(), reason="native codec not built")
+    def test_strict_native_vs_python_identical(self, corpus, tmp_path):
+        a = fuzz.run_once(
+            corpus.golden, "strict", str(tmp_path / "n.bam"), ingest="auto"
+        )
+        b = fuzz.run_once(
+            corpus.golden, "strict", str(tmp_path / "p.bam"), ingest="python"
+        )
+        assert a["outcome"] == b["outcome"] == "ok"
+        assert a["output"] == b["output"]
+
+    def test_native_ingest_refused_under_resilient_policy(self, corpus):
+        from bsseqconsensusreads_tpu.pipeline.workflow import WorkflowError
+
+        r = fuzz.run_once(
+            corpus.golden, "quarantine", "/dev/null", ingest="native"
+        )
+        # run_once reports the crash class: must be the loud refusal,
+        # not a silent engine swap
+        assert r["outcome"] == "crash"
+        assert "WorkflowError" in r["error"]
+        assert "quarantine" in r["error"]
+
+
+# ---------------------------------------------------------------------------
+# quarantine semantics
+
+
+class TestQuarantine:
+    def _mutated(self, corpus, tmp_path, mutator, seed=5):
+        rng = np.random.default_rng(seed)
+        return dict(fuzz.MUTATORS)[mutator](
+            corpus, rng, str(tmp_path / f"{mutator}.bam")
+        )
+
+    def test_missing_mi_quarantined_with_reason_tag(self, corpus, tmp_path):
+        path = self._mutated(corpus, tmp_path, "tag_delete_mi")
+        r = fuzz.run_once(path, "quarantine", str(tmp_path / "out.bam"))
+        assert r["outcome"] == "ok"
+        s = r["stats"]
+        assert s["records_quarantined"] == 1
+        assert s["records_seen"] == s["records_in"] + 1
+        sidecar = path + ".quarantined.bam"
+        assert os.path.exists(sidecar)
+        with BamReader(sidecar) as sr:
+            recs = list(sr)
+        assert len(recs) == 1
+        assert recs[0].get_tag("qr") == "missing-mi"
+
+    def test_strict_fails_fast_on_same_input(self, corpus, tmp_path):
+        path = self._mutated(corpus, tmp_path, "tag_delete_mi")
+        r = fuzz.run_once(path, "strict", str(tmp_path / "out.bam"))
+        assert r["outcome"] == "typed_error"
+        assert r["reason"] == "missing-mi"
+
+    def test_lenient_repairs_qual_garbage(self, corpus, tmp_path):
+        path = self._mutated(corpus, tmp_path, "qual_garbage")
+        rq = fuzz.run_once(path, "quarantine", str(tmp_path / "q.bam"))
+        rl = fuzz.run_once(path, "lenient", str(tmp_path / "l.bam"))
+        assert rq["outcome"] == rl["outcome"] == "ok"
+        # quarantine drops the record; lenient clamps and keeps it
+        assert rq["stats"]["records_quarantined"] == 1
+        assert rl["stats"]["records_quarantined"] == 0
+        assert rl["stats"]["records_repaired"] >= 1
+        assert rl["stats"]["records_in"] == rq["stats"]["records_in"] + 1
+
+    def test_bgzf_bitflip_resyncs_and_reconciles(self, corpus, tmp_path):
+        """A corrupt interior BGZF block: quarantine mode skips to the
+        next valid block, re-finds record framing, and finishes; the
+        guard counters account for the discontinuity."""
+        path = fuzz.mut_bitflip_block(
+            corpus, np.random.default_rng(3), str(tmp_path / "flip.bam")
+        )
+        r = fuzz.run_once(path, "quarantine", str(tmp_path / "out.bam"))
+        assert r["outcome"] == "ok"
+        assert r["events"] > 0
+        s = r["stats"]
+        assert s["stream_gaps"] >= 1  # the BGZF layer resynced
+        assert s["records_seen"] == s["records_in"] + s["records_quarantined"]
+        assert s["records_in"] < len(corpus.records)  # the gap cost records
+        # strict refuses the same bytes loudly
+        rs = fuzz.run_once(path, "strict", str(tmp_path / "s.bam"))
+        assert rs["outcome"] == "typed_error"
+
+    def test_truncated_tail_ends_cleanly(self, corpus, tmp_path):
+        path = fuzz.mut_truncate_mid_block(
+            corpus, np.random.default_rng(4), str(tmp_path / "trunc.bam")
+        )
+        r = fuzz.run_once(path, "quarantine", str(tmp_path / "out.bam"))
+        assert r["outcome"] == "ok"
+        assert r["stats"]["stream_truncations"] >= 1
+        assert 0 < r["stats"]["records_in"] < len(corpus.records)
+
+    def test_guarded_reader_direct_iteration(self, corpus, tmp_path):
+        """GuardedBamReader yields every record of a clean file and
+        marks them prevalidated."""
+        g = Guard(policy="quarantine", stats=StageStats())
+        with GuardedBamReader(corpus.golden, g) as r:
+            n = sum(1 for _ in r)
+        g.close()
+        assert n == len(corpus.records)
+        assert g.records_prevalidated
+
+
+# ---------------------------------------------------------------------------
+# family-level admission control
+
+
+def _mk_records(n, mi="0/A", read_len=40):
+    from bsseqconsensusreads_tpu.io.bam import BamRecord
+
+    out = []
+    for i in range(n):
+        rec = BamRecord(
+            qname=f"r{i}", flag=99, ref_id=0, pos=10, mapq=60,
+            cigar=[(0, read_len)], seq="A" * read_len,
+            qual=b"\x1e" * read_len,
+        )
+        rec.set_tag("MI", mi, "Z")
+        out.append(rec)
+    return out
+
+
+class TestFamilyAdmission:
+    def test_family_bomb_strict_raises(self):
+        g = Guard(policy="strict", stats=StageStats(), max_family_records=8)
+        groups = [("1", _mk_records(4, "1")), ("2", _mk_records(9, "2"))]
+        with pytest.raises(FamilyGuardError, match="family '2' has 9"):
+            list(guard_groups(groups, g))
+
+    def test_family_bomb_quarantined_whole(self):
+        stats = StageStats()
+        g = Guard(
+            policy="quarantine", stats=stats, max_family_records=8
+        )
+        groups = [("1", _mk_records(4, "1")), ("2", _mk_records(9, "2")),
+                  ("3", _mk_records(2, "3"))]
+        kept = list(guard_groups(groups, g))
+        assert [mi for mi, _ in kept] == ["1", "3"]
+        assert stats.families_quarantined == 1
+        assert stats.family_records_quarantined == 9
+
+    def test_read_length_outlier(self):
+        stats = StageStats()
+        g = Guard(policy="quarantine", stats=stats, max_read_len=64)
+        groups = [("1", _mk_records(2, "1", read_len=40)),
+                  ("2", _mk_records(2, "2", read_len=100))]
+        kept = list(guard_groups(groups, g))
+        assert [mi for mi, _ in kept] == ["1"]
+        assert stats.families_quarantined == 1
+
+    def test_off_policy_is_passthrough(self):
+        g = Guard(policy="off", stats=StageStats(), max_family_records=2)
+        groups = [("1", _mk_records(9, "1"))]
+        assert list(guard_groups(groups, g)) == groups
+        assert list(guard_groups(groups, None)) == groups
+
+    def test_prevalidated_records_not_rechecked(self):
+        """A reader-validated stream skips per-record re-validation in
+        the family pass (the zero-double-cost contract)."""
+        stats = StageStats()
+        g = Guard(policy="quarantine", stats=stats, max_read_len=10)
+        g.records_prevalidated = True
+        # read_len 40 would violate max_read_len=10 — but the reader
+        # already vouched for these records
+        groups = [("1", _mk_records(2, "1", read_len=40))]
+        assert len(list(guard_groups(groups, g))) == 1
+        assert stats.families_quarantined == 0
+
+
+# ---------------------------------------------------------------------------
+# record-level semantic validation
+
+
+class TestRecordViolation:
+    def test_clean_record(self):
+        (rec,) = _mk_records(1)
+        assert record_violation(rec) is None
+
+    def test_cigar_seq_mismatch(self):
+        (rec,) = _mk_records(1)
+        rec.cigar = [(0, 99)]
+        assert record_violation(rec) == ("cigar-seq-mismatch", False)
+
+    def test_ref_and_pos_bounds(self):
+        (rec,) = _mk_records(1)
+        rec.ref_id = 5
+        assert record_violation(rec, n_ref=1) == ("ref-out-of-range", False)
+        (rec,) = _mk_records(1)
+        rec.pos = 1000
+        assert record_violation(rec, ref_lens=[100]) == (
+            "pos-out-of-range", False,
+        )
+
+    def test_qual_out_of_range_is_repairable(self):
+        (rec,) = _mk_records(1)
+        rec.qual = bytes([30, 200] + [30] * 38)
+        assert record_violation(rec) == ("qual-out-of-range", True)
+        from bsseqconsensusreads_tpu.faults.guard import repair_record
+
+        assert repair_record(rec) == "qual-out-of-range"
+        assert max(rec.qual) <= guard_mod.QUAL_MAX
+
+    def test_tag_shape(self):
+        (rec,) = _mk_records(1)
+        rec.set_tag("RX", "", "Z")
+        assert record_violation(rec) == ("tag-shape", False)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 fuzz smoke: the no-crash contract on every future PR
+
+
+class TestFuzzSmoke:
+    def test_seeded_corpus_no_crash_no_silent_corruption(self, tmp_path):
+        """A fast subset of tools/fuzz_ingest.py — at least one seed
+        per mutator, all three policies."""
+        out = fuzz.fuzz(
+            len(fuzz.MUTATORS), str(tmp_path / "FUZZ_SMOKE.json")
+        )
+        assert out["ok"], out["failures"]
+        assert out["seeds"] == len(fuzz.MUTATORS)
+        # every policy participated
+        assert any(k.startswith("strict:") for k in out["outcomes"])
+        assert any(k.startswith("quarantine:") for k in out["outcomes"])
+        assert any(k.startswith("lenient:") for k in out["outcomes"])

@@ -330,6 +330,74 @@ class TestParityModeStages:
         logs = os.listdir(os.path.join(outdir, "log", "bwameth_results"))
         assert logs == [f"{builder.sample}_consensus_unfiltered.log"]
 
+    def test_bwameth_shellout_contract(self, pipeline_env, tmp_path):
+        """Fake-binary contract stub (PARITY row 13): run_bwameth must
+        invoke `<bwameth> --reference <fasta> -t 8 <fq1> <fq2>` with
+        exactly those argv (shell quoting surviving spaces in the fastq
+        paths), feed stdout through a real pipe into the SAM->BAM
+        writer, and tee stderr to the reference's log path."""
+        import json as _json
+        import sys as _sys
+
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+        from bsseqconsensusreads_tpu.pipeline.workflow import Rule
+
+        env = pipeline_env
+        argv_out = tmp_path / "argv.json"
+        fake = tmp_path / "fake_bwameth.py"
+        fake.write_text(
+            "import json, os, stat, sys\n"
+            "json.dump({'argv': sys.argv[1:],\n"
+            "           'stdout_is_pipe': stat.S_ISFIFO("
+            "os.fstat(1).st_mode)},\n"
+            f"          open({str(argv_out)!r}, 'w'))\n"
+            "sys.stderr.write('contract-stderr-line\\n')\n"
+            "sys.stdout.write('@HD\\tVN:1.6\\tSO:unsorted\\n')\n"
+            "sys.stdout.write('@SQ\\tSN:chr1\\tLN:1000\\n')\n"
+            "sys.stdout.write("
+            "'r1\\t0\\tchr1\\t1\\t60\\t4M\\t*\\t0\\t0\\tACGT\\tIIII\\n')\n"
+            "sys.stdout.write("
+            "'r2\\t16\\tchr1\\t9\\t60\\t4M\\t*\\t0\\t0\\tTTTT\\tIIII\\n')\n"
+        )
+        # fastq paths with a space: the argv must arrive as single
+        # arguments (stages.run_bwameth shell-quotes them)
+        fqdir = tmp_path / "fq dir"
+        fqdir.mkdir()
+        fq1, fq2 = str(fqdir / "in_1.fq.gz"), str(fqdir / "in_2.fq.gz")
+        for fq in (fq1, fq2):
+            with gzip.open(fq, "wt") as fh:
+                fh.write("@r1\nACGT\n+\nIIII\n")
+        cfg = FrameworkConfig(
+            genome_dir=os.path.dirname(env["fasta"]),
+            genome_fasta_file_name=os.path.basename(env["fasta"]),
+            aligner="bwameth",
+            bwameth=f"{_sys.executable} {fake}",
+        )
+        outdir = str(tmp_path / "output")
+        builder = PipelineBuilder(cfg, env["bam"], outdir=outdir)
+        out_bam = str(tmp_path / "aligned.bam")
+        builder.run_bwameth(Rule(
+            name="align_consensus_unfiltered",
+            inputs=[fq1, fq2], outputs=[out_bam], run=None,
+        ))
+        seen = _json.load(open(argv_out))
+        assert seen["argv"] == [
+            "--reference", env["fasta"], "-t", "8", fq1, fq2,
+        ]
+        assert seen["stdout_is_pipe"] is True
+        # pipe wiring: both SAM records came through into the BAM
+        with BamReader(out_bam) as r:
+            recs = list(r)
+        assert [(x.qname, x.flag, x.pos) for x in recs] == [
+            ("r1", 0, 0), ("r2", 16, 8),
+        ]
+        # stderr teed to the reference's log path, exactly once
+        log = os.path.join(
+            outdir, "log", "bwameth_results",
+            f"{builder.sample}_consensus_unfiltered.log",
+        )
+        assert open(log).read() == "contract-stderr-line\n"
+
 
 class TestStreaming:
     def _tagged(self, qname, mi, pos):

@@ -87,6 +87,43 @@ def _build_input(wd: str, n_families: int, genome_len: int) -> str:
     return bam
 
 
+def _mutate_input(bam: str, path: str) -> int:
+    """Deterministic content-level corruption of the drill input for the
+    corrupt_input_quarantine_resume scenario: strip the MI tag from
+    every 23rd record and push one record's quals out of range. The
+    stream stays BGZF-valid so the whole pipeline runs; the guard must
+    quarantine exactly these records — identically on an uninterrupted
+    run and on a kill+resume. Returns the number of records mutated."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+
+    n_bad = 0
+    with BamReader(bam) as r:
+        with BamWriter(path, r.header) as w:
+            for i, rec in enumerate(r):
+                if i % 23 == 7:
+                    del rec.tags["MI"]
+                    n_bad += 1
+                elif i % 23 == 15:
+                    rec.qual = bytes([200]) + rec.qual[1:]
+                    n_bad += 1
+                w.write(rec)
+    return n_bad
+
+
+def _guard_counts(payload: dict) -> dict:
+    """Guard counters summed across stages — the reconciliation object
+    the resume scenario compares."""
+    keys = (
+        "records_quarantined", "records_repaired", "families_quarantined",
+        "family_records_quarantined",
+    )
+    out = {k: 0 for k in keys}
+    for st in payload["stages"].values():
+        for k in keys:
+            out[k] += int(st.get(k, 0) or 0)
+    return out
+
+
 def _run_child(wd: str, bam: str, outdir: str, ledger: str,
                failpoints: str = "", env_extra: dict | None = None):
     env = dict(
@@ -320,6 +357,57 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 entry["error"] = (
                     f"resume rc={cp2.returncode}: " + cp2.stderr[-500:]
                 )
+
+        # corrupt input + quarantine policy + kill mid-run + resume:
+        # the resumed run must reproduce the uninterrupted quarantine
+        # run EXACTLY — output bytes and every quarantine counter (the
+        # resume replays ingest, so guard decisions replay too)
+        mutated = os.path.join(wd, "input", "mutated.bam")
+        n_bad = _mutate_input(bam, mutated)
+        qenv = {"BSSEQ_TPU_INPUT_POLICY": "quarantine"}
+        entry = {"ok": False, "records_mutated": n_bad}
+        results["corrupt_input_quarantine_resume"] = entry
+        cp = _run_child(wd, mutated, os.path.join(wd, "out_qref"),
+                        os.path.join(wd, "q0.jsonl"), env_extra=qenv)
+        if cp.returncode != 0:
+            entry["error"] = f"uninterrupted rc={cp.returncode}: " + cp.stderr[-500:]
+        else:
+            qref = _child_payload(cp)
+            qref_bytes = open(qref["target"], "rb").read()
+            entry["counts_uninterrupted"] = _guard_counts(qref)
+            outdir = os.path.join(wd, "out_qkill")
+            cp2 = _run_child(
+                wd, mutated, outdir, os.path.join(wd, "q1.jsonl"),
+                "dispatch_kernel=exit:9@batch=4@stage=molecular",
+                env_extra=qenv,
+            )
+            entry["kill_rc"] = cp2.returncode
+            if cp2.returncode == 9:
+                cp3 = _run_child(wd, mutated, outdir,
+                                 os.path.join(wd, "q2.jsonl"),
+                                 env_extra=qenv)
+                if cp3.returncode == 0:
+                    resumed = _child_payload(cp3)
+                    entry["counts_resumed"] = _guard_counts(resumed)
+                    entry["byte_identical"] = (
+                        open(resumed["target"], "rb").read() == qref_bytes
+                    )
+                    entry["resumed_batches"] = _stage_counter(
+                        resumed, "molecular", "batches"
+                    )
+                    entry["ok"] = (
+                        entry["byte_identical"]
+                        and entry["counts_resumed"]
+                        == entry["counts_uninterrupted"]
+                        and entry["counts_uninterrupted"][
+                            "records_quarantined"] > 0
+                        and entry["resumed_batches"]
+                        < _stage_counter(qref, "molecular", "batches")
+                    )
+                else:
+                    entry["error"] = (
+                        f"resume rc={cp3.returncode}: " + cp3.stderr[-500:]
+                    )
 
     ok = all(v.get("ok") for v in results.values())
     out = {
