@@ -485,6 +485,7 @@ class PackageIndex:
 
 def all_rules() -> dict[str, Rule]:
     from bsseqconsensusreads_tpu.analysis import (
+        rules_emit,
         rules_hostphase,
         rules_input,
         rules_io,
@@ -495,7 +496,7 @@ def all_rules() -> dict[str, Rule]:
 
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
-                rules_hostphase, rules_input):
+                rules_hostphase, rules_input, rules_emit):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

@@ -71,6 +71,14 @@ class FrameworkConfig:
     #: when built. The 'self' aligner mode coordinate-sorts the blobs
     #: directly (pipeline.extsort.external_sort_raw).
     emit: str = "auto"
+    #: raw coordinate-sort engine for the 'self' stage outputs — the same
+    #: auto|native|python contract as `emit`: 'native' keys, sorts, and
+    #: k-way-merges the encoded record blobs in C
+    #: (pipeline.extsort.resolve_sort_engine; merge BGZF compression rides
+    #: the mt-writer threadpool), 'python' keeps the blob-generator +
+    #: heapq parity twin, 'auto' picks native when built. Output bytes are
+    #: identical across engines. BSSEQ_TPU_SORT_ENGINE overrides.
+    sort_engine: str = "auto"
     #: BGZF deflate level for INTERMEDIATE stage outputs — the durable
     #: rule-boundary checkpoints between stages (e.g. the molecular output
     #: feeding the duplex stage), which stay on disk like the reference's

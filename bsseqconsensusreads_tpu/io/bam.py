@@ -265,6 +265,12 @@ def reg2bin(beg: int, end: int) -> int:
 
 
 _TAG_FMT = {"c": "<b", "C": "<B", "s": "<h", "S": "<H", "i": "<i", "I": "<I", "f": "<f"}
+#: B-subtype -> little-endian numpy dtype for the vectorized array-tag
+#: encode (byte-identical to the struct.pack path for in-range values).
+_TAG_NP_DTYPE = {
+    "c": "<i1", "C": "<u1", "s": "<i2", "S": "<u2",
+    "i": "<i4", "I": "<u4", "f": "<f4",
+}
 
 
 def skip_tag(data: bytes, off: int) -> int:
@@ -354,8 +360,17 @@ def _encode_tags(tags: dict[str, tuple[str, Any]]) -> bytes:
             out += tc.encode("ascii") + val.encode("ascii") + b"\x00"
         elif tc == "B":
             sub, vals = val
-            out += b"B" + sub.encode("ascii") + struct.pack("<I", len(vals))
-            out += struct.pack(f"<{len(vals)}{_TAG_FMT[sub][1]}", *vals)
+            if isinstance(vals, np.ndarray):
+                # vectorized: one astype+tobytes instead of a per-element
+                # struct.pack — the emit twin passes its per-base tag
+                # arrays through without .tolist() (ISSUE 6 satellite 1)
+                out += b"B" + sub.encode("ascii")
+                out += struct.pack("<I", vals.size)
+                out += vals.astype(_TAG_NP_DTYPE[sub], copy=False).tobytes()
+            else:
+                out += b"B" + sub.encode("ascii")
+                out += struct.pack("<I", len(vals))
+                out += struct.pack(f"<{len(vals)}{_TAG_FMT[sub][1]}", *vals)
         else:
             raise BamError(f"unknown tag type {tc!r} for {key}")
     return bytes(out)

@@ -44,7 +44,7 @@ def _try_load():
             "bamio_group_refragmented", "bamio_group_free",
             "bamio_encode_scan", "bamio_encode_fill",
             "bamio_duplex_scan", "bamio_duplex_fill",
-            "bamio_open_mt",
+            "bamio_open_mt", "bamio_merge_runs",
         ),
     )
     if lib is None:
@@ -126,6 +126,11 @@ def _try_load():
         [C.c_int64] + [C.c_void_p] * 12 + [C.c_int64]
         + [C.c_void_p] * 3
     )
+    lib.bamio_merge_runs.restype = C.c_int64
+    lib.bamio_merge_runs.argtypes = [
+        C.POINTER(C.c_void_p), C.c_int32, C.c_void_p, C.c_int32,
+        C.c_char_p, C.c_int32, C.POINTER(C.c_double),
+    ]
     _lib = lib
 
 
@@ -657,6 +662,42 @@ def duplex_scan(
     if rc != 0:
         raise RuntimeError(f"bamio_duplex_scan failed: rc={rc}")
     return out
+
+
+def merge_runs(readers: "list[NativeBgzfReader]",
+               writer: "NativeBgzfWriter") -> tuple[int, float]:
+    """k-way native merge of sorted spill runs (bamio_merge_runs).
+
+    readers: NativeBgzfReaders positioned just past their BAM headers
+    (io.native._skip_header — which reads unbuffered, so no Python-side
+    bytes can be stranded). writer: an open NativeBgzfWriter the merged
+    record stream is appended to (header already written by the caller).
+    Returns (records merged, seconds spent inside the writer's
+    deflate/write calls — the sort_write.merge_bgzf attribution).
+    Ordering and tie-breaks are raw_coordinate_key + run-index stable,
+    byte-identical to heapq.merge over the Python engine's runs.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native codec unavailable")
+    for i, r in enumerate(readers):
+        if r._off != len(r._buf):
+            raise GuardError(
+                f"merge run {i}: reader holds Python-buffered bytes; "
+                "open it fresh and skip the header unbuffered"
+            )
+    handles = (C.c_void_p * len(readers))(
+        *[C.c_void_p(r._h) for r in readers]
+    )
+    err = C.create_string_buffer(256)
+    write_s = C.c_double(0.0)
+    n = _lib.bamio_merge_runs(
+        handles, len(readers), writer._h, int(writer._mt),
+        err, 256, C.byref(write_s),
+    )
+    if n < 0:
+        raise IOError(f"native merge failed: {err.value.decode()}")
+    return int(n), write_s.value
 
 
 def duplex_fill(

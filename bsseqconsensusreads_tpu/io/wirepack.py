@@ -13,6 +13,7 @@ numpy implementations in ops.wire / models.duplex when no compiler exists.
 from __future__ import annotations
 
 import ctypes as C
+import os
 
 import numpy as np
 
@@ -32,7 +33,9 @@ def _try_load():
     if _lib is not None or _load_error is not None:
         return
     lib, _load_error = load_library(
-        "libwirepack.so",
+        # BSSEQ_TPU_WIREPACK_SO selects an alternate build of the same ABI
+        # (e.g. libwirepack_asan.so for tools/sanitize_native.py)
+        os.environ.get("BSSEQ_TPU_WIREPACK_SO", "libwirepack.so"),
         "wirepack.cpp",
         env_flag="BSSEQ_TPU_NATIVE_WIRE",
         required_symbols=(
@@ -42,6 +45,9 @@ def _try_load():
             "wirepack_duplex_rawize",
             "wirepack_duplex_retire",
             "wirepack_emit_consensus_records_v4",
+            "wirepack_sort_raw_records",
+            "wirepack_strand_calls",
+            "wirepack_bcount_sparse",
         ),
     )
     if lib is None:
@@ -89,6 +95,20 @@ def _try_load():
         + [C.c_int, C.c_int, C.c_void_p, C.c_int64]
         + [C.c_void_p] * 3
     )
+    lib.wirepack_sort_raw_records.restype = C.c_int64
+    lib.wirepack_sort_raw_records.argtypes = [
+        C.c_void_p, C.c_int64, C.c_void_p,
+        C.POINTER(C.c_double), C.POINTER(C.c_double),
+    ]
+    lib.wirepack_strand_calls.restype = None
+    lib.wirepack_strand_calls.argtypes = (
+        [C.c_void_p] * 5 + [C.c_int64, C.c_int64, C.c_void_p]
+    )
+    lib.wirepack_bcount_sparse.restype = None
+    lib.wirepack_bcount_sparse.argtypes = [
+        C.c_void_p, C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
+        C.c_void_p, C.c_int, C.c_int, C.c_void_p,
+    ]
     _lib = lib
 
 
@@ -459,3 +479,89 @@ def emit_consensus_records(
     # tobytes() trims the used span out of the (deliberately oversized)
     # scratch buffer so downstream holders don't pin the full capacity
     return buf[: out_len.value].tobytes(), n_records.value, n_skipped.value
+
+
+def sort_raw_records(blob) -> tuple[bytes, int, float, float]:
+    """Native in-RAM sort of one spill run of encoded record blobs.
+
+    blob: a bytes-like of concatenated encoded records (each with its
+    4-byte block_size prefix). Returns (sorted bytes, n_records,
+    key_extract_seconds, sort_gather_seconds). The ordering is exactly
+    pipeline.extsort.raw_coordinate_key over a stable sort — the Python
+    engine's `buf.sort(key=raw_coordinate_key)` twin.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    src = np.frombuffer(blob, dtype=np.uint8)
+    out = np.empty(src.size, dtype=np.uint8)
+    key_s = C.c_double(0.0)
+    sort_s = C.c_double(0.0)
+    n = _lib.wirepack_sort_raw_records(
+        src.ctypes.data_as(C.c_void_p), src.size,
+        out.ctypes.data_as(C.c_void_p), C.byref(key_s), C.byref(sort_s),
+    )
+    if n < 0:
+        raise ValueError(
+            "native raw-record sort found a malformed record frame "
+            f"(rc={n}) — the emit stream is corrupt"
+        )
+    return out.tobytes(), int(n), key_s.value, sort_s.value
+
+
+def bcount_sparse(bases, quals, cons, params) -> np.ndarray:
+    """Native one-pass sparse cB dissent histogram for one molecular
+    batch: overlap co-call + observation filter + per-base tally +
+    call-plane sparsification (the numpy chain _overlap_cocall_np ->
+    _base_histogram -> sparsify_base_counts, integer-exact — the emit
+    span's tag-build prologue). bases int8 [f, t, 2, w], quals uint8,
+    cons int8 [f, 2, w] -> uint16 [f, 2, 4, w]."""
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    bases = np.ascontiguousarray(bases, dtype=np.int8)
+    quals = np.ascontiguousarray(quals, dtype=np.uint8)
+    cons = np.ascontiguousarray(cons, dtype=np.int8)
+    f, t, _, w = bases.shape
+    out = np.empty((f, 2, 4, w), np.uint16)
+    _lib.wirepack_bcount_sparse(
+        bases.ctypes.data_as(C.c_void_p),
+        quals.ctypes.data_as(C.c_void_p),
+        f, t, w,
+        cons.ctypes.data_as(C.c_void_p),
+        int(params.min_input_base_quality),
+        int(bool(params.consensus_call_overlapping_bases)),
+        out.ctypes.data_as(C.c_void_p),
+    )
+    return out
+
+
+def strand_calls(bases, cover, ref, convert_mask, eligible) -> np.ndarray:
+    """Native twin of ops.hosttwin.strand_call_planes (calls plane only).
+
+    bases int8 [f, 4, w], cover bool/u8 [f, 4, w], ref int8 [f, w+1],
+    convert_mask bool/u8 [f, 4], eligible bool/u8 [f] -> int8 [f, 4, w]
+    post-transform per-strand consensus calls, NBASE where the
+    transformed row has no coverage. Byte-identical to the numpy twin
+    (tests/test_wirepack.py pins it); the duplex rawize pass's hot path.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    bases = np.ascontiguousarray(bases, dtype=np.int8)
+    cover = np.ascontiguousarray(cover, dtype=np.uint8)
+    ref = np.ascontiguousarray(ref, dtype=np.int8)
+    cmask = np.ascontiguousarray(convert_mask, dtype=np.uint8)
+    elig = np.ascontiguousarray(eligible, dtype=np.uint8)
+    f, r, w = bases.shape
+    if r != 4 or ref.shape != (f, w + 1):
+        raise ValueError(
+            f"strand_calls wants [f, 4, w] bases and [f, w+1] ref; got "
+            f"{bases.shape} / {ref.shape}"
+        )
+    out = np.empty((f, 4, w), np.int8)
+    p = lambda a: a.ctypes.data_as(C.c_void_p)  # noqa: E731
+    _lib.wirepack_strand_calls(
+        p(bases), p(cover), p(ref), p(cmask), p(elig), f, w, p(out)
+    )
+    return out

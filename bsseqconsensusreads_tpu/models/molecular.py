@@ -236,7 +236,8 @@ def _overlap_cocall_np(bases, quals):
 
 def singleton_consensus_host(bases, quals,
                              params: ConsensusParams = ConsensusParams(),
-                             vote_kernel: str = "xla") -> dict:
+                             vote_kernel: str = "xla",
+                             with_histogram: bool = False) -> dict:
     """Host fast path for T == 1 batches: numerically identical to
     molecular_consensus on [F, 1, 2, W] with no device round trip.
 
@@ -274,12 +275,22 @@ def singleton_consensus_host(bases, quals,
     called = observed & ~masked
     from bsseqconsensusreads_tpu.ops.phred import NO_CALL_QUAL
 
-    return {
+    out = {
         "base": np.where(called, call, NBASE).astype(np.int8),
         "qual": np.where(called, t_single[qi], NO_CALL_QUAL).astype(np.uint8),
         "depth": observed.astype(np.int16),
         "errors": (called & flip).astype(np.int16),
     }
+    if with_histogram:
+        # the cB tag payload from THIS pass's cocalled observations —
+        # identical to molecular_base_counts(bases, quals) on the T == 1
+        # batch, without a second cocall+filter sweep in the emit span
+        # (the r5 ledger's molecular-emit wall was exactly that rework)
+        counts = np.empty(b.shape[:2] + (NUM_BASES, b.shape[-1]), np.uint16)
+        for x in range(NUM_BASES):
+            counts[:, :, x, :] = observed & (b == x)
+        out["bcount"] = counts
+    return out
 
 
 def pack_molecular_outputs(out: dict):

@@ -858,9 +858,39 @@ def _group_batches_bucketed(
         yield pending[b]
 
 
+def _batch_spans(depth):
+    """Vectorized per-(family, role) covered-span digest of one retired
+    batch: (has, first, last, span_mask) — the contiguous [first, last]
+    covered window every emitter slices (interior no-call columns
+    included, matching the per-record np.nonzero it replaces)."""
+    pres = np.asarray(depth) > 0
+    w = pres.shape[-1]
+    has = pres.any(axis=-1)
+    first = pres.argmax(axis=-1)
+    last = w - 1 - pres[..., ::-1].argmax(axis=-1)
+    idx = np.arange(w)
+    span = (idx >= first[..., None]) & (idx <= last[..., None])
+    return has, first, last, span
+
+
+def _span_stats(arr, span):
+    """(max, min, sum int64) over the covered span per (family, role) —
+    one batch-level masked reduction instead of three numpy reduces per
+    emitted record (the parity twin's emit wall). Rows without coverage
+    return sentinel garbage; callers skip them via `has`."""
+    a = np.asarray(arr)
+    s = np.where(span, a, 0).sum(axis=-1, dtype=np.int64)
+    mx = np.where(span, a, np.int32(-(1 << 30))).max(axis=-1)
+    mn = np.where(span, a, np.int32(1 << 30)).min(axis=-1)
+    return mx, mn, s
+
+
 def _consensus_tags(depth_arr, err_arr, mi, rx, bcount=None,
-                    flip: bool = False):
+                    flip: bool = False, pre=None):
     """The consensus tag block fgbio emits: cD/cM/cE + per-base cd/ce.
+
+    pre: optional (dmax, dmin, dtot, etot) ints precomputed by the
+    batch-level _span_stats pass — skips four per-record reductions.
 
     bcount (uint16 [4, n] or None) adds the cB raw base histogram —
     4 plane-major runs of per-base counts (A,C,G,T order), the duplex
@@ -882,24 +912,32 @@ def _consensus_tags(depth_arr, err_arr, mi, rx, bcount=None,
         err_arr = err_arr[::-1]
         if bcount is not None:
             bcount = bcount[::-1, ::-1]  # complement planes + reverse cols
-    # int64 accumulators: int16 per-column counts sum past 32767 on deep
-    # families over a full window
-    total = int(depth_arr.sum(dtype=np.int64))
-    errs = int(err_arr.sum(dtype=np.int64))
+    if pre is not None:
+        dmax, dmin, total, errs = pre
+    else:
+        # int64 accumulators: int16 per-column counts sum past 32767 on
+        # deep families over a full window
+        total = int(depth_arr.sum(dtype=np.int64))
+        errs = int(err_arr.sum(dtype=np.int64))
+        dmax = int(depth_arr.max()) if depth_arr.size else 0
+        dmin = int(depth_arr.min()) if depth_arr.size else 0
     tags = {
         "MI": ("Z", mi),
-        "cD": ("i", int(depth_arr.max()) if depth_arr.size else 0),
-        "cM": ("i", int(depth_arr.min()) if depth_arr.size else 0),
+        "cD": ("i", dmax),
+        "cM": ("i", dmin),
         "cE": ("f", errs / total if total else 0.0),
-        "cd": ("B", ("S", depth_arr.tolist())),
-        "ce": ("B", ("S", err_arr.tolist())),
+        # arrays stay numpy: io.bam._encode_tags serializes them with one
+        # astype+tobytes (the per-record .tolist() + struct.pack loop was
+        # the parity twin's 6x-vs-native emit asymmetry)
+        "cd": ("B", ("S", np.ascontiguousarray(depth_arr))),
+        "ce": ("B", ("S", np.ascontiguousarray(err_arr))),
     }
     if bcount is not None:
-        flat = np.asarray(bcount).reshape(-1)
+        flat = np.ascontiguousarray(bcount).reshape(-1)
         # uint8 subtype when every count fits (the overwhelmingly common
         # case; deep families fall back to u16) — half the tag bytes
         sub = "C" if (flat.size == 0 or int(flat.max()) < 256) else "S"
-        tags["cB"] = ("B", (sub, flat.tolist()))
+        tags["cB"] = ("B", (sub, flat))
     if rx:
         tags["RX"] = ("Z", rx)
     return tags
@@ -990,7 +1028,22 @@ def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
                     role_reverse, duplex, bcount=None,
                     strand_calls=None, strand_err=None) -> RawRecords:
     """Native batch emit (io.wirepack) — byte-identical to the Python
-    emit + encode_record path, minus the per-record Python."""
+    emit + encode_record path, minus the per-record Python. The C call
+    is sub-attributed as 'emit.pack' (the kernel-plane -> record-bytes
+    handoff proper) apart from the emit span's tag-building prologue."""
+    from bsseqconsensusreads_tpu.io import wirepack
+
+    with stats.metrics.timed("emit.pack"):
+        return _emit_pack(
+            batch, out, params, mode, stats, n_reads=n_reads,
+            role_reverse=role_reverse, duplex=duplex, bcount=bcount,
+            strand_calls=strand_calls, strand_err=strand_err,
+        )
+
+
+def _emit_pack(batch, out, params, mode, stats, *, n_reads,
+               role_reverse, duplex, bcount=None,
+               strand_calls=None, strand_err=None) -> RawRecords:
     from bsseqconsensusreads_tpu.io import wirepack
 
     blob, n, skipped = wirepack.emit_consensus_records(
@@ -1016,28 +1069,46 @@ def _emit_batch_raw(batch, out, params, mode, stats, *, n_reads,
 
 def _emit_molecular_batch_raw(batch, out, params, mode, stats,
                               base_counts: bool = False) -> RawRecords:
-    bcount = None
-    if base_counts:
-        from bsseqconsensusreads_tpu.models.molecular import (
-            molecular_base_counts,
-            sparsify_base_counts,
-        )
+    with stats.metrics.timed("emit.tags"):
+        bcount = None
+        if base_counts:
+            from bsseqconsensusreads_tpu.io import wirepack
+            from bsseqconsensusreads_tpu.models.molecular import (
+                molecular_base_counts,
+                sparsify_base_counts,
+            )
 
-        bcount = out.get("bcount")  # slim-wire retire computed it already
-        if bcount is None:
-            bcount = molecular_base_counts(batch.bases, batch.quals, params)
-        bcount = sparsify_base_counts(bcount, out["base"])
-    return _emit_batch_raw(
-        batch, out, params, mode, stats,
-        n_reads=(batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
-        .astype(np.int32),
-        role_reverse=np.array(
+            # slim-wire retire tallied it already from its own cocall
+            # pass; otherwise ONE native sweep builds the sparse dissent
+            # histogram (cocall + filter + tally + sparsify — the numpy
+            # chain was most of the r05 molecular-emit wall)
+            bcount = out.get("bcount")
+            if bcount is not None:
+                bcount = sparsify_base_counts(bcount, out["base"])
+            elif wirepack.available():
+                bcount = wirepack.bcount_sparse(
+                    batch.bases, batch.quals, out["base"], params
+                )
+            else:
+                bcount = sparsify_base_counts(
+                    molecular_base_counts(batch.bases, batch.quals, params),
+                    out["base"],
+                )
+        n_reads = (
+            (batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
+            .astype(np.int32)
+        )
+        role_reverse = np.array(
             [
                 [int(m.role_reverse[0]), int(m.role_reverse[1])]
                 for m in batch.meta
             ],
             np.uint8,
-        ),
+        )
+    return _emit_batch_raw(
+        batch, out, params, mode, stats,
+        n_reads=n_reads,
+        role_reverse=role_reverse,
         duplex=False,
         bcount=bcount,
     )
@@ -1081,43 +1152,49 @@ def _emit_molecular_batch(batch, out, params, mode, stats,
         if bcounts is None:
             bcounts = molecular_base_counts(batch.bases, batch.quals, params)
         bcounts = sparsify_base_counts(bcounts, out["base"])
+    # batch-level span digest + tag scalars: one vectorized pass instead
+    # of np.nonzero + four reductions per record (ISSUE 6 satellite 1 —
+    # the parity twin's emit wall)
+    has, first, last, span = _batch_spans(depth)
+    dmax, dmin, dtot = _span_stats(depth, span)
+    _emx, _emn, etot = _span_stats(errors, span)
+    n_reads_fam = (batch.bases != NBASE).any(axis=-1).sum(axis=(-2, -1))
     emitted: list[BamRecord] = []
     for fi, meta in enumerate(batch.meta):
         stats.families += 1
-        n_reads = int((batch.bases[fi] != NBASE).any(axis=-1).sum())
-        if n_reads < params.min_reads:
+        if int(n_reads_fam[fi]) < params.min_reads:
             stats.skipped_families += 1
             continue
-        spans = []
-        for role in range(2):
-            cov = np.nonzero(depth[fi, role] > 0)[0]
-            spans.append(cov)
         starts = [
-            meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+            meta.window_start + int(first[fi, r]) if has[fi, r] else -1
+            for r in range(2)
         ]
         for role in range(2):
-            cov = spans[role]
-            if len(cov) == 0:
+            if not has[fi, role]:
                 continue
             # CONTIGUOUS span [first, last] covered column: interior
             # no-call columns (possible at depth 1-2 when a tie masks an
             # overlap column) emit as N/qual-2 like fgbio's consensus
             # reads — compacting them out would shift every downstream
             # base against the M-run CIGAR
-            sl = slice(int(cov[0]), int(cov[-1]) + 1)
+            sl = slice(int(first[fi, role]), int(last[fi, role]) + 1)
             seq_fwd = codes_to_seq(base[fi, role, sl])
             quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
             tags = _consensus_tags(
                 depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx,
                 bcount=None if bcounts is None else bcounts[fi, role, :, sl],
                 flip=mode != "self" and bool(meta.role_reverse[role]),
+                pre=(
+                    int(dmax[fi, role]), int(dmin[fi, role]),
+                    int(dtot[fi, role]), int(etot[fi, role]),
+                ),
             )
             other = 1 - role
             tlen = 0
             if starts[0] >= 0 and starts[1] >= 0:
                 lo = min(starts)
                 hi = max(
-                    meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                    meta.window_start + int(last[fi, r]) + 1 for r in range(2)
                 )
                 tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
             emitted.append(_emit_read(
@@ -1213,10 +1290,9 @@ def call_molecular_batches(
     stage_label = stats.stage or "molecular"
     kernel_choice = _resolve_vote_kernel(vote_kernel)
     consensus_fn = _molecular_kernel(vote_kernel)
+    native_emit = _resolve_emit(emit, mode) == "native"
     emit_fn = partial(
-        _emit_molecular_batch_raw
-        if _resolve_emit(emit, mode) == "native"
-        else _emit_molecular_batch,
+        _emit_molecular_batch_raw if native_emit else _emit_molecular_batch,
         base_counts=base_counts,
     )
     if deep_threshold is None:
@@ -1278,8 +1354,15 @@ def call_molecular_batches(
                 singleton_consensus_host,
             )
 
+            # with_histogram (python-twin emit only): the twin's emit
+            # needs the cB histogram — tallying it from THIS pass's
+            # cocall saves it a second full cocall+filter sweep per
+            # singleton batch. The native emit builds the sparse
+            # histogram in ONE C pass instead (wirepack.bcount_sparse),
+            # so the numpy tally here would be wasted work there.
             out = singleton_consensus_host(
-                batch.bases, batch.quals, params, kernel_choice
+                batch.bases, batch.quals, params, kernel_choice,
+                with_histogram=base_counts and not native_emit,
             )
             return ("host", out), f
         if sharded_fn is None:
@@ -2428,12 +2511,21 @@ def _duplex_rawize(out: dict, batch, sidecar: dict, ref=None,
         )
     calls = None
     if (strand_tags or need_exact) and ref is not None:
-        from bsseqconsensusreads_tpu.ops import hosttwin
+        if wirepack.available():
+            # native sweep of the convert->extend host twin (the rawize
+            # span's largest numpy segment at scale); ops.hosttwin stays
+            # the parity reference (tests/test_wirepack.py pins equality)
+            calls = wirepack.strand_calls(
+                batch.bases, batch.cover, ref, batch.convert_mask,
+                batch.extend_eligible,
+            )
+        else:
+            from bsseqconsensusreads_tpu.ops import hosttwin
 
-        calls, _ccov = hosttwin.strand_call_planes(
-            batch.bases, batch.cover, ref, batch.convert_mask,
-            batch.extend_eligible,
-        )
+            calls, _ccov = hosttwin.strand_call_planes(
+                batch.bases, batch.cover, ref, batch.convert_mask,
+                batch.extend_eligible,
+            )
     out = dict(out)
     if strand_tags and calls is not None:
         rows_a = [p[0] for p in ROLE_STRAND_ROWS]
@@ -2685,6 +2777,16 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
     errors = out["errors"]
     a_depth = out["a_depth"]
     b_depth = out["b_depth"]
+    # batch-level span digest + tag scalars (see _emit_molecular_batch)
+    has, first, last, span = _batch_spans(depth)
+    dmax, dmin, dtot = _span_stats(depth, span)
+    _emx, _emn, etot = _span_stats(errors, span)
+    amax, amin, atot = _span_stats(a_depth, span)
+    bmax, bmin, btot = _span_stats(b_depth, span)
+    have_ss = "a_ss_err" in out
+    if have_ss:
+        _x, _n, asetot = _span_stats(out["a_ss_err"], span)
+        _x, _n, bsetot = _span_stats(out["b_ss_err"], span)
     emitted: list[BamRecord] = []
     for fi, meta in enumerate(batch.meta):
         stats.families += 1
@@ -2693,23 +2795,26 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             # configuration = emit everything, README.md:9)
             stats.skipped_families += 1
             continue
-        spans = [np.nonzero(depth[fi, role] > 0)[0] for role in range(2)]
         starts = [
-            meta.window_start + int(c[0]) if len(c) else -1 for c in spans
+            meta.window_start + int(first[fi, r]) if has[fi, r] else -1
+            for r in range(2)
         ]
         for role in range(2):
-            cov = spans[role]
-            if len(cov) == 0:
+            if not has[fi, role]:
                 continue
             # contiguous span, interior no-calls as N (see
             # _emit_molecular_batch)
-            sl = slice(int(cov[0]), int(cov[-1]) + 1)
+            sl = slice(int(first[fi, role]), int(last[fi, role]) + 1)
             seq_fwd = codes_to_seq(base[fi, role, sl])
             quals_fwd = qual[fi, role, sl].astype(np.uint8, copy=False).tobytes()
             flip = mode != "self" and bool(role)
             tags = _consensus_tags(
                 depth[fi, role, sl], errors[fi, role, sl], meta.mi, meta.rx,
                 flip=flip,
+                pre=(
+                    int(dmax[fi, role]), int(dmin[fi, role]),
+                    int(dtot[fi, role]), int(etot[fi, role]),
+                ),
             )
             # fgbio duplex per-strand tag surface (README.md:9 contract;
             # fgbio DuplexConsensusCaller docs): aD/bD max depth, aM/bM
@@ -2722,11 +2827,11 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             b_cov = b_depth[fi, role, sl]
             if flip:
                 a_cov, b_cov = a_cov[::-1], b_cov[::-1]
-            tags["aD"] = ("i", int(a_cov.max()))
-            tags["bD"] = ("i", int(b_cov.max()))
-            tags["aM"] = ("i", int(a_cov.min()))
-            tags["bM"] = ("i", int(b_cov.min()))
-            emit_ss = "a_ss_err" in out and bool(
+            tags["aD"] = ("i", int(amax[fi, role]))
+            tags["bD"] = ("i", int(bmax[fi, role]))
+            tags["aM"] = ("i", int(amin[fi, role]))
+            tags["bM"] = ("i", int(bmin[fi, role]))
+            emit_ss = have_ss and bool(
                 np.asarray(out["ss_valid"])[fi, role]
             )
             if emit_ss:
@@ -2738,19 +2843,19 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
                 b_se = np.asarray(out["b_ss_err"])[fi, role, sl]
                 if flip:
                     a_se, b_se = a_se[::-1], b_se[::-1]
-                a_tot = int(a_cov.sum(dtype=np.int64))
-                b_tot = int(b_cov.sum(dtype=np.int64))
+                a_tot = int(atot[fi, role])
+                b_tot = int(btot[fi, role])
                 tags["aE"] = (
-                    "f", int(a_se.sum(dtype=np.int64)) / a_tot if a_tot else 0.0
+                    "f", int(asetot[fi, role]) / a_tot if a_tot else 0.0
                 )
                 tags["bE"] = (
-                    "f", int(b_se.sum(dtype=np.int64)) / b_tot if b_tot else 0.0
+                    "f", int(bsetot[fi, role]) / b_tot if b_tot else 0.0
                 )
-            tags["ad"] = ("B", ("S", a_cov.tolist()))
-            tags["bd"] = ("B", ("S", b_cov.tolist()))
+            tags["ad"] = ("B", ("S", np.ascontiguousarray(a_cov)))
+            tags["bd"] = ("B", ("S", np.ascontiguousarray(b_cov)))
             if emit_ss:
-                tags["ae"] = ("B", ("S", a_se.tolist()))
-                tags["be"] = ("B", ("S", b_se.tolist()))
+                tags["ae"] = ("B", ("S", np.ascontiguousarray(a_se)))
+                tags["be"] = ("B", ("S", np.ascontiguousarray(b_se)))
             if "a_call" in out:
                 # per-strand consensus call strings (fgbio's ac/bc surface):
                 # what each strand actually voted in the merge, N where the
@@ -2768,7 +2873,7 @@ def _emit_duplex_batch(batch, out, params, mode, stats) -> list[BamRecord]:
             if starts[0] >= 0 and starts[1] >= 0:
                 lo = min(starts)
                 hi = max(
-                    meta.window_start + int(spans[r][-1]) + 1 for r in range(2)
+                    meta.window_start + int(last[fi, r]) + 1 for r in range(2)
                 )
                 tlen = (hi - lo) if starts[role] == lo else -(hi - lo)
             # duplex R1 merges the forward-mapped pair (99,163): emit

@@ -328,13 +328,19 @@ class BatchCheckpoint:
             with BamReader(os.path.join(d, shard)) as r:
                 yield from r.raw_records()
 
-    def finalize(self, records: Iterable | None = None) -> int:
+    def finalize(self, records: Iterable | None = None,
+                 writer_fn=None) -> int:
         """Concatenate shards into the target BAM and remove scratch files.
 
         records: optionally a transformed stream (e.g. coordinate-sorted
         iter_records(), or encoded blobs from a raw sort over
         iter_raw_records()) to write instead of the raw shard order.
-        Returns the record count.
+        writer_fn: alternatively a callable receiving the open target
+        BamWriter and returning the record count — the native raw sort
+        writes its merged stream through the writer's codec directly
+        (pipeline.extsort.external_sort_raw_to_writer), so the finalize
+        path stays free of per-record Python too. Returns the record
+        count.
 
         The target appears atomically (tmp + rename): a crash mid-finalize
         leaves no partial target for the workflow's mtime check to mistake
@@ -345,7 +351,9 @@ class BatchCheckpoint:
         n = 0
         tmp = self.target + ".finalize.tmp"
         with BamWriter(tmp, self.header, level=self.level) as w:
-            if records is None:
+            if writer_fn is not None:
+                n = writer_fn(w)
+            elif records is None:
                 # raw-order concatenation: copy each shard's record bytes
                 # verbatim (no decode/re-encode round trip), coalesced
                 d = os.path.dirname(self.target)

@@ -311,8 +311,9 @@ def raw_coordinate_key(blob: bytes) -> tuple:
 
 
 def iter_record_blobs(items: Iterable) -> Iterator[bytes]:
-    """Normalize a mixed BamRecord / RawRecords stream to per-record
-    encoded blobs (RawRecords blocks split at their block_size prefixes)."""
+    """Normalize a mixed BamRecord / RawRecords / raw-blob stream to
+    per-record encoded blobs (RawRecords blocks split at their block_size
+    prefixes; already-encoded single-record bytes pass through)."""
     for item in items:
         if isinstance(item, RawRecords):
             blob = item.blob
@@ -322,6 +323,8 @@ def iter_record_blobs(items: Iterable) -> Iterator[bytes]:
                 (size,) = struct.unpack_from("<i", blob, off)
                 yield blob[off : off + 4 + size]
                 off += 4 + size
+        elif isinstance(item, (bytes, memoryview)):
+            yield item
         else:
             yield encode_record(item)
 
@@ -347,6 +350,308 @@ def external_sort_raw(
     )
 
 
+def resolve_sort_engine(engine: str = "auto") -> str:
+    """THE sort-engine resolution for the raw coordinate sort — the same
+    auto|native|python contract as the emit knob (calling._resolve_emit).
+
+    'native' runs the whole record path in C: in-RAM run sorts
+    (wirepack_sort_raw_records), k-way merges whose BGZF compression
+    rides the mt-writer threadpool (bamio_merge_runs), zero per-record
+    Python between spill and bytes-on-disk. 'python' keeps the blob
+    generator + heapq engine (the parity twin). 'auto' picks native when
+    both native libraries are built. BSSEQ_TPU_SORT_ENGINE overrides the
+    passed value (experiments/bench A-B runs)."""
+    engine = os.environ.get("BSSEQ_TPU_SORT_ENGINE", engine)
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(
+            f"unknown sort engine {engine!r}; use auto|native|python"
+        )
+    if engine == "python":
+        return "python"
+    from bsseqconsensusreads_tpu.io import native as _native
+    from bsseqconsensusreads_tpu.io import wirepack as _wirepack
+
+    built = _wirepack.available() and _native.available()
+    if engine == "native":
+        if not built:
+            raise OSError(
+                "native sort unavailable: "
+                f"{_wirepack.load_error() or _native.load_error()}"
+            )
+        return "native"
+    return "native" if built else "python"
+
+
+def _append_item(buf: bytearray, item) -> int:
+    """Append one stream item's encoded bytes to a run buffer; returns
+    the record count appended. RawRecords blocks append whole — a run
+    boundary may fall mid-block, which keeps runs contiguous chunks of
+    the input stream, so the stable in-run sort + run-ordered tie-break
+    still reproduce the Python engine's output byte-for-byte."""
+    if isinstance(item, RawRecords):
+        buf += item.blob
+        return item.count
+    if isinstance(item, (bytes, memoryview)):
+        buf += item
+        return 1
+    buf += encode_record(item)
+    return 1
+
+
+def external_sort_raw_to_writer(
+    items: Iterable,
+    writer: BamWriter,
+    header: BamHeader,
+    workdir: str | None = None,
+    buffer_records: int = DEFAULT_BUFFER_RECORDS,
+    metrics=None,
+    engine: str = "auto",
+) -> int:
+    """Coordinate-sort a mixed item stream (RawRecords blocks / encoded
+    blobs / BamRecord objects) into an open BamWriter whose header is
+    already written; returns records written.
+
+    The ONE entry both stage writers and the checkpoint finalize use, so
+    the engine knob applies everywhere the raw coordinate sort runs.
+    Under the native engine no per-record Python executes between the
+    producer's batches and bytes-on-disk: native-emit RawRecords blocks
+    append to the run buffer whole, runs sort in C, and the merge loop +
+    its BGZF compression run in C through the writer's codec. Spill CRC
+    (BSSEQ_TPU_VERIFY_SPILLS), the background spill writer
+    (BSSEQ_TPU_HOST_WORKERS >= 1), and the extsort_spill/extsort_merge
+    failpoints carry over from the Python core. Output bytes are
+    identical across engines (tests/test_nativesort.py pins it)."""
+    if resolve_sort_engine(engine) != "native":
+        return writer.write_raw_many(
+            external_sort_raw(
+                iter_record_blobs(items), header, workdir=workdir,
+                buffer_records=buffer_records, metrics=metrics,
+            )
+        )
+    return _native_sort_to_writer(
+        items, writer, header, workdir, buffer_records, metrics
+    )
+
+
+def _native_sort_to_writer(
+    items: Iterable,
+    writer: BamWriter,
+    header: BamHeader,
+    workdir: str | None,
+    buffer_records: int,
+    metrics=None,
+) -> int:
+    """The native raw-blob external sort (resolve_sort_engine docs).
+
+    Structure mirrors _external_sort_core: accumulate ~buffer_records
+    records per run, sort + spill (level-1 BGZF shards, CRC'd, retried,
+    background-written), pre-merge in MERGE_FANIN groups, then one final
+    C merge into `writer`. Sub-phase seconds land as dotted attributions
+    (sort_write.key_extract / sort_write.order / sort_write.merge /
+    sort_write.merge_bgzf — Metrics.add_sub_seconds)."""
+    import contextlib
+    import time as _time
+    from functools import partial
+
+    from bsseqconsensusreads_tpu.io import wirepack as _wirepack
+    from bsseqconsensusreads_tpu.io.native import (
+        NativeBgzfReader,
+        NativeBgzfWriter,
+        _skip_header,
+        merge_runs,
+    )
+    from bsseqconsensusreads_tpu.parallel import hostpool as _hostpool
+
+    if buffer_records < 1:
+        raise ValueError(f"buffer_records must be >= 1, got {buffer_records}")
+    if not isinstance(writer._bgzf, NativeBgzfWriter):
+        # fail BEFORE any spill work: the C merge writes through the
+        # output writer's native codec handle
+        raise OSError(
+            "native sort needs a native-codec output writer "
+            "(BamWriter engine 'auto'/'native')"
+        )
+
+    def timed(name: str = "sort_write"):
+        return (
+            metrics.timed(name)
+            if metrics is not None
+            else contextlib.nullcontext()
+        )
+
+    def sub(name: str, dt: float) -> None:
+        if metrics is not None and dt:
+            metrics.add_sub_seconds(name, dt)
+
+    def sort_buf(buf: bytearray) -> tuple[bytes, int]:
+        with timed():
+            data, n, key_s, order_s = _wirepack.sort_raw_records(buf)
+        sub("sort_write.key_extract", key_s)
+        sub("sort_write.order", order_s)
+        return data, n
+
+    buf = bytearray()
+    buf_n = 0
+    run_paths: list[str] = []
+    run_crcs: dict[str, int] = {}
+    run_records: dict[str, int] = {}
+    verify = _verify_spills()
+    tmpdir: tempfile.TemporaryDirectory | None = None
+    bg_pool = None
+    bg_pending = None
+    use_bg = _hostpool.host_workers() >= 1
+
+    def write_run_file(path: str, payload: bytes, run_index: int) -> None:
+        _failpoints.fire("extsort_spill", run=run_index)
+        with BamWriter(path, header, level=1) as w:
+            w.write_raw(payload)
+        if verify:
+            run_crcs[path] = _integrity.file_crc32(path)
+
+    def write_run_guarded(path: str, payload: bytes, n: int,
+                          run_index: int, t0: float) -> None:
+        with timed("spill_write"):
+            _faultretry.guarded(
+                partial(write_run_file, path, payload, run_index),
+                metrics=metrics, stage="extsort_spill", batch=run_index,
+            )
+        if metrics is not None:
+            metrics.count("spill_runs")
+            metrics.count("spill_records", n)
+        observe.emit(
+            "spill",
+            {
+                "run": run_index,
+                "records": n,
+                "seconds": round(_time.monotonic() - t0, 3),
+            },
+        )
+
+    def drain() -> None:
+        nonlocal bg_pending
+        if bg_pending is not None:
+            fut, bg_pending = bg_pending, None
+            fut.result()
+
+    def spill() -> None:
+        nonlocal tmpdir, buf, buf_n, bg_pool, bg_pending
+        t0 = _time.monotonic()
+        if use_bg:
+            drain()
+        data, n = sort_buf(buf)
+        buf = bytearray()
+        buf_n = 0
+        if tmpdir is None:
+            tmpdir = tempfile.TemporaryDirectory(
+                prefix="bsseq_extsort_", dir=workdir
+            )
+        run_index = len(run_paths)
+        path = os.path.join(tmpdir.name, f"run{run_index:05d}.bam")
+        run_paths.append(path)
+        run_records[path] = n
+        if use_bg:
+            if bg_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                bg_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="bsseq-spill"
+                )
+            bg_pending = bg_pool.submit(
+                write_run_guarded, path, data, n, run_index, t0
+            )
+        else:
+            write_run_guarded(path, data, n, run_index, t0)
+
+    total = 0
+    try:
+        try:
+            for item in items:
+                buf_n += _append_item(buf, item)
+                if buf_n >= buffer_records:
+                    spill()
+
+            if not run_paths:  # fits one buffer: straight to the writer
+                data, total = sort_buf(buf)
+                if data:
+                    with timed():
+                        writer.write_raw(data)
+                return total
+
+            if buf_n:
+                spill()
+            drain()  # every run durable + CRC'd before the first merge open
+        finally:
+            if bg_pool is not None:
+                bg_pool.shutdown(wait=True, cancel_futures=True)
+
+        def open_runs(paths: list[str], readers: list):
+            for p in paths:
+                want = run_crcs.get(p)
+                if want is not None:
+                    _integrity.verify_file_crc32(
+                        p, want, what=f"spill run {p}"
+                    )
+                r = NativeBgzfReader(p, threads=1)
+                readers.append(r)
+                _skip_header(r, p)
+            return readers
+
+        pass_index = 0
+        while len(run_paths) > MERGE_FANIN:
+            _failpoints.fire("extsort_merge", runs=len(run_paths))
+            observe.emit(
+                "merge_pass", {"pass": pass_index, "runs": len(run_paths)}
+            )
+            merged_paths: list[str] = []
+            for gi in range(0, len(run_paths), MERGE_FANIN):
+                group = run_paths[gi : gi + MERGE_FANIN]
+                out = os.path.join(
+                    tmpdir.name,
+                    f"pass{pass_index:02d}_{len(merged_paths):05d}.bam",
+                )
+                readers: list = []
+                t0 = _time.monotonic()
+                try:
+                    with BamWriter(
+                        out, header, level=1, engine="native"
+                    ) as w:
+                        n, write_s = merge_runs(
+                            open_runs(group, readers), w._bgzf
+                        )
+                finally:
+                    for r in readers:
+                        r.close()
+                sub("sort_write.merge", _time.monotonic() - t0)
+                sub("sort_write.merge_bgzf", write_s)
+                run_records[out] = n
+                for p in group:
+                    os.remove(p)
+                    run_crcs.pop(p, None)
+                    run_records.pop(p, None)
+                if verify:
+                    run_crcs[out] = _integrity.file_crc32(out)
+                merged_paths.append(out)
+            run_paths = merged_paths
+            pass_index += 1
+
+        _failpoints.fire("extsort_merge", runs=len(run_paths))
+        readers = []
+        t0 = _time.monotonic()
+        try:
+            total, write_s = merge_runs(
+                open_runs(run_paths, readers), writer._bgzf
+            )
+        finally:
+            for r in readers:
+                r.close()
+        sub("sort_write.merge", _time.monotonic() - t0)
+        sub("sort_write.merge_bgzf", write_s)
+        return total
+    finally:
+        if tmpdir is not None:
+            tmpdir.cleanup()
+
+
 def write_batch_stream(
     batches: Iterable,
     out_path: str,
@@ -356,6 +661,7 @@ def write_batch_stream(
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
     level: int = 6,
     metrics=None,
+    sort_engine: str = "auto",
 ) -> None:
     """Write a consensus batch stream (lists of BamRecord / RawRecords) to
     a BAM: straight through when order-preserving, or via the raw-blob
@@ -364,18 +670,15 @@ def write_batch_stream(
     `level` is the BGZF deflate level (stage intermediates pass a fast
     level; see FrameworkConfig.intermediate_level). `metrics` attributes
     the sort's in-stream spill time ('sort_write' — see
-    _external_sort_core)."""
+    _external_sort_core). `sort_engine` selects the raw-sort engine
+    (resolve_sort_engine: auto|native|python, byte-identical output)."""
     with BamWriter(out_path, header, level=level) as writer:
         if mode == "self":
-            blobs = iter_record_blobs(
-                item for batch in batches for item in batch
-            )
-            writer.write_raw_many(
-                external_sort_raw(
-                    blobs, header, workdir=workdir,
-                    buffer_records=buffer_records,
-                    metrics=metrics,
-                )
+            external_sort_raw_to_writer(
+                (item for batch in batches for item in batch),
+                writer, header, workdir=workdir,
+                buffer_records=buffer_records, metrics=metrics,
+                engine=sort_engine,
             )
         else:
             for batch in batches:

@@ -43,6 +43,8 @@ from bsseqconsensusreads_tpu.pipeline.calling import (
 from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
 from bsseqconsensusreads_tpu.pipeline.extsort import (
     external_sort_raw,
+    external_sort_raw_to_writer,
+    resolve_sort_engine,
     write_batch_stream,
 )
 from bsseqconsensusreads_tpu.pipeline.record_ops import (
@@ -280,10 +282,26 @@ class PipelineBuilder:
         t0 = _time.monotonic()
         if ck is not None:
             ck.write_batches(batches)
-            ck.finalize(
-                self._sorted_raw(ck.iter_raw_records(), header, metrics)
-                if mode == "self" else None  # None = raw shard concatenation
-            )
+            if mode == "self":
+                if resolve_sort_engine(self.cfg.sort_engine) == "native":
+                    # native raw sort writes its merged stream straight
+                    # through the finalize writer's codec — no per-record
+                    # Python between the durable shards and the target
+                    ck.finalize(writer_fn=lambda w: (
+                        external_sort_raw_to_writer(
+                            ck.iter_raw_records(), w, header,
+                            workdir=self.cfg.tmp or None,
+                            buffer_records=self.cfg.sort_buffer_records,
+                            metrics=metrics, engine="native",
+                        )
+                    ))
+                else:
+                    ck.finalize(
+                        self._sorted_raw(ck.iter_raw_records(), header,
+                                         metrics)
+                    )
+            else:
+                ck.finalize(None)  # raw shard concatenation
         else:
             write_batch_stream(
                 batches, out_path, header, mode,
@@ -291,6 +309,7 @@ class PipelineBuilder:
                 buffer_records=self.cfg.sort_buffer_records,
                 level=self._out_level(out_path),
                 metrics=metrics,
+                sort_engine=self.cfg.sort_engine,
             )
         if stats is not None:
             # the remainder: post-stream merge + writer finalize, with

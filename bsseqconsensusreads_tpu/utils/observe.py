@@ -387,6 +387,20 @@ class Metrics:
         stream-active wall — pipeline.stages)."""
         self._accumulate(name, name, dt, outermost=not self._stack())
 
+    def add_sub_seconds(self, name: str, dt: float) -> None:
+        """Accumulate a SUB-PHASE attribution: a dotted name
+        ('emit.pack', 'sort_write.merge_bgzf') measuring a share of time
+        already booked under its parent phase. Dotted names are excluded
+        from phase_summary's host/device/stall sums and never touch
+        owner_seconds, so they can never double-count the timeline —
+        they exist purely so the artifact can say WHERE inside a phase
+        the seconds went (the PR-6 emit/sort_write sub-attribution)."""
+        if "." not in name:
+            raise ValueError(
+                f"sub-phase name must be dotted (parent.child), got {name!r}"
+            )
+        self._accumulate(name, name, dt, outermost=False)
+
     def rate(self, counter: str, timer: str) -> float:
         dt = self.seconds.get(timer, 0.0)
         return self.counters.get(counter, 0) / dt if dt else 0.0
@@ -422,11 +436,15 @@ class Metrics:
         with self._lock:
             secs = dict(self.seconds)
             owner = dict(self.owner_seconds)
+        # dotted names are sub-phase attributions INSIDE a parent phase
+        # (Metrics.add_sub_seconds) — summing them alongside the parent
+        # would double-count the same wall
         device_s = sum(v for k, v in secs.items() if k in DEVICE_PHASES)
         stall_s = sum(v for k, v in secs.items() if k in STALL_PHASES)
         host_s = sum(
             v for k, v in secs.items()
             if k not in DEVICE_PHASES and k not in STALL_PHASES
+            and "." not in k
         )
         attributed = sum(owner.values())
         return {

@@ -11,6 +11,7 @@
 //
 // Build: make -C native   (produces libbamio.so)
 
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -1373,6 +1374,154 @@ int64_t bamio_parse_grouped3(
   }
   *n_fams = fams;
   return o.nrec;
+}
+
+}  // extern "C"
+
+// ---- k-way raw-record merge (pipeline/extsort.py 'native' engine) ---------
+//
+// Merge sorted spill runs of encoded BAM records without any per-record
+// Python: each run is an already-open Reader positioned just past its
+// header, the output an already-open (single- or multi-threaded) BGZF
+// writer. The comparator is EXACTLY pipeline.extsort.raw_coordinate_key's
+// tuple order — (ref_id or 1<<30, pos or 1<<30, qname bytes, flag) — and
+// ties prefer the LOWEST run index, matching heapq.merge's iterator-order
+// stability, so the merged byte stream is identical to the Python
+// engine's. Output rides the writer's normal 65280-byte block chunking,
+// so the BGZF container is byte-identical too.
+
+namespace {
+
+struct MergeStream {
+  Reader* r = nullptr;
+  std::vector<uint8_t> rec;  // current record incl. its 4-byte prefix
+  bool done = false;
+  int64_t kref = 0, kpos = 0;
+  int32_t qlen = 0;
+  uint16_t kflag = 0;
+};
+
+// Pull the next record into s.rec; false on EOF or error (err set).
+bool merge_advance(MergeStream& s, std::string& err) {
+  uint8_t szbuf[4];
+  int64_t got = bamio_read(s.r, szbuf, 4);
+  if (got == 0) {
+    s.done = true;
+    return false;
+  }
+  if (got < 0) {
+    err = s.r->err.empty() ? "read failed" : s.r->err;
+    return false;
+  }
+  if (got < 4) {
+    err = "truncated record size in spill run";
+    return false;
+  }
+  int32_t bs;
+  memcpy(&bs, szbuf, 4);
+  if (bs < 32 || bs > (1 << 28)) {  // io/bam.py MIN/MAX_RECORD_SIZE
+    err = "corrupt record size in spill run";
+    return false;
+  }
+  s.rec.resize(size_t(bs) + 4);
+  memcpy(s.rec.data(), szbuf, 4);
+  if (bamio_read(s.r, s.rec.data() + 4, bs) != bs) {
+    err = "truncated record body in spill run";
+    return false;
+  }
+  const uint8_t* p = s.rec.data();
+  int32_t ref, pos;
+  memcpy(&ref, p + 4, 4);
+  memcpy(&pos, p + 8, 4);
+  s.kref = ref >= 0 ? ref : (int64_t(1) << 30);
+  s.kpos = pos >= 0 ? pos : (int64_t(1) << 30);
+  memcpy(&s.kflag, p + 18, 2);
+  const int32_t lq = p[12];
+  s.qlen = lq > 0 ? lq - 1 : 0;
+  return true;
+}
+
+// strict-less on the raw_coordinate_key tuple (qname bytes compare like
+// Python bytes: memcmp then shorter-prefix-first).
+inline bool merge_less(const MergeStream& a, const MergeStream& b) {
+  if (a.kref != b.kref) return a.kref < b.kref;
+  if (a.kpos != b.kpos) return a.kpos < b.kpos;
+  const int32_t n = a.qlen < b.qlen ? a.qlen : b.qlen;
+  const int c = memcmp(a.rec.data() + 36, b.rec.data() + 36, size_t(n));
+  if (c != 0) return c < 0;
+  if (a.qlen != b.qlen) return a.qlen < b.qlen;
+  return a.kflag < b.kflag;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Merge n_runs sorted runs into `writer` (a Writer*, or an MtWriter* when
+// writer_mt != 0 — its deflate worker pool is what the merge's BGZF
+// compression rides on multi-core hosts). Readers must be positioned just
+// past their BAM headers. Returns records written, or -1 with `err`
+// filled. write_s (optional) accumulates the seconds spent inside the
+// writer calls — the deflate/IO share of the merge, reported apart from
+// the pure merge loop for the sort_write sub-attribution.
+int64_t bamio_merge_runs(void** readers, int32_t n_runs, void* writer,
+                         int32_t writer_mt, char* err, int32_t errlen,
+                         double* write_s) {
+  using clock = std::chrono::steady_clock;
+  std::vector<MergeStream> streams(static_cast<size_t>(n_runs));
+  std::string serr;
+  for (int32_t i = 0; i < n_runs; ++i) {
+    streams[size_t(i)].r = static_cast<Reader*>(readers[i]);
+    if (!merge_advance(streams[size_t(i)], serr) &&
+        !streams[size_t(i)].done) {
+      snprintf(err, size_t(errlen), "run %d: %s", i, serr.c_str());
+      return -1;
+    }
+  }
+  std::vector<uint8_t> outbuf;
+  outbuf.reserve(1 << 20);
+  double wsec = 0.0;
+  auto flush_out = [&]() -> bool {
+    if (outbuf.empty()) return true;
+    const auto t0 = clock::now();
+    int rc;
+    if (writer_mt)
+      rc = bamio_write_mt(static_cast<MtWriter*>(writer), outbuf.data(),
+                          int64_t(outbuf.size()));
+    else
+      rc = bamio_write(static_cast<Writer*>(writer), outbuf.data(),
+                       int64_t(outbuf.size()));
+    wsec += std::chrono::duration<double>(clock::now() - t0).count();
+    outbuf.clear();
+    return rc == 0;
+  };
+  int64_t n_out = 0;
+  for (;;) {
+    int32_t best = -1;
+    for (int32_t i = 0; i < n_runs; ++i) {
+      MergeStream& s = streams[size_t(i)];
+      if (s.done) continue;
+      if (best < 0 || merge_less(s, streams[size_t(best)])) best = i;
+    }
+    if (best < 0) break;
+    MergeStream& s = streams[size_t(best)];
+    outbuf.insert(outbuf.end(), s.rec.begin(), s.rec.end());
+    ++n_out;
+    if (outbuf.size() >= (1 << 20) && !flush_out()) {
+      snprintf(err, size_t(errlen), "merge output write failed");
+      return -1;
+    }
+    if (!merge_advance(s, serr) && !s.done) {
+      snprintf(err, size_t(errlen), "run %d: %s", best, serr.c_str());
+      return -1;
+    }
+  }
+  if (!flush_out()) {
+    snprintf(err, size_t(errlen), "merge output write failed");
+    return -1;
+  }
+  if (write_s) *write_s = wsec;
+  return n_out;
 }
 
 }  // extern "C"

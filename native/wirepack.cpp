@@ -14,6 +14,8 @@
 // TPU framework's equivalent native serialization layer, sized for the
 // device tunnel instead of the filesystem.
 
+#include <algorithm>
+#include <chrono>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -790,6 +792,289 @@ void wirepack_unpack_duplex_b0(const uint8_t* wire, int64_t f, int64_t w,
   const int64_t n = f * 2 * w;
   for (int64_t i = 0; i < n; ++i)
     decode_b0(wire[i], i, base, depth, errors, a_depth, b_depth, a_err, b_err);
+}
+
+// ---- native raw-blob record sort (pipeline/extsort.py 'native' engine) ----
+//
+// One in-RAM spill run: a concatenated stream of encoded BAM records
+// (each with its leading block_size prefix — the native emit /
+// BamReader.raw_records framing) is key-scanned at fixed offsets,
+// stable-sorted, and gathered into `out` in sorted order. The key is
+// EXACTLY pipeline.extsort.raw_coordinate_key's tuple — (ref_id or
+// 1<<30, pos or 1<<30, qname bytes, flag), compared like Python compares
+// it (lexicographic bytes with shorter-prefix-first, unsigned flag) —
+// and std::stable_sort preserves input order on full ties like
+// list.sort, so for any run partitioning into contiguous input chunks
+// the merged output is byte-identical to the Python engine's.
+//
+// key_s / sort_s return the pass split (key extraction vs order+gather)
+// so the bench's sort_write sub-attribution comes from measurement.
+// Returns record count, or -2 on a malformed record frame (a corrupt
+// block_size / overrun — these blobs are internally produced, so this
+// is a bug or memory corruption, never input data).
+
+namespace {
+
+struct RawRecKey {
+  int64_t off;        // byte offset of the record (incl. prefix)
+  int32_t size;       // total bytes incl. prefix
+  int32_t ref, pos;   // already mapped (-1 -> 1<<30)
+  int32_t qlen;
+  uint16_t flag;
+};
+
+constexpr int32_t kMinRecordSize = 32;        // io/bam.py MIN_RECORD_SIZE
+constexpr int32_t kMaxRecordSize = 1 << 28;   // io/bam.py MAX_RECORD_SIZE
+constexpr int32_t kUnmappedKey = 1 << 30;     // raw_coordinate_key sentinel
+
+inline bool scan_raw_key(const uint8_t* blob, int64_t nbytes, int64_t off,
+                         RawRecKey& k) {
+  if (off + 4 > nbytes) return false;
+  int32_t bs;
+  std::memcpy(&bs, blob + off, 4);
+  if (bs < kMinRecordSize || bs > kMaxRecordSize || off + 4 + bs > nbytes)
+    return false;
+  k.off = off;
+  k.size = bs + 4;
+  int32_t ref, pos;
+  std::memcpy(&ref, blob + off + 4, 4);
+  std::memcpy(&pos, blob + off + 8, 4);
+  k.ref = ref >= 0 ? ref : kUnmappedKey;
+  k.pos = pos >= 0 ? pos : kUnmappedKey;
+  std::memcpy(&k.flag, blob + off + 18, 2);
+  const int32_t lq = blob[off + 12];
+  k.qlen = lq > 0 ? lq - 1 : 0;
+  if (36 + k.qlen > k.size) return false;
+  return true;
+}
+
+// raw_coordinate_key tuple comparison (qname bytes compare like Python
+// bytes: memcmp, then shorter-is-smaller).
+inline bool raw_key_less(const uint8_t* blob, const RawRecKey& a,
+                         const RawRecKey& b) {
+  if (a.ref != b.ref) return a.ref < b.ref;
+  if (a.pos != b.pos) return a.pos < b.pos;
+  const int n = a.qlen < b.qlen ? a.qlen : b.qlen;
+  const int c = std::memcmp(blob + a.off + 36, blob + b.off + 36, size_t(n));
+  if (c != 0) return c < 0;
+  if (a.qlen != b.qlen) return a.qlen < b.qlen;
+  return a.flag < b.flag;
+}
+
+}  // namespace
+
+int64_t wirepack_sort_raw_records(const uint8_t* blob, int64_t nbytes,
+                                  uint8_t* out, double* key_s,
+                                  double* sort_s) {
+  using clock = std::chrono::steady_clock;
+  const auto t0 = clock::now();
+  std::vector<RawRecKey> keys;
+  keys.reserve(size_t(nbytes / 256) + 16);
+  int64_t off = 0;
+  while (off < nbytes) {
+    RawRecKey k;
+    if (!scan_raw_key(blob, nbytes, off, k)) return -2;
+    keys.push_back(k);
+    off += k.size;
+  }
+  const auto t1 = clock::now();
+  std::stable_sort(keys.begin(), keys.end(),
+                   [blob](const RawRecKey& a, const RawRecKey& b) {
+                     return raw_key_less(blob, a, b);
+                   });
+  uint8_t* dst = out;
+  for (const RawRecKey& k : keys) {
+    std::memcpy(dst, blob + k.off, size_t(k.size));
+    dst += k.size;
+  }
+  const auto t2 = clock::now();
+  if (key_s)
+    *key_s = std::chrono::duration<double>(t1 - t0).count();
+  if (sort_s)
+    *sort_s = std::chrono::duration<double>(t2 - t1).count();
+  return int64_t(keys.size());
+}
+
+// ---- sparse cB dissent histogram (models/molecular.py twin) --------------
+//
+// The molecular emit path's tag prologue: overlap co-call
+// (_overlap_cocall_np), observation filter, per-base histogram
+// (_base_histogram), and call-plane sparsification
+// (sparsify_base_counts) — four numpy sweeps over [F, T, 2, W] — as ONE
+// C pass. Integer-exact twin of the numpy chain (every operation is a
+// comparison, sum, or absolute difference of integers; tests pin
+// equality). The r05 ledger's molecular-emit wall was largely this
+// rework running inside the emit span per batch.
+//
+//   bases i8 [f, t, 2, w], quals u8 [f, t, 2, w] (<= 93+93 co-called),
+//   cons  i8 [f, 2, w]  (the consensus call plane; NBASE = masked),
+//   min_q: observation threshold (post-cocall), cocall: 1 = co-call on.
+//   out  u16 [f, 2, 4, w], fully written (zeros included).
+void wirepack_bcount_sparse(const int8_t* bases, const uint8_t* quals,
+                            int64_t f, int64_t t, int64_t w,
+                            const int8_t* cons, int min_q, int cocall,
+                            uint16_t* out) {
+  constexpr int8_t kN = 4;
+  for (int64_t fi = 0; fi < f; ++fi) {
+    uint16_t* ob = out + fi * 2 * 4 * w;
+    std::memset(ob, 0, sizeof(uint16_t) * 2 * 4 * size_t(w));
+    for (int64_t ti = 0; ti < t; ++ti) {
+      const int8_t* b1 = bases + ((fi * t + ti) * 2 + 0) * w;
+      const int8_t* b2 = b1 + w;
+      const uint8_t* q1 = quals + ((fi * t + ti) * 2 + 0) * w;
+      const uint8_t* q2 = q1 + w;
+      for (int64_t i = 0; i < w; ++i) {
+        int8_t x1 = b1[i], x2 = b2[i];
+        int q1v = q1[i], q2v = q2[i];
+        if (cocall) {
+          const bool both = x1 != kN && x2 != kN;
+          if (both) {
+            if (x1 == x2) {
+              const int qs = q1v + q2v;
+              q1v = qs;
+              q2v = qs;
+            } else {
+              const int qd = q1v >= q2v ? q1v - q2v : q2v - q1v;
+              if (qd == 0) {  // tie masks the column on both rows
+                x1 = kN;
+                x2 = kN;
+              } else {
+                const int8_t win = q1v >= q2v ? x1 : x2;
+                x1 = win;
+                x2 = win;
+              }
+              q1v = qd;
+              q2v = qd;
+            }
+          }
+        }
+        if (x1 != kN && q1v >= min_q) ob[size_t(x1) * w + i]++;
+        if (x2 != kN && q2v >= min_q) ob[(4 + size_t(x2)) * w + i]++;
+      }
+    }
+    // sparsify: zero the consensus-call plane wherever the call exists
+    for (int role = 0; role < 2; ++role) {
+      const int8_t* crow = cons + (fi * 2 + role) * w;
+      uint16_t* orole = ob + size_t(role) * 4 * w;
+      for (int64_t i = 0; i < w; ++i) {
+        const int8_t c = crow[i];
+        if (c != kN) orole[size_t(c) * w + i] = 0;
+      }
+    }
+  }
+}
+
+// ---- native strand-call planes (ops/hosttwin.py strand_call_planes) ----
+//
+// The duplex rawize pass's largest numpy segment: the host twin of the
+// convert -> extend window transforms, recomputed per retired batch to
+// recover the per-strand consensus calls (ac/bc tags, exact-ce input).
+// This is the C sweep of the same integer rules, term for term:
+// ops.hosttwin.convert_np (prepend, per-column rewrite, trailing trim)
+// then extend_np (boundary-column copies between pair rows, PAIRS =
+// ((1,0),(2,3))), then the coverage mask. The numpy twin stays as the
+// parity reference (tests/test_hosttwin.py pins it against the jit ops;
+// tests/test_wirepack.py pins this against the numpy twin).
+//
+//   bases int8 [f, 4, w], cover u8 [f, 4, w], ref int8 [f, w+1],
+//   cmask u8 [f, 4], elig u8 [f]  ->  calls int8 [f, 4, w]
+//   (NBASE where the transformed row has no coverage).
+void wirepack_strand_calls(const int8_t* bases, const uint8_t* cover,
+                           const int8_t* ref, const uint8_t* cmask,
+                           const uint8_t* elig, int64_t f, int64_t w,
+                           int8_t* calls) {
+  constexpr int8_t kA = 0, kC = 1, kG = 2, kT = 3, kN = 4;
+  std::vector<int8_t> b(4 * size_t(w));
+  std::vector<uint8_t> c(4 * size_t(w));
+  for (int64_t fam = 0; fam < f; ++fam) {
+    std::memcpy(b.data(), bases + fam * 4 * w, 4 * size_t(w));
+    std::memcpy(c.data(), cover + fam * 4 * w, 4 * size_t(w));
+    const int8_t* refrow = ref + fam * (w + 1);
+    int8_t la[4] = {0, 0, 0, 0}, rd[4] = {0, 0, 0, 0};
+    for (int row = 0; row < 4; ++row) {
+      int8_t* br = b.data() + row * w;
+      uint8_t* cr = c.data() + row * w;
+      int64_t first = -1;
+      for (int64_t i = 0; i < w; ++i)
+        if (cr[i]) {
+          first = i;
+          break;
+        }
+      const bool act = cmask[fam * 4 + row] != 0 && first >= 0;
+      if (!act) continue;
+      // conversion prepend: one column left of the read, ref base there
+      if (first > 0) {
+        br[first - 1] = refrow[first - 1];
+        cr[first - 1] = 1;
+        la[row] = 1;
+      }
+      // per-column rewrite, left to right in place: reading br[i + 1]
+      // before it is rewritten matches the numpy twin's vectorized
+      // select over the post-prepend (pre-rewrite) values
+      for (int64_t i = 0; i < w; ++i) {
+        if (!cr[i]) continue;
+        const int8_t x = br[i];
+        const int8_t refc = refrow[i], refn = refrow[i + 1];
+        if (x == kA && refc == kG) {
+          br[i] = kG;
+        } else if (x == kC) {
+          if (refc == kC && refn == kG) {  // CpG: pair rule
+            const int8_t nxt = i + 1 < w ? br[i + 1] : kN;
+            const bool nxtcov = i + 1 < w && cr[i + 1] != 0;
+            if (nxtcov && nxt == kA) br[i] = kT;
+          } else {
+            br[i] = kT;
+          }
+        }
+      }
+      // trailing trim: ref past the end is G and the row now ends in C
+      int64_t last = -1;
+      for (int64_t i = w - 1; i >= 0; --i)
+        if (cr[i]) {
+          last = i;
+          break;
+        }
+      if (last >= 0 && refrow[last + 1] == kG && br[last] == kC) {
+        cr[last] = 0;
+        br[last] = kN;
+        rd[row] = 1;
+      }
+    }
+    // extend-gap boundary copies (ops/extend.PAIRS, left = converted row)
+    const int pairs[2][2] = {{1, 0}, {2, 3}};
+    for (const auto& pr : pairs) {
+      const int left = pr[0], right = pr[1];
+      int8_t* bl = b.data() + left * w;
+      int8_t* brr = b.data() + right * w;
+      uint8_t* cl = c.data() + left * w;
+      uint8_t* crr = c.data() + right * w;
+      bool has_l = false, has_r = false;
+      int64_t first_l = 0, last_r = 0;
+      for (int64_t i = 0; i < w; ++i)
+        if (cl[i]) {
+          first_l = i;
+          has_l = true;
+          break;
+        }
+      for (int64_t i = w - 1; i >= 0; --i)
+        if (crr[i]) {
+          last_r = i;
+          has_r = true;
+          break;
+        }
+      const bool both = has_l && has_r && elig[fam] != 0;
+      if (both && la[left] == 1) {
+        brr[first_l] = bl[first_l];
+        crr[first_l] = 1;
+      }
+      if (both && rd[left] == 1) {
+        bl[last_r] = brr[last_r];
+        cl[last_r] = 1;
+      }
+    }
+    int8_t* dst = calls + fam * 4 * w;
+    for (int64_t i = 0; i < 4 * w; ++i) dst[i] = c[i] ? b[i] : kN;
+  }
 }
 
 }  // extern "C"

@@ -36,6 +36,7 @@ FIXTURES = {
     "unbounded-retry": "fx_unbounded_retry.py",
     "serialized-host-phase": "fx_serialized_host_phase.py",
     "assert-on-input": "fx_assert_on_input.py",
+    "per-record-alloc": "fx_per_record_alloc.py",
 }
 
 
@@ -243,3 +244,82 @@ class TestCli:
         suppressed = [f for f in findings]  # clean self-app => all suppressed
         assert run_lint([PKG]) == []
         assert len(suppressed) >= 1  # the documented package suppressions
+
+
+class TestPerRecordAlloc:
+    """per-record-alloc specifics beyond the seeded fixture: each flagged
+    pattern, and the exemptions that keep batch-level code clean."""
+
+    def lint(self, tmp_path, body):
+        p = tmp_path / "case.py"
+        p.write_text(body)
+        return run_lint([str(p)], rules=["per-record-alloc"])
+
+    def test_bamrecord_in_loop_fires(self, tmp_path):
+        body = (
+            "def hot_emit_all(recs):\n"
+            "    out = []\n"
+            "    for r in recs:\n"
+            "        out.append(BamRecord(qname=r.name))\n"
+            "    return out\n"
+        )
+        (f,) = self.lint(tmp_path, body)
+        assert f.rule == "per-record-alloc" and f.line == 4
+
+    def test_str_concat_in_loop_fires(self, tmp_path):
+        body = (
+            "def hot_sort_names(recs):\n"
+            "    keys = []\n"
+            "    for r in recs:\n"
+            "        keys.append('mi:' + r.mi)\n"
+            "    return keys\n"
+        )
+        (f,) = self.lint(tmp_path, body)
+        assert f.line == 4 and "concatenation" in f.message
+
+    def test_comprehension_counts_as_loop(self, tmp_path):
+        body = (
+            "def hot_emit_all(recs):\n"
+            "    return [BamRecord(qname=r.name) for r in recs]\n"
+        )
+        (f,) = self.lint(tmp_path, body)
+        assert f.line == 2
+
+    def test_non_hot_function_is_exempt(self, tmp_path):
+        # same shape, but not reachable from a batch-loop root
+        body = (
+            "def emit_report(recs):\n"
+            "    return [BamRecord(qname=r.name) for r in recs]\n"
+        )
+        assert self.lint(tmp_path, body) == []
+
+    def test_non_emit_sort_hot_path_is_exempt(self, tmp_path):
+        # hot, but not on an emit/sort-named reachability path
+        body = (
+            "def hot_ingest_all(recs):\n"
+            "    return [BamRecord(qname=r.name) for r in recs]\n"
+        )
+        assert self.lint(tmp_path, body) == []
+
+    def test_batch_level_tolist_is_clean(self, tmp_path):
+        body = (
+            "def hot_emit_all(depths):\n"
+            "    cols = depths.tolist()\n"
+            "    out = []\n"
+            "    for c in cols:\n"
+            "        out.append(c)\n"
+            "    return out\n"
+        )
+        assert self.lint(tmp_path, body) == []
+
+    def test_reachable_callee_is_flagged(self, tmp_path):
+        # the per-record loop lives in a helper the emit root calls
+        body = (
+            "def build_rows(recs):\n"
+            "    return [r.depths.tolist() for r in recs]\n"
+            "\n"
+            "def hot_emit_all(recs):\n"
+            "    return build_rows(recs)\n"
+        )
+        (f,) = self.lint(tmp_path, body)
+        assert f.line == 2

@@ -1,0 +1,333 @@
+"""Native raw-blob external sort vs the Python engine (ISSUE 6).
+
+The native sort (wirepack_sort_raw_records + bamio_merge_runs, behind
+pipeline.extsort.resolve_sort_engine) is a pure speed substitution for
+the blob-generator + heapq path — any divergence is silent output
+corruption. These tests pin byte-identity of the SORTED OUTPUT across
+engines: unit-level over adversarial record sets (multi-run merges,
+ties, unmapped records, a forced multi-pass merge), stage-level through
+the real pipeline across both consensus stages x both alignment modes x
+all input policies, under the extsort_spill failpoint (retried run
+rewrite), and with an fd + jax.live_arrays census on abandon.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import struct
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io import native, wirepack
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamReader,
+    BamRecord,
+    BamWriter,
+    CMATCH,
+    RawRecords,
+    encode_record,
+)
+from bsseqconsensusreads_tpu.pipeline import extsort
+
+pytestmark = pytest.mark.skipif(
+    not (wirepack.available() and native.available()),
+    reason=f"native libs: {wirepack.load_error()} / {native.load_error()}",
+)
+
+HEADER = BamHeader("@HD\tVN:1.6\n", [("chr1", 1 << 20), ("chr2", 1 << 20)])
+
+
+def _random_blobs(n: int, seed: int, qname_pool: int = 40) -> list[bytes]:
+    """Encoded records with heavy key ties (shared qnames/positions),
+    unmapped records, and varied lengths — the sort comparator's edge
+    surface."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        ln = rng.choice((8, 12, 20))
+        r = BamRecord(
+            qname=f"q{rng.randrange(qname_pool)}" + "x" * rng.randrange(3),
+            flag=rng.choice((99, 147, 83, 163, 0, 4)),
+            ref_id=rng.choice((-1, 0, 0, 1)),
+            pos=rng.choice((-1, rng.randrange(64), rng.randrange(4096))),
+            mapq=60,
+            cigar=[(CMATCH, ln)],
+            seq="ACGT" * (ln // 4),
+            qual=bytes([rng.randrange(2, 40)] * ln),
+        )
+        r.set_tag("MI", str(i), "Z")
+        out.append(encode_record(r))
+    return out
+
+
+def _sorted_bytes(items, engine: str, buffer_records: int,
+                  tmp_path, tag: str) -> bytes:
+    path = str(tmp_path / f"{tag}_{engine}.bam")
+    with BamWriter(path, HEADER) as w:
+        extsort.external_sort_raw_to_writer(
+            iter(items), w, HEADER, workdir=str(tmp_path),
+            buffer_records=buffer_records, engine=engine,
+        )
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+class TestEngineIdentityUnit:
+    @pytest.mark.parametrize("buffer_records", [10_000, 700, 97])
+    def test_blob_stream_identity(self, tmp_path, buffer_records):
+        """No-spill, few-run, and many-run shapes all byte-identical."""
+        blobs = _random_blobs(3000, seed=buffer_records)
+        a = _sorted_bytes(blobs, "python", buffer_records, tmp_path, "u")
+        b = _sorted_bytes(blobs, "native", buffer_records, tmp_path, "u")
+        assert a == b and len(a) > 1000
+
+    def test_rawrecords_blocks_split_across_runs(self, tmp_path):
+        """RawRecords blocks append whole, so native run boundaries can
+        differ from the python engine's record-exact splits — the merged
+        output must be identical anyway (contiguous-chunk stability)."""
+        blobs = _random_blobs(2400, seed=7)
+        items = []
+        i = 0
+        rng = random.Random(1)
+        while i < len(blobs):
+            k = rng.randrange(1, 9)
+            items.append(RawRecords(b"".join(blobs[i : i + k]),
+                                    len(blobs[i : i + k])))
+            i += k
+        a = _sorted_bytes(items, "python", 150, tmp_path, "rr")
+        b = _sorted_bytes(items, "native", 150, tmp_path, "rr")
+        assert a == b
+
+    def test_multi_pass_merge_identity(self, tmp_path):
+        """> MERGE_FANIN runs forces the pre-merge pass on both engines."""
+        old = extsort.MERGE_FANIN
+        extsort.MERGE_FANIN = 4
+        try:
+            blobs = _random_blobs(1200, seed=3)
+            a = _sorted_bytes(blobs, "python", 60, tmp_path, "mp")
+            b = _sorted_bytes(blobs, "native", 60, tmp_path, "mp")
+            assert a == b
+        finally:
+            extsort.MERGE_FANIN = old
+
+    def test_bamrecord_items_accepted(self, tmp_path):
+        recs = [
+            BamRecord(qname=f"r{i % 5}", flag=99, ref_id=0, pos=100 - i,
+                      mapq=60, cigar=[(CMATCH, 4)], seq="ACGT",
+                      qual=bytes([30] * 4))
+            for i in range(50)
+        ]
+        a = _sorted_bytes(recs, "python", 10, tmp_path, "br")
+        b = _sorted_bytes(recs, "native", 10, tmp_path, "br")
+        assert a == b
+
+    def test_resolve_engine_contract(self, monkeypatch):
+        assert extsort.resolve_sort_engine("auto") == "native"
+        assert extsort.resolve_sort_engine("python") == "python"
+        assert extsort.resolve_sort_engine("native") == "native"
+        with pytest.raises(ValueError, match="unknown sort engine"):
+            extsort.resolve_sort_engine("frobnicate")
+        monkeypatch.setenv("BSSEQ_TPU_SORT_ENGINE", "python")
+        assert extsort.resolve_sort_engine("native") == "python"
+
+    def test_sub_phase_attribution_lands(self, tmp_path):
+        from bsseqconsensusreads_tpu.utils import observe
+
+        metrics = observe.Metrics()
+        blobs = _random_blobs(1500, seed=11)
+        path = str(tmp_path / "attr.bam")
+        with BamWriter(path, HEADER) as w:
+            extsort.external_sort_raw_to_writer(
+                iter(blobs), w, HEADER, workdir=str(tmp_path),
+                buffer_records=300, metrics=metrics, engine="native",
+            )
+        secs = metrics.seconds
+        assert "sort_write.order" in secs and "sort_write.merge" in secs
+        assert "sort_write.merge_bgzf" in secs
+        # dotted sub-phases must not inflate the phase summary's host sum
+        summary = metrics.phase_summary(1.0)
+        host_named = (
+            secs.get("sort_write", 0.0) + secs.get("spill_write", 0.0)
+        )
+        # phase_summary rounds to 3 decimals; the check is that dotted
+        # names add ~nothing, not float exactness
+        assert summary["host_s"] == pytest.approx(host_named, abs=2e-3)
+
+
+class TestSpillFaultThroughNativeSort:
+    def test_spill_io_error_retried_byte_identical(self, tmp_path):
+        """The extsort_spill failpoint fires inside the native engine's
+        retried write unit: one injected IO error, one retry, identical
+        bytes to the fault-free run."""
+        from bsseqconsensusreads_tpu.faults import failpoints
+        from bsseqconsensusreads_tpu.utils import observe
+
+        blobs = _random_blobs(900, seed=21)
+        clean = _sorted_bytes(blobs, "native", 120, tmp_path, "clean")
+        metrics = observe.Metrics()
+        failpoints.arm("extsort_spill=io_error:times=1")
+        try:
+            path = str(tmp_path / "faulted.bam")
+            with BamWriter(path, HEADER) as w:
+                extsort.external_sort_raw_to_writer(
+                    iter(blobs), w, HEADER, workdir=str(tmp_path),
+                    buffer_records=120, metrics=metrics, engine="native",
+                )
+            with open(path, "rb") as fh:
+                faulted = fh.read()
+        finally:
+            failpoints.disarm()
+        assert faulted == clean
+        assert metrics.counters.get("batches_retried", 0) == 1
+
+    def test_merge_failpoint_fires_on_native_path(self, tmp_path):
+        from bsseqconsensusreads_tpu.faults import failpoints
+
+        blobs = _random_blobs(400, seed=22)
+        failpoints.arm("extsort_merge=raise:RuntimeError:times=1")
+        try:
+            with pytest.raises(RuntimeError):
+                _sorted_bytes(blobs, "native", 100, tmp_path, "mf")
+        finally:
+            failpoints.disarm()
+
+
+class TestAbandonLeakCensus:
+    def _fd_count(self) -> int:
+        return len(os.listdir("/proc/self/fd"))
+
+    def test_producer_raise_releases_fds_and_tmpdir(self, tmp_path):
+        """A producer exception mid-stream must leave no spill tempdir,
+        no open run descriptors, and no extra live jax arrays."""
+        import gc
+
+        import jax
+
+        blobs = _random_blobs(600, seed=31)
+
+        def items():
+            for i, b in enumerate(blobs):
+                if i == 450:  # after several spills
+                    raise RuntimeError("producer died")
+                yield b
+
+        gc.collect()
+        fd0 = self._fd_count()
+        live0 = len(jax.live_arrays())
+        before = set(os.listdir(tmp_path))
+        with pytest.raises(RuntimeError, match="producer died"):
+            path = str(tmp_path / "abandon.bam")
+            with BamWriter(path, HEADER) as w:
+                extsort.external_sort_raw_to_writer(
+                    iter(items()), w, HEADER, workdir=str(tmp_path),
+                    buffer_records=100, engine="native",
+                )
+        gc.collect()
+        leftover = {
+            d for d in set(os.listdir(tmp_path)) - before
+            if d.startswith("bsseq_extsort_")
+        }
+        assert leftover == set()
+        assert self._fd_count() <= fd0 + 1  # the (closed) output file
+        assert len(jax.live_arrays()) <= live0
+
+
+def _pipeline_digest(tmp_path, tag: str, sort_engine: str, policy: str,
+                     records, name: str, genome: str) -> str:
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import write_fasta
+
+    wd = tmp_path / f"{tag}_{sort_engine}_{policy}"
+    wd.mkdir()
+    fa = str(wd / "g.fa")
+    write_fasta(fa, name, genome)
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n", [(name, len(genome))]
+    )
+    inbam = str(wd / "in.bam")
+    with BamWriter(inbam, header) as w:
+        for r in records:
+            w.write(r)
+    env_before = os.environ.get("BSSEQ_TPU_INPUT_POLICY")
+    os.environ["BSSEQ_TPU_INPUT_POLICY"] = policy
+    try:
+        cfg = FrameworkConfig(
+            genome_dir=str(wd), genome_fasta_file_name="g.fa",
+            tmp=str(wd), aligner="self", grouping="coordinate",
+            batch_families=7, sort_buffer_records=40,
+            sort_engine=sort_engine,
+        )
+        target, _, _ = run_pipeline(cfg, inbam, outdir=str(wd / "out"))
+    finally:
+        if env_before is None:
+            os.environ.pop("BSSEQ_TPU_INPUT_POLICY", None)
+        else:
+            os.environ["BSSEQ_TPU_INPUT_POLICY"] = env_before
+    with open(target, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+class TestPipelineIdentityAcrossPolicies:
+    """Both stages (molecular + duplex, via the self-aligned pipeline
+    whose outputs both ride the raw coordinate sort) x all input
+    policies x both engines: one digest per policy, identical across
+    engines."""
+
+    @pytest.mark.parametrize("policy", ["strict", "quarantine", "lenient"])
+    def test_both_engines_identical(self, tmp_path, policy):
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+            random_genome,
+        )
+
+        rng = np.random.default_rng(61)
+        name, genome = random_genome(rng, 6000)
+        _, records = make_grouped_bam_records(rng, name, genome,
+                                              n_families=12)
+        digests = {
+            eng: _pipeline_digest(
+                tmp_path, "pol", eng, policy, records, name, genome
+            )
+            for eng in ("python", "native")
+        }
+        assert digests["python"] == digests["native"]
+
+
+class TestUnalignedModeIdentity:
+    """mode='unaligned' emits order-preserving batches (no sort), but the
+    stage x engine matrix must still be byte-stable: the emit engines'
+    records ride write_batch_stream untouched."""
+
+    def test_molecular_unaligned_both_emit_engines(self, tmp_path):
+        from bsseqconsensusreads_tpu.pipeline.calling import (
+            StageStats,
+            call_molecular_batches,
+        )
+        from bsseqconsensusreads_tpu.pipeline.extsort import (
+            write_batch_stream,
+        )
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+            random_genome,
+        )
+
+        rng = np.random.default_rng(71)
+        name, genome = random_genome(rng, 5000)
+        header, records = make_grouped_bam_records(rng, name, genome,
+                                                   n_families=8)
+        outs = {}
+        for emit in ("python", "native"):
+            path = str(tmp_path / f"un_{emit}.bam")
+            batches = call_molecular_batches(
+                iter(records), mode="unaligned", grouping="adjacent",
+                batch_families=3, stats=StageStats(), emit=emit,
+            )
+            write_batch_stream(batches, path, header, "unaligned")
+            with open(path, "rb") as fh:
+                outs[emit] = fh.read()
+        assert outs["python"] == outs["native"] and len(outs["python"]) > 200

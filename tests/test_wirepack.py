@@ -144,3 +144,112 @@ def test_native_pack_rejects_odd_cell_count():
     elig = np.ones(f, dtype=bool)
     with pytest.raises(ValueError, match="even"):
         wirepack.pack_duplex(bases, quals, cover, cmask, elig, "q8")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6 surfaces: native strand-call planes + raw-record sort
+
+
+def _random_transform_batch(f, w, seed, cover_p=0.85):
+    """Bases/cover/ref/cmask/elig shaped like a duplex encode batch, with
+    empty rows, single-column reads, and full-width rows mixed in — the
+    convert/extend edge surface."""
+    rng = np.random.default_rng(seed)
+    bases = np.full((f, 4, w), 4, np.int8)
+    cover = np.zeros((f, 4, w), bool)
+    for fi in range(f):
+        for row in range(4):
+            u = rng.random()
+            if u < 0.08:
+                continue  # empty row
+            if u < 0.16:
+                a = int(rng.integers(0, w))
+                b = a + 1  # single-column read
+            elif u < 0.24:
+                a, b = 0, w  # full-width (first=0: no prepend room)
+            else:
+                a = int(rng.integers(0, w - 4))
+                b = int(rng.integers(a + 2, w + 1))
+            cover[fi, row, a:b] = True
+            bases[fi, row, a:b] = rng.integers(0, 4, b - a)
+    ref = rng.integers(0, 4, (f, w + 1)).astype(np.int8)
+    cmask = rng.random((f, 4)) < 0.6
+    elig = rng.random(f) < 0.8
+    return bases, cover, ref, cmask, elig
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_native_strand_calls_match_hosttwin(seed):
+    from bsseqconsensusreads_tpu.ops import hosttwin
+
+    f, w = 53, 40 + seed
+    bases, cover, ref, cmask, elig = _random_transform_batch(f, w, seed)
+    want, _cov = hosttwin.strand_call_planes(bases, cover, ref, cmask, elig)
+    got = wirepack.strand_calls(bases, cover, ref, cmask, elig)
+    assert np.array_equal(got, want)
+
+
+def test_native_sort_matches_python_key():
+    import random
+    import struct
+
+    from bsseqconsensusreads_tpu.io.bam import BamRecord, CMATCH, encode_record
+    from bsseqconsensusreads_tpu.pipeline.extsort import raw_coordinate_key
+
+    rng = random.Random(5)
+    blobs = []
+    for i in range(4000):
+        ln = rng.choice((4, 8))
+        r = BamRecord(
+            qname=f"q{rng.randrange(30)}" + "z" * rng.randrange(2),
+            flag=rng.choice((99, 147, 83, 163)),
+            ref_id=rng.choice((-1, 0, 1)),
+            pos=rng.choice((-1, rng.randrange(200))),
+            mapq=60, cigar=[(CMATCH, ln)], seq="ACGT" * (ln // 4),
+            qual=bytes([30] * ln),
+        )
+        blobs.append(encode_record(r))
+    want = sorted(blobs, key=raw_coordinate_key)  # stable, like the C sort
+    got_blob, n, key_s, sort_s = wirepack.sort_raw_records(b"".join(blobs))
+    assert n == len(blobs) and key_s >= 0.0 and sort_s >= 0.0
+    got, off = [], 0
+    while off < len(got_blob):
+        (size,) = struct.unpack_from("<i", got_blob, off)
+        got.append(got_blob[off : off + 4 + size])
+        off += 4 + size
+    assert got == want
+
+
+def test_native_sort_rejects_corrupt_frame():
+    with pytest.raises(ValueError, match="malformed record frame"):
+        wirepack.sort_raw_records(b"\x03\x00\x00\x00abc")
+
+
+@pytest.mark.parametrize("cocall", [True, False])
+@pytest.mark.parametrize("t", [1, 2, 5])
+def test_native_bcount_sparse_matches_numpy_chain(cocall, t):
+    from bsseqconsensusreads_tpu.models.molecular import (
+        molecular_base_counts,
+        sparsify_base_counts,
+    )
+    from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+    rng = np.random.default_rng(100 * t + cocall)
+    f, w = 31, 24
+    bases = np.where(
+        rng.random((f, t, 2, w)) < 0.75, rng.integers(0, 4, (f, t, 2, w)), 4
+    ).astype(np.int8)
+    quals = np.where(
+        bases != 4, rng.choice(np.array([2, 12, 23, 37]), (f, t, 2, w)), 0
+    ).astype(np.uint8)
+    cons = np.where(
+        rng.random((f, 2, w)) < 0.8, rng.integers(0, 4, (f, 2, w)), 4
+    ).astype(np.int8)
+    params = ConsensusParams(
+        min_reads=0, consensus_call_overlapping_bases=cocall
+    )
+    want = sparsify_base_counts(
+        molecular_base_counts(bases, quals, params), cons
+    )
+    got = wirepack.bcount_sparse(bases, quals, cons, params)
+    assert np.array_equal(got, want)

@@ -204,6 +204,17 @@ SCENARIOS = [
         "expect": [("ledger", "batch_retry", 1)],
     },
     {
+        # ISSUE 6: the same spill fault with the raw sort pinned to the
+        # NATIVE engine (C key-extract/sort + C k-way merge) — the
+        # retried run rewrite must leave the merged output
+        # byte-identical, proving the failpoint/retry/CRC contract
+        # carried over to the native record path
+        "name": "native_sort_spill_io_error",
+        "failpoints": "extsort_spill=io_error:times=1",
+        "env": {"BSSEQ_TPU_SORT_ENGINE": "native"},
+        "expect": [("ledger", "batch_retry", 1)],
+    },
+    {
         # ISSUE 4: a fault INSIDE a host-pool task (worker-side
         # fetch/rawize/emit) is retried by the task's own guarded
         # wrapper — byte-identity proves the ordered retire replays it

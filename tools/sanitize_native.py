@@ -42,6 +42,7 @@ TSAN_STRESS = os.path.join(REPO, "tools", "tsan_stress.py")
 SANITIZERS = {
     "asan": {
         "target": "libbamio_asan.so",
+        "wirepack_target": "libwirepack_asan.so",
         "runtime": "libasan.so",
         "opt_var": "ASAN_OPTIONS",
         "opts": "detect_leaks=0",
@@ -49,6 +50,7 @@ SANITIZERS = {
     },
     "ubsan": {
         "target": "libbamio_ubsan.so",
+        "wirepack_target": "libwirepack_ubsan.so",
         "runtime": "libubsan.so",
         "opt_var": "UBSAN_OPTIONS",
         "opts": "print_stacktrace=1",
@@ -73,7 +75,8 @@ def _run_one(name: str, spec: dict, rounds: int, timeout: int) -> dict:
     workdir = tempfile.mkdtemp(prefix=f"bsseq_{name}_")
     try:
         mk = subprocess.run(
-            ["make", "-C", os.path.join(REPO, "native"), spec["target"]],
+            ["make", "-C", os.path.join(REPO, "native"), spec["target"],
+             spec["wirepack_target"]],
             capture_output=True, text=True, timeout=300,
         )
         if mk.returncode != 0:
@@ -84,6 +87,7 @@ def _run_one(name: str, spec: dict, rounds: int, timeout: int) -> dict:
             os.environ,
             LD_PRELOAD=_runtime_path(spec["runtime"]),
             BSSEQ_TPU_BAMIO_SO=spec["target"],
+            BSSEQ_TPU_WIREPACK_SO=spec["wirepack_target"],
             BSSEQ_TPU_BGZF_THREADS="4",
             PYTHONPATH=REPO
             + (os.pathsep + os.environ.get("PYTHONPATH", "")
@@ -139,6 +143,8 @@ def main() -> int:
             "MtInflate worker pool (3 concurrent readers x 4 workers)",
             "columnar parser over mt-inflated stream",
             "MtWriter deflate pool under concurrent readers",
+            "native raw sort (wirepack key-extract/sort) + "
+            "bamio_merge_runs k-way merge through the mt writer",
         ],
     }
     names = [args.only] if args.only else sorted(SANITIZERS)

@@ -150,6 +150,10 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
     gen_s = time.monotonic() - t0
     gen_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
+    # engine overrides for the A-B identity leg (--verify-identity):
+    # BSSEQ_SCALE_EMIT pins the record emitter, BSSEQ_TPU_SORT_ENGINE
+    # (read by pipeline.extsort.resolve_sort_engine) the raw sort
+    emit_engine = os.environ.get("BSSEQ_SCALE_EMIT", "auto")
     cfg = FrameworkConfig(
         genome_dir=workdir,
         genome_fasta_file_name="genome.fa",
@@ -161,15 +165,27 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         # (the pre-merge pass re-reads/re-writes the whole stage output)
         sort_buffer_records=200_000,
         batch_families=2048,
+        emit=emit_engine,
     )
     t0 = time.monotonic()
     target, results, stats = run_pipeline(
         cfg, bam, outdir=os.path.join(workdir, "output")
     )
     pipe_s = time.monotonic() - t0
+    import hashlib
+
+    from bsseqconsensusreads_tpu.pipeline.extsort import resolve_sort_engine
+
+    sha = hashlib.sha256()
+    with open(target, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 22), b""):
+            sha.update(chunk)
     out = {
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
+        "emit_engine": emit_engine,
+        "sort_engine": resolve_sort_engine(cfg.sort_engine),
+        "output_sha256": sha.hexdigest(),
         "n_families": n_families,
         "n_records": n_records,
         "input_bytes": os.path.getsize(bam),
@@ -223,6 +239,14 @@ def main() -> int:
         "exercises the full standalone path: GroupReadsByUmi-equivalent "
         "pre-stage (auto-prepended) -> molecular -> duplex",
     )
+    ap.add_argument(
+        "--verify-identity", type=int, default=0, metavar="FAMILIES",
+        help="before the main run, run the pipeline TWICE at this family "
+        "count — once with the python emit+sort engines, once with the "
+        "native ones — and record whether the final BAMs are "
+        "byte-identical (the ISSUE-6 engine-parity evidence, at a "
+        "tractable scale; 0 = skip)",
+    )
     args = ap.parse_args()
     if not args.out:
         if args.backend == "tpu":
@@ -258,6 +282,36 @@ def main() -> int:
         child_env["BSSEQ_TPU_BACKEND"] = "cpu"
     else:
         child_env.pop("BSSEQ_TPU_BACKEND", None)
+    if args.verify_identity > 0:
+        ident: dict = {"families": args.verify_identity, "shas": {}}
+        for eng in ("python", "native"):
+            vdir = os.path.join(workdir, f"verify_{eng}")
+            os.makedirs(vdir, exist_ok=True)
+            venv = dict(
+                child_env,
+                BSSEQ_SCALE_EMIT=eng,
+                BSSEQ_TPU_SORT_ENGINE=eng,
+            )
+            try:
+                vp = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--child",
+                     vdir, str(args.verify_identity)]
+                    + (["--raw-umis"] if args.raw_umis else [])
+                    + (["--tpu"] if args.backend == "tpu" else []),
+                    stdout=subprocess.PIPE, text=True,
+                    timeout=args.timeout, env=venv,
+                )
+                child = json.loads(vp.stdout.strip().splitlines()[-1])
+                ident["shas"][eng] = child.get("output_sha256")
+            except Exception as exc:  # identity leg must not kill the run
+                ident["shas"][eng] = f"error: {exc}"
+            shutil.rmtree(vdir, ignore_errors=True)
+        shas = list(ident["shas"].values())
+        ident["identical"] = (
+            len(shas) == 2 and shas[0] == shas[1]
+            and not str(shas[0]).startswith("error")
+        )
+        report["engine_identity"] = ident
     try:
         cp = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", workdir,

@@ -42,7 +42,12 @@ def _child(workdir: str, rounds: int) -> None:
     import numpy as np
 
     from bsseqconsensusreads_tpu.io import native
-    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter, BamReader
+    from bsseqconsensusreads_tpu.io.bam import (
+        BamHeader,
+        BamReader,
+        BamWriter,
+        encode_record,
+    )
     from bsseqconsensusreads_tpu.utils.testing import (
         make_grouped_bam_records,
         random_genome,
@@ -85,9 +90,33 @@ def _child(workdir: str, rounds: int) -> None:
         except Exception as e:
             errors.append(f"writer: {e!r}")
 
+    def sort_merge_loop() -> None:
+        """ISSUE 6 surface: the native raw sort's spill writes (mt
+        writer), CRC re-reads, and the C k-way merge (bamio_merge_runs
+        reading several Readers while writing through the mt deflate
+        pool) — under concurrency with the reader/writer loops."""
+        try:
+            from bsseqconsensusreads_tpu.pipeline.extsort import (
+                external_sort_raw_to_writer,
+            )
+
+            blobs = [encode_record(r) for r in records[:300]]
+            for k in range(rounds):
+                dst = os.path.join(workdir, f"sorted{k % 2}.bam")
+                with BamWriter(dst, header) as w:
+                    external_sort_raw_to_writer(
+                        iter(blobs), w, header, workdir=workdir,
+                        buffer_records=64, engine="native",
+                    )
+        except Exception as e:
+            errors.append(f"sorter: {e!r}")
+
     threads = [
         threading.Thread(target=read_loop, args=(i,)) for i in range(3)
-    ] + [threading.Thread(target=write_loop)]
+    ] + [
+        threading.Thread(target=write_loop),
+        threading.Thread(target=sort_merge_loop),
+    ]
     for t in threads:
         t.start()
     for t in threads:
@@ -153,6 +182,8 @@ def main() -> int:
             "MtInflate worker pool (3 concurrent readers x 4 workers)",
             "columnar parser over mt-inflated stream",
             "MtWriter deflate pool under concurrent readers",
+            "native raw sort: spill writes + CRC re-reads + "
+            "bamio_merge_runs k-way merge through the mt writer",
         ]
         # rc 66 = TSan found races (exitcode option); any other nonzero
         # is a functional child failure
