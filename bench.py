@@ -860,6 +860,42 @@ def _run_fuzz_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_serve_quick() -> dict | None:
+    """tools/serve_loadgen.py --quick -> SERVE_HEAD.json: the resident-
+    engine artifact (Poisson arrivals against a live `cli serve`
+    process; jobs/hour + p50/p99 with every tenant byte-identical to
+    its standalone run and batches_shared_jobs > 0). Best-effort and
+    cpu-pinned like the chaos drill. BSSEQ_BENCH_SERVE=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_SERVE", "1") == "0":
+        return None
+    loadgen = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "serve_loadgen.py",
+    )
+    out_path = os.path.join(os.getcwd(), "SERVE_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, loadgen, "--quick", "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_SERVE_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "jobs_per_hour": data.get("jobs_per_hour"),
+            "latency_p50_s": data.get("latency_p50_s"),
+            "latency_p99_s": data.get("latency_p99_s"),
+            "batches_shared_jobs": data.get("batches_shared_jobs"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -1015,6 +1051,14 @@ def main() -> None:
         observe.emit(
             "bench_ingest_fuzz",
             {"ok": fuzz.get("ok"), "path": fuzz.get("path")},
+            sink=ledger_sink,
+        )
+    serve = _run_serve_quick()
+    if serve is not None:
+        out["serve"] = serve
+        observe.emit(
+            "bench_serve_loadgen",
+            {"ok": serve.get("ok"), "path": serve.get("path")},
             sink=ledger_sink,
         )
     observe.flush_sinks()

@@ -103,6 +103,9 @@ class SourceFile:
                 self.parents[child] = parent
         self.line_suppress: dict[int, set[str]] = {}
         self.file_suppress: set[str] = set()
+        #: lines whose Thread(...) call is a declared single-owner
+        #: thread (`# graftlint: owned-thread`) — not a worker root
+        self.owned_thread_lines: set[int] = set()
         self._scan_suppressions(set(known_rules))
 
     # -- suppressions ----------------------------------------------------
@@ -111,7 +114,13 @@ class SourceFile:
         """tokenize pass: `# graftlint: disable=a,b` binds to its own
         line; on a standalone comment line it binds to the next code
         line instead. `disable-file=` covers the whole file. Unknown
-        rule names raise — a typo must not silently disable nothing."""
+        rule names raise — a typo must not silently disable nothing.
+
+        `# graftlint: owned-thread -- why` on a Thread(...) call
+        declares a single-owner thread: its target owns its state for
+        the thread's whole life (a resident engine loop, a per-job
+        reader), so the instance-blind worker-reachability closure must
+        not treat it as one of N racing pool workers."""
         code_lines: set[int] = set()
         comments: list[tuple[int, bool, str]] = []  # line, standalone, text
         tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
@@ -145,11 +154,18 @@ class SourceFile:
             elif directive.startswith("disable="):
                 names = directive[len("disable="):]
                 target = None  # line-scoped, resolved below
+            elif directive == "owned-thread":
+                bind = line
+                if standalone:  # applies to the next code line
+                    later = [ln for ln in code_lines if ln > line]
+                    bind = min(later) if later else line
+                self.owned_thread_lines.add(bind)
+                continue
             else:
                 raise LintError(
                     f"{self.display}:{line}: bad graftlint directive "
-                    f"{body!r} (want disable=<rule[,rule]> or "
-                    f"disable-file=<rule[,rule]>)"
+                    f"{body!r} (want disable=<rule[,rule]>, "
+                    f"disable-file=<rule[,rule]>, or owned-thread)"
                 )
             rules = {n.strip() for n in names.split(",") if n.strip()}
             unknown = rules - known
@@ -435,6 +451,11 @@ class PackageIndex:
                     continue
                 base = call_basename(node)
                 if base == "Thread":
+                    span = range(
+                        node.lineno, (node.end_lineno or node.lineno) + 1
+                    )
+                    if any(ln in sf.owned_thread_lines for ln in span):
+                        continue  # declared single-owner, not a worker
                     for kw in node.keywords:
                         if kw.arg == "target":
                             resolve(kw.value)
@@ -491,12 +512,13 @@ def all_rules() -> dict[str, Rule]:
         rules_io,
         rules_jax,
         rules_retry,
+        rules_serve,
         rules_thread,
     )
 
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
-                rules_hostphase, rules_input, rules_emit):
+                rules_hostphase, rules_input, rules_emit, rules_serve):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

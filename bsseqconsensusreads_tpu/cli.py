@@ -429,19 +429,28 @@ def cmd_observe(args) -> int:
     """Run-ledger consumer (utils.ledger_tools): summarize / diff / check
     over BSSEQ_TPU_STATS JSONL ledgers. `check` exits non-zero on any
     schema or closure-invariant violation so CI and round verdicts can
-    gate on ledger integrity instead of re-deriving the numbers."""
+    gate on ledger integrity instead of re-deriving the numbers.
+
+    --job (summarize) / --job-a/--job-b (diff) scope the view to one
+    serve tenant's lines, so a job served from a shared ledger can be
+    compared 1:1 against its standalone-run ledger."""
     from bsseqconsensusreads_tpu.utils import ledger_tools
 
     try:
         if args.op == "summarize":
             s = ledger_tools.summarize_ledger(
-                args.ledger, rel_tol=args.tolerance
+                args.ledger, rel_tol=args.tolerance,
+                job=args.job or None,
             )
             print(ledger_tools.format_summary(s))
             return 0 if s.ok else 1
         if args.op == "diff":
-            a = ledger_tools.summarize_ledger(args.ledger_a)
-            b = ledger_tools.summarize_ledger(args.ledger_b)
+            a = ledger_tools.summarize_ledger(
+                args.ledger_a, job=args.job_a or None
+            )
+            b = ledger_tools.summarize_ledger(
+                args.ledger_b, job=args.job_b or None
+            )
             print(ledger_tools.format_diff(a, b))
             return 0
         problems = ledger_tools.check_ledger(
@@ -510,6 +519,109 @@ def cmd_lint(args) -> int:
             print(f.format())
         print(f"{len(findings)} finding(s)")
     return 1 if findings else 0
+
+
+def cmd_serve(args) -> int:
+    """graftserve: the resident consensus engine (serve/). Holds warm
+    jitted kernels + the hostpool across jobs, accepts BAM jobs over a
+    local unix socket (`cli submit`), packs families from different
+    jobs into shared device batches, and demultiplexes at retire so
+    each job's output is byte-identical to a standalone
+    `cli molecular --batching sequential` run. SIGTERM/SIGINT drain
+    gracefully: admitted jobs finish, then the process exits 0."""
+    import signal
+
+    from bsseqconsensusreads_tpu.serve.server import ServeEngine, ServeServer
+
+    _arm_failpoints(args)
+    observe.open_ledger(component="serve")
+    engine = ServeEngine(
+        params=_params(args),
+        mode=args.mode,
+        batch_families=args.batch_families,
+        max_window=args.max_window,
+        grouping=args.grouping,
+        indel_policy=args.indel_policy,
+        vote_kernel=args.vote_kernel,
+        transport=args.transport,
+        max_active=args.max_active,
+        stride=args.stride,
+        idle_wait_s=args.idle_flush_ms / 1000.0,
+        max_pending=args.max_pending,
+    )
+    if args.warmup:
+        engine.warmup()
+    engine.start()
+    server = ServeServer(engine, args.socket)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: server.request_drain())
+    server.serve_forever()
+    observe.emit_stage_stats({"serve-cli": engine.scheduler.stats})
+    observe.flush_sinks()
+    states: dict[str, int] = {}
+    for j in engine.queue.jobs():
+        states[j.state] = states.get(j.state, 0) + 1
+    observe.stderr_line(json.dumps(
+        {"jobs": states, **engine.scheduler.counters()}
+    ))
+    return 0
+
+
+def cmd_submit(args) -> int:
+    """Client half of the serve protocol: submit one BAM job to a
+    running `cli serve` engine; --wait blocks until the job retires and
+    exits non-zero if it failed."""
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    spec = {
+        "input": args.input,
+        "output": args.output,
+        "policy": args.policy or None,
+        "grouping": args.grouping or None,
+        "ingest": args.ingest,
+    }
+    try:
+        resp = request(args.socket, {"op": "submit", "spec": spec})
+        if not resp.get("ok"):
+            observe.stderr_line(f"submit refused: {resp.get('error')}")
+            return 3
+        job = resp["job"]
+        if args.wait:
+            resp = request(
+                args.socket,
+                {"op": "wait", "job": job["id"], "timeout": args.timeout},
+                timeout=args.timeout + 30.0,
+            )
+            job = resp.get("job", job)
+    except OSError as exc:
+        observe.stderr_line(f"submit: cannot reach {args.socket}: {exc}")
+        return 2
+    print(json.dumps(job))
+    if args.wait:
+        return 0 if job.get("state") == "done" else 1
+    return 0
+
+
+def cmd_serve_ctl(args) -> int:
+    """Operator half of the serve protocol: ping / stats / status /
+    drain against a running engine."""
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    payload: dict = {"op": args.op}
+    if args.op == "status":
+        if not args.job:
+            observe.stderr_line("serve-ctl status needs --job")
+            return 2
+        payload["job"] = args.job
+    if args.op == "drain":
+        payload["timeout"] = args.timeout
+    try:
+        resp = request(args.socket, payload, timeout=args.timeout + 30.0)
+    except OSError as exc:
+        observe.stderr_line(f"serve-ctl: cannot reach {args.socket}: {exc}")
+        return 2
+    print(json.dumps(resp))
+    return 0 if resp.get("ok") else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -645,6 +757,72 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_filter_mapped)
 
     p = sub.add_parser(
+        "serve",
+        help="resident consensus engine: warm kernels across jobs, "
+        "cross-job continuous batching, unix-socket submit protocol",
+    )
+    p.add_argument("--socket", required=True, help="unix socket path")
+    p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
+    p.add_argument(
+        "--indel-policy", choices=("drop", "align"), default="drop"
+    )
+    p.add_argument(
+        "--max-active", type=int, default=4,
+        help="jobs ingesting concurrently (each holds one reader thread)",
+    )
+    p.add_argument(
+        "--stride", type=int, default=8,
+        help="families pulled per job per round-robin pass",
+    )
+    p.add_argument(
+        "--idle-flush-ms", type=float, default=20.0,
+        help="idle wait before a partial chunk is flushed to the device "
+        "(continuous batching: latency under low load)",
+    )
+    p.add_argument(
+        "--max-pending", type=int, default=64,
+        help="bounded admission queue depth (submits beyond it block)",
+    )
+    p.add_argument(
+        "--warmup", action="store_true",
+        help="compile kernels on a synthetic family before accepting jobs",
+    )
+    _add_params(p, min_reads_default=1)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "submit", help="submit one BAM job to a running serve engine"
+    )
+    p.add_argument("--socket", required=True)
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "--policy", choices=("strict", "quarantine", "lenient", "off"),
+        default="",
+        help="graftguard policy for THIS job's ingest (default: the "
+        "server's BSSEQ_TPU_INPUT_POLICY)",
+    )
+    p.add_argument(
+        "--grouping", choices=("gather", "adjacent", "coordinate"),
+        default="", help="MI-group streaming strategy (default: server's)",
+    )
+    p.add_argument(
+        "--ingest", choices=("auto", "native", "python"), default="python"
+    )
+    p.add_argument("--wait", action="store_true", help="block until done")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser(
+        "serve-ctl", help="ping/stats/status/drain a running serve engine"
+    )
+    p.add_argument("op", choices=("ping", "stats", "status", "drain"))
+    p.add_argument("--socket", required=True)
+    p.add_argument("--job", default="")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.set_defaults(fn=cmd_serve_ctl)
+
+    p = sub.add_parser(
         "lint",
         help="graftlint static analysis: TPU-hostile / thread-unsafe "
         "code checkers over the package (or given paths)",
@@ -685,12 +863,24 @@ def main(argv: list[str] | None = None) -> int:
         "--tolerance", type=float, default=0.15,
         help="relative closure tolerance (unattributed share of the wall)",
     )
+    s.add_argument(
+        "--job", default="",
+        help="scope to one serve tenant's lines (job id)",
+    )
     s.set_defaults(fn=cmd_observe)
     d = op.add_parser(
         "diff", help="two ledgers side by side with B/A ratios"
     )
     d.add_argument("ledger_a")
     d.add_argument("ledger_b")
+    d.add_argument(
+        "--job-a", default="",
+        help="scope ledger A to one serve tenant (job id)",
+    )
+    d.add_argument(
+        "--job-b", default="",
+        help="scope ledger B to one serve tenant (job id)",
+    )
     d.set_defaults(fn=cmd_observe)
     c = op.add_parser(
         "check",
@@ -702,6 +892,9 @@ def main(argv: list[str] | None = None) -> int:
     c.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
+    from bsseqconsensusreads_tpu.utils import compilecache
+
+    compilecache.maybe_enable()  # BSSEQ_TPU_COMPILE_CACHE_DIR, if set
     try:
         return args.fn(args)
     except _guard.GuardError as e:

@@ -26,6 +26,8 @@ Arguments (colon-separated `k=v` after the action)
 Predicates (each `@k=v` must match the fire() call's context)
     @batch=<int>        only when the site reports that batch index
     @stage=<name>       only when the site reports that stage
+    @job=<id>           only when the site reports that serve job id
+                        (the serve_* sites — targets ONE tenant)
     @hit=<int>          only on the Nth predicate-matching hit
 
 Examples (the grammar of ISSUE 3):
@@ -75,6 +77,12 @@ SITES = frozenset(
         # parallel.multihost — liveness + collectives
         "multihost_heartbeat",
         "multihost_collective",
+        # serve — resident engine: job admission, per-job ingest pump,
+        # shared-batch retire/demux (predicate @job=<id> targets one
+        # tenant, proving cross-tenant isolation in the chaos drill)
+        "serve_submit",
+        "serve_ingest",
+        "serve_retire",
     }
 )
 
@@ -112,6 +120,7 @@ class FailPoint:
     times: int | None = None
     batch: int | None = None
     stage: str | None = None
+    job: str | None = None
     hit: int | None = None
     spec: str = ""
     _hits: int = 0
@@ -125,6 +134,8 @@ class FailPoint:
         if self.batch is not None and ctx.get("batch") != self.batch:
             return False
         if self.stage is not None and ctx.get("stage") != self.stage:
+            return False
+        if self.job is not None and ctx.get("job") != self.job:
             return False
         return True
 
@@ -243,12 +254,14 @@ def parse_schedule(spec: str) -> list[FailPoint]:
                 fp.batch = _parse_int("batch", v, term)
             elif k == "stage":
                 fp.stage = v
+            elif k == "job":
+                fp.job = v
             elif k == "hit":
                 fp.hit = _parse_int("hit", v, term)
             else:
                 raise FailpointError(
                     f"unknown predicate {k!r} in {term!r} "
-                    "(want batch|stage|hit)"
+                    "(want batch|stage|job|hit)"
                 )
         fp.__post_init__()  # re-seed after arg parse set .seed
         points.append(fp)
@@ -309,7 +322,10 @@ def fire(site: str, **ctx) -> None:
                 "site": site,
                 "action": fp.action,
                 "spec": fp.spec,
-                **{k: v for k, v in ctx.items() if k in ("batch", "stage")},
+                **{
+                    k: v for k, v in ctx.items()
+                    if k in ("batch", "stage", "job")
+                },
             },
         )
         _act(fp, site)

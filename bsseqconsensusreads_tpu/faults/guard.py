@@ -415,9 +415,13 @@ class Guard:
 
     def __init__(self, policy: str | None = None, stats=None,
                  max_family_records: int | None = None,
-                 max_read_len: int | None = None):
+                 max_read_len: int | None = None,
+                 job: str | None = None):
         self.policy = resolve_policy(policy)
         self.stats = stats
+        #: serve tenancy: a job-bound guard tags its ledger events so a
+        #: shared serve ledger attributes quarantines to the right tenant
+        self.job = job
         self.max_family_records = (
             max_family_records
             if max_family_records is not None
@@ -486,7 +490,7 @@ class Guard:
     def _emit(self, event: str, payload: dict) -> None:
         if self._event_budget > 0:
             self._event_budget -= 1
-            observe.emit(event, payload)
+            observe.emit(event, payload, job=self.job)
         else:
             self._events_dropped += 1
 
@@ -580,7 +584,7 @@ class Guard:
         if self._events_dropped:
             observe.emit("guard_events_truncated", {
                 "input": self.input_path, "dropped": self._events_dropped,
-            })
+            }, job=self.job)
             self._events_dropped = 0
         if self._sidecar is not None:
             self._sidecar.close()

@@ -66,6 +66,7 @@ from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.faults import guard as _guard_mod
 from bsseqconsensusreads_tpu.faults import retry as _faultretry
 from bsseqconsensusreads_tpu.parallel import hostpool as _hostpool
+from bsseqconsensusreads_tpu.utils import compilecache as _compilecache
 from bsseqconsensusreads_tpu.utils import observe
 
 from bsseqconsensusreads_tpu.io.fastq import reverse_complement as _revcomp
@@ -638,6 +639,36 @@ class StageStats:
         }
 
 
+#: Batch-composition flush sentinel: a GroupSource may interleave this
+#: between families to cut the chunk under composition immediately (the
+#: serve scheduler's continuous-batching partial flush — resident
+#: families retire on an idle queue instead of waiting for a full
+#: chunk). _group_batches / _group_batches_bucketed consume it; the
+#: sentinel itself never reaches encode. In sequential batching a flush
+#: on an EMPTY buffer yields an empty chunk — a sync barrier whose
+#: "now" event drains the deferred-retire pipeline.
+FLUSH_BATCH = object()
+
+
+class GroupSource:
+    """A pre-grouped batch-composition source: an iterable of
+    (mi, records) families, optionally interleaved with FLUSH_BATCH
+    sentinels. stream_mi_groups passes it through ungrouped, so one
+    engine call can be fed families composed OUTSIDE the caller — the
+    serve scheduler's multi-job source, which merges per-job
+    stream_mi_groups streams and tags each family's mi with its job
+    (serve.scheduler.JobMi, a str subclass: identical bytes on the wire
+    and in the emitted qname, recoverable provenance at demux)."""
+
+    __slots__ = ("groups",)
+
+    def __init__(self, groups: Iterable):
+        self.groups = groups
+
+    def __iter__(self):
+        return iter(self.groups)
+
+
 def stream_mi_groups(
     records: Iterable[BamRecord],
     strip_suffix: bool = False,
@@ -670,6 +701,19 @@ def stream_mi_groups(
     through; its grouping and strip_suffix must match this call's, and
     flush_margin too in 'coordinate' mode ('adjacent' never reads it).
     """
+    if isinstance(records, GroupSource):
+        # pre-grouped multi-job source: families (and FLUSH_BATCH
+        # sentinels) pass straight to batch composition; record counts
+        # still accrue so the shared engine's ledger closes
+        for item in records:
+            if item is FLUSH_BATCH:
+                yield item
+                continue
+            if stats is not None:
+                _, recs = item
+                stats.records_in += len(recs)
+            yield item
+        return
     iter_groups = getattr(records, "iter_groups", None)
     if iter_groups is not None:
         stream_grouping = getattr(records, "grouping", "coordinate")
@@ -801,6 +845,15 @@ def _group_batches(
 ) -> Iterator[list[tuple[str, list[BamRecord]]]]:
     buf: list[tuple[str, list[BamRecord]]] = []
     for g in groups:
+        if g is FLUSH_BATCH:
+            # cut the partial chunk now; with an empty buffer this yields
+            # an EMPTY chunk — a sync barrier ("now" event) that drains
+            # the deferred-retire pipeline, so a lone in-flight batch
+            # retires on an idle queue instead of waiting for the next
+            # chunk (the serve scheduler's low-load latency path)
+            yield buf
+            buf = []
+            continue
         buf.append(g)
         if len(buf) >= size:
             yield buf
@@ -837,6 +890,13 @@ def _group_batches_bucketed(
     counts: dict[int, int] = {}
     max_records = size * 8
     for g in groups:
+        if g is FLUSH_BATCH:
+            # composition flush (GroupSource): every open bucket cuts now,
+            # in deterministic bucket order
+            for b in sorted(pending):
+                yield pending.pop(b)
+                counts.pop(b)
+            continue
         # the indel-filtered distinct-qname count is what encode actually
         # materializes (a raw record count would put every R1+R2 cfDNA
         # family one bucket too high); an ingest.FamilyRun carries it
@@ -1759,6 +1819,7 @@ def call_molecular_batches(
             pool.shutdown(wait=True, cancel_futures=True)
         if hpool is not None:
             hpool.shutdown()
+    _compilecache.publish(stats.metrics)
     stats.wall_seconds += time.monotonic() - t0
 
 
@@ -2323,6 +2384,7 @@ def call_duplex_batches(
             pool.shutdown(wait=True, cancel_futures=True)
         if hpool is not None:
             hpool.shutdown()
+    _compilecache.publish(stats.metrics)
     stats.wall_seconds += time.monotonic() - t0
 
 

@@ -789,7 +789,10 @@ def run_pipeline(
     invariant `observe check` enforces."""
     import time
 
+    from bsseqconsensusreads_tpu.utils import compilecache
+
     _apply_backend(cfg.backend)
+    compilecache.maybe_enable()
     builder = PipelineBuilder(cfg, bam_path, outdir)
     wf, target = builder.build()
     observe.open_ledger(

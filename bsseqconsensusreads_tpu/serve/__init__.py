@@ -1,0 +1,38 @@
+"""graftserve — the resident consensus engine (ISSUE 8).
+
+A long-lived process holds the expensive capital a one-shot CLI run
+rebuilds every time — warm jitted kernels, the persistent compile
+cache, the hostpool — and amortizes it across many BAM jobs submitted
+over a local socket. The three layers:
+
+    jobs.py       job specs, graftguard admission, fingerprinting,
+                  the bounded submission queue
+    scheduler.py  continuous batching: families from DIFFERENT jobs
+                  packed into the same device batch, demultiplexed at
+                  retire by per-family job provenance (JobMi)
+    server.py     ServeEngine (in-process API) + ServeServer (unix
+                  socket JSONL protocol) + client helpers
+
+Identity contract: each job's output BAM is byte-identical to a
+standalone `cli molecular --batching sequential` run of the same
+input (README "Serving"); isolation contract: one tenant's corrupt
+input, family bomb, or stall never blocks another tenant's retirement
+(tools/chaos_drill.py serve scenarios).
+"""
+
+from bsseqconsensusreads_tpu.serve.jobs import (  # noqa: F401
+    AdmissionError,
+    Job,
+    JobQueue,
+    JobSpec,
+    QueueClosed,
+)
+from bsseqconsensusreads_tpu.serve.scheduler import (  # noqa: F401
+    JobMi,
+    Scheduler,
+)
+from bsseqconsensusreads_tpu.serve.server import (  # noqa: F401
+    ServeEngine,
+    ServeServer,
+    request,
+)

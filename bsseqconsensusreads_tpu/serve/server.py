@@ -1,0 +1,314 @@
+"""ServeEngine (in-process API) + ServeServer (unix-socket protocol).
+
+The engine is the embeddable form — tests and the tier-1 smoke drive
+it directly: submit/wait/drain with no sockets. The server wraps it in
+a local unix-socket JSONL protocol for `cli submit` / `cli serve-ctl`:
+
+    one connection = one request = one JSON line each way
+
+    {"op": "ping"}                          → {"ok": true, "pong": true}
+    {"op": "submit", "spec": {...JobSpec}}  → {"ok": true, "job": {...}}
+    {"op": "status", "job": "j0001"}        → {"ok": true, "job": {...}}
+    {"op": "wait", "job": "j0001",
+     "timeout": 600}                        → {"ok": true, "job": {...}}
+    {"op": "stats"}                         → {"ok": true, "stats": {...}}
+    {"op": "drain", "timeout": 600}         → {"ok": true, "drained": b}
+                                              (server exits afterwards)
+
+Admission failures answer {"ok": false, "error": ...} — a refused job
+is the submitter's problem, never the server's. SIGTERM/SIGINT request
+a graceful drain: stop admitting, finish every admitted job, exit 0
+(tests/test_serve.py proves no job is lost).
+
+The accept loop polls with a socket timeout and each connection rides
+its own daemon thread, so a tenant parked on a long `wait` never
+blocks another tenant's submit (and the blocking-scheduler-loop lint
+rule holds the loop itself to bounded waits).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from bsseqconsensusreads_tpu.serve import jobs as _jobs
+from bsseqconsensusreads_tpu.serve import scheduler as _scheduler
+from bsseqconsensusreads_tpu.utils import compilecache as _compilecache
+from bsseqconsensusreads_tpu.utils import observe
+
+
+class ServeEngine:
+    """The resident engine: one JobQueue + one Scheduler, warm across
+    jobs. Construct, `start()`, then submit/wait from any thread."""
+
+    def __init__(
+        self,
+        params=None,
+        *,
+        mode: str = "unaligned",
+        batch_families: int = 64,
+        max_window: int = 4096,
+        grouping: str = "coordinate",
+        indel_policy: str = "drop",
+        vote_kernel: str | None = None,
+        transport: str = "auto",
+        mesh="auto",
+        level: int = 6,
+        max_active: int = 4,
+        stride: int = 8,
+        idle_wait_s: float = 0.02,
+        max_pending: int = 64,
+    ):
+        if params is None:
+            from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+            params = ConsensusParams(min_reads=1)
+        _compilecache.maybe_enable()
+        self.queue = _jobs.JobQueue(max_pending=max_pending)
+        self.scheduler = _scheduler.Scheduler(
+            self.queue,
+            params,
+            mode=mode,
+            batch_families=batch_families,
+            max_window=max_window,
+            grouping=grouping,
+            indel_policy=indel_policy,
+            vote_kernel=vote_kernel,
+            transport=transport,
+            mesh=mesh,
+            level=level,
+            max_active=max_active,
+            stride=stride,
+            idle_wait_s=idle_wait_s,
+        )
+        self._started = False
+        self._start_lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "ServeEngine":
+        with self._start_lock:
+            if not self._started:
+                self._started = True
+                self.scheduler.start()
+        return self
+
+    def warmup(self) -> None:
+        """Compile the engine's kernels on a throwaway synthetic family
+        BEFORE the first tenant arrives (with BSSEQ_TPU_COMPILE_CACHE_DIR
+        set this is a cache load, not a compile). Runs a separate
+        one-shot engine call; the resident generator itself stays
+        untouched."""
+        import numpy as np
+
+        from bsseqconsensusreads_tpu.pipeline import calling as _calling
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+        )
+
+        rng = np.random.default_rng(0)
+        genome = "".join(
+            "ACGT"[i] for i in rng.integers(0, 4, size=400)
+        )
+        _, records = make_grouped_bam_records(
+            rng, "warm", genome, n_families=2, reads_per_strand=(2, 2),
+            read_len=30,
+        )
+        stats = _calling.StageStats(stage="warmup")
+        for _ in _calling.call_molecular_batches(
+            records,
+            params=self.scheduler.params,
+            mode="unaligned",
+            batch_families=4,
+            max_window=self.scheduler.max_window,
+            grouping="gather",
+            stats=stats,
+            emit="python",
+            batching="sequential",
+            transport=self.scheduler.transport,
+            indel_policy=self.scheduler.indel_policy,
+            vote_kernel=self.scheduler.vote_kernel,
+        ):
+            pass
+        observe.emit(
+            "serve_warmup",
+            {"families": stats.families, "batches": stats.batches},
+        )
+
+    def drain(self, timeout: float | None = None) -> bool:
+        return self.scheduler.drain(timeout=timeout)
+
+    def stop(self, timeout: float | None = 10.0) -> bool:
+        return self.scheduler.stop(timeout=timeout)
+
+    # -- job API ---------------------------------------------------------
+
+    def submit(self, spec) -> _jobs.Job:
+        if isinstance(spec, dict):
+            spec = _jobs.JobSpec.from_dict(spec)
+        job = self.queue.submit(spec)
+        self.scheduler._wake.set()
+        return job
+
+    def status(self, job_id: str) -> dict | None:
+        job = self.queue.get(job_id)
+        return None if job is None else job.status()
+
+    def wait(self, job_id: str, timeout: float | None = None) -> dict | None:
+        job = self.queue.get(job_id)
+        if job is None:
+            return None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not job.done.is_set():
+            job.done.wait(timeout=0.25)
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        return job.status()
+
+    def stats_dict(self) -> dict:
+        jobs = self.queue.jobs()
+        return {
+            "jobs": [j.status() for j in jobs],
+            "pending": self.queue.pending_count(),
+            "counters": self.scheduler.counters(),
+            "engine_alive": self.scheduler.alive,
+            "engine_error": self.scheduler.engine_error,
+        }
+
+
+class ServeServer:
+    """Unix-socket front of a ServeEngine. `serve_forever()` owns the
+    calling thread until a drain request (socket op or request_drain(),
+    e.g. from a SIGTERM handler) completes."""
+
+    def __init__(self, engine: ServeEngine, socket_path: str):
+        self.engine = engine
+        self.socket_path = socket_path
+        self._drain_requested = threading.Event()
+        self._drained = threading.Event()
+
+    def request_drain(self) -> None:
+        """Signal-handler safe: ask the accept loop to drain and exit."""
+        self._drain_requested.set()
+
+    def serve_forever(self) -> None:
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            sock.bind(self.socket_path)
+            sock.listen(16)
+            sock.settimeout(0.25)
+            observe.emit("serve_listening", {"socket": self.socket_path})
+            while not self._drain_requested.is_set():
+                try:
+                    conn, _ = sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                # graftlint: owned-thread -- one connection = one
+                # request; the handler owns conn and only calls the
+                # lock-guarded engine API
+                threading.Thread(
+                    target=self._handle, args=(conn,),
+                    name="serve-conn", daemon=True,
+                ).start()
+        finally:
+            sock.close()
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+        # graceful drain: every admitted job completes before we return
+        self.engine.drain(timeout=None)
+        self._drained.set()
+        observe.emit("serve_drained", {"socket": self.socket_path})
+        observe.flush_sinks()
+
+    # -- one connection = one request ------------------------------------
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10.0)
+            fh = conn.makefile("rwb")
+            line = fh.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line)
+                resp = self._dispatch(req)
+            except Exception as exc:  # protocol errors answer, not crash
+                resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+            conn.settimeout(10.0)
+            fh.write((json.dumps(resp) + "\n").encode())
+            fh.flush()
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "submit":
+            try:
+                job = self.engine.submit(req.get("spec") or {})
+            except (_jobs.AdmissionError, _jobs.QueueClosed) as exc:
+                return {"ok": False, "error": str(exc)}
+            return {"ok": True, "job": job.status()}
+        if op == "status":
+            st = self.engine.status(str(req.get("job")))
+            if st is None:
+                return {"ok": False, "error": f"unknown job {req.get('job')!r}"}
+            return {"ok": True, "job": st}
+        if op == "wait":
+            timeout = req.get("timeout")
+            st = self.engine.wait(
+                str(req.get("job")),
+                timeout=float(timeout) if timeout is not None else None,
+            )
+            if st is None:
+                return {"ok": False, "error": f"unknown job {req.get('job')!r}"}
+            return {"ok": st["state"] in (_jobs.DONE, _jobs.FAILED), "job": st}
+        if op == "stats":
+            return {"ok": True, "stats": self.engine.stats_dict()}
+        if op == "drain":
+            self._drain_requested.set()
+            timeout = req.get("timeout")
+            deadline = (
+                None if timeout is None
+                else time.monotonic() + float(timeout)
+            )
+            while not self._drained.is_set():
+                self._drained.wait(timeout=0.25)
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+            return {"ok": True, "drained": self._drained.is_set()}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+def request(socket_path: str, payload: dict, timeout: float = 600.0) -> dict:
+    """One client request against a running ServeServer."""
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        s.settimeout(timeout)
+        s.connect(socket_path)
+        fh = s.makefile("rwb")
+        fh.write((json.dumps(payload) + "\n").encode())
+        fh.flush()
+        line = fh.readline()
+    finally:
+        s.close()
+    if not line:
+        raise ConnectionError(f"no response from {socket_path}")
+    return json.loads(line)

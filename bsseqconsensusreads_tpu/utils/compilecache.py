@@ -1,0 +1,107 @@
+"""Persistent XLA compile cache: the BSSEQ_TPU_COMPILE_CACHE_DIR knob.
+
+Every cold process pays XLA compilation for each kernel shape it
+touches — the dominant share of serve warm-start and of short CLI
+reruns. When BSSEQ_TPU_COMPILE_CACHE_DIR is set, compiled executables
+persist there (jax's compilation cache) and are reloaded by any later
+process with the same backend + jaxlib + shape, so the serve engine's
+restart and ordinary `cli molecular`/`duplex` reruns skip compilation
+entirely.
+
+Accounting rides the run ledger: jax announces persistent-cache
+outcomes on its monitoring bus ('/jax/compilation_cache/cache_hits' /
+'cache_misses'); a listener registered at enable time tallies them and
+`publish(metrics)` books the delta into the active stage's counters as
+`compile_cache_hit` / `compile_cache_miss` — so a ledger can prove a
+rerun actually reused its capital (hit > 0, miss == 0) instead of
+silently recompiling.
+
+The knob is environment-driven like the rest of the framework
+(BSSEQ_TPU_STATS, BSSEQ_TPU_FAILPOINTS): `maybe_enable()` is called by
+the CLI entry point and the serve engine, is idempotent, and is a no-op
+when the variable is unset.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+ENV_DIR = "BSSEQ_TPU_COMPILE_CACHE_DIR"
+
+_LOCK = threading.Lock()
+_STATE = {
+    "enabled": False,
+    "hits": 0,
+    "misses": 0,
+    # already booked into some Metrics by publish() — the bus counters
+    # are process-global, stage bookings must not double-count
+    "published_hits": 0,
+    "published_misses": 0,
+}
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == _HIT_EVENT:
+        with _LOCK:
+            _STATE["hits"] += 1
+    elif event == _MISS_EVENT:
+        with _LOCK:
+            _STATE["misses"] += 1
+
+
+def maybe_enable() -> str | None:
+    """Point jax's persistent compilation cache at BSSEQ_TPU_COMPILE_CACHE_DIR
+    (created if missing) and start tallying hit/miss events. Idempotent;
+    returns the cache dir, or None when the knob is unset."""
+    directory = os.environ.get(ENV_DIR) or None
+    if directory is None:
+        return None
+    with _LOCK:
+        already = _STATE["enabled"]
+        _STATE["enabled"] = True
+    if already:
+        return directory
+    os.makedirs(directory, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", directory)
+    # cache every executable: the tier-1/CPU kernels compile in
+    # milliseconds and the default min-compile-time floor would skip
+    # them, making warm-start unobservable (and untestable) off-TPU
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    from jax._src import monitoring
+
+    monitoring.register_event_listener(_on_event)
+    return directory
+
+
+def enabled() -> bool:
+    with _LOCK:
+        return _STATE["enabled"]
+
+
+def counts() -> tuple[int, int]:
+    """(hits, misses) tallied so far in this process."""
+    with _LOCK:
+        return _STATE["hits"], _STATE["misses"]
+
+
+def publish(metrics) -> None:
+    """Book the unpublished hit/miss delta into `metrics` counters
+    (compile_cache_hit / compile_cache_miss). Called at stage end by the
+    batch callers and the serve engine; no-op while disabled, so the
+    counters only appear in ledgers of cache-enabled runs."""
+    with _LOCK:
+        if not _STATE["enabled"]:
+            return
+        dh = _STATE["hits"] - _STATE["published_hits"]
+        dm = _STATE["misses"] - _STATE["published_misses"]
+        _STATE["published_hits"] = _STATE["hits"]
+        _STATE["published_misses"] = _STATE["misses"]
+    metrics.count("compile_cache_hit", dh)
+    metrics.count("compile_cache_miss", dm)

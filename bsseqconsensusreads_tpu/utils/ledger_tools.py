@@ -36,6 +36,14 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "overlap_pool_disabled": ("reason",),
     "overlap_pool_enabled": ("workers",),
     "worker_heartbeat": ("process_index", "seq", "phase"),
+    # graftserve (serve/): per-tenant lines carry a 'job' field and are
+    # mirrored to BSSEQ_TPU_STATS_JOBS sub-sinks
+    "job_admitted": ("input", "output", "fingerprint"),
+    "job_complete": ("output", "families", "consensus_out"),
+    "job_failed": ("error",),
+    "serve_listening": ("socket",),
+    "serve_drained": ("socket",),
+    "serve_warmup": ("families",),
 }
 
 #: Default closure tolerance: relative share of the wall allowed to go
@@ -51,6 +59,7 @@ class LedgerError(RuntimeError):
 @dataclass
 class LedgerSummary:
     path: str = ""
+    job: str | None = None  # serve tenant the view is scoped to
     manifest: dict = field(default_factory=dict)
     stages: dict = field(default_factory=dict)  # stage -> stage_stats line
     rules: list = field(default_factory=list)  # rule_complete lines
@@ -58,6 +67,7 @@ class LedgerSummary:
     events: dict = field(default_factory=dict)  # event -> count
     notes: list = field(default_factory=list)  # overlap disables etc.
     problems: list = field(default_factory=list)  # schema/invariant breaks
+    jobs: dict = field(default_factory=dict)  # job id -> tagged-line count
 
     @property
     def ok(self) -> bool:
@@ -152,13 +162,39 @@ def summarize_ledger(
     path: str,
     rel_tol: float = CLOSURE_REL_TOL,
     abs_tol: float = CLOSURE_ABS_TOL,
+    job: str | None = None,
 ) -> LedgerSummary:
+    """Summarize one ledger.
+
+    job: scope the view to one serve tenant — only lines tagged with
+    that job id count (the run_manifest is kept for context). The
+    scoped view is a comparison surface, not a validation one, so the
+    whole-ledger schema checks are skipped (a BSSEQ_TPU_STATS_JOBS
+    sub-sink, which has no run_manifest, summarizes cleanly too).
+
+    Untargeted (job=None) views of a shared serve ledger tally
+    job-tagged lines per tenant in `.jobs` instead of merging them into
+    the engine's stages — one tenant's numbers never masquerade as the
+    run's."""
     lines, problems = parse_ledger(path)
-    s = LedgerSummary(path=path, problems=problems)
-    s.problems.extend(_schema_problems(lines))
+    s = LedgerSummary(path=path, job=job, problems=problems)
+    if job is None:
+        s.problems.extend(_schema_problems(lines))
     for d in lines:
         ev = d.get("event")
         if not isinstance(ev, str):
+            continue
+        line_job = d.get("job")
+        if job is not None:
+            if ev == "run_manifest":
+                if not s.manifest:
+                    s.manifest = d
+                continue
+            if line_job != job:
+                continue
+        elif line_job is not None:
+            s.jobs[str(line_job)] = s.jobs.get(str(line_job), 0) + 1
+            s.events[ev] = s.events.get(ev, 0) + 1
             continue
         s.events[ev] = s.events.get(ev, 0) + 1
         if ev == "run_manifest" and not s.manifest:
@@ -174,6 +210,8 @@ def summarize_ledger(
                 f"overlap pool disabled ({d.get('stage', '?')}): "
                 f"{d.get('reason', '?')}"
             )
+    if job is not None and not s.events:
+        s.problems.append(f"no ledger lines tagged job={job!r}")
     s.problems.extend(_closure_problems(s, rel_tol, abs_tol))
     return s
 
@@ -231,6 +269,13 @@ def format_summary(s: LedgerSummary) -> str:
             f" devices={m.get('device_count', '?')}"
             f" config={m.get('config_digest') or '-'}"
             f" component={m.get('component') or '-'}"
+        )
+    if s.job is not None:
+        out.append(f"scoped to job: {s.job}")
+    if s.jobs:
+        out.append(
+            f"serve jobs in ledger: {len(s.jobs)} "
+            f"({', '.join(sorted(s.jobs))}) — scope with --job"
         )
     if s.stages:
         rows = []

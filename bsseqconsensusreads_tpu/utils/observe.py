@@ -54,6 +54,27 @@ def stats_sink() -> str | None:
     return os.environ.get("BSSEQ_TPU_STATS") or None
 
 
+def job_sink_dir() -> str | None:
+    """Directory for per-job ledger sub-sinks (BSSEQ_TPU_STATS_JOBS):
+    when set, every job-tagged emit is mirrored to <dir>/<job>.jsonl —
+    one standalone-shaped ledger per tenant — in addition to carrying a
+    'job' field in the shared serve ledger."""
+    return os.environ.get("BSSEQ_TPU_STATS_JOBS") or None
+
+
+def job_sink(job: str) -> str | None:
+    """The sub-sink path for one job id, or None when sub-sinks are off.
+    Job ids are serve-assigned ([A-Za-z0-9_.-]); anything else is
+    sanitized so a hostile tag cannot traverse out of the directory."""
+    directory = job_sink_dir()
+    if directory is None:
+        return None
+    safe = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in str(job)
+    ) or "_"
+    return os.path.join(directory, f"{safe}.jsonl")
+
+
 def trace_dir() -> str | None:
     return os.environ.get("BSSEQ_TPU_TRACE") or None
 
@@ -162,19 +183,37 @@ def ledger_digest(sink: str | None = None) -> str | None:
     return w.digest() if w is not None and w.lines else None
 
 
-def emit(event: str, payload: dict, sink: str | None = None) -> None:
+def emit(
+    event: str, payload: dict, sink: str | None = None,
+    job: str | None = None,
+) -> None:
     """Write one JSON line {ts, event, **payload} to the configured sink.
     Worker-thread emits carry a 'thread' field so span/phase lines stay
-    attributable after the fact."""
+    attributable after the fact.
+
+    job: tag the line with a job id (the serve engine's per-tenant
+    sub-stream key — `observe summarize --job` / `diff` filter on it)
+    and mirror it to the job's sub-sink when BSSEQ_TPU_STATS_JOBS is
+    set. Job-tagged lines in the shared ledger are ignored by untargeted
+    summaries, so one serve ledger carries every tenant without
+    cross-talk."""
     sink = sink if sink is not None else stats_sink()
-    if sink is None:
+    sub = job_sink(job) if job is not None else None
+    if sink is None and sub is None:
         return
     record = {"ts": round(time.time(), 3), "event": event}
     cur = threading.current_thread()
     if cur is not threading.main_thread():
         record["thread"] = cur.name
     record.update(payload)
-    _writer(sink).write_line(json.dumps(record))
+    if job is not None:
+        record["job"] = job
+    line = json.dumps(record)
+    if sink is not None:
+        _writer(sink).write_line(line)
+    if sub is not None:
+        os.makedirs(os.path.dirname(sub), exist_ok=True)
+        _writer(sub).write_line(line)
 
 
 # ---------------------------------------------------------------------------
@@ -480,10 +519,12 @@ def maybe_trace(label: str, directory: str | None = None):
         yield
 
 
-def emit_stage_stats(stage_stats: dict, sample: str | None = None) -> None:
+def emit_stage_stats(
+    stage_stats: dict, sample: str | None = None, job: str | None = None
+) -> None:
     """Emit one 'stage_stats' line per pipeline stage (StageStats.as_dict)."""
     for stage, stats in stage_stats.items():
         payload = {"stage": stage, **stats.as_dict()}
         if sample:
             payload["sample"] = sample
-        emit("stage_stats", payload)
+        emit("stage_stats", payload, job=job)

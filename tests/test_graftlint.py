@@ -37,6 +37,7 @@ FIXTURES = {
     "serialized-host-phase": "fx_serialized_host_phase.py",
     "assert-on-input": "fx_assert_on_input.py",
     "per-record-alloc": "fx_per_record_alloc.py",
+    "blocking-scheduler-loop": "fx_blocking_scheduler_loop.py",
 }
 
 

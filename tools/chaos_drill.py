@@ -169,6 +169,94 @@ def _stage_counter(payload: dict, stage: str, key: str) -> int:
     return int(payload["stages"].get(stage, {}).get(key, 0) or 0)
 
 
+# ---------------------------------------------------------------------------
+# graftserve scenarios (ISSUE 8): multi-tenant fault isolation. The
+# universal byte-identity check above compares full-pipeline outputs;
+# the serve engine's contract is per-TENANT — each job identical to its
+# own standalone `cli molecular --batching sequential` run — so these
+# blocks carry their own references.
+
+
+def _serve_env(ledger: str, extra: dict | None = None) -> dict:
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BSSEQ_TPU_BACKEND="cpu",
+        BSSEQ_TPU_STATS=ledger,
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+    )
+    env.update(extra or {})
+    return env
+
+
+def _molecular_ref(bam: str, out: str, ledger: str,
+                   env_extra: dict | None = None) -> bytes:
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "molecular",
+         "-i", bam, "-o", out, "--batching", "sequential"],
+        env=_serve_env(ledger, env_extra), capture_output=True, text=True,
+        timeout=CHILD_TIMEOUT,
+    )
+    if cp.returncode != 0:
+        raise RuntimeError(f"standalone ref failed: {cp.stderr[-1000:]}")
+    return open(out, "rb").read()
+
+
+def _spawn_serve(sock: str, ledger: str, env_extra: dict | None = None):
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "serve",
+         "--socket", sock, "--batch-families", "16"],
+        env=_serve_env(ledger, env_extra),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                "serve died at startup: " + proc.stderr.read().decode()[-1000:]
+            )
+        try:
+            request(sock, {"op": "ping"}, timeout=2.0)
+            return proc
+        except (OSError, ConnectionError):
+            time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("serve socket never came up")
+
+
+def _stop_serve(proc, sock: str) -> int:
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    try:
+        request(sock, {"op": "drain", "timeout": 300}, timeout=360)
+    except (OSError, ConnectionError):
+        pass
+    try:
+        return proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait(timeout=30)
+
+
+def _ledger_quarantined(path: str) -> int:
+    n = 0
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if d.get("event") == "stage_stats":
+                    n += int(d.get("records_quarantined", 0) or 0)
+    except OSError:
+        pass
+    return n
+
+
 #: Scenario table: fault schedule + what must have happened (beyond the
 #: universal byte-identity check). `expect` maps to (source, key, min):
 #: source 'stage:<name>' reads the child's stage stats, 'ledger' the
@@ -419,6 +507,134 @@ def run_drill(quick: bool, out_path: str) -> dict:
                     entry["error"] = (
                         f"resume rc={cp3.returncode}: " + cp3.stderr[-500:]
                     )
+
+        # graftserve: a tenant with a corrupt BAM (quarantine policy)
+        # shares the resident engine with a clean tenant mid-load — the
+        # clean tenant must come out byte-identical to its standalone
+        # run, the corrupt one identical to a standalone quarantine run
+        from bsseqconsensusreads_tpu.serve.server import request
+
+        clean_ref = _molecular_ref(
+            bam, os.path.join(wd, "serve_clean_ref.bam"),
+            os.path.join(wd, "sref.jsonl"),
+        )
+        q_ref = _molecular_ref(
+            mutated, os.path.join(wd, "serve_q_ref.bam"),
+            os.path.join(wd, "sqref.jsonl"),
+            {"BSSEQ_TPU_INPUT_POLICY": "quarantine"},
+        )
+        entry = {"ok": False, "records_mutated": n_bad}
+        results["serve_corrupt_tenant_quarantine"] = entry
+        sock = os.path.join(wd, "serve_a.sock")
+        ledger = os.path.join(wd, "serve_a.jsonl")
+        t0 = time.monotonic()
+        proc = _spawn_serve(sock, ledger)
+        try:
+            corrupt_out = os.path.join(wd, "serve_corrupt.out.bam")
+            clean_out = os.path.join(wd, "serve_clean.out.bam")
+            r1 = request(sock, {"op": "submit", "spec": {
+                "input": mutated, "output": corrupt_out,
+                "policy": "quarantine",
+            }})
+            r2 = request(sock, {"op": "submit", "spec": {
+                "input": bam, "output": clean_out,
+            }})
+            if not (r1.get("ok") and r2.get("ok")):
+                entry["error"] = f"submit refused: {r1} {r2}"
+            else:
+                t_clean = time.monotonic()
+                sc = request(sock, {"op": "wait", "job": r2["job"]["id"],
+                                    "timeout": 300}, timeout=360)
+                entry["clean_latency_s"] = round(
+                    time.monotonic() - t_clean, 2
+                )
+                sq = request(sock, {"op": "wait", "job": r1["job"]["id"],
+                                    "timeout": 300}, timeout=360)
+                rc = _stop_serve(proc, sock)
+                entry["quarantined"] = _ledger_quarantined(ledger)
+                entry["clean_identical"] = (
+                    open(clean_out, "rb").read() == clean_ref
+                )
+                entry["corrupt_identical_to_quarantine_run"] = (
+                    open(corrupt_out, "rb").read() == q_ref
+                )
+                entry["ok"] = (
+                    sc["job"]["state"] == "done"
+                    and sq["job"]["state"] == "done"
+                    and entry["clean_identical"]
+                    and entry["corrupt_identical_to_quarantine_run"]
+                    and entry["quarantined"] >= 1
+                    and entry["clean_latency_s"] < 120
+                    and rc == 0
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftserve: one tenant's ingest stalls (failpoint pins its
+        # reader for 6s) — the co-resident tenant must retire well
+        # inside the stall window, then the stalled tenant completes
+        # byte-identical anyway
+        entry = {"ok": False}
+        results["serve_stalled_tenant_isolation"] = entry
+        sock = os.path.join(wd, "serve_b.sock")
+        ledger = os.path.join(wd, "serve_b.jsonl")
+        t0 = time.monotonic()
+        proc = _spawn_serve(sock, ledger, {
+            "BSSEQ_TPU_FAILPOINTS":
+                "serve_ingest=stall:6s:times=1@job=j0001",
+        })
+        try:
+            stalled_out = os.path.join(wd, "serve_stalled.out.bam")
+            other_out = os.path.join(wd, "serve_other.out.bam")
+            t_sub = time.monotonic()
+            r1 = request(sock, {"op": "submit", "spec": {
+                "input": bam, "output": stalled_out,
+            }})
+            r2 = request(sock, {"op": "submit", "spec": {
+                "input": bam, "output": other_out,
+            }})
+            if not (r1.get("ok") and r2.get("ok")):
+                entry["error"] = f"submit refused: {r1} {r2}"
+            elif r1["job"]["id"] != "j0001":
+                entry["error"] = f"expected j0001, got {r1['job']['id']}"
+            else:
+                so = request(sock, {"op": "wait", "job": r2["job"]["id"],
+                                    "timeout": 5}, timeout=60)
+                entry["other_latency_s"] = round(
+                    time.monotonic() - t_sub, 2
+                )
+                stalled_mid = request(
+                    sock, {"op": "status", "job": "j0001"}
+                )
+                ss = request(sock, {"op": "wait", "job": "j0001",
+                                    "timeout": 300}, timeout=360)
+                rc = _stop_serve(proc, sock)
+                entry["stalled_state_while_other_done"] = (
+                    stalled_mid.get("job", {}).get("state")
+                )
+                entry["other_identical"] = (
+                    open(other_out, "rb").read() == clean_ref
+                )
+                entry["stalled_identical"] = (
+                    open(stalled_out, "rb").read() == clean_ref
+                )
+                entry["ok"] = (
+                    so["job"]["state"] == "done"
+                    and entry["other_latency_s"] < 5.0
+                    and entry["stalled_state_while_other_done"] != "done"
+                    and ss["job"]["state"] == "done"
+                    and entry["other_identical"]
+                    and entry["stalled_identical"]
+                    and rc == 0
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        entry["seconds"] = round(time.monotonic() - t0, 1)
 
     ok = all(v.get("ok") for v in results.values())
     out = {

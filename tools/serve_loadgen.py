@@ -1,0 +1,304 @@
+"""graftserve load generator: Poisson arrivals against a live server.
+
+Measures the resident engine the way a queueing system is measured —
+jobs/hour and p50/p99 submit→retire latency under a seeded Poisson
+arrival process — while holding the serve identity contract: every
+job's output BAM must be byte-identical (SHA-256) to a standalone
+`cli molecular --batching sequential` run of the same input, and at
+least one device batch must have packed families from different jobs
+(`batches_shared_jobs` > 0, i.e. the continuous batching actually
+happened; the numbers are not N sequential runs wearing a socket).
+
+    python tools/serve_loadgen.py [--jobs 8] [--rate 2.0] [--quick]
+                                  [--out SERVE_HEAD.json]
+
+Writes SERVE_HEAD.json (committed denominator; bench.py's
+BSSEQ_BENCH_SERVE leg runs the --quick form). The server runs as a
+real subprocess (`cli serve`) so the measurement includes socket,
+admission, and demux overheads — everything a tenant would feel.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SERVER_START_TIMEOUT = 120.0
+
+
+def _sha(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _build_inputs(wd: str, n_jobs: int, n_families: int, seed: int):
+    """n_jobs distinct grouped BAMs (different seeds → different
+    families: identical tenants would let a demux bug hide)."""
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io.bam import BamWriter
+    from bsseqconsensusreads_tpu.utils.testing import make_grouped_bam_records
+
+    genome = "".join(
+        "ACGT"[i]
+        for i in np.random.default_rng(seed).integers(0, 4, size=4000)
+    )
+    paths = []
+    for k in range(n_jobs):
+        rng = np.random.default_rng(seed + 1 + k)
+        header, records = make_grouped_bam_records(
+            rng, f"chr{k + 1}", genome, n_families=n_families,
+            reads_per_strand=(2, 3), read_len=60,
+        )
+        path = os.path.join(wd, f"in{k:03d}.bam")
+        with BamWriter(path, header) as w:
+            for r in records:
+                w.write(r)
+        paths.append(path)
+    return paths
+
+
+def _standalone_refs(inputs, wd: str):
+    """The identity denominators: one-shot CLI runs, sequential
+    batching (the contract the scheduler pins)."""
+    from bsseqconsensusreads_tpu import cli
+
+    shas = []
+    for k, inp in enumerate(inputs):
+        out = os.path.join(wd, f"ref{k:03d}.bam")
+        rc = cli.main(
+            ["molecular", "-i", inp, "-o", out, "--batching", "sequential"]
+        )
+        if rc != 0:
+            raise SystemExit(f"standalone reference run failed for {inp}")
+        shas.append(_sha(out))
+    return shas
+
+
+def _spawn_server(sock: str, ledger: str, batch_families: int):
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+        BSSEQ_TPU_STATS=ledger,
+    )
+    return subprocess.Popen(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "serve",
+         "--socket", sock, "--batch-families", str(batch_families),
+         "--warmup"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+
+
+def _wait_server(sock: str, proc) -> None:
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    deadline = time.monotonic() + SERVER_START_TIMEOUT
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(
+                "server died during startup: "
+                + proc.stderr.read().decode()[-2000:]
+            )
+        try:
+            request(sock, {"op": "ping"}, timeout=2.0)
+            return
+        except (OSError, ConnectionError):
+            time.sleep(0.1)
+    raise SystemExit("server socket never came up")
+
+
+def _drive_load(sock: str, inputs, wd: str, rate: float, seed: int):
+    """Seeded Poisson process: exponential inter-arrival gaps at
+    `rate` jobs/s. One thread per tenant blocks on the wait op, so a
+    tenant's latency clock runs exactly from its own submit to its own
+    retire — concurrent tenants overlap like real load."""
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    arrivals = random.Random(seed)
+    results = [None] * len(inputs)
+    threads = []
+
+    def tenant(k: int, inp: str):
+        out = os.path.join(wd, f"out{k:03d}.bam")
+        t_submit = time.monotonic()
+        resp = request(
+            sock, {"op": "submit", "spec": {"input": inp, "output": out}}
+        )
+        if not resp.get("ok"):
+            results[k] = {"error": resp.get("error"), "latency_s": None}
+            return
+        jid = resp["job"]["id"]
+        resp = request(
+            sock, {"op": "wait", "job": jid, "timeout": 600}, timeout=660
+        )
+        results[k] = {
+            "job": jid,
+            "output": out,
+            "state": resp.get("job", {}).get("state"),
+            "latency_s": time.monotonic() - t_submit,
+        }
+
+    t_start = time.monotonic()
+    for k, inp in enumerate(inputs):
+        if k:
+            time.sleep(arrivals.expovariate(rate))
+        th = threading.Thread(target=tenant, args=(k, inp), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=700)
+    wall = time.monotonic() - t_start
+    return results, wall
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _ledger_counters(ledger: str) -> dict:
+    counts: dict = {}
+    try:
+        with open(ledger) as fh:
+            for line in fh:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if d.get("event") != "stage_stats":
+                    continue
+                for key in ("serve_batches", "batches_shared_jobs",
+                            "records_dropped", "compile_cache_hit",
+                            "compile_cache_miss"):
+                    if d.get(key) is not None:
+                        counts[key] = counts.get(key, 0) + int(d[key])
+    except OSError:
+        pass
+    return counts
+
+
+def run_load(n_jobs: int, n_families: int, rate: float, seed: int,
+             batch_families: int, out_path: str) -> dict:
+    wd = tempfile.mkdtemp(prefix="serve_loadgen_")
+    sock = os.path.join(wd, "serve.sock")
+    ledger = os.path.join(wd, "serve_ledger.jsonl")
+    proc = None
+    try:
+        inputs = _build_inputs(wd, n_jobs, n_families, seed)
+        refs = _standalone_refs(inputs, wd)
+        proc = _spawn_server(sock, ledger, batch_families)
+        _wait_server(sock, proc)
+        results, wall = _drive_load(sock, inputs, wd, rate, seed)
+
+        from bsseqconsensusreads_tpu.serve.server import request
+
+        request(sock, {"op": "drain", "timeout": 300}, timeout=360)
+        rc = proc.wait(timeout=120)
+
+        jobs = []
+        latencies = []
+        for k, r in enumerate(results):
+            entry = {"input": os.path.basename(inputs[k])}
+            if r is None or r.get("latency_s") is None:
+                entry.update({"ok": False, "error": (r or {}).get("error")})
+            else:
+                identical = (
+                    os.path.exists(r["output"])
+                    and _sha(r["output"]) == refs[k]
+                )
+                entry.update({
+                    "job": r["job"],
+                    "state": r["state"],
+                    "latency_s": round(r["latency_s"], 4),
+                    "identical": identical,
+                    "ok": r["state"] == "done" and identical,
+                })
+                latencies.append(r["latency_s"])
+            jobs.append(entry)
+        latencies.sort()
+        counters = _ledger_counters(ledger)
+        all_ok = bool(jobs) and all(j.get("ok") for j in jobs)
+        shared = counters.get("batches_shared_jobs", 0)
+        head = {
+            "suite": "serve_loadgen",
+            "config": {
+                "jobs": n_jobs,
+                "families_per_job": n_families,
+                "arrival_rate_jobs_per_s": rate,
+                "seed": seed,
+                "batch_families": batch_families,
+                "backend": "cpu",
+            },
+            "wall_seconds": round(wall, 3),
+            "jobs_per_hour": round(n_jobs / wall * 3600.0, 1) if wall else 0,
+            "latency_p50_s": round(_percentile(latencies, 0.50), 4),
+            "latency_p99_s": round(_percentile(latencies, 0.99), 4),
+            "batches_shared_jobs": shared,
+            "counters": counters,
+            "server_exit_code": rc,
+            "jobs_detail": jobs,
+            "ok": all_ok and rc == 0 and shared > 0,
+        }
+        with open(out_path, "w") as fh:
+            json.dump(head, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return head
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(wd, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Poisson load against a live graftserve engine"
+    )
+    ap.add_argument("--jobs", type=int, default=8)
+    ap.add_argument("--families", type=int, default=24,
+                    help="duplex families per job")
+    ap.add_argument("--rate", type=float, default=25.0,
+                    help="Poisson arrival rate, jobs/second (high enough "
+                         "that tenants overlap — an idle engine shares "
+                         "no batches and proves nothing)")
+    ap.add_argument("--seed", type=int, default=1302)
+    ap.add_argument("--batch-families", type=int, default=16)
+    ap.add_argument("--quick", action="store_true",
+                    help="small fleet for the bench leg (4 jobs)")
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_HEAD.json"))
+    args = ap.parse_args()
+    if args.quick:
+        args.jobs, args.families = min(args.jobs, 4), min(args.families, 8)
+    head = run_load(
+        args.jobs, args.families, args.rate, args.seed,
+        args.batch_families, args.out,
+    )
+    summary = {
+        k: head[k]
+        for k in ("jobs_per_hour", "latency_p50_s", "latency_p99_s",
+                  "batches_shared_jobs", "ok")
+    }
+    print(json.dumps(summary))
+    return 0 if head["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
