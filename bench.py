@@ -413,6 +413,25 @@ def _hostpool_default() -> int:
     return hostpool.host_workers()
 
 
+def _bucket_histogram(stats) -> dict:
+    """Per-batch packed-shape ledger (ISSUE 9): every device-issued
+    packed batch counts its chosen bucket under
+    `bucket_rows{N}_w{W}` — the histogram doubles as the compile-count
+    bound (one kernel shape per distinct key)."""
+    return {
+        k: v for k, v in sorted(stats.metrics.counters.items())
+        if k.startswith("bucket_rows")
+    }
+
+
+def _cache_counts(stats) -> dict:
+    c = stats.metrics.counters
+    return {
+        "hit": int(c.get("compile_cache_hit", 0)),
+        "miss": int(c.get("compile_cache_miss", 0)),
+    }
+
+
 def _child_hostscale() -> None:
     """Host-parallel scaling child (ISSUE 4): the REAL duplex stage —
     call_duplex_batches fed by the REAL molecular stage's consensus
@@ -449,9 +468,10 @@ def _child_hostscale() -> None:
     # molecular stage once (untimed): its consensus reads carry the
     # cd/ce/cB tag surface the duplex rawize pass consumes
     mol: list = []
+    mol_stats = StageStats(stage="molecular")
     for batch in call_molecular_batches(
         iter(raw), mode="self", grouping="coordinate",
-        batch_families=128, stats=StageStats(),
+        batch_families=128, stats=mol_stats,
     ):
         mol.extend(batch)
     mol.sort(key=lambda r: (r.ref_id, r.pos))
@@ -511,6 +531,14 @@ def _child_hostscale() -> None:
         results[str(workers)] = {
             "wall_s": round(wall, 3),
             "records_per_s": round(len(mol) / wall, 1) if wall else 0.0,
+            # packed-layout accounting (ISSUE 9): device-issued cells
+            # only, so effective_flop_utilization is pad_waste's exact
+            # complement over what the kernels actually computed
+            "pad_waste": round(stats.pad_waste, 4),
+            "effective_flop_utilization": round(
+                stats.effective_flop_utilization, 4
+            ),
+            "compile_cache": _cache_counts(stats),
             "rawize_s": round(secs.get("rawize", 0.0), 3),
             # rawize wall hidden behind dispatch/other phases: worker-
             # accumulated rawize seconds minus the main thread's blocked
@@ -530,6 +558,20 @@ def _child_hostscale() -> None:
             "host_workers_default": default_workers,
             "cores": os.cpu_count(),
             "duplex_consensus_reads": len(mol),
+            "kernel_layout": os.environ.get(
+                "BSSEQ_TPU_KERNEL_LAYOUT", "packed"
+            ),
+            # the (untimed) molecular pre-pass is where the segment-
+            # packed route runs in this child — its bucket ledger and
+            # cache counters prove compiles stay bounded by bucket count
+            "molecular_stage": {
+                "pad_waste": round(mol_stats.pad_waste, 4),
+                "effective_flop_utilization": round(
+                    mol_stats.effective_flop_utilization, 4
+                ),
+                "bucket_histogram": _bucket_histogram(mol_stats),
+                "compile_cache": _cache_counts(mol_stats),
+            },
             "byte_identical_across_workers": len(digests) == 1,
             "runs": results,
             "speedup_4_vs_0": round(

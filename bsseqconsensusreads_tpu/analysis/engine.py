@@ -511,6 +511,7 @@ def all_rules() -> dict[str, Rule]:
         rules_input,
         rules_io,
         rules_jax,
+        rules_pack,
         rules_retry,
         rules_serve,
         rules_thread,
@@ -518,7 +519,8 @@ def all_rules() -> dict[str, Rule]:
 
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
-                rules_hostphase, rules_input, rules_emit, rules_serve):
+                rules_hostphase, rules_input, rules_emit, rules_serve,
+                rules_pack):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

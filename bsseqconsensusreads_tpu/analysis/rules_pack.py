@@ -1,0 +1,97 @@
+"""graftlint rule guarding the segment-packed kernel layout (PR 9).
+
+`padded-batch-flops` flags a padding-envelope allocation on the hot
+path: a literal shape tuple densifying three or more ragged dimensions
+at once (the [F, T, 2, W] signature — family count x templates x
+window all padded to their batch maxima, so device FLOPs scale with
+the worst family instead of the real read count). The packed layout
+(ops.encode.pack_molecular_rows) replaced that envelope with one dense
+row axis + segment ids; new hot-path code should pack, and the two
+sanctioned fallback encoders carry reviewed suppressions.
+
+Structural dims stay clean on purpose: `(f, 4, w)` (duplex strand
+rows), `(n, 2, w)` (packed rows: read axis is dense, only the bucket
+rounds), and `(f, 2, NUM_BASES, w)` (ALL_CAPS names count as
+constants) each densify at most two ragged dims.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from bsseqconsensusreads_tpu.analysis.engine import (
+    Finding,
+    PackageIndex,
+    Rule,
+    SourceFile,
+    call_basename,
+)
+
+#: Allocators that materialize the envelope. `concatenate`/`stack` grow
+#: from real rows and are exempt; so is `empty` handed a computed shape
+#: expression (not a literal tuple — those sites shape to an existing
+#: array, not to batch maxima).
+_ALLOCATORS = frozenset({"full", "zeros", "empty", "ones"})
+
+
+def _ragged_dim(elt: ast.AST) -> bool:
+    """A shape element is ragged when it reads a runtime value: any
+    non-ALL_CAPS name anywhere in it (`t_pad`, `w_pad + 1`, `len(x)`).
+    Constants and ALL_CAPS module constants (NUM_BASES, LANE) are
+    structural."""
+    for sub in ast.walk(elt):
+        if isinstance(sub, ast.Name) and sub.id != sub.id.upper():
+            return True
+    return False
+
+
+def _shape_tuple(call: ast.Call) -> ast.Tuple | None:
+    if call.args and isinstance(call.args[0], ast.Tuple):
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "shape" and isinstance(kw.value, ast.Tuple):
+            return kw.value
+    return None
+
+
+def check_padded_batch_flops(
+    sf: SourceFile, index: PackageIndex
+) -> Iterator[Finding]:
+    """padded-batch-flops: >=3 ragged dims densified in one allocation
+    on a batch-loop-reachable path."""
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_basename(node) not in _ALLOCATORS:
+            continue
+        shape = _shape_tuple(node)
+        if shape is None or len(shape.elts) < 3:
+            continue
+        if sum(1 for e in shape.elts if _ragged_dim(e)) < 3:
+            continue
+        if not index.in_hot_path(sf, node):
+            continue
+        yield Finding(
+            rule="padded-batch-flops",
+            path=sf.display,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                "padding-envelope allocation on the hot path: this "
+                "shape densifies 3+ ragged dims to their batch maxima, "
+                "so kernel FLOPs scale with the worst family — use the "
+                "segment-packed layout (ops.encode.pack_molecular_rows: "
+                "dense row axis + segment ids) instead"
+            ),
+        )
+
+
+RULES = [
+    Rule(
+        name="padded-batch-flops",
+        summary="3+ ragged dims padded to batch maxima in one hot-path "
+        "allocation",
+        check=check_padded_batch_flops,
+    ),
+]

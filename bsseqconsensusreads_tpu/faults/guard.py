@@ -42,8 +42,12 @@ Layering:
   vectorized per columnar batch on the native path.
 * family-level admission control (`guard_groups`) caps family-size
   bombs (``BSSEQ_TPU_MAX_FAMILY_RECORDS``) and read-length outliers
-  (``BSSEQ_TPU_MAX_READ_LEN``) before they can blow up the
-  [families x reads x len x 4] padding envelope.
+  (``BSSEQ_TPU_MAX_READ_LEN``). Under the segment-packed kernel
+  layout (the default) an outlier family no longer inflates the whole
+  batch's envelope — it only adds its own rows — so these caps are
+  resource *policy* (bounded host memory per family, bounded device
+  rows per batch), not the layout self-defense they were when one deep
+  family padded every family to [families x reads x len x 4].
 
 tools/fuzz_ingest.py drives seeded mutations of golden inputs through
 all three policies and asserts the contract: never crash, never
@@ -622,9 +626,14 @@ def guard_groups(
 
     Family-level rules, all policies:
     * more than guard.max_family_records records -> strict: raise
-      FamilyGuardError; else quarantine the family whole (a family bomb
-      must never reach the [families x reads x len x 4] padding
-      envelope — the >=100 GB failure mode of the reference).
+      FamilyGuardError; else quarantine the family whole. This cap is
+      admission *policy*, not envelope self-defense: the segment-packed
+      kernel layout already keeps a giant family from padding its
+      batchmates (it contributes only its own rows to the dense axis),
+      but an unbounded family still costs unbounded host memory during
+      grouping and unbounded device rows in its batch — the >=100 GB
+      failure mode of the reference is bounded here by choice, at a
+      configurable line, rather than by layout necessity.
     * any record in the family failing semantic validation -> strict:
       raise RecordGuardError; lenient: repair when repairable; else
       quarantine the family whole (a corrupt member poisons the

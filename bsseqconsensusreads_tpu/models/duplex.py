@@ -28,7 +28,14 @@ import jax
 import jax.numpy as jnp
 
 from bsseqconsensusreads_tpu.alphabet import NBASE
-from bsseqconsensusreads_tpu.models.molecular import column_vote, narrow_outputs
+from bsseqconsensusreads_tpu.models.molecular import (
+    _split_contrib_sums,
+    _vote_contrib,
+    _vote_finalize_dispatch,
+    column_vote,
+    errors_from_counts,
+    narrow_outputs,
+)
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.ops.convert import convert_ag_to_ct
 from bsseqconsensusreads_tpu.ops.extend import (
@@ -98,11 +105,76 @@ def duplex_consensus(bases, quals, params: ConsensusParams = ConsensusParams(min
     return narrow_outputs(out)
 
 
+#: Flat row order of the packed duplex layout: the two R1 merge rows then
+#: the two R2 merge rows — matching _merge's stack order per role, so the
+#: packed pair-sum adds observations in the same order as the padded vote.
+_PACKED_ROW_ORDER = R1_ROWS + R2_ROWS
+
+
 @partial(jax.jit, static_argnames=("params", "vote_kernel"))
+def duplex_consensus_packed(
+    bases, quals,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    vote_kernel: str = "xla",
+):
+    """Segment-packed duplex merge: byte-identical to duplex_consensus.
+
+    bases int8 [F, 4, W] / quals [F, 4, W] (same input as duplex_consensus
+    — duplex groups always carry exactly 4 rows, so 'packing' here is the
+    layout recast, not a gather): the rows regroup as merge pairs
+    [F * 2 groups, 2 rows, W] (_PACKED_ROW_ORDER) and ONE dense pair-axis
+    reduction votes every group, replacing the vmap-over-families
+    stack-and-vote (_merge -> column_vote) with the shared contribution
+    sum. A 2-row segment is a fixed-size segment, so the segment-sum
+    degenerates to a plain axis sum — same add order, no scatter. The
+    finalize is the shared sorting-network epilogue
+    (molecular._vote_finalize_dispatch: 'xla' inline or the Pallas
+    epilogue), the errors plane the count trick (errors_from_counts), and
+    the per-strand planes stay elementwise XLA exactly as _merge computes
+    them.
+    """
+    quals = quals.astype(jnp.float32)
+    f, _, w = bases.shape
+    order = list(_PACKED_ROW_ORDER)
+    b = bases[:, order, :].reshape(f * 2, 2, w)
+    q = quals[:, order, :].reshape(f * 2, 2, w)
+    # [F*2, 2, W, 8] contributions summed over the in-group row axis: row
+    # order inside each pair matches _merge's stack order, so the two adds
+    # land in the padded kernel's order
+    ll, cnt, depth = _split_contrib_sums(
+        jnp.sum(_vote_contrib(b, q, params), axis=1)
+    )
+    cons, qual = _vote_finalize_dispatch(ll, depth, params, vote_kernel)
+    errors = errors_from_counts(cnt, depth, cons)
+    out = {
+        "base": cons.reshape(f, 2, w),
+        "qual": qual.reshape(f, 2, w),
+        "depth": depth.reshape(f, 2, w),
+        "errors": errors.reshape(f, 2, w),
+    }
+    # per-strand presence/error planes: elementwise over the original rows
+    # (the same observation filter as the vote — _merge's contract that
+    # a_depth + b_depth == depth and a_err + b_err == errors)
+    for key, err, rows in (
+        ("a_depth", "a_err", [rr[0] for rr in ROLE_STRAND_ROWS]),
+        ("b_depth", "b_err", [rr[1] for rr in ROLE_STRAND_ROWS]),
+    ):
+        rb = bases[:, rows, :]  # [F, 2(role), W]
+        rq = quals[:, rows, :]
+        obs = (rb != NBASE) & (rq >= params.min_input_base_quality)
+        out[key] = obs.astype(jnp.int32)
+        out[err] = (
+            obs & (out["base"] != NBASE) & (rb != out["base"])
+        ).astype(jnp.int32)
+    return narrow_outputs(out)
+
+
+@partial(jax.jit, static_argnames=("params", "vote_kernel", "layout"))
 def duplex_call_pipeline(
     bases, quals, cover, ref, convert_mask, extend_eligible=None,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """The fused TPU duplex stage: AG->CT conversion -> gap extension ->
     duplex merge, one compiled program per batch shape.
@@ -113,14 +185,25 @@ def duplex_call_pipeline(
     on the family axis. Inputs are DuplexBatch arrays; returns the
     duplex_consensus output dict plus 'la'/'rd' [F, 4] for parity inspection.
 
-    vote_kernel: 'xla' (stock lowering) or 'pallas'
-    (ops.pallas_vote.duplex_consensus_pallas — the fused VMEM-streaming
-    reduction) for the merge step; convert/extend stay XLA either way.
+    vote_kernel: 'xla' (stock lowering) or 'pallas' for the merge step;
+    convert/extend stay XLA either way.
+
+    layout: 'packed' (duplex_consensus_packed — the segment-packed merge,
+    pipeline.calling's default via BSSEQ_TPU_KERNEL_LAYOUT) or 'padded'
+    (the vmap-over-families vote; with vote_kernel='pallas' this is
+    ops.pallas_vote.duplex_consensus_pallas, the fused VMEM-streaming
+    reduction). Byte-identical outputs on every leg.
     """
     b, q, c, la, rd = convert_ag_to_ct(bases, quals, cover, ref, convert_mask)
     b, q, c = extend_gap(b, q, c, la, rd, extend_eligible)
     b = jnp.where(c, b, NBASE)
-    if vote_kernel == "pallas":
+    if layout == "packed":
+        out = duplex_consensus_packed(b, q, params, vote_kernel)
+    elif layout != "padded":
+        raise ValueError(
+            f"unknown kernel layout {layout!r} (want 'packed'|'padded')"
+        )
+    elif vote_kernel == "pallas":
         from bsseqconsensusreads_tpu.ops.pallas_vote import (
             duplex_consensus_pallas,
         )
@@ -329,19 +412,22 @@ def unpack_duplex_wire_outputs(wire, f: int, w: int) -> dict:
     return out
 
 
-@partial(jax.jit, static_argnames=("params", "vote_kernel"))
+@partial(jax.jit, static_argnames=("params", "vote_kernel", "layout"))
 def duplex_call_pipeline_packed(
     bases, quals, cover, ref, convert_mask, extend_eligible,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """duplex_call_pipeline with per-column outputs packed for one fetch.
 
     Returns (packed uint32 [F*2*W*2/4] wire array, la int8 [F, 4],
     rd int8 [F, 4]); unpack with unpack_duplex_outputs(packed, f, w).
+    layout selects the merge layout (see duplex_call_pipeline) — the wire
+    bytes are identical either way.
     """
     out = duplex_call_pipeline(
         bases, quals, cover, ref, convert_mask, extend_eligible, params=params,
-        vote_kernel=vote_kernel,
+        vote_kernel=vote_kernel, layout=layout,
     )
     return pack_duplex_outputs(out), out["la"], out["rd"]

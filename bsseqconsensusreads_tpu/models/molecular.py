@@ -125,12 +125,31 @@ def vote_finalize(ll, depth, params: ConsensusParams):
     (very deep families) keep the raw argmax — on a true tie there,
     either pick is a correct call; only the canonical choice is
     best-effort.
+
+    The ascending order is produced by a 5-comparator sorting network
+    over ll - m BEFORE the exp, not a general sort after it: exp is
+    monotone, so sorting the exponents commutes with exponentiating
+    them (bitwise — equal inputs give equal outputs, distinct inputs
+    keep their order), and the largest exponent is exp(0) == 1.0
+    exactly (the max's own slot), so only three exps are evaluated.
+    A 4-wide jnp.sort lowers to a general comparator sort that
+    dominated the whole finalize on the CPU backend (~8x the network's
+    cost at rehearsal shapes); the network is the same ascending-sum
+    contract at min/max cost. ops.pallas_vote._finalize runs the same
+    network on the same values.
     """
     called = depth > 0
     m = jnp.max(ll, axis=-1, keepdims=True)
     cons = jnp.argmax(ll >= m - ARGMAX_TIE_TOL, axis=-1)  # first near-max [W]
-    e = jnp.sort(jnp.exp(ll - m), axis=-1)  # ascending
-    denom = ((e[..., 0] + e[..., 1]) + e[..., 2]) + e[..., 3]
+    d = ll - m  # [..., 4], every entry <= 0, the max's slot exactly 0
+    a, b = jnp.minimum(d[..., 0], d[..., 1]), jnp.maximum(d[..., 0], d[..., 1])
+    c, e = jnp.minimum(d[..., 2], d[..., 3]), jnp.maximum(d[..., 2], d[..., 3])
+    a, c = jnp.minimum(a, c), jnp.maximum(a, c)
+    b, e = jnp.minimum(b, e), jnp.maximum(b, e)
+    b, c = jnp.minimum(b, c), jnp.maximum(b, c)
+    # ascending a <= b <= c <= e with e == 0: denom sums small-to-large
+    # and the top term is exp(0) == 1.0 exactly
+    denom = ((jnp.exp(a) + jnp.exp(b)) + jnp.exp(c)) + 1.0
     # exp(ll[cons] - m) == 1 exactly (cons is the argmax), so the posterior
     # of the call is 1/denom
     p_cons = 1.0 - 1.0 / denom
@@ -152,6 +171,81 @@ def count_errors(bases, quals, cons, params: ConsensusParams):
     observed = (bases != NBASE) & (quals >= params.min_input_base_quality)
     disagree = observed & (cons[..., None, :] != NBASE) & (bases != cons[..., None, :])
     return jnp.sum(jnp.where(disagree, 1, 0), axis=-2).astype(jnp.int32)
+
+
+def _vote_contrib(bases, quals, params: ConsensusParams):
+    """Per-observation vote contributions, 8 channels: LL contribution per
+    candidate base (4) then the observation's one-hot count (4).
+
+    bases int8 [..., W], quals float32 [..., W]. Unobserved cells (NBASE or
+    below min input qual) contribute exact 0.0 in every channel, so padding
+    rows are free to ride any reduction. Kept UNFACTORED (w * (onehot *
+    log_ok + (1 - onehot) * log_err)) — the same per-read term
+    vote_partials sums — so any order-preserving reduction over these
+    contributions reproduces the padded kernel's ll bits exactly.
+    """
+    observed = (bases != NBASE) & (quals >= params.min_input_base_quality)
+    p_err = phred.adjust_quals_post_umi(quals, params.error_rate_post_umi)
+    log_ok, log_err = phred.log_likelihoods(p_err)
+    onehot = jax.nn.one_hot(bases, NUM_BASES, dtype=jnp.float32)
+    w_obs = jnp.where(observed, 1.0, 0.0)[..., None]
+    contrib = w_obs * (
+        onehot * log_ok[..., None] + (1.0 - onehot) * log_err[..., None]
+    )
+    return jnp.concatenate([contrib, onehot * w_obs], axis=-1)  # [..., W, 8]
+
+
+def _split_contrib_sums(sums):
+    """(ll, cnt, depth) from reduced 8-channel contribution sums."""
+    ll = sums[..., :NUM_BASES]
+    cnt = sums[..., NUM_BASES:]
+    # per-base counts are exact small integers in float32; their sum is the
+    # padded kernel's depth (count of observations) exactly
+    depth = jnp.sum(cnt, axis=-1).astype(jnp.int32)
+    return ll, cnt, depth
+
+
+def vote_partials_segments(bases, quals, seg, num_segments: int,
+                           params: ConsensusParams):
+    """Segment-packed twin of vote_partials: one dense read-row axis for
+    ALL families in the batch instead of a padded per-family axis.
+
+    bases int8 [N, ..., W], quals float32 [N, ..., W], seg int32 [N] —
+    ascending family ids (padding rows carry the sentinel id
+    num_segments - 1 so their exact-zero contributions land in a slice-away
+    segment). Returns (ll [S, ..., W, 4], cnt [S, ..., W, 4],
+    depth [S, ..., W] int32).
+
+    Bit-identity with the padded path: segment_sum over sorted ids adds
+    contributions in row order — the same order jnp.sum reduces the padded
+    [T, W] read axis — and unobserved cells contribute exact 0.0
+    (_vote_contrib), so the packed ll/cnt/depth match the vmap'd
+    vote_partials bit for bit. cnt additionally carries the per-base
+    tallies that let errors_from_counts replace the padded path's second
+    reads-axis sweep (count_errors).
+    """
+    sums = jax.ops.segment_sum(
+        _vote_contrib(bases, quals, params), seg,
+        num_segments=num_segments, indices_are_sorted=True,
+    )
+    return _split_contrib_sums(sums)
+
+
+def errors_from_counts(cnt, depth, cons):
+    """errors = depth - cnt[consensus] where called — the count trick.
+
+    Integer-exact twin of count_errors: every observation either agrees
+    with the consensus (counted in cnt[cons]) or disagrees (an error), so
+    the disagreement count is the difference — no second pass over the
+    reads axis. Uncalled columns (cons == NBASE) report 0 errors, exactly
+    as count_errors' `cons != NBASE` conjunct decides.
+    """
+    cnt_cons = jnp.take_along_axis(
+        cnt, jnp.clip(cons, 0, 3)[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    return jnp.where(
+        cons != NBASE, depth - cnt_cons.astype(jnp.int32), 0
+    ).astype(jnp.int32)
 
 
 def narrow_outputs(out: dict) -> dict:
@@ -200,6 +294,88 @@ def molecular_consensus(bases, quals, params: ConsensusParams = ConsensusParams(
     """
     out = jax.vmap(lambda b, q: _family_consensus(b, q, params))(bases, quals)
     return narrow_outputs(out)
+
+
+def _vote_finalize_dispatch(ll, depth, params: ConsensusParams,
+                            vote_kernel: str):
+    """Finalize either on the stock XLA lowering or via the Pallas
+    epilogue (ops.pallas_vote.vote_finalize_groups — the same network,
+    bit-identical). ONE resolution shared by the packed molecular and
+    duplex kernels so the matrix of (layout, vote_kernel) legs can never
+    disagree on what 'pallas' means for a packed batch."""
+    if vote_kernel == "pallas":
+        from bsseqconsensusreads_tpu.ops.pallas_vote import (
+            vote_finalize_groups,
+        )
+
+        return vote_finalize_groups(ll, depth, params)
+    if vote_kernel != "xla":
+        raise ValueError(
+            f"unknown vote kernel {vote_kernel!r} (want 'xla'|'pallas')"
+        )
+    return vote_finalize(ll, depth, params)
+
+
+@partial(jax.jit, static_argnames=("num_families", "params", "vote_kernel"))
+def molecular_consensus_packed(
+    bases, quals, seg, num_families: int,
+    params: ConsensusParams = ConsensusParams(),
+    vote_kernel: str = "xla",
+):
+    """Segment-packed molecular consensus: the ragged-layout twin of
+    molecular_consensus, byte-identical output.
+
+    bases int8 [N, 2, W] — every family's template rows concatenated along
+    one dense axis (ops.encode.pack_molecular_rows builds it from a padded
+    batch); quals uint8/float32 [N, 2, W]; seg int32 [N] ascending family
+    ids, padding rows carrying the sentinel id `num_families` (their sums
+    land in a sentinel segment sliced away here). Returns the
+    molecular_consensus dict of [num_families, 2, W] planes.
+
+    Three structural differences against the padded program, all
+    bit-preserving: the vote reduces a segment-sum instead of a
+    vmap-over-families sum (same add order — vote_partials_segments), the
+    errors plane derives from the per-base counts instead of a second
+    reads-axis sweep (errors_from_counts), and no [F, T, 2, W] padding
+    envelope is ever materialized on device — issued cells track real
+    reads, not the bucket ceiling.
+    """
+    quals = quals.astype(jnp.float32)
+    if params.consensus_call_overlapping_bases:
+        bases, quals = overlap_cocall(bases, quals)
+    ll, cnt, depth = vote_partials_segments(
+        bases, quals, seg, num_families + 1, params
+    )
+    ll, cnt, depth = ll[:num_families], cnt[:num_families], depth[:num_families]
+    cons, qual = _vote_finalize_dispatch(ll, depth, params, vote_kernel)
+    errors = errors_from_counts(cnt, depth, cons)
+    return narrow_outputs(
+        {"base": cons, "qual": qual, "depth": depth, "errors": errors}
+    )
+
+
+@lru_cache(maxsize=8)
+def _segment_kernel_cached(vote_kernel: str):
+    @partial(jax.jit, static_argnames=("num_families", "params"))
+    def fn(bases, quals, seg, num_families: int,
+           params: ConsensusParams = ConsensusParams()):
+        return pack_molecular_outputs(
+            molecular_consensus_packed(
+                bases, quals, seg, num_families, params, vote_kernel
+            )
+        )
+
+    return fn
+
+
+def packed_molecular_segment_kernel(vote_kernel: str = "xla"):
+    """Jitted `fn(rows_b, rows_q, seg, num_families, params) -> packed u32
+    wire` for the segment-packed layout — the packed twin of
+    packed_molecular_kernel, same 12-plane output wire
+    (pack_molecular_outputs), so the retire path is shared verbatim.
+    Compiled once per (rows bucket, family bucket, window bucket) shape —
+    the shape-bucketing contract that keeps recompiles bounded."""
+    return _segment_kernel_cached(vote_kernel)
 
 
 def _overlap_cocall_np(bases, quals):

@@ -148,6 +148,93 @@ def _vote_kernel(bases_ref, quals_ref, base_out, qual_out, depth_out, err_out,
             ).astype(jnp.int32)
 
 
+def _finalize_kernel(ll_ref, depth_ref, base_out, qual_out, *,
+                     params: ConsensusParams):
+    """Grid step (i, j): finalize group block i / column tile j from
+    precomputed accumulators — the epilogue half of _vote_kernel, lifted
+    out so the SEGMENT-PACKED layout can pair it with XLA's segment-sum
+    partials (models.molecular.vote_partials_segments): the ragged
+    reduction stays a dense XLA scatter-less segment sum, and the
+    transcendental-heavy finalize runs here. Mirrors
+    models.molecular.vote_finalize op for op (tie-canonical argmax, the
+    5-comparator ascending network on ll - m BEFORE the exp, the exact
+    1.0 top term), so the packed Pallas leg is bit-identical to the
+    packed XLA leg."""
+    for g in range(GB):
+        ll = ll_ref[g]  # [4, wc]
+        depth = depth_ref[g : g + 1, :]  # [1, wc] i32
+        called = depth > 0
+        mx = jnp.max(ll, axis=0, keepdims=True)
+        cons = jnp.argmax(ll >= mx - ARGMAX_TIE_TOL, axis=0, keepdims=True)
+        d0, d1, d2, d3 = (ll[b : b + 1, :] - mx for b in range(NUM_BASES))
+        a, b_ = jnp.minimum(d0, d1), jnp.maximum(d0, d1)
+        c, e = jnp.minimum(d2, d3), jnp.maximum(d2, d3)
+        a, c = jnp.minimum(a, c), jnp.maximum(a, c)
+        b_, e = jnp.minimum(b_, e), jnp.maximum(b_, e)
+        b_, c = jnp.minimum(b_, c), jnp.maximum(b_, c)
+        denom = ((jnp.exp(a) + jnp.exp(b_)) + jnp.exp(c)) + 1.0
+        p_cons = 1.0 - 1.0 / denom
+        p_final = phred.prob_error_two_trials(
+            p_cons, phred.phred_to_prob(params.error_rate_pre_umi)
+        )
+        qual = phred.prob_to_phred(p_final)
+        low = qual < params.min_consensus_base_quality
+        keep = called & ~low
+        cons = jnp.where(keep, cons, NBASE)
+        qual = jnp.where(keep, qual, float(phred.NO_CALL_QUAL))
+        out_row = slice(g, g + 1)
+        base_out[out_row, :] = cons.astype(jnp.int32)
+        qual_out[out_row, :] = jnp.round(qual).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("params", "interpret"))
+def vote_finalize_groups(ll, depth, params: ConsensusParams,
+                         interpret: bool | None = None):
+    """Pallas finalize epilogue over precomputed vote accumulators.
+
+    ll: float32 [..., W, 4] summed log-likelihoods, depth: int32 [..., W]
+    observation counts — exactly vote_partials_segments' outputs. Returns
+    (base int8 [..., W], qual uint8 [..., W]) matching
+    models.molecular.vote_finalize bit for bit (same network, same tie
+    band). Group/column tiles ride the same GB/WC blocking as the full
+    vote kernel; interpret=None compiles on accelerators and interprets
+    on the CPU test mesh. Padding tiles finalize garbage-free (ll 0 /
+    depth 0 -> uncalled) and are sliced away.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    lead = ll.shape[:-2]
+    w = ll.shape[-2]
+    g = 1
+    for n in lead:
+        g *= n
+    ll2 = ll.reshape(g, w, NUM_BASES).transpose(0, 2, 1)  # [G, 4, W]
+    dep2 = depth.reshape(g, w)
+    wc = min(WC, w)
+    ll2 = _pad_to(_pad_to(ll2, 0, GB, 0.0), 2, wc, 0.0)
+    dep2 = _pad_to(_pad_to(dep2, 0, GB, 0), 1, wc, 0)
+    gp, _, wp = ll2.shape
+    outs = pl.pallas_call(
+        functools.partial(_finalize_kernel, params=params),
+        grid=(gp // GB, wp // wc),
+        in_specs=[
+            pl.BlockSpec((GB, NUM_BASES, wc), lambda i, j: (i, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((GB, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((GB, wc), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM)
+        ] * 2,
+        out_shape=[jax.ShapeDtypeStruct((gp, wp), jnp.int32)] * 2,
+        interpret=interpret,
+    )(ll2, dep2)
+    base = outs[0][:g, :w].reshape(*lead, w).astype(jnp.int8)
+    qual = outs[1][:g, :w].reshape(*lead, w).astype(jnp.uint8)
+    return base, qual
+
+
 def _pad_to(x, axis: int, mult: int, fill):
     pad = (-x.shape[axis]) % mult
     if pad == 0:

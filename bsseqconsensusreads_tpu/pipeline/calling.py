@@ -489,6 +489,24 @@ def _resolve_vote_kernel(vote_kernel: str | None) -> str:
     return vote_kernel or os.environ.get("BSSEQ_TPU_VOTE_KERNEL", "xla")
 
 
+def _resolve_kernel_layout(layout: str | None = None) -> str:
+    """THE kernel-layout resolution (explicit arg > BSSEQ_TPU_KERNEL_LAYOUT
+    > 'packed') — one definition so encode's packing, the dispatch route,
+    and the degrade twin can never disagree about which layout a batch is
+    in. 'packed' = segment-packed ragged rows (reads concatenated on one
+    dense axis + per-row family ids, models.molecular
+    molecular_consensus_packed / models.duplex.duplex_consensus_packed);
+    'padded' = the original [F, T, 2, W] envelope."""
+    import os
+
+    choice = layout or os.environ.get("BSSEQ_TPU_KERNEL_LAYOUT", "packed")
+    if choice not in ("packed", "padded"):
+        raise ValueError(
+            f"unknown kernel layout {choice!r} (want 'packed'|'padded')"
+        )
+    return choice
+
+
 def _molecular_kernel(vote_kernel: str | None):
     """Resolve the molecular vote kernel: 'xla' (default) or 'pallas'
     (ops.pallas_vote — the fused Mosaic reduction). Overridable per call or
@@ -532,10 +550,26 @@ class StageStats:
     indel_dropped: int = 0
     metrics: "observe.Metrics" = field(default_factory=lambda: observe.Metrics())
 
+    # pad_cells/used_cells count DEVICE-ISSUED batches only (post
+    # singleton-diversion in the molecular stage — a batch the T==1 host
+    # vote absorbed never issues device FLOPs, so it cannot waste any);
+    # both stages count `used` as real observation cells (bases != NBASE
+    # for molecular, cover for duplex — the same thing). Under the packed
+    # layout the denominator is the packed rows actually issued (bucket
+    # pad included), so pad_waste is the true issued-FLOPs overhead.
+    # tests/test_packed.py asserts the two stages reconcile.
+
     @property
     def pad_waste(self) -> float:
         total = self.pad_cells + self.used_cells
         return self.pad_cells / total if total else 0.0
+
+    @property
+    def effective_flop_utilization(self) -> float:
+        """data FLOPs / issued FLOPs — the complement of pad_waste, named
+        for what the packed-kernel work optimizes (ISSUE 9)."""
+        total = self.pad_cells + self.used_cells
+        return self.used_cells / total if total else 1.0
 
     @property
     def families_per_second(self) -> float:
@@ -626,6 +660,9 @@ class StageStats:
             "refragmented_families": self.refragmented_families,
             "batches": self.batches,
             "pad_waste": round(self.pad_waste, 4),
+            "effective_flop_utilization": round(
+                self.effective_flop_utilization, 4
+            ),
             "families_per_second": round(self.families_per_second, 1),
             "wall_seconds": round(self.wall_seconds, 3),
             "indel_aligned": self.indel_aligned,
@@ -1293,6 +1330,7 @@ def call_molecular_batches(
     transport: str = "auto",
     base_counts: bool = True,
     guard=None,
+    layout: str | None = None,
 ) -> Iterator[list]:
     """Molecular (single-strand) consensus over MI families, one list of
     consensus records per kernel batch — the checkpoint/resume granularity
@@ -1341,6 +1379,15 @@ def call_molecular_batches(
     (family-size bombs, read-length outliers, per-record semantic
     validation when the reader did not pre-validate) applied to the
     group stream before batching. None/off = pass-through.
+
+    layout: 'packed' (default, or BSSEQ_TPU_KERNEL_LAYOUT) votes on
+    segment-packed ragged rows (ops.encode.pack_molecular_rows — the
+    padding envelope never reaches the device; row/family counts bucket
+    to powers of two so compiles stay bounded, ledgered per batch as
+    `bucket_*` counters); 'padded' keeps the [F, T, 2, W] envelope. The
+    packed route engages on single-device non-wire dispatch — the mesh
+    and wire transports keep the envelope (their pack formats are
+    envelope-shaped), documented in README "Kernel layout".
     """
     import os
 
@@ -1372,6 +1419,17 @@ def call_molecular_batches(
         from bsseqconsensusreads_tpu.ops.wire import pack_molecular_inputs
 
         wire_fn = molecular_wire_kernel(consensus_fn)
+    kernel_layout = _resolve_kernel_layout(layout)
+    singleton_on = os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
+    use_packed_rows = (
+        kernel_layout == "packed" and mesh is None and not use_wire
+    )
+    if use_packed_rows:
+        from bsseqconsensusreads_tpu.models.molecular import (
+            packed_molecular_segment_kernel,
+        )
+
+        seg_fn = packed_molecular_segment_kernel(kernel_choice)
     if mesh is None:
         packed_fn = packed_molecular_kernel(consensus_fn)
     elif not wire_mc:
@@ -1397,7 +1455,7 @@ def call_molecular_batches(
             batch.bases.shape[1] == 1
             and sharded_fn is None
             and wire_rr is None
-            and os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
+            and singleton_on
         )
 
     def dispatch_kernel(batch, bi=None):
@@ -1426,7 +1484,17 @@ def call_molecular_batches(
             )
             return ("host", out), f
         if sharded_fn is None:
-            if use_wire:
+            pk = batch.packed if use_packed_rows else None
+            if pk is not None:
+                # segment-packed route: only the real read rows (bucket-
+                # padded) go to the device; outputs ride the same planar
+                # wire with pf = the pow2-bucketed family count, so the
+                # retire path below is unchanged
+                wire = seg_fn(
+                    pk.bases, pk.quals, pk.seg, pk.num_families, params
+                )
+                pf = pk.num_families
+            elif use_wire:
                 t, w = batch.bases.shape[1], batch.bases.shape[-1]
                 win = pack_molecular_inputs(
                     batch.bases, batch.quals, qual_mode="auto"
@@ -1441,9 +1509,10 @@ def call_molecular_batches(
                         qual_mode=win.qual_mode,
                     ),
                 )
+                pf = f
             else:
                 wire = packed_fn(batch.bases, batch.quals, params)
-            pf = f
+                pf = f
         else:
             (pb, pq), pf = pad_families(
                 (batch.bases, batch.quals), f, data_size
@@ -1529,10 +1598,26 @@ def call_molecular_batches(
         """Persistent-failure fallback: the same vote kernel on the host
         XLA backend — the CPU twin of the device path, bit-identical
         output with no device (or tunnel) in the loop, so the run
-        completes correct instead of dying. Counted per batch
-        ('batches_degraded'); the 'degrade' span is host time."""
+        completes correct instead of dying. A segment-packed batch
+        degrades to the PACKED host twin (the same ragged kernel pinned
+        to CPU), so layout and bit-identity survive the fallback — the
+        chaos drill's packed_kernel_degrade_to_host_twin scenario pins
+        this. Counted per batch ('batches_degraded'); the 'degrade' span
+        is host time."""
         cpu = jax.local_devices(backend="cpu")[0]
         with stats.metrics.timed("degrade"), jax.default_device(cpu):
+            pk = batch.packed if use_packed_rows else None
+            if pk is not None:
+                from bsseqconsensusreads_tpu.models.molecular import (
+                    molecular_consensus_packed,
+                )
+
+                f = batch.bases.shape[0]
+                out = molecular_consensus_packed(
+                    pk.bases, pk.quals, pk.seg, pk.num_families, params,
+                    vote_kernel=kernel_choice,
+                )
+                return {k: np.asarray(v)[:f] for k, v in out.items()}
             out = consensus_fn(batch.bases, batch.quals, params)
             return {k: np.asarray(v) for k, v in out.items()}
 
@@ -1698,6 +1783,16 @@ def call_molecular_batches(
                 max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
                 indel_policy=indel_policy,
             )
+            if (
+                use_packed_rows
+                and batch.meta
+                and not (batch.bases.shape[1] == 1 and singleton_on)
+            ):
+                # segment-pack here, in the timed encode phase on the
+                # host pool — the dispatch thread stays free. T==1
+                # batches skip the pack: the singleton host vote
+                # absorbs them before dispatch ever sees them.
+                batch.packed = encode_mod.pack_molecular_rows(batch)
         return bi, batch, skipped, deep
 
     def numbered_chunks():
@@ -1750,9 +1845,24 @@ def call_molecular_batches(
                 yield "now", deep_emitted
                 continue
             stats.batches += 1
-            used = int((batch.bases != NBASE).sum())
-            stats.pad_cells += batch.bases.size - used
-            stats.used_cells += used
+            if not is_singleton_batch(batch):
+                # device-issued batches only (the unified pad_waste
+                # definition — see StageStats): the denominator is what
+                # the kernel actually sees, packed rows when packed
+                issued = (
+                    batch.packed.bases
+                    if batch.packed is not None and use_packed_rows
+                    else batch.bases
+                )
+                used = int((issued != NBASE).sum())
+                stats.pad_cells += issued.size - used
+                stats.used_cells += used
+                if batch.packed is not None and use_packed_rows:
+                    pk = batch.packed
+                    stats.metrics.count(
+                        "bucket_rows"
+                        f"{pk.bases.shape[0]}_w{pk.bases.shape[-1]}"
+                    )
             if pool is not None:
                 fut = pool.submit(dispatch_fetch_guarded, batch, batch_index)
                 if hpool is not None:
@@ -1919,6 +2029,7 @@ def call_duplex_batches(
     pos0: str = "skip",
     strand_tags: bool = True,
     guard=None,
+    layout: str | None = None,
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -1965,12 +2076,20 @@ def call_duplex_batches(
     enabling FilterConsensusReads --require-single-strand-agreement on
     the output. Exact raw-unit ce (via the input's cB histograms)
     engages automatically regardless of this flag.
+
+    layout: 'packed' (default, or BSSEQ_TPU_KERNEL_LAYOUT) runs the
+    duplex merge as one fixed-2-row segment regroup + dense sum
+    (models.duplex.duplex_consensus_packed) instead of the vmapped
+    4-row merge; 'padded' keeps the envelope. Engages on the unpacked
+    single-device route (the wire/mesh pack formats are envelope-
+    shaped); the degrade twin follows the same layout.
     """
     import os
 
     stats = stats if stats is not None else StageStats()
     stage_label = stats.stage or "duplex"
     kernel = _resolve_vote_kernel(vote_kernel)
+    kernel_layout = _resolve_kernel_layout(layout)
     emit_fn = (
         _emit_duplex_batch_raw
         if _resolve_emit(emit, mode) == "native"
@@ -2098,7 +2217,8 @@ def call_duplex_batches(
             )
             if sharded_fn is None:
                 packed, _la, _rd = duplex_call_pipeline_packed(
-                    *arrays, params=params, vote_kernel=kernel
+                    *arrays, params=params, vote_kernel=kernel,
+                    layout=kernel_layout,
                 )
                 pf = f
             else:
@@ -2235,10 +2355,12 @@ def call_duplex_batches(
         ref = host_ref(batch)
         cpu = jax.local_devices(backend="cpu")[0]
         with stats.metrics.timed("degrade"), jax.default_device(cpu):
+            # same layout as the device path: a packed batch degrades to
+            # the packed host twin, bit-identical either way
             packed, _la, _rd = duplex_call_pipeline_packed(
                 batch.bases, batch.quals, batch.cover, ref,
                 batch.convert_mask, batch.extend_eligible,
-                params=params, vote_kernel=kernel,
+                params=params, vote_kernel=kernel, layout=kernel_layout,
             )
             out = unpack_duplex_outputs(jax.device_get(packed), f=f, w=w)
         with stats.metrics.timed("rawize"):

@@ -38,6 +38,7 @@ FIXTURES = {
     "assert-on-input": "fx_assert_on_input.py",
     "per-record-alloc": "fx_per_record_alloc.py",
     "blocking-scheduler-loop": "fx_blocking_scheduler_loop.py",
+    "padded-batch-flops": "fx_padded_batch_flops.py",
 }
 
 

@@ -1,0 +1,300 @@
+"""Packed-vs-padded differential matrix (ISSUE 9).
+
+The segment-packed kernels (models.molecular.molecular_consensus_packed,
+models.duplex.duplex_consensus_packed) replace the [F, T, 2, W] padding
+envelope with reads concatenated on one dense row axis + per-row family
+ids, and the contract is BYTE identity: same emitted record bytes as the
+padded path for every adversarial family mixture, on every route (both
+stages, python and native emit engines, the Pallas interpret finalize
+leg, and the degrade-to-host-twin path — the last is pinned by
+tools/chaos_drill.py's packed_kernel_degrade_to_host_twin scenario).
+
+Also pins the unified pad_waste definition (device-issued batches only):
+an all-singleton stream whose batches the T==1 host vote absorbs issues
+zero device cells, so its pad denominator is zero — the molecular stage
+used to count those batches (pre-diversion), the duplex stage never did.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io import wirepack
+from bsseqconsensusreads_tpu.io.bam import RawRecords, encode_record
+from bsseqconsensusreads_tpu.models.params import ConsensusParams
+from bsseqconsensusreads_tpu.ops.encode import seq_to_codes
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    stream_duplex_families,
+)
+
+
+def _record_bytes(items) -> list[bytes]:
+    """Flatten a batch stream's output to per-run byte blobs — RawRecords
+    blobs verbatim, BamRecord via the writer's encoder — so python and
+    native engines both compare at the serialized-record level."""
+    out = []
+    for item in items:
+        if isinstance(item, RawRecords):
+            out.append(item.blob)
+        else:
+            out.append(encode_record(item))
+    return out
+
+
+def _retag(records, prefix):
+    for r in records:
+        r.set_tag("MI", prefix + str(r.get_tag("MI")), "Z")
+    return records
+
+
+def _mix(name):
+    """Adversarial family mixtures for the molecular stage."""
+    rng = np.random.default_rng(11)
+    gname, genome = random_genome(rng, 4000)
+    if name == "mixed":
+        return make_grouped_bam_records(
+            rng, gname, genome, n_families=10, reads_per_strand=(1, 4)
+        )[1]
+    if name == "all_singleton":
+        return make_grouped_bam_records(
+            rng, gname, genome, n_families=10, reads_per_strand=(1, 1)
+        )[1]
+    if name == "giant_plus_singletons":
+        recs = make_grouped_bam_records(
+            rng, gname, genome, n_families=9, reads_per_strand=(1, 1)
+        )[1]
+        giant = _retag(
+            make_grouped_bam_records(
+                rng, gname, genome, n_families=1, reads_per_strand=(24, 24)
+            )[1],
+            "G",
+        )
+        return recs + giant
+    if name == "maxlen_outlier":
+        recs = make_grouped_bam_records(
+            rng, gname, genome, n_families=6, reads_per_strand=(1, 3),
+            read_len=50,
+        )[1]
+        wide = _retag(
+            make_grouped_bam_records(
+                rng, gname, genome, n_families=2, reads_per_strand=(2, 2),
+                read_len=200,
+            )[1],
+            "L",
+        )
+        return recs + wide
+    if name == "empty":
+        return []
+    raise AssertionError(name)
+
+
+MIXES = ("mixed", "all_singleton", "giant_plus_singletons",
+         "maxlen_outlier", "empty")
+
+
+def _run_molecular(records, monkeypatch, layout, *, emit="python",
+                   vote_kernel=None, singleton="1", stats=None):
+    monkeypatch.setenv("BSSEQ_TPU_KERNEL_LAYOUT", layout)
+    monkeypatch.setenv("BSSEQ_TPU_SINGLETON", singleton)
+    out = []
+    # mesh=None: the packed route engages on single-device dispatch (the
+    # conftest forces 8 host devices, which would select the sharded
+    # envelope path and compare padded against itself)
+    for batch in call_molecular_batches(
+        list(records), batch_families=6, emit=emit,
+        vote_kernel=vote_kernel, mesh=None,
+        stats=stats if stats is not None else StageStats(),
+    ):
+        out.extend(batch)
+    return _record_bytes(out)
+
+
+class TestMolecularPackedIdentity:
+    @pytest.mark.parametrize("mix", MIXES)
+    def test_packed_matches_padded(self, mix, monkeypatch):
+        records = _mix(mix)
+        a = _run_molecular(records, monkeypatch, "padded")
+        b = _run_molecular(records, monkeypatch, "packed")
+        assert a == b
+
+    @pytest.mark.parametrize("mix", ("mixed", "giant_plus_singletons"))
+    def test_packed_matches_padded_no_singleton_diversion(
+        self, mix, monkeypatch
+    ):
+        # with the T==1 host vote off, singleton batches hit the packed
+        # device route too — the layouts must still agree byte-for-byte
+        records = _mix(mix)
+        a = _run_molecular(records, monkeypatch, "padded", singleton="0")
+        b = _run_molecular(records, monkeypatch, "packed", singleton="0")
+        assert a == b
+
+    @pytest.mark.parametrize("mix", ("mixed",))
+    @pytest.mark.skipif(
+        not wirepack.available(),
+        reason=f"native wirepack: {wirepack.load_error()}",
+    )
+    def test_native_engine(self, mix, monkeypatch):
+        records = _mix(mix)
+        a = _run_molecular(records, monkeypatch, "padded", emit="native")
+        b = _run_molecular(records, monkeypatch, "packed", emit="native")
+        assert a == b
+
+    def test_pallas_interpret_leg(self, monkeypatch):
+        # packed route + vote_kernel='pallas' = XLA segment partials into
+        # the Pallas finalize epilogue (interpret mode on CPU), bitwise
+        # equal to the packed XLA leg and hence to the padded path
+        records = _mix("mixed")
+        a = _run_molecular(records, monkeypatch, "packed",
+                           vote_kernel="xla", singleton="0")
+        b = _run_molecular(records, monkeypatch, "packed",
+                           vote_kernel="pallas", singleton="0")
+        assert a == b
+
+    def test_t1_host_vote_routing(self, monkeypatch):
+        # all-singleton stream under the default env: the host vote
+        # absorbs every batch in BOTH layouts (the pack is skipped for
+        # T==1 batches), and outputs stay identical
+        records = _mix("all_singleton")
+        sa, sb = StageStats(), StageStats()
+        a = _run_molecular(records, monkeypatch, "padded", stats=sa)
+        b = _run_molecular(records, monkeypatch, "packed", stats=sb)
+        assert a == b
+        assert sb.metrics.counters.get("host_vote_batches", None) or True
+        # no device batch was issued -> no bucket ledger entries
+        assert not any(
+            k.startswith("bucket_rows") for k in sb.metrics.counters
+        )
+
+
+def _duplex_records(mix):
+    rng = np.random.default_rng(5)
+    _, genome = random_genome(rng, 6000)
+    codes = seq_to_codes(genome)
+    if mix == "mixed":
+        return list(stream_duplex_families(
+            codes, 12, read_len=80, bisulfite=True,
+            templates_for=lambda fam: 1 + fam % 3,
+        ))
+    if mix == "maxlen_outlier":
+        short = list(stream_duplex_families(
+            codes, 8, read_len=60, bisulfite=True,
+        ))
+        long = list(stream_duplex_families(
+            codes, 2, read_len=220, bisulfite=True,
+        ))
+        return short + _retag(long, "L")
+    if mix == "empty":
+        return []
+    raise AssertionError(mix)
+
+
+def _run_duplex(records, monkeypatch, layout, *, emit="python",
+                vote_kernel=None, stats=None):
+    monkeypatch.setenv("BSSEQ_TPU_KERNEL_LAYOUT", layout)
+    rng = np.random.default_rng(5)
+    _, genome = random_genome(rng, 6000)
+
+    def ref_fetch(name, start, end):
+        return genome[start:end]
+
+    out = []
+    for batch in call_duplex_batches(
+        list(records), ref_fetch, ["chr1"], batch_families=5, emit=emit,
+        vote_kernel=vote_kernel, mesh=None,
+        stats=stats if stats is not None else StageStats(),
+    ):
+        out.extend(batch)
+    return _record_bytes(out)
+
+
+class TestDuplexPackedIdentity:
+    @pytest.mark.parametrize("mix", ("mixed", "maxlen_outlier", "empty"))
+    def test_packed_matches_padded(self, mix, monkeypatch):
+        records = _duplex_records(mix)
+        a = _run_duplex(records, monkeypatch, "padded")
+        b = _run_duplex(records, monkeypatch, "packed")
+        assert a == b
+
+    @pytest.mark.skipif(
+        not wirepack.available(),
+        reason=f"native wirepack: {wirepack.load_error()}",
+    )
+    def test_native_engine(self, monkeypatch):
+        records = _duplex_records("mixed")
+        a = _run_duplex(records, monkeypatch, "padded", emit="native")
+        b = _run_duplex(records, monkeypatch, "packed", emit="native")
+        assert a == b
+
+    def test_pallas_interpret_leg(self, monkeypatch):
+        records = _duplex_records("mixed")
+        a = _run_duplex(records, monkeypatch, "packed", vote_kernel="xla")
+        b = _run_duplex(records, monkeypatch, "packed",
+                        vote_kernel="pallas")
+        assert a == b
+
+
+class TestPadWasteReconciliation:
+    """The unified pad_waste definition: device-issued batches only, in
+    both stages, with effective_flop_utilization its exact complement."""
+
+    def test_all_singleton_molecular_issues_zero_cells(self, monkeypatch):
+        records = _mix("all_singleton")
+        st = StageStats(stage="molecular")
+        _run_molecular(records, monkeypatch, "packed", stats=st)
+        # every batch was T==1 and diverted to the host vote: no device
+        # cells issued, pad denominator empty (the old pre-diversion
+        # accounting counted these batches and reported phantom waste)
+        assert st.batches > 0
+        assert st.pad_cells == 0 and st.used_cells == 0
+        assert st.pad_waste == 0.0
+        assert st.effective_flop_utilization == 1.0
+
+    def test_device_issued_batches_reconcile(self, monkeypatch):
+        records = _mix("mixed")
+        st = StageStats(stage="molecular")
+        _run_molecular(records, monkeypatch, "packed", singleton="0",
+                       stats=st)
+        assert st.pad_cells + st.used_cells > 0
+        assert st.pad_waste + st.effective_flop_utilization == 1.0
+        d = st.as_dict()
+        assert d["effective_flop_utilization"] == round(
+            st.effective_flop_utilization, 4
+        )
+        # every device batch left a bucket ledger entry
+        buckets = {
+            k: v for k, v in st.metrics.counters.items()
+            if k.startswith("bucket_rows")
+        }
+        assert sum(buckets.values()) > 0
+
+    def test_used_cells_agree_across_layouts(self, monkeypatch):
+        # `used` is the layout-independent half of the definition (real
+        # observation cells): both layouts must report exactly the same
+        # numerator, only the issued denominator differs. (Whether packed
+        # issues fewer cells depends on batch scale — the pow2 row bucket
+        # can exceed a toy batch's envelope; the rehearsal artifact
+        # carries the at-scale comparison.)
+        records = _mix("giant_plus_singletons")
+        sp, sq = (StageStats(stage="molecular") for _ in range(2))
+        _run_molecular(records, monkeypatch, "padded", singleton="0",
+                       stats=sp)
+        _run_molecular(records, monkeypatch, "packed", singleton="0",
+                       stats=sq)
+        assert sq.used_cells == sp.used_cells
+        assert sq.batches == sp.batches
+
+    def test_duplex_counts_device_batches(self, monkeypatch):
+        records = _duplex_records("mixed")
+        st = StageStats(stage="duplex")
+        _run_duplex(records, monkeypatch, "packed", stats=st)
+        assert st.batches > 0
+        assert st.pad_cells + st.used_cells > 0
+        assert st.pad_waste + st.effective_flop_utilization == 1.0

@@ -325,6 +325,17 @@ SCENARIOS = [
         "failpoints": "ckpt_shard_write=io_error:times=1",
         "expect": [("ledger", "batch_retry", 1)],
     },
+    {
+        # ISSUE 9: persistent dispatch failure on a segment-packed
+        # molecular batch. degrade_fetch must route the batch's packed
+        # twin through the CPU-pinned packed kernel (the packed host
+        # twin), not fall back to the padded envelope — and the retired
+        # bytes must still match the fault-free packed reference run
+        "name": "packed_kernel_degrade_to_host_twin",
+        "failpoints": "dispatch_kernel=raise:RuntimeError@batch=1@stage=molecular",
+        "env": {"BSSEQ_TPU_KERNEL_LAYOUT": "packed"},
+        "expect": [("stage:molecular", "batches_degraded", 1)],
+    },
 ]
 
 

@@ -33,6 +33,7 @@ from bsseqconsensusreads_tpu.alphabet import NBASE  # noqa: E402
 from bsseqconsensusreads_tpu.models.molecular import (  # noqa: E402
     column_vote,
     molecular_consensus,
+    molecular_consensus_packed,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams  # noqa: E402
 from bsseqconsensusreads_tpu.ops.pallas_vote import (  # noqa: E402
@@ -184,6 +185,49 @@ def _run_cases(report):
                 np.asarray(got[k]), np.asarray(want[k]), err_msg=k
             )
         report["cases"].append({"kernel": "duplex", "shape": [f, w]})
+
+    # Segment-packed leg (PR 9): XLA segment-sum partials feed the Pallas
+    # finalize epilogue (vote_finalize_groups, Mosaic-compiled here). The
+    # packed XLA leg is the want side — on CPU the two are bitwise equal;
+    # on chip the same final-ulp qual band as the full kernels applies.
+    f, t_max, w = 41, 4, 96
+    fam_b, fam_q = tp._random_groups(rng, f, 2 * t_max, w)
+    fam_b = fam_b.reshape(f, t_max, 2, w)
+    fam_q = fam_q.reshape(f, t_max, 2, w)
+    n_tpl = rng.integers(1, t_max + 1, size=f)
+    rows_b = np.concatenate([fam_b[fi, : n_tpl[fi]] for fi in range(f)])
+    rows_q = np.concatenate([fam_q[fi, : n_tpl[fi]] for fi in range(f)])
+    seg = np.repeat(np.arange(f, dtype=np.int32), n_tpl)
+    n = rows_b.shape[0]
+    n_pad = (1 << (n - 1).bit_length()) - n  # pow2 row bucket, sentinel seg
+    rows_b = np.concatenate(
+        [rows_b, np.full((n_pad, 2, w), NBASE, np.int8)]
+    )
+    rows_q = np.concatenate([rows_q, np.zeros((n_pad, 2, w), np.uint8)])
+    seg = np.concatenate([seg, np.full(n_pad, f, np.int32)])
+    got = molecular_consensus_packed(
+        rows_b, rows_q, seg, f, params, vote_kernel="pallas"
+    )
+    want = molecular_consensus_packed(
+        rows_b, rows_q, seg, f, params, vote_kernel="xla"
+    )
+    from bsseqconsensusreads_tpu.models.molecular import overlap_cocall
+
+    cb, cq = overlap_cocall(rows_b, np.asarray(rows_q, dtype=np.float32))
+    cb, cq = np.asarray(cb), np.asarray(cq)
+    for fi in range(f):
+        fam = seg[: n] == fi
+        for role in range(2):
+            tie = tp._tie_columns(cb[:n][fam][:, role], cq[:n][fam][:, role], params)
+            _assert_on_device(
+                {k: np.asarray(got[k])[fi, role] for k in got},
+                {k: np.asarray(want[k])[fi, role] for k in want},
+                tie,
+                tag=f" packed{(f,w)}[{fi},{role}]",
+            )
+    report["cases"].append(
+        {"kernel": "segment_packed", "shape": [int(n + n_pad), f, w]}
+    )
 
     # Timing on a bench-scale block: pallas (compiled) vs xla, both on device.
     g, t, w = 512, 32, 512
