@@ -938,6 +938,42 @@ def _run_serve_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_methyl_quick() -> dict | None:
+    """tools/methyl_bench.py --quick -> METHYL_HEAD.json: the methylation
+    subsystem artifact (sites/sec + fused-epilogue overhead, admissible
+    only with the context oracle, the fused==host differential, and the
+    consensus-BAM-unperturbed gate all green — a fast wrong answer
+    reports ok=False and a null rate). Best-effort and cpu-pinned like
+    the chaos drill. BSSEQ_BENCH_METHYL=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_METHYL", "1") == "0":
+        return None
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "methyl_bench.py",
+    )
+    out_path = os.path.join(os.getcwd(), "METHYL_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, tool, "--quick", "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_METHYL_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "sites_per_sec": data.get("sites_per_sec"),
+            "methyl_overhead_pct": data.get("methyl_overhead_pct"),
+            "methyl_span_s": data.get("methyl_span_s"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -1101,6 +1137,14 @@ def main() -> None:
         observe.emit(
             "bench_serve_loadgen",
             {"ok": serve.get("ok"), "path": serve.get("path")},
+            sink=ledger_sink,
+        )
+    methyl = _run_methyl_quick()
+    if methyl is not None:
+        out["methyl"] = methyl
+        observe.emit(
+            "bench_methyl",
+            {"ok": methyl.get("ok"), "path": methyl.get("path")},
             sink=ledger_sink,
         )
     observe.flush_sinks()

@@ -33,7 +33,8 @@ SUPPRESS_TAG = "graftlint:"
 #: the T==1 path that never touches the device, 'degrade' = the
 #: CPU-twin fallback of a persistently failing batch, faults.retry).
 ACCOUNTED_SPANS = frozenset(
-    {"kernel", "device_wait", "fetch", "stall", "host_vote", "degrade"}
+    {"kernel", "device_wait", "fetch", "stall", "host_vote", "degrade",
+     "methyl"}
 )
 
 #: Functions treated as batch-loop roots for hot-path reachability: the
@@ -511,6 +512,7 @@ def all_rules() -> dict[str, Rule]:
         rules_input,
         rules_io,
         rules_jax,
+        rules_methyl,
         rules_pack,
         rules_retry,
         rules_serve,
@@ -520,7 +522,7 @@ def all_rules() -> dict[str, Rule]:
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
-                rules_pack):
+                rules_pack, rules_methyl):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

@@ -146,6 +146,14 @@ def cmd_run(args) -> int:
 
         cfg.genome_dir = os.path.dirname(args.reference) or "."
         cfg.genome_fasta_file_name = os.path.basename(args.reference)
+    if args.chemistry:
+        cfg.chemistry = args.chemistry
+    if args.methyl:
+        cfg.methyl = args.methyl
+    if args.methyl_out:
+        cfg.methyl_out = args.methyl_out
+    if args.single_strand:
+        cfg.single_strand = True
     target, results, stats = run_pipeline(
         cfg, args.bam, outdir=args.outdir, force=args.force
     )
@@ -228,6 +236,22 @@ def cmd_duplex(args) -> int:
     observe.open_ledger(component="duplex-cli")
     stats = StageStats(stage="duplex")
     fasta = FastaFile(args.reference)
+    methyl_acc = None
+    store = args.reference  # FASTA path; loaded only if the wire engages
+    if args.methyl != "off":
+        from bsseqconsensusreads_tpu.methyl.tally import MethylAccumulator
+        from bsseqconsensusreads_tpu.ops.refstore import RefStore
+
+        base = args.methyl_out or args.output
+        methyl_acc = MethylAccumulator(
+            RefStore.from_fasta(args.reference),
+            base + ".bedmethyl" if args.methyl in ("bedmethyl", "both")
+            else None,
+            base + ".CX_report.txt" if args.methyl in ("cx", "both")
+            else None,
+            metrics=stats.metrics,
+        )
+        store = methyl_acc.refstore
     g = _guard.Guard.from_env(stats)
     try:
         with open_guarded_reader(args.input, g) as reader:
@@ -248,16 +272,21 @@ def cmd_duplex(args) -> int:
                 grouping=args.grouping,
                 stats=stats,
                 emit=args.emit,
-                refstore=args.reference,  # FASTA path; loaded only if wire engages
+                refstore=store,
                 transport=args.transport,
                 passthrough=args.passthrough,
                 vote_kernel=args.vote_kernel,
                 pos0=args.pos0,
                 guard=g,
+                methyl=methyl_acc,
+                chemistry=args.chemistry,
             )
             from bsseqconsensusreads_tpu.pipeline.extsort import write_batch_stream
 
             write_batch_stream(batches, args.output, reader.header, args.mode)
+            if methyl_acc is not None:
+                report = methyl_acc.finalize()
+                observe.stderr_line(json.dumps({"methyl": report}))
     finally:
         g.close()
     observe.emit_stage_stats({"duplex": stats})
@@ -579,6 +608,7 @@ def cmd_submit(args) -> int:
         "policy": args.policy or None,
         "grouping": args.grouping or None,
         "ingest": args.ingest,
+        "chemistry": args.chemistry or None,
     }
     try:
         resp = request(args.socket, {"op": "submit", "spec": spec})
@@ -635,6 +665,24 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--aligner", choices=("self", "bwameth", "none"), default="")
     p.add_argument("--reference", default="", help="genome FASTA (overrides config)")
     p.add_argument("--force", action="store_true")
+    p.add_argument(
+        "--chemistry", choices=("bisulfite", "emseq", "none"), default="",
+        help="library chemistry (overrides config; see `duplex --help`)",
+    )
+    p.add_argument(
+        "--methyl", choices=("off", "bedmethyl", "cx", "both"), default="",
+        help="fused methylation extraction at the duplex stage "
+        "(overrides config)",
+    )
+    p.add_argument(
+        "--methyl-out", default="",
+        help="base path for the methylation outputs (overrides config)",
+    )
+    p.add_argument(
+        "--single-strand", action="store_true",
+        help="molecular emit without duplex pairing: stop after the "
+        "molecular consensus stage",
+    )
     _add_failpoints(p)
     p.set_defaults(fn=cmd_run)
 
@@ -668,6 +716,26 @@ def main(argv: list[str] | None = None) -> int:
         "'skip' (default, documented deviation) or 'shift' = exact "
         "reference parity incl. the one-base register shift "
         "(tools/1.convert_AG_to_CT.py:87-92)",
+    )
+    p.add_argument(
+        "--chemistry", choices=("bisulfite", "emseq", "none"),
+        default="bisulfite",
+        help="library chemistry: bisulfite/emseq run the conversion-aware "
+        "engine (identical C->T readout; emseq is provenance), 'none' "
+        "declares an unconverted plain (fgbio-style) duplex library — "
+        "the convert transform is disabled, same engine otherwise",
+    )
+    p.add_argument(
+        "--methyl", choices=("off", "bedmethyl", "cx", "both"),
+        default="off",
+        help="fused methylation extraction: per-column classify-and-count "
+        "epilogue on the vote kernels, bedMethyl and/or CX cytosine "
+        "report next to the output (methyl/ subsystem)",
+    )
+    p.add_argument(
+        "--methyl-out", default="",
+        help="base path for the methylation outputs (default: the duplex "
+        "output path)",
     )
     _add_params(p, min_reads_default=0)
     p.set_defaults(fn=cmd_duplex)
@@ -808,6 +876,12 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument(
         "--ingest", choices=("auto", "native", "python"), default="python"
+    )
+    p.add_argument(
+        "--chemistry", choices=("bisulfite", "emseq", "none"), default="",
+        help="THIS job's library chemistry (admission validation + "
+        "provenance: the molecular stage is chemistry-invariant, so "
+        "mixed-chemistry tenants share device batches safely)",
     )
     p.add_argument("--wait", action="store_true", help="block until done")
     p.add_argument("--timeout", type=float, default=600.0)

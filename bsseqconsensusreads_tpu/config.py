@@ -152,6 +152,31 @@ class FrameworkConfig:
     #: surface; FilterConsensusReads --require-single-strand-agreement
     #: input — pipeline.calling._duplex_rawize).
     duplex_strand_tags: bool = True
+    #: library chemistry: 'bisulfite' (reference parity) and 'emseq'
+    #: (enzymatic conversion — computationally identical C->T readout,
+    #: recorded as provenance in stage reports and serve job stats);
+    #: 'none' declares an UNCONVERTED plain duplex library (fgbio-style):
+    #: the convert transform is disabled wholesale (the flag-derived
+    #: convert mask is cleared after encode) and the identical engine
+    #: runs everything downstream. 'none' refuses the conversion-coupled
+    #: surfaces (duplex_passthrough, pos0='shift', methyl extraction) —
+    #: pipeline.calling.call_duplex_batches validates the combinations.
+    chemistry: str = "bisulfite"
+    #: fused methylation extraction at the duplex stage (methyl/):
+    #: 'off' (default), 'bedmethyl', 'cx', or 'both' — per-column
+    #: classify-and-count epilogue on the vote kernels, contig-sharded
+    #: tally accumulation riding the duplex checkpoint's watermark
+    #: protocol, outputs next to the duplex target (<target>.bedmethyl /
+    #: <target>.CX_report.txt, or `methyl_out` as the base path).
+    methyl: str = "off"
+    #: base path for the methylation outputs (''= derive from the duplex
+    #: stage target).
+    methyl_out: str = ""
+    #: single-strand consensus mode: stop after the molecular stage
+    #: (molecular emit without duplex pairing — libraries whose protocol
+    #: never forms ab/ba duplex pairs). Incompatible with methyl
+    #: extraction (which is a duplex-stage epilogue).
+    single_strand: bool = False
     molecular: ConsensusParams = dataclasses.field(
         default_factory=lambda: ConsensusParams(min_reads=1)
     )

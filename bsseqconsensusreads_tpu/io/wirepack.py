@@ -48,6 +48,7 @@ def _try_load():
             "wirepack_sort_raw_records",
             "wirepack_strand_calls",
             "wirepack_bcount_sparse",
+            "wirepack_methyl_tally_merge",
         ),
     )
     if lib is None:
@@ -108,6 +109,11 @@ def _try_load():
     lib.wirepack_bcount_sparse.argtypes = [
         C.c_void_p, C.c_void_p, C.c_int64, C.c_int64, C.c_int64,
         C.c_void_p, C.c_int, C.c_int, C.c_void_p,
+    ]
+    lib.wirepack_methyl_tally_merge.restype = C.c_int64
+    lib.wirepack_methyl_tally_merge.argtypes = [
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_int64,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
     ]
     _lib = lib
 
@@ -340,6 +346,32 @@ def duplex_rawize(out: dict, row_pos, row_off, row_len, aux, window_start,
     new["a_err"], new["b_err"] = ae, be
     new["depth"], new["errors"] = depth, errors
     return new
+
+
+def methyl_tally_merge(sites, ctx, meth, unmeth):
+    """Native merge of methylation site tallies -> sorted unique summed
+    rows (methyl.tally.merge_tallies holds the pinned numpy twin)."""
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    sites = np.ascontiguousarray(sites, dtype=np.int64)
+    ctx = np.ascontiguousarray(ctx, dtype=np.uint8)
+    meth = np.ascontiguousarray(meth, dtype=np.uint32)
+    unmeth = np.ascontiguousarray(unmeth, dtype=np.uint32)
+    n = sites.size
+    out_sites = np.empty(n, np.int64)
+    out_ctx = np.empty(n, np.uint8)
+    out_meth = np.empty(n, np.uint32)
+    out_unmeth = np.empty(n, np.uint32)
+    p = lambda a: a.ctypes.data_as(C.c_void_p)  # noqa: E731
+    m = _lib.wirepack_methyl_tally_merge(
+        p(sites), p(ctx), p(meth), p(unmeth), n,
+        p(out_sites), p(out_ctx), p(out_meth), p(out_unmeth),
+    )
+    return (
+        out_sites[:m].copy(), out_ctx[:m].copy(),
+        out_meth[:m].copy(), out_unmeth[:m].copy(),
+    )
 
 
 def _string_blob(strings: list[str]):

@@ -1,0 +1,24 @@
+"""graftmethyl: fused on-chip methylation extraction.
+
+The subsystem the consensus engine exists to feed: per-column methylation
+calls fall out of the duplex vote as a fused epilogue (methyl.context),
+per-batch tallies reduce through a contig-sharded spill accumulator
+(methyl.tally), and the aggregate emits bedMethyl / CX cytosine reports
+(methyl.emit). Chemistry modes (bisulfite | emseq | none) gate the
+conversion transform upstream; the epilogue itself is chemistry-invariant
+because it reads the RAW pre-conversion planes.
+"""
+
+from bsseqconsensusreads_tpu.methyl.context import (  # noqa: F401
+    CTX_NONE,
+    CTX_NAMES,
+    methyl_epilogue,
+    methyl_epilogue_host,
+    methyl_wire_words,
+    unpack_methyl_planes,
+)
+from bsseqconsensusreads_tpu.methyl.tally import (  # noqa: F401
+    MethylAccumulator,
+    extract_tallies,
+    merge_tallies,
+)

@@ -1,0 +1,169 @@
+"""Per-column methylation epilogue on the duplex vote kernels.
+
+Bisulfite (and EM-seq) conversion leaves methylated cytosines as C and
+converts unmethylated ones to T — so after the duplex engine has grouped a
+family's four reads (rows 99/163/83/147) into window space, every reference
+cytosine column already holds the complete methylation evidence for that
+molecule, and extraction is a per-column classify-and-count over tensors the
+vote kernel is ALREADY holding in registers. That is the fusion argument:
+no re-scan of the consensus BAM, no per-read host loop (the shape
+analysis/rules_methyl.py exists to forbid) — one epilogue on the same
+arrays, shipped as two extra u8 planes per family.
+
+Semantics (the mini-genome oracle in tests/test_methyl.py pins these):
+
+  * A site is a reference C (top-strand cytosine, evidence read directly by
+    the NON-converted rows: raw C = methylated, raw T = unmethylated) or a
+    reference G (bottom-strand cytosine, evidence carried by the
+    CONVERT-MASK rows: raw G = methylated, raw A = unmethylated). The
+    epilogue consumes the RAW pre-conversion planes — ops.convert erases
+    exactly this signal (that is its job).
+  * Context is classified from a bounded reference extension ref_ext
+    [F, W + 4] with ref_ext[j] = genome[window_start - 2 + j]:
+    CpG / CHG / CHH on the + strand from the two FOLLOWING bases, on the
+    - strand from the two PRECEDING bases (reverse-complement symmetry).
+    Any needed base that is N (including out-of-contig columns — the
+    bounded gather yields N there) suppresses the call.
+  * An observation counts when the cell is covered and its input quality
+    passes params.min_input_base_quality — the same observation gate the
+    vote itself applies.
+  * A column only reports when the duplex consensus CALLED a base there in
+    at least one role — uncalled columns carry no consensus evidence.
+
+Outputs per family: ctx u8 [F, W] (0 = no site; 1/2/3 = CpG/CHG/CHH on +;
+4/5/6 = CpG/CHG/CHH on -) and counts u8 [F, W] nibble-packed
+meth | unmeth << 4 (each <= 4 rows of evidence). Both the jit epilogue and
+the numpy host twin are pure integer pipelines over the same formulas, so
+the bit-identity contract is structural, not numerical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from bsseqconsensusreads_tpu.alphabet import NBASE
+
+#: ctx plane codes. 0 reserved for "no callable site".
+CTX_NONE = 0
+#: code -> (context name, strand char) for the emit surface.
+CTX_NAMES = {
+    1: ("CpG", "+"), 2: ("CHG", "+"), 3: ("CHH", "+"),
+    4: ("CpG", "-"), 5: ("CHG", "-"), 6: ("CHH", "-"),
+}
+
+_A, _C, _G, _T = 0, 1, 2, 3
+
+
+def _classify(xp, r_m2, r_m1, r_0, r_p1, r_p2):
+    """Shared context classification: identical formula for jnp and numpy.
+
+    + strand (ref C): CpG when next is G; CHG when next is a non-N non-G
+    and next-but-one is G; CHH when both followers are non-N non-G.
+    - strand (ref G): the mirror over the preceding bases with C.
+    """
+    p1g, p1n = r_p1 == _G, r_p1 == NBASE
+    p2g, p2n = r_p2 == _G, r_p2 == NBASE
+    ctx_p = xp.where(
+        p1g, 1, xp.where(p1n, 0, xp.where(p2g, 2, xp.where(p2n, 0, 3)))
+    )
+    m1c, m1n = r_m1 == _C, r_m1 == NBASE
+    m2c, m2n = r_m2 == _C, r_m2 == NBASE
+    ctx_m = xp.where(
+        m1c, 4, xp.where(m1n, 0, xp.where(m2c, 5, xp.where(m2n, 0, 6)))
+    )
+    return xp.where(
+        r_0 == _C, ctx_p, xp.where(r_0 == _G, ctx_m, 0)
+    )
+
+
+def _epilogue(xp, bases, quals, cover, convert_mask, cons_base, ref_ext,
+              min_q):
+    """One implementation, two array namespaces (jnp on device, numpy as
+    the host twin) — the layout-independence and engine-parity tests pin
+    the outputs byte-identical, and sharing the formula makes that a
+    structural property rather than a maintained one."""
+    w = bases.shape[-1]
+    q = quals.astype(xp.float32)
+    obs = cover & (q >= min_q)  # [F, 4, W]
+    cm = convert_mask.astype(bool)[:, :, None]  # [F, 4, 1]
+    r_m2 = ref_ext[:, 0:w]
+    r_m1 = ref_ext[:, 1 : w + 1]
+    r_0 = ref_ext[:, 2 : w + 2]
+    r_p1 = ref_ext[:, 3 : w + 3]
+    r_p2 = ref_ext[:, 4 : w + 4]
+    ctx = _classify(xp, r_m2, r_m1, r_0, r_p1, r_p2)
+    called = (cons_base[:, 0, :] != NBASE) | (cons_base[:, 1, :] != NBASE)
+    ctx = xp.where(called, ctx, 0).astype(xp.uint8)
+    # evidence: top-strand sites read the untreated rows as-is; bottom-
+    # strand sites read the convert-mask rows (the reads whose C->T
+    # treatment happened on the OTHER strand, so their G/A carries the
+    # bottom-strand cytosine state)
+    obs_p = obs & ~cm
+    obs_m = obs & cm
+    meth_p = xp.sum(obs_p & (bases == _C), axis=1)
+    unme_p = xp.sum(obs_p & (bases == _T), axis=1)
+    meth_m = xp.sum(obs_m & (bases == _G), axis=1)
+    unme_m = xp.sum(obs_m & (bases == _A), axis=1)
+    top = r_0 == _C
+    meth = xp.where(top, meth_p, meth_m).astype(xp.uint8)
+    unme = xp.where(top, unme_p, unme_m).astype(xp.uint8)
+    valid = ctx != 0
+    counts = xp.where(valid, meth | (unme << 4), 0).astype(xp.uint8)
+    return ctx, counts
+
+
+def methyl_epilogue(bases, quals, cover, convert_mask, cons_base, ref_ext,
+                    min_q: float):
+    """Device epilogue (jit-traceable): returns planes u8 [F, 2, W] —
+    row 0 = ctx codes, row 1 = nibble-packed counts (meth | unmeth << 4).
+
+    bases/quals/cover are the RAW batch planes [F, 4, W] (pre-conversion),
+    convert_mask bool [F, 4], cons_base int8 [F, 2, W] (the vote output),
+    ref_ext int8 [F, W + 4] (ops.refstore bounded extension gather).
+    """
+    ctx, counts = _epilogue(
+        jnp, bases, quals, cover, convert_mask, cons_base, ref_ext,
+        jnp.float32(min_q),
+    )
+    return jnp.stack([ctx, counts], axis=1)
+
+
+def methyl_epilogue_host(bases, quals, cover, convert_mask, cons_base,
+                         ref_ext, min_q: float) -> np.ndarray:
+    """numpy host twin of methyl_epilogue — byte-identical planes.
+
+    Engaged on the mesh-sharded path and under
+    BSSEQ_TPU_METHYL_ENGINE=host (the differential leg the acceptance
+    byte-compare drives); also the degrade path's implementation.
+    """
+    ctx, counts = _epilogue(
+        np,
+        np.asarray(bases),
+        np.asarray(quals),
+        np.asarray(cover, dtype=bool),
+        np.asarray(convert_mask, dtype=bool),
+        np.asarray(cons_base),
+        np.asarray(ref_ext),
+        np.float32(min_q),
+    )
+    return np.stack([ctx, counts], axis=1)
+
+
+def methyl_wire_words(planes):
+    """Device-side pack of the methyl planes [F, 2, W] u8 into flat u32
+    words for the output wire — appended AFTER the b0 + la/rd sections so
+    the existing wire prefix parses unchanged (ops.reconstruct)."""
+    return jax.lax.bitcast_convert_type(
+        planes.reshape(-1, 4), jnp.uint32
+    ).reshape(-1)
+
+
+def unpack_methyl_planes(words, f: int, w: int) -> np.ndarray:
+    """numpy inverse of methyl_wire_words -> u8 [f, 2, w]."""
+    u8 = np.asarray(words)
+    if u8.dtype != np.uint8:
+        u8 = u8.view(np.uint8)
+    return u8[: f * 2 * w].reshape(f, 2, w)

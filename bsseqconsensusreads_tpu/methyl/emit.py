@@ -1,0 +1,92 @@
+"""Methylation output formats: bedMethyl and CX cytosine report.
+
+Both render the merged global-offset tallies (methyl.tally) back into
+contig coordinates via the RefStore's offset table. Sites arrive sorted by
+global offset — contig-major — so output order is (contig, pos) without a
+sort. Both surfaces cover OBSERVED sites only (coverage >= 1); the classic
+bismark CX report enumerates every genomic cytosine, covered or not — the
+covered-only scoping here is deliberate (PARITY.md) so output size scales
+with data, not genome.
+
+bedMethyl (ENCODE-style 11 columns):
+  chrom  start0  end  context  score(min(1000, cov))  strand
+  thickStart  thickEnd  0,0,0  coverage  methyl%% (integer floor)
+
+CX report (bismark-style columns, covered sites only):
+  chrom  pos1  strand  count_meth  count_unmeth  context  trinucleotide
+
+The per-site python loop below is the COLD finalize path (once per run,
+after all batches) — the hot path ships dense planes; graftlint's
+unfused-methyl-scan rule guards the hot side, not this one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.methyl.context import CTX_NAMES
+
+_CODE_CHAR = "ACGTN"
+_COMP_CHAR = "TGCAN"
+
+
+def _site_coords(refstore, sites):
+    """(contig index, local pos) arrays for sorted global offsets."""
+    rid = (
+        np.searchsorted(refstore.offsets, sites, side="right") - 1
+        if sites.size
+        else np.zeros(0, np.int64)
+    )
+    pos = sites - refstore.offsets[rid] if sites.size else sites
+    return rid, pos
+
+
+def _trinucleotide(refstore, rid: int, pos: int, minus: bool) -> str:
+    """Reference trinucleotide 5'->3' on the site's own strand; N where the
+    contig ends inside the window (context never needs those columns, the
+    report shows them as unresolved)."""
+    length = int(refstore.lengths[rid])
+    off = int(refstore.offsets[rid])
+    out = []
+    for k in range(3):
+        p = pos - k if minus else pos + k
+        if 0 <= p < length:
+            code = int(refstore.codes[off + p])
+            out.append(_COMP_CHAR[code] if minus else _CODE_CHAR[code])
+        else:
+            out.append("N")
+    return "".join(out)
+
+
+def write_bedmethyl(path: str, refstore, sites, ctx, meth, unmeth) -> None:
+    rid, pos = _site_coords(refstore, sites)
+    with open(path, "wb") as fh:
+        for i in range(sites.size):
+            name, strand = CTX_NAMES[int(ctx[i])]
+            m, u = int(meth[i]), int(unmeth[i])
+            cov = m + u
+            p = int(pos[i])
+            chrom = refstore.names[int(rid[i])]
+            fh.write(
+                (
+                    f"{chrom}\t{p}\t{p + 1}\t{name}\t{min(1000, cov)}\t"
+                    f"{strand}\t{p}\t{p + 1}\t0,0,0\t{cov}\t"
+                    f"{(100 * m) // cov}\n"
+                ).encode()
+            )
+
+
+def write_cx_report(path: str, refstore, sites, ctx, meth, unmeth) -> None:
+    rid, pos = _site_coords(refstore, sites)
+    with open(path, "wb") as fh:
+        for i in range(sites.size):
+            name, strand = CTX_NAMES[int(ctx[i])]
+            r = int(rid[i])
+            p = int(pos[i])
+            tri = _trinucleotide(refstore, r, p, strand == "-")
+            fh.write(
+                (
+                    f"{refstore.names[r]}\t{p + 1}\t{strand}\t"
+                    f"{int(meth[i])}\t{int(unmeth[i])}\t{name}\t{tri}\n"
+                ).encode()
+            )

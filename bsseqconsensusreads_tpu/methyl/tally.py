@@ -1,0 +1,397 @@
+"""Contig-sharded methylation tally accumulator.
+
+Per-batch methyl planes (methyl.context) reduce into global per-site
+(methylated, unmethylated) sums keyed by the site's GLOBAL genome offset
+(ops.refstore's concatenated coordinate — already contig-major, so sorted
+global offsets ARE (contig, pos) order and the emit never re-sorts).
+
+Crash consistency rides the duplex checkpoint's watermark protocol:
+
+  * add() is idempotent per batch index — a watchdog-redispatched batch
+    recomputes identical tallies, so replacing the pending entry (or
+    ignoring a batch at/below the committed watermark) never double-counts;
+  * flush(watermark) — wired as pipeline.checkpoint.BatchCheckpoint's
+    on_flush hook, called after the shard write succeeds and BEFORE the
+    manifest commits — spills every pending batch <= watermark into one
+    CRC'd run file recorded in a sidecar manifest
+    (<output>.methyl.runs.json) whose entries carry their `upto` watermark;
+  * resume(batches_done) keeps the longest manifest prefix whose `upto`
+    does not exceed the checkpoint's committed batch count, verifies CRCs,
+    and deletes orphan run files — batches above the kept watermark replay
+    through the engine exactly like the consensus stream itself.
+
+Tally sums are commutative integers, so the final bedMethyl/CX bytes are
+independent of run boundaries — the kill/resume chaos drill
+(methyl_spill_io_error_resume) pins byte-identity, not just row equality.
+
+The run-write attempt fires the `extsort_spill` failpoint with
+stage="methyl" (the accumulator IS a spill client of the extsort
+machinery), so fault schedules can target methyl spills without touching
+the sort engine's own runs.
+
+The merge pass itself (merge_tallies) has a native wirepack sweep
+(native/wirepack.cpp methyl_tally_merge) with the numpy
+argsort + reduceat twin below as the pinned parity reference
+(BSSEQ_TPU_METHYL_MERGE=python forces the twin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+import numpy as np
+
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import integrity as _integrity
+from bsseqconsensusreads_tpu.faults import retry as _faultretry
+from bsseqconsensusreads_tpu.utils import observe
+
+_RUN_MAGIC = b"BSMT"
+_RUN_VERSION = 1
+
+
+def merge_tallies(sites, ctx, meth, unmeth, engine: str = "auto"):
+    """Reduce (possibly duplicated) site tallies to sorted unique sums.
+
+    sites int64 [n] global genome offsets, ctx u8 [n] (a pure function of
+    the site, so any occurrence's value is THE value), meth/unmeth u32 [n].
+    Returns the same four arrays, sites strictly increasing. engine:
+    'auto' (native wirepack when built), 'native', 'python';
+    BSSEQ_TPU_METHYL_MERGE overrides.
+    """
+    engine = os.environ.get("BSSEQ_TPU_METHYL_MERGE", engine)
+    sites = np.ascontiguousarray(sites, dtype=np.int64)
+    ctx = np.ascontiguousarray(ctx, dtype=np.uint8)
+    meth = np.ascontiguousarray(meth, dtype=np.uint32)
+    unmeth = np.ascontiguousarray(unmeth, dtype=np.uint32)
+    if engine != "python":
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if wirepack.available():
+            return wirepack.methyl_tally_merge(sites, ctx, meth, unmeth)
+        if engine == "native":
+            raise RuntimeError(
+                "BSSEQ_TPU_METHYL_MERGE=native but the wirepack library "
+                "is not built (native/Makefile)"
+            )
+    if not sites.size:
+        return sites, ctx, meth, unmeth
+    order = np.argsort(sites, kind="stable")
+    s = sites[order]
+    first = np.concatenate([[True], s[1:] != s[:-1]])
+    idx = np.nonzero(first)[0]
+    return (
+        s[idx],
+        ctx[order][idx],
+        np.add.reduceat(meth[order].astype(np.uint64), idx).astype(np.uint32),
+        np.add.reduceat(unmeth[order].astype(np.uint64), idx).astype(
+            np.uint32
+        ),
+    )
+
+
+def extract_tallies(planes, metas, refstore, rid_map=None):
+    """Sparse per-batch tallies from the dense methyl planes.
+
+    planes u8 [F, 2, W] (ctx, nibble counts), metas the batch's FamilyMeta
+    list, refstore an ops.refstore.RefStore (global offset arithmetic).
+    rid_map (refstore.contig_indices over the BAM header names) translates
+    each meta's ref_id into a STORE contig index — the header's contig
+    order is not the store's, and a raw ref_id would land the sites on the
+    wrong contig. Families without a reference (unknown contig / negative
+    start) carry no sites. One vectorized nonzero over the batch — no
+    per-record loop.
+    """
+    planes = np.asarray(planes)
+    f, _, w = planes.shape
+    rid = np.asarray([m.ref_id for m in metas], dtype=np.int64)
+    if rid_map is not None:
+        rid_map = np.asarray(rid_map, dtype=np.int64)
+        known = (rid >= 0) & (rid < len(rid_map))
+        rid = np.where(known, rid_map[np.where(known, rid, 0)], -1)
+    ws = np.asarray([m.window_start for m in metas], dtype=np.int64)
+    ok = (rid >= 0) & (rid < len(refstore.names)) & (ws >= 0)
+    gstart = np.where(ok, refstore.offsets[np.where(ok, rid, 0)] + ws, -1)
+    ctx_plane = planes[:, 0, :]
+    cnt_plane = planes[:, 1, :]
+    mask = (ctx_plane != 0) & (cnt_plane != 0) & ok[:, None]
+    fi, col = np.nonzero(mask)
+    cnt = cnt_plane[fi, col]
+    return (
+        gstart[fi] + col,
+        ctx_plane[fi, col],
+        (cnt & 0xF).astype(np.uint32),
+        (cnt >> 4).astype(np.uint32),
+    )
+
+
+def _write_run_payload(path: str, entries) -> int:
+    """One run-file write attempt (the retry unit): header + the four
+    tally arrays of every pending entry, concatenated and pre-merged."""
+    sites = np.concatenate([e[0] for e in entries])
+    ctx = np.concatenate([e[1] for e in entries])
+    meth = np.concatenate([e[2] for e in entries])
+    unmeth = np.concatenate([e[3] for e in entries])
+    sites, ctx, meth, unmeth = merge_tallies(sites, ctx, meth, unmeth)
+    with open(path, "wb") as fh:
+        fh.write(_RUN_MAGIC)
+        fh.write(struct.pack("<IQ", _RUN_VERSION, sites.size))
+        fh.write(sites.tobytes())
+        fh.write(ctx.tobytes())
+        fh.write(meth.tobytes())
+        fh.write(unmeth.tobytes())
+    return int(sites.size)
+
+
+def _read_run_file(path: str):
+    with open(path, "rb") as fh:
+        magic = fh.read(4)
+        if magic != _RUN_MAGIC:
+            raise _integrity.IntegrityError(
+                f"{path}: bad methyl run magic {magic!r}"
+            )
+        version, n = struct.unpack("<IQ", fh.read(12))
+        if version != _RUN_VERSION:
+            raise _integrity.IntegrityError(
+                f"{path}: methyl run version {version} != {_RUN_VERSION}"
+            )
+        sites = np.frombuffer(fh.read(8 * n), dtype=np.int64)
+        ctx = np.frombuffer(fh.read(n), dtype=np.uint8)
+        meth = np.frombuffer(fh.read(4 * n), dtype=np.uint32)
+        unmeth = np.frombuffer(fh.read(4 * n), dtype=np.uint32)
+    if unmeth.size != n:
+        raise _integrity.IntegrityError(f"{path}: truncated methyl run")
+    return sites, ctx, meth, unmeth
+
+
+class MethylAccumulator:
+    """Thread-safe tally sink for one duplex stage run.
+
+    bed_path / cx_path select the emit formats (either may be None, not
+    both). Run files spill next to the first output. When a
+    BatchCheckpoint drives flush(), spills happen ONLY at its committed
+    watermarks (resume safety: a run can never contain a batch the replay
+    would skip AND the manifest would drop); without a checkpoint, a size
+    threshold (spill_sites) bounds pending memory instead.
+    """
+
+    def __init__(self, refstore, bed_path: str | None = None,
+                 cx_path: str | None = None, *, metrics=None,
+                 spill_sites: int = 1 << 22):
+        if bed_path is None and cx_path is None:
+            raise ValueError("MethylAccumulator needs bed_path or cx_path")
+        self.refstore = refstore
+        self.bed_path = bed_path
+        self.cx_path = cx_path
+        self.metrics = metrics
+        self.spill_sites = spill_sites
+        target = bed_path if bed_path is not None else cx_path
+        self._base = target
+        self._manifest_path = target + ".methyl.runs.json"
+        self._lock = threading.Lock()
+        self._pending: dict[int, tuple] = {}
+        self._pending_sites = 0
+        self._watermark = 0
+        self._runs: list[dict] = []
+        self._checkpointed = False
+        self._rid_map = None  # set by bind_names (BAM ref_id -> store idx)
+        self.sites_out = 0  # final unique site count (set by finalize)
+
+    def bind_names(self, ref_names) -> None:
+        """Pin the BAM-header ref_id -> store contig translation that
+        add_planes' global-offset arithmetic needs (the header order and
+        the store order are independent)."""
+        self._rid_map = self.refstore.contig_indices(ref_names)
+
+    # ---- ingestion ----------------------------------------------------
+
+    def add(self, batch_index: int, sites, ctx, meth, unmeth) -> None:
+        """Record one batch's tallies. Idempotent per batch index: a
+        duplicate add (watchdog redispatch) replaces the identical pending
+        entry or — at/below the committed watermark — is ignored."""
+        with self._lock:
+            if batch_index <= self._watermark:
+                return
+            prev = self._pending.get(batch_index)
+            if prev is not None:
+                self._pending_sites -= prev[0].size
+            self._pending[batch_index] = (
+                np.asarray(sites, dtype=np.int64),
+                np.asarray(ctx, dtype=np.uint8),
+                np.asarray(meth, dtype=np.uint32),
+                np.asarray(unmeth, dtype=np.uint32),
+            )
+            self._pending_sites += self._pending[batch_index][0].size
+            over = (
+                not self._checkpointed
+                and self._pending_sites > self.spill_sites
+            )
+            if over:
+                self._spill_locked(max(self._pending))
+
+    def add_planes(self, batch_index: int, planes, metas) -> None:
+        self.add(
+            batch_index,
+            *extract_tallies(planes, metas, self.refstore, self._rid_map),
+        )
+
+    # ---- spill / watermark protocol ------------------------------------
+
+    def attach_checkpoint(self, ck) -> None:
+        """Wire this accumulator as the checkpoint's on_flush hook and
+        restore the committed run chain for a resumed run."""
+        self._checkpointed = True
+        self.resume(ck.batches_done)
+        ck.on_flush = self.flush
+
+    def flush(self, watermark: int) -> None:
+        """Spill every pending batch <= watermark into one run file.
+        Called by BatchCheckpoint._flush AFTER its shard write succeeds
+        and BEFORE the manifest commits — a crash between the two leaves
+        a run the next resume drops as above-watermark, never a hole."""
+        with self._lock:
+            self._spill_locked(watermark)
+
+    def _spill_locked(self, watermark: int) -> None:
+        take = [bi for bi in self._pending if bi <= watermark]
+        if not take:
+            return
+        take.sort()
+        entries = [self._pending[bi] for bi in take]
+        run_index = len(self._runs)
+        path = f"{self._base}.methyl.run.{run_index:04d}"
+
+        def write_attempt() -> int:
+            _failpoints.fire("extsort_spill", stage="methyl", run=run_index)
+            return _write_run_payload(path, entries)
+
+        n = _faultretry.guarded(
+            write_attempt,
+            metrics=self.metrics, stage="extsort_spill", batch=run_index,
+        )
+        crc = _integrity.file_crc32(path)
+        self._runs.append(
+            {
+                "file": os.path.basename(path),
+                "crc": crc,
+                "upto": watermark,
+                "records": n,
+            }
+        )
+        self._save_manifest()
+        for bi in take:
+            # graftlint: disable=thread-unsafe-mutation -- _spill_locked
+            # runs under the caller's self._lock (flush / add)
+            self._pending_sites -= self._pending[bi][0].size
+            del self._pending[bi]
+        # graftlint: disable=thread-unsafe-mutation -- same lock as above
+        self._watermark = max(self._watermark, watermark)
+        if self.metrics is not None:
+            self.metrics.count("methyl_spill_runs")
+            self.metrics.count("methyl_spill_sites", n)
+        observe.emit(
+            "methyl_spill",
+            {"run": run_index, "sites": n, "upto": watermark},
+        )
+
+    def _save_manifest(self) -> None:
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"runs": self._runs}, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._manifest_path)
+
+    def resume(self, batches_done: int) -> None:
+        """Restore the committed run chain: keep the longest manifest
+        prefix with upto <= batches_done and verified CRCs; delete
+        everything after it (orphan runs from a crashed spill — their
+        batches replay through the engine)."""
+        if not os.path.exists(self._manifest_path):
+            return
+        with open(self._manifest_path) as fh:
+            manifest = json.load(fh)
+        keep: list[dict] = []
+        base_dir = os.path.dirname(self._base) or "."
+        for run in manifest.get("runs", ()):
+            path = os.path.join(base_dir, run["file"])
+            if run["upto"] > batches_done:
+                break
+            try:
+                _integrity.verify_file_crc32(path, run["crc"], run["file"])
+            except _integrity.IntegrityError:
+                break
+            keep.append(run)
+        for run in manifest.get("runs", ())[len(keep):]:
+            path = os.path.join(base_dir, run["file"])
+            if os.path.exists(path):
+                os.unlink(path)
+        dropped = len(manifest.get("runs", ())) - len(keep)
+        self._runs = keep
+        self._watermark = keep[-1]["upto"] if keep else 0
+        if dropped or keep:
+            observe.emit(
+                "methyl_resume",
+                {
+                    "runs_kept": len(keep),
+                    "runs_dropped": dropped,
+                    "watermark": self._watermark,
+                },
+            )
+        if dropped:
+            self._save_manifest()
+
+    # ---- finalize ------------------------------------------------------
+
+    def finalize(self) -> dict:
+        """Merge the run chain + still-pending tallies and write the emit
+        formats. Returns {"sites": n, "bed": path?, "cx": path?}."""
+        from bsseqconsensusreads_tpu.methyl import emit as _emit
+
+        with self._lock:
+            parts = []
+            base_dir = os.path.dirname(self._base) or "."
+            for run in self._runs:
+                path = os.path.join(base_dir, run["file"])
+                _integrity.verify_file_crc32(path, run["crc"], run["file"])
+                parts.append(_read_run_file(path))
+            for bi in sorted(self._pending):
+                parts.append(self._pending[bi])
+            if parts:
+                sites = np.concatenate([p[0] for p in parts])
+                ctx = np.concatenate([p[1] for p in parts])
+                meth = np.concatenate([p[2] for p in parts])
+                unmeth = np.concatenate([p[3] for p in parts])
+            else:
+                sites = np.zeros(0, np.int64)
+                ctx = np.zeros(0, np.uint8)
+                meth = unmeth = np.zeros(0, np.uint32)
+            sites, ctx, meth, unmeth = merge_tallies(
+                sites, ctx, meth, unmeth
+            )
+            self.sites_out = int(sites.size)
+            out: dict = {"sites": self.sites_out}
+            if self.bed_path is not None:
+                _emit.write_bedmethyl(
+                    self.bed_path, self.refstore, sites, ctx, meth, unmeth
+                )
+                out["bed"] = self.bed_path
+            if self.cx_path is not None:
+                _emit.write_cx_report(
+                    self.cx_path, self.refstore, sites, ctx, meth, unmeth
+                )
+                out["cx"] = self.cx_path
+            for run in self._runs:
+                path = os.path.join(base_dir, run["file"])
+                if os.path.exists(path):
+                    os.unlink(path)
+            if os.path.exists(self._manifest_path):
+                os.unlink(self._manifest_path)
+            self._runs = []
+            self._pending.clear()
+            self._pending_sites = 0
+            observe.emit("methyl_finalize", out)
+            return out

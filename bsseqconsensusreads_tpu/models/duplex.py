@@ -431,3 +431,101 @@ def duplex_call_pipeline_packed(
         vote_kernel=vote_kernel, layout=layout,
     )
     return pack_duplex_outputs(out), out["la"], out["rd"]
+
+
+# ---- methylation epilogue variants (methyl/context.py) -------------------
+#
+# Each mirrors its plain counterpart with the fused per-column methylation
+# epilogue bolted onto the SAME traced program: the epilogue reads the RAW
+# pre-conversion planes (ops.convert erases the bottom-strand signal) plus
+# the vote's base plane, so fusing it here costs two extra u8 planes of
+# output and no extra pass over the batch.
+
+
+@partial(jax.jit, static_argnames=("params", "vote_kernel", "layout"))
+def duplex_call_pipeline_packed_methyl(
+    bases, quals, cover, ref, convert_mask, extend_eligible, ref_ext,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    vote_kernel: str = "xla",
+    layout: str = "padded",
+):
+    """duplex_call_pipeline_packed + fused methyl epilogue.
+
+    ref_ext int8 [F, W + 4]: the bounded extension window
+    (ops.refstore.gather_windows_ext / host_windows_ext — host-gathered on
+    this path, where the transfer is local). Returns
+    (packed, la, rd, planes u8 [F, 2, W])."""
+    from bsseqconsensusreads_tpu.methyl.context import methyl_epilogue
+
+    out = duplex_call_pipeline(
+        bases, quals, cover, ref, convert_mask, extend_eligible,
+        params=params, vote_kernel=vote_kernel, layout=layout,
+    )
+    planes = methyl_epilogue(
+        bases, quals, cover, convert_mask, out["base"], ref_ext,
+        params.min_input_base_quality,
+    )
+    return pack_duplex_outputs(out), out["la"], out["rd"], planes
+
+
+@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r", "vote_kernel"))
+def duplex_call_wire_fused_methyl(
+    words, genome, f: int, w: int,
+    params: ConsensusParams = ConsensusParams(min_reads=0),
+    qual_mode: str = "q8",
+    r: int = 4,
+    vote_kernel: str = "xla",
+):
+    """duplex_call_wire_fused + fused methyl epilogue, one wire each way.
+
+    Input wire = DuplexWire.to_words() ++ los u32 [f] (each family's contig
+    origin — gather_windows_ext's lower bound), appended at the END so the
+    existing five-section prefix parses unchanged. Output wire = the plain
+    b0 ++ la/rd words ++ methyl planes (f*2*w/4 words) appended after the
+    lard section, so ops.reconstruct.retire_duplex_wire consumes the
+    prefix as-is and the planes peel off the tail
+    (methyl.context.unpack_methyl_planes)."""
+    from bsseqconsensusreads_tpu.methyl.context import (
+        methyl_epilogue,
+        methyl_wire_words,
+    )
+    from bsseqconsensusreads_tpu.ops.refstore import (
+        gather_windows,
+        gather_windows_ext,
+    )
+    from bsseqconsensusreads_tpu.ops.wire import (
+        pack_lard,
+        split_duplex_wire,
+        unpack_duplex_inputs,
+        wire_section_sizes,
+    )
+
+    if r != 4:
+        raise ValueError(
+            f"duplex windows have 4 rows (flags 99/163/83/147); got r={r}"
+        )
+    base_words = sum(wire_section_sizes(f, w, r, qual_mode))
+    nib, qual, meta, starts, limits = split_duplex_wire(
+        words[:base_words], f, w, r=r, qual_mode=qual_mode
+    )
+    los = words[base_words : base_words + f]
+    bases, quals, cover, convert_mask, eligible = unpack_duplex_inputs(
+        nib, qual, meta, f, w, qual_mode=qual_mode
+    )
+    ref = gather_windows(genome, starts, limits, w + 1)
+    ref_ext = gather_windows_ext(genome, starts, los, limits, w + 4)
+    out = duplex_call_pipeline(
+        bases, quals, cover, ref, convert_mask, eligible, params=params,
+        vote_kernel=vote_kernel,
+    )
+    planes = methyl_epilogue(
+        bases, quals, cover, convert_mask, out["base"], ref_ext,
+        params.min_input_base_quality,
+    )
+    return jnp.concatenate(
+        [
+            pack_duplex_b0_outputs(out),
+            pack_lard(out["la"], out["rd"]),
+            methyl_wire_words(planes),
+        ]
+    )

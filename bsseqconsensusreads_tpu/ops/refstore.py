@@ -49,6 +49,30 @@ def gather_windows(genome, starts, limits, width: int):
     return jnp.where(valid, ref, jnp.int8(NBASE))
 
 
+@partial(jax.jit, static_argnames=("width",))
+def gather_windows_ext(genome, starts, los, limits, width: int):
+    """Bounded EXTENSION gather: [F, width] windows starting 2 bases BEFORE
+    each family's window (ref_ext[j] = genome[start - 2 + j]).
+
+    Unlike gather_windows, this needs a LOWER bound too: start - 2 can fall
+    before the family's contig, and the methylation context classifier must
+    see N there, not the previous contig's trailing bases. los: uint32 [F]
+    global offset of the contig's first base. uint32 wrap arithmetic makes
+    pre-genome columns land above `limits` (the offset cap leaves 2**16
+    headroom below 2**32), so the two range checks cover underflow as well.
+    """
+    starts = starts.astype(jnp.uint32) - jnp.uint32(2)
+    idx = starts[:, None] + jnp.arange(width, dtype=jnp.uint32)
+    valid = (
+        (starts[:, None] != NO_REF - jnp.uint32(2))
+        & (idx >= los[:, None].astype(jnp.uint32))
+        & (idx < limits[:, None].astype(jnp.uint32))
+    )
+    safe = jnp.minimum(idx, jnp.uint32(genome.shape[0] - 1))
+    ref = jnp.take(genome, safe, axis=0)
+    return jnp.where(valid, ref, jnp.int8(NBASE))
+
+
 class RefStore:
     """Concatenated genome codes + per-contig offsets, uploaded to device once."""
 
@@ -126,6 +150,36 @@ class RefStore:
             else np.zeros(idx.shape, np.int8)
         )
         return np.where(valid, ref, np.int8(NBASE))
+
+    def host_windows_ext(self, starts, los, limits, width: int) -> np.ndarray:
+        """numpy twin of gather_windows_ext over the HOST genome copy:
+        int8 [F, width] extension windows (start - 2), N outside
+        [los, limits). int64 arithmetic replaces the device's uint32 wrap —
+        pre-genome columns are simply negative and fail the lower bound."""
+        starts = np.asarray(starts, dtype=np.uint32)
+        idx = starts[:, None].astype(np.int64) - 2 + np.arange(width)
+        valid = (
+            (starts[:, None] != NO_REF)
+            & (idx >= np.asarray(los, dtype=np.uint32)[:, None].astype(np.int64))
+            & (idx < np.asarray(limits, dtype=np.uint32)[:, None].astype(np.int64))
+        )
+        safe = np.clip(idx, 0, max(self.codes.size - 1, 0))
+        ref = (
+            self.codes[safe]
+            if self.codes.size
+            else np.zeros(idx.shape, np.int8)
+        )
+        return np.where(valid, ref, np.int8(NBASE))
+
+    def window_origins(self, ref_ids) -> np.ndarray:
+        """uint32 [F] global offset of each family's contig FIRST base —
+        the lower bound of gather_windows_ext. Invalid ref_ids map to 0
+        (their starts are NO_REF / limits 0, so the bound never engages)."""
+        rid = np.asarray(ref_ids, dtype=np.int64)
+        ok = (rid >= 0) & (rid < len(self.names))
+        return np.where(ok, self.offsets[np.where(ok, rid, 0)], 0).astype(
+            np.uint32
+        )
 
     def window_offsets(self, ref_ids, window_starts):
         """Vectorized (starts, limits) uint32 arrays for gather_windows.

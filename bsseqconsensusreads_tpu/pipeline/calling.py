@@ -2030,6 +2030,8 @@ def call_duplex_batches(
     strand_tags: bool = True,
     guard=None,
     layout: str | None = None,
+    methyl=None,
+    chemistry: str = "bisulfite",
 ) -> Iterator[list]:
     """The fused duplex stage: convert + extend + duplex merge per MI group,
     one list of consensus records per kernel batch (the checkpoint/resume
@@ -2083,6 +2085,26 @@ def call_duplex_batches(
     4-row merge; 'padded' keeps the envelope. Engages on the unpacked
     single-device route (the wire/mesh pack formats are envelope-
     shaped); the degrade twin follows the same layout.
+
+    methyl: a methyl.tally.MethylAccumulator, or None. When set, every
+    kernel batch also yields per-column methylation planes
+    (methyl.context) — fused into the vote dispatch on single-device
+    routes (the wire ships them in the same output array), the numpy host
+    twin elsewhere (mesh-sharded pack formats have no methyl section) and
+    under BSSEQ_TPU_METHYL_ENGINE=host (the differential leg) — and the
+    sparse tallies land in the accumulator as the LAST action of each
+    retire unit (retry replays a batch before its tally ever exists;
+    add() itself is idempotent per batch index for redispatch races).
+
+    chemistry: 'bisulfite' (default) and 'emseq' run the conversion-aware
+    engine (computationally identical — EM-seq converts enzymatically to
+    the same C->T readout; the distinction is provenance, recorded by the
+    stage runner). 'none' declares an unconverted (plain fgbio-style)
+    duplex library: the convert transform is disabled wholesale by
+    clearing the flag-derived convert mask after encode, and the
+    conversion-coupled surfaces are refused (passthrough re-applies the
+    convert-stage treatment; pos0='shift' IS a conversion-prepend
+    behavior; methyl extraction needs a converting chemistry).
     """
     import os
 
@@ -2120,6 +2142,52 @@ def call_duplex_batches(
 
         refstore = RefStore.from_fasta(refstore)
     rid_map = refstore.contig_indices(ref_names) if use_wire else None
+    if chemistry not in ("bisulfite", "emseq", "none"):
+        raise ValueError(
+            f"unknown chemistry {chemistry!r} (bisulfite | emseq | none)"
+        )
+    unconverted = chemistry == "none"
+    if unconverted and passthrough:
+        raise ValueError(
+            "chemistry='none' is incompatible with passthrough=True: the "
+            "leftover surface re-applies the reference convert-stage "
+            "treatment the chemistry disables"
+        )
+    if unconverted and pos0 == "shift":
+        raise ValueError(
+            "chemistry='none' is incompatible with pos0='shift' (the "
+            "shift is a conversion-prepend behavior)"
+        )
+    methyl_store = None
+    methyl_rid_map = None
+    methyl_device = False
+    if methyl is not None:
+        if unconverted:
+            raise ValueError(
+                "methylation extraction needs a converting chemistry "
+                "(bisulfite or emseq), not chemistry='none'"
+            )
+        m_eng = os.environ.get("BSSEQ_TPU_METHYL_ENGINE", "auto")
+        if m_eng not in ("auto", "device", "host"):
+            raise ValueError(
+                f"BSSEQ_TPU_METHYL_ENGINE={m_eng!r} (auto | device | host)"
+            )
+        from bsseqconsensusreads_tpu.methyl.context import (
+            methyl_epilogue_host,
+            unpack_methyl_planes,
+        )
+
+        methyl_store = methyl.refstore
+        methyl_rid_map = methyl_store.contig_indices(ref_names)
+        # the tally extraction shares the SAME translation: context
+        # windows (methyl_ref_ext) and global site offsets (add_planes)
+        # must come from one coordinate system
+        methyl.bind_names(ref_names)
+        # fused device epilogue on the single-device routes (wire and
+        # unpacked); the mesh-sharded pack format has no methyl section,
+        # so that route (and the =host differential leg) runs the numpy
+        # twin — bit-identical either way, the parity tests pin it
+        methyl_device = sharded_fn is None and m_eng != "host"
     wire_rr = _WireRoundRobin(mesh) if wire_mc else None
     pool, pool_depth = _make_overlap_pool(
         wire_rr, sharded_fn, stats, stats.stage or "duplex"
@@ -2135,22 +2203,57 @@ def call_duplex_batches(
     # composition): the per-device genome cache needs its own lock
     genome_lock = threading.Lock()
 
-    def wire_window_offsets(batch):
-        """(starts, limits) uint32 global offsets for one wire batch —
-        the ONE ref_id -> store-contig mapping shared by the device
-        dispatch and the host-side rawize window fetch (a drifted copy
-        would hand the tag passes a different window than the kernel
-        gathered)."""
+    def wire_mapped_rids(batch):
+        """Store-contig index per family (-1 invalid) — the ONE
+        ref_id -> store-contig mapping shared by the device dispatch, the
+        host-side rawize window fetch, and the methyl los appendix (a
+        drifted copy would hand the tag passes a different window than
+        the kernel gathered)."""
         fb = len(batch.meta)
         rids = np.fromiter((m.ref_id for m in batch.meta), np.int64, fb)
         valid = (rids >= 0) & (rids < len(rid_map))
         # a plain rid_map[rids] would let -1 wrap to the last contig
-        mapped = np.where(valid, rid_map[np.where(valid, rids, 0)], -1)
+        return np.where(valid, rid_map[np.where(valid, rids, 0)], -1)
+
+    def wire_window_offsets(batch):
+        """(starts, limits) uint32 global offsets for one wire batch."""
         return refstore.window_offsets(
+            wire_mapped_rids(batch),
+            np.fromiter(
+                (m.window_start for m in batch.meta),
+                np.int64,
+                len(batch.meta),
+            ),
+        )
+
+    def methyl_ref_ext(batch):
+        """Host-gathered [F, W+4] extension windows for the methyl
+        epilogue (the unpacked-dispatch input and the host twin's), keyed
+        to the accumulator's own store so the tally's global offsets and
+        the context windows come from one coordinate system."""
+        fb = len(batch.meta)
+        rids = np.fromiter((m.ref_id for m in batch.meta), np.int64, fb)
+        valid = (rids >= 0) & (rids < len(methyl_rid_map))
+        mapped = np.where(valid, methyl_rid_map[np.where(valid, rids, 0)], -1)
+        starts, limits = methyl_store.window_offsets(
             mapped,
             np.fromiter(
                 (m.window_start for m in batch.meta), np.int64, fb
             ),
+        )
+        los = methyl_store.window_origins(mapped)
+        return methyl_store.host_windows_ext(
+            starts, los, limits, batch.bases.shape[-1] + 4
+        )
+
+    def methyl_host_planes(batch, cons_base):
+        """numpy-twin methyl planes for one retired batch — the
+        mesh-sharded route, the BSSEQ_TPU_METHYL_ENGINE=host differential
+        leg, and the degrade path all land here."""
+        return methyl_epilogue_host(
+            batch.bases, batch.quals, batch.cover, batch.convert_mask,
+            cons_base, methyl_ref_ext(batch),
+            params.min_input_base_quality,
         )
 
     def host_ref(batch):
@@ -2194,6 +2297,7 @@ def call_duplex_batches(
             # — the path bench.py measures, lossless by construction)
             from bsseqconsensusreads_tpu.models.duplex import (
                 duplex_call_wire_fused,
+                duplex_call_wire_fused_methyl,
             )
             from bsseqconsensusreads_tpu.ops.wire import pack_duplex_inputs
 
@@ -2204,11 +2308,24 @@ def call_duplex_batches(
                 batch.convert_mask, batch.extend_eligible, starts, limits,
                 qual_mode="auto",
             )
-            words, genome = _wire_device_args(wire.to_words())
-            packed = duplex_call_wire_fused(
-                words, genome, f, w,
-                params=params, qual_mode=wire.qual_mode, vote_kernel=kernel,
-            )
+            host_words = wire.to_words()
+            if methyl_device:
+                # methyl input appendix: each family's contig-origin
+                # lower bound for the bounded ref_ext gather, appended
+                # AFTER the base wire so its prefix parses unchanged
+                los = refstore.window_origins(wire_mapped_rids(batch))
+                host_words = np.concatenate([host_words, los])
+                words, genome = _wire_device_args(host_words)
+                packed = duplex_call_wire_fused_methyl(
+                    words, genome, f, w, params=params,
+                    qual_mode=wire.qual_mode, vote_kernel=kernel,
+                )
+            else:
+                words, genome = _wire_device_args(host_words)
+                packed = duplex_call_wire_fused(
+                    words, genome, f, w, params=params,
+                    qual_mode=wire.qual_mode, vote_kernel=kernel,
+                )
             pf = f
         else:
             arrays = (
@@ -2216,17 +2333,31 @@ def call_duplex_batches(
                 batch.convert_mask, batch.extend_eligible,
             )
             if sharded_fn is None:
-                packed, _la, _rd = duplex_call_pipeline_packed(
-                    *arrays, params=params, vote_kernel=kernel,
-                    layout=kernel_layout,
-                )
+                if methyl_device:
+                    from bsseqconsensusreads_tpu.models.duplex import (
+                        duplex_call_pipeline_packed_methyl,
+                    )
+
+                    packed, _la, _rd, mplanes = (
+                        duplex_call_pipeline_packed_methyl(
+                            *arrays, methyl_ref_ext(batch), params=params,
+                            vote_kernel=kernel, layout=kernel_layout,
+                        )
+                    )
+                    packed = (packed, mplanes)
+                else:
+                    packed, _la, _rd = duplex_call_pipeline_packed(
+                        *arrays, params=params, vote_kernel=kernel,
+                        layout=kernel_layout,
+                    )
                 pf = f
             else:
                 padded, pf = pad_families(arrays, f, data_size)
                 packed, _la, _rd = sharded_fn(*padded)
-        copy_async = getattr(packed, "copy_to_host_async", None)
-        if copy_async is not None:
-            copy_async()
+        for arr in packed if isinstance(packed, tuple) else (packed,):
+            copy_async = getattr(arr, "copy_to_host_async", None)
+            if copy_async is not None:
+                copy_async()
         return packed, pf
 
     def fetch_out(packed, pf, batch, sidecar, bi=None) -> dict:
@@ -2236,10 +2367,21 @@ def call_duplex_batches(
         from 'fetch' so the artifact shows transfer vs host compute."""
         _failpoints.fire("fetch_out", stage=stage_label, batch=bi)
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
+        mplanes_dev = None
+        if isinstance(packed, tuple):
+            # unpacked methyl dispatch: (wire, planes) device pair
+            packed, mplanes_dev = packed
         _device_wait(packed, stats.metrics)
+        planes = None
         with stats.metrics.timed("fetch"):
             host = jax.device_get(packed)
             if use_wire:
+                if methyl_device:
+                    # the methyl planes ride the wire tail (after the
+                    # b0 + la/rd sections, which parse unchanged)
+                    planes = unpack_methyl_planes(
+                        host[-(f * 2 * w // 4):], f, w
+                    )
                 # b0-only wire: decode + rebuild the qual plane host-side
                 # from the shipped strand bits + this host's own input
                 # quals (ops.reconstruct — exact, kernel-built tables;
@@ -2254,13 +2396,27 @@ def call_duplex_batches(
                 )
             else:
                 out = unpack_duplex_outputs(host, f=pf, w=w)
+            if mplanes_dev is not None:
+                planes = np.asarray(jax.device_get(mplanes_dev))
             out = {k: v[:f] for k, v in out.items()}
+        if methyl is not None and planes is None:
+            # numpy-twin epilogue: the mesh-sharded route and the
+            # engine=host differential leg
+            with stats.metrics.timed("methyl"):
+                planes = methyl_host_planes(batch, np.asarray(out["base"]))
         with stats.metrics.timed("rawize"):
-            return _duplex_rawize(
+            rawized = _duplex_rawize(
                 out, batch, sidecar,
                 ref=host_ref(batch) if (strand_tags or sidecar) else None,
                 strand_tags=strand_tags,
             )
+        if methyl is not None:
+            # LAST action of the retire unit: any failure above retries
+            # the whole unit with no tally landed; add() is idempotent
+            # per batch index for the redispatch races that remain
+            with stats.metrics.timed("methyl"):
+                methyl.add_planes(bi, planes, batch.meta)
+        return rawized
 
     def emit_out(out, batch, passed, st=None):
         """Record emit for one retired batch; `st` is the stage stats
@@ -2277,7 +2433,7 @@ def call_duplex_batches(
         after `exc` — the ONE recovery entry the retire paths share."""
         return _faultretry.guarded(
             partial(dispatch_fetch, batch, sidecar, bi),
-            degrade=partial(degrade_fetch, batch, sidecar),
+            degrade=partial(degrade_fetch, batch, sidecar, bi),
             metrics=stats.metrics, stage=stage_label, batch=bi,
             failed=exc,
         )
@@ -2345,12 +2501,13 @@ def call_duplex_batches(
             packed, pf = dispatch_kernel(batch, bi)
         return fetch_out(packed, pf, batch, sidecar, bi)
 
-    def degrade_fetch(batch, sidecar) -> dict:
+    def degrade_fetch(batch, sidecar, bi=None) -> dict:
         """Persistent-failure fallback: the fused duplex pipeline on the
         host XLA backend (the CPU twin of the device path, unpacked
         tensors + host-fetched reference windows) — bit-identical output
         with no device in the loop, then the same rawize passes the
-        normal retire runs."""
+        normal retire runs. The methyl planes come from the numpy twin
+        here (no device in the loop), tallied last like every retire."""
         f, w = batch.bases.shape[0], batch.bases.shape[-1]
         ref = host_ref(batch)
         cpu = jax.local_devices(backend="cpu")[0]
@@ -2364,16 +2521,21 @@ def call_duplex_batches(
             )
             out = unpack_duplex_outputs(jax.device_get(packed), f=f, w=w)
         with stats.metrics.timed("rawize"):
-            return _duplex_rawize(
+            rawized = _duplex_rawize(
                 out, batch, sidecar,
                 ref=ref if (strand_tags or sidecar) else None,
                 strand_tags=strand_tags,
             )
+        if methyl is not None:
+            with stats.metrics.timed("methyl"):
+                planes = methyl_host_planes(batch, np.asarray(out["base"]))
+                methyl.add_planes(bi, planes, batch.meta)
+        return rawized
 
     def dispatch_fetch_guarded(batch, sidecar, bi):
         return _faultretry.guarded(
             partial(dispatch_fetch, batch, sidecar, bi),
-            degrade=partial(degrade_fetch, batch, sidecar),
+            degrade=partial(degrade_fetch, batch, sidecar, bi),
             metrics=stats.metrics, stage=stage_label, batch=bi,
         )
 
@@ -2415,6 +2577,12 @@ def call_duplex_batches(
                 chunk, ref_fetch, ref_names, max_window=max_window,
                 fetch_ref=not use_wire, pos0=pos0,
             )
+            if unconverted:
+                # chemistry='none': an unconverted (plain fgbio-style)
+                # duplex library — clearing the flag-derived mask
+                # disables the convert transform wholesale while the
+                # identical engine runs everything downstream of it
+                batch.convert_mask = np.zeros_like(batch.convert_mask)
         passed: list[BamRecord] = []
         if passthrough and leftovers:
             passed = _passthrough_records(

@@ -358,6 +358,11 @@ class PipelineBuilder:
             # changes the duplex record set only — scoping it here keeps a
             # toggle from discarding unrelated molecular-stage shards
             fingerprint["passthrough"] = self.cfg.duplex_passthrough
+            # chemistry changes every consensus base (the convert mask);
+            # the methyl mode joins because its run-chain watermarks are
+            # only meaningful against shards computed with it armed
+            fingerprint["chemistry"] = self.cfg.chemistry
+            fingerprint["methyl"] = self.cfg.methyl
         return BatchCheckpoint(
             rule.outputs[0], header, every=self.cfg.checkpoint_every,
             fingerprint=fingerprint,
@@ -520,6 +525,27 @@ class PipelineBuilder:
         finally:
             g.close()
 
+    def _methyl_accumulator(self, rule, stats):
+        """Build the tally sink for the duplex stage's methyl epilogue
+        (methyl.tally): outputs land next to the duplex target (or at
+        cfg.methyl_out as the base path), keyed to a host RefStore of the
+        run's genome — the same store the wire dispatch then shares, so
+        the kernel's windows and the tally's global offsets come from one
+        coordinate system."""
+        from bsseqconsensusreads_tpu.methyl.tally import MethylAccumulator
+        from bsseqconsensusreads_tpu.ops.refstore import RefStore
+
+        choice = self.cfg.methyl
+        base = self.cfg.methyl_out or rule.outputs[0]
+        bed = base + ".bedmethyl" if choice in ("bedmethyl", "both") else None
+        cx = (
+            base + ".CX_report.txt" if choice in ("cx", "both") else None
+        )
+        return MethylAccumulator(
+            RefStore.from_fasta(self.cfg.genome_fasta), bed, cx,
+            metrics=stats.metrics,
+        )
+
     def run_duplex(self, rule, mode: str) -> None:
         stats = self.stats.setdefault("duplex", StageStats(stage="duplex"))
         fasta = FastaFile(self.cfg.genome_fasta)
@@ -532,6 +558,16 @@ class PipelineBuilder:
                 if mode == "self":  # output leaves coordinate-sorted
                     header = header.with_sort_order("coordinate")
                 ck = self._checkpointed("duplex", rule, header)
+                methyl_acc = None
+                store = self.cfg.genome_fasta
+                if self.cfg.methyl != "off":
+                    methyl_acc = self._methyl_accumulator(rule, stats)
+                    store = methyl_acc.refstore
+                    if ck is not None:
+                        # spill at the checkpoint's committed watermarks
+                        # (and restore the run chain on resume) — the
+                        # crash-consistency contract methyl.tally documents
+                        methyl_acc.attach_checkpoint(ck)
                 batches = call_duplex_batches(
                     duplex_ingest_stream(
                         rule.inputs[0], reader, stats,
@@ -551,15 +587,21 @@ class PipelineBuilder:
                     skip_batches=ck.batches_done if ck else 0,
                     passthrough=self.cfg.duplex_passthrough,
                     emit=self.cfg.emit,
-                    # FASTA path, loaded into a device-resident genome only if
-                    # the wire transport engages (call_duplex_batches decides)
-                    refstore=self.cfg.genome_fasta,
+                    # FASTA path, loaded into a device-resident genome only
+                    # if the wire transport engages (call_duplex_batches
+                    # decides) — or the methyl accumulator's already-built
+                    # store when extraction is on
+                    refstore=store,
                     transport=self.cfg.transport,
                     pos0=self.cfg.pos0,
                     strand_tags=self.cfg.duplex_strand_tags,
                     guard=g,
+                    methyl=methyl_acc,
+                    chemistry=self.cfg.chemistry,
                 )
                 self._write_stage_output(batches, rule.outputs[0], header, mode, ck, stats)
+                if methyl_acc is not None:
+                    methyl_acc.finalize()
         finally:
             g.close()
 
@@ -658,6 +700,26 @@ class PipelineBuilder:
 
     def build(self) -> tuple[Workflow, str]:
         cfg = self.cfg
+        if cfg.chemistry not in ("bisulfite", "emseq", "none"):
+            raise WorkflowError(
+                f"unknown chemistry {cfg.chemistry!r} "
+                "(bisulfite | emseq | none)"
+            )
+        if cfg.methyl not in ("off", "bedmethyl", "cx", "both"):
+            raise WorkflowError(
+                f"unknown methyl mode {cfg.methyl!r} "
+                "(off | bedmethyl | cx | both)"
+            )
+        if cfg.methyl != "off" and cfg.chemistry == "none":
+            raise WorkflowError(
+                "methyl extraction needs a converting chemistry "
+                "(bisulfite or emseq), not chemistry 'none'"
+            )
+        if cfg.methyl != "off" and cfg.single_strand:
+            raise WorkflowError(
+                "methyl extraction is a duplex-stage epilogue; "
+                "single_strand stops after the molecular stage"
+            )
         wf = Workflow()
         consensus_input = self.bam_path
         if self._needs_grouping():
@@ -669,6 +731,22 @@ class PipelineBuilder:
                 self.run_group,
             )
             self.molecular_grouping = "adjacent"
+        if cfg.single_strand:
+            # molecular emit without duplex pairing: libraries whose
+            # protocol never forms ab/ba duplex pairs stop after the
+            # molecular stage — the identical engine, one stage shorter.
+            # 'self' leaves a coordinate-sorted aligned BAM; other
+            # aligner modes leave the unaligned molecular consensus.
+            target = self.out("_consensus_molecular_unfiltered.bam")
+            mode = "self" if cfg.aligner == "self" else "unaligned"
+            wf.rule(
+                "call_consensus_molecular_tpu",
+                [consensus_input],
+                [target],
+                lambda r: self.run_molecular(r, mode=mode),
+            )
+            self.final_output = target
+            return wf, target
         if cfg.aligner == "self":
             aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
             wf.rule(

@@ -70,6 +70,14 @@ class JobSpec:
     #: each family's MI with job provenance (scheduler.JobMi), which
     #: requires the Python group shape end-to-end.
     ingest: str = "python"
+    #: library chemistry, per job (None → engine default). The serve
+    #: engine runs the MOLECULAR stage, which is chemistry-invariant
+    #: (conversion engages at the duplex stage) — so mixed-chemistry
+    #: tenants share device batches safely and the field is admission
+    #: validation + provenance: it joins the job fingerprint and the
+    #: retire stats, so a ledger line proves what chemistry each
+    #: tenant's downstream duplex/methyl run should declare.
+    chemistry: str | None = None
 
     def as_dict(self) -> dict:
         return {
@@ -78,6 +86,7 @@ class JobSpec:
             "policy": self.policy,
             "grouping": self.grouping,
             "ingest": self.ingest,
+            "chemistry": self.chemistry,
         }
 
     @classmethod
@@ -89,6 +98,7 @@ class JobSpec:
                 policy=d.get("policy") or None,
                 grouping=d.get("grouping") or None,
                 ingest=str(d.get("ingest") or "python"),
+                chemistry=d.get("chemistry") or None,
             )
         except KeyError as exc:
             raise AdmissionError(f"job spec missing {exc.args[0]!r}") from None
@@ -145,6 +155,8 @@ class Job:
             "consensus_out": self.consensus_out,
             "fingerprint": self.fingerprint,
         }
+        if self.spec.chemistry is not None:
+            d["chemistry"] = self.spec.chemistry
         if self.error is not None:
             d["error"] = self.error
         if self.latency_s is not None:
@@ -221,6 +233,8 @@ class JobQueue:
             raise AdmissionError(str(exc)) from None
         if spec.ingest not in ("auto", "native", "python"):
             raise AdmissionError(f"unknown ingest {spec.ingest!r}")
+        if spec.chemistry not in (None, "bisulfite", "emseq", "none"):
+            raise AdmissionError(f"unknown chemistry {spec.chemistry!r}")
         if spec.grouping not in (None, "gather", "adjacent", "coordinate"):
             raise AdmissionError(f"unknown grouping {spec.grouping!r}")
         if not spec.output:

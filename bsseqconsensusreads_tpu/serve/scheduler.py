@@ -513,6 +513,11 @@ class Scheduler:
                 (job.started_s or job.submitted_s) - job.submitted_s, 3
             ),
         }
+        if job.spec.chemistry is not None:
+            # provenance only: the molecular stage is chemistry-invariant
+            # (conversion engages at the duplex stage), but the ledger
+            # line records what each tenant's downstream run declared
+            payload["chemistry"] = job.spec.chemistry
         observe.emit("stage_stats", payload, job=job.id)
 
     def _finish_all(self) -> None:

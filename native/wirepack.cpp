@@ -1077,4 +1077,38 @@ void wirepack_strand_calls(const int8_t* bases, const uint8_t* cover,
   }
 }
 
+
+// Methylation tally merge (methyl/tally.py twin): reduce n (site, ctx,
+// meth, unmeth) tuples — duplicated sites allowed — to sorted unique rows
+// with summed counts. ctx is a pure function of the site (genome context),
+// so the first occurrence's value is THE value. Returns m (unique rows);
+// out arrays are caller-allocated with capacity n. Stable index sort, so
+// ties keep input order exactly like numpy argsort(kind="stable").
+int64_t wirepack_methyl_tally_merge(
+    const int64_t* sites, const uint8_t* ctx, const uint32_t* meth,
+    const uint32_t* unmeth, int64_t n, int64_t* out_sites,
+    uint8_t* out_ctx, uint32_t* out_meth, uint32_t* out_unmeth) {
+  std::vector<int64_t> order(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; ++i) order[static_cast<size_t>(i)] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [sites](int64_t a, int64_t b) {
+                     return sites[a] < sites[b];
+                   });
+  int64_t m = 0;
+  for (int64_t k = 0; k < n; ++k) {
+    const int64_t i = order[static_cast<size_t>(k)];
+    if (m > 0 && out_sites[m - 1] == sites[i]) {
+      out_meth[m - 1] += meth[i];
+      out_unmeth[m - 1] += unmeth[i];
+    } else {
+      out_sites[m] = sites[i];
+      out_ctx[m] = ctx[i];
+      out_meth[m] = meth[i];
+      out_unmeth[m] = unmeth[i];
+      ++m;
+    }
+  }
+  return m;
+}
+
 }  // extern "C"

@@ -39,6 +39,7 @@ FIXTURES = {
     "per-record-alloc": "fx_per_record_alloc.py",
     "blocking-scheduler-loop": "fx_blocking_scheduler_loop.py",
     "padded-batch-flops": "fx_padded_batch_flops.py",
+    "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
 }
 
 

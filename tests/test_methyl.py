@@ -1,0 +1,783 @@
+"""graftmethyl tests: the fused methylation extraction subsystem.
+
+* epilogue parity — the jit epilogue and its numpy host twin are the
+  same integer formula; bit-identity is asserted on hand cases and
+  randomized batches;
+* mini-genome oracle — every emitted site's context/strand is re-derived
+  by an independent string-walk over the genome (CpG/CHG/CHH on both
+  strands, N suppression, contig ends);
+* engine differential — wire (fused kernel tail), unpacked (device
+  epilogue), BSSEQ_TPU_METHYL_ENGINE=host (numpy twin) and the degrade
+  path all produce byte-identical bedMethyl/CX — and the consensus BAM
+  is byte-identical to a methyl-free run;
+* byte-goldens — SHA-pinned bedMethyl/CX from the deterministic fixture;
+* spill/resume — the accumulator's watermark protocol replays cleanly
+  (orphan runs dropped, idempotent re-adds, byte-identical finalize);
+* chemistry — emseq == bisulfite bytes; 'none' runs the plain duplex
+  engine transport-identically; forbidden combinations refuse loudly;
+* serve — mixed-chemistry tenants share the engine, each job's output
+  SHA equal to its standalone run, chemistry in the job status.
+"""
+
+import hashlib
+import os
+import types
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamWriter,
+    write_items,
+)
+from bsseqconsensusreads_tpu.methyl import (
+    CTX_NAMES,
+    MethylAccumulator,
+    merge_tallies,
+    methyl_epilogue,
+    methyl_epilogue_host,
+)
+from bsseqconsensusreads_tpu.ops.refstore import RefStore
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    StageStats,
+    call_duplex_batches,
+)
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_aligned_duplex_group,
+    random_genome,
+)
+
+_A, _C, _G, _T, _N = 0, 1, 2, 3, 4
+
+
+# ---------------------------------------------------------------------------
+# fixture: the transport-test duplex shape + a methyl-aware runner
+
+
+@pytest.fixture(scope="module")
+def duplex_setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("methyl")
+    rng = np.random.default_rng(11)
+    _, g1 = random_genome(rng, 9000, name="chrA")
+    _, g2 = random_genome(rng, 7000, name="chrB")
+    genomes = {"chrA": g1, "chrB": g2}
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n", [("chrA", 9000), ("chrB", 7000)]
+    )
+    records = []
+    for fam in range(40):
+        ref_id = fam % 2
+        gname = ("chrA", "chrB")[ref_id]
+        start = 50 + (fam // 2) * 150
+        if fam == 6:  # window runs past the contig end: context columns
+            # there come back N and must be suppressed
+            start = len(genomes[gname]) - 60
+        recs = make_aligned_duplex_group(
+            rng, gname, genomes[gname], fam, start, 60,
+            softclip=3 if fam % 5 == 0 else 0,
+        )
+        for r in recs:
+            r.ref_id = ref_id
+            if fam == 9:
+                r.ref_id = -1  # unmapped family: no reference, no sites
+        records.extend(recs)
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    path = str(tmp / "dup_in.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    # store contig order differs from the BAM header on purpose: global
+    # site offsets must come from the name mapping, not raw ref_ids
+    store = RefStore(["chrB", "chrA"], seqs=[g2, g1])
+    return {
+        "path": path, "header": header, "genomes": genomes, "store": store,
+        "tmp": tmp,
+    }
+
+
+def _run(setup, transport, out_name, methyl_formats=("bed",), **kw):
+    """One duplex stage run; returns {'bam': bytes, 'bed': bytes|None,
+    'cx': bytes|None, 'report': dict|None}."""
+    from bsseqconsensusreads_tpu.io.bam import BamReader
+
+    genomes = setup["genomes"]
+
+    def fetch(name, s, e):
+        return genomes[name][s:e]
+
+    kw.setdefault("mesh", None)
+    kw.setdefault("refstore", setup["store"])
+    kw.setdefault("stats", StageStats())
+    acc = None
+    bed = cx = None
+    if methyl_formats:
+        bed = (
+            str(setup["tmp"] / (out_name + ".bedmethyl"))
+            if "bed" in methyl_formats else None
+        )
+        cx = (
+            str(setup["tmp"] / (out_name + ".CX_report.txt"))
+            if "cx" in methyl_formats else None
+        )
+        acc = MethylAccumulator(setup["store"], bed, cx)
+    with BamReader(setup["path"]) as reader:
+        names = [n for n, _ in reader.header.references]
+        batches = call_duplex_batches(
+            reader, fetch, names, mode="self", grouping="coordinate",
+            transport=transport, methyl=acc, **kw,
+        )
+        out = str(setup["tmp"] / out_name)
+        with BamWriter(out, setup["header"], engine="python") as w:
+            for b in batches:
+                write_items(w, b)
+    report = acc.finalize() if acc is not None else None
+    return {
+        "bam": open(out, "rb").read(),
+        "bed": open(bed, "rb").read() if bed else None,
+        "cx": open(cx, "rb").read() if cx else None,
+        "report": report,
+    }
+
+
+# ---------------------------------------------------------------------------
+# epilogue: hand case + randomized jnp/numpy bit-identity
+
+
+def _hand_case():
+    """One family, W=8, genome slice TACGCTAGGCAT (window = g[2:10])."""
+    g = "TACGCTAGGCAT"
+    code = {"A": _A, "C": _C, "G": _G, "T": _T}
+    ref_ext = np.array([[code[c] for c in g]], dtype=np.int8)
+    w = 8
+    bases = np.full((1, 4, w), _N, np.int8)
+    quals = np.full((1, 4, w), 30, np.int8)
+    cover = np.zeros((1, 4, w), bool)
+    # rows 99/163/83/147 -> convert rows are indices 1 and 2
+    convert_mask = np.array([[False, True, True, False]])
+    # col0 = ref C (CpG+): one untreated C (meth), one untreated T (unmeth)
+    bases[0, 0, 0], cover[0, 0, 0] = _C, True
+    bases[0, 3, 0], cover[0, 3, 0] = _T, True
+    # col1 = ref G (CpG-): both treated rows read G (2 meth)
+    bases[0, 1, 1], cover[0, 1, 1] = _G, True
+    bases[0, 2, 1], cover[0, 2, 1] = _G, True
+    # col2 = ref C (CHH+): an untreated C below the quality gate
+    bases[0, 0, 2], cover[0, 0, 2] = _C, True
+    quals[0, 0, 2] = 3
+    cons_base = np.zeros((1, 2, w), np.int8)  # called everywhere
+    return bases, quals, cover, convert_mask, cons_base, ref_ext
+
+
+class TestEpilogue:
+    def test_hand_case_contexts_and_counts(self):
+        args = _hand_case()
+        planes = methyl_epilogue_host(*args, min_q=20)
+        ctx, counts = planes[0, 0], planes[0, 1]
+        # TACGCTAGGCAT windows to CGCTAGGC: CpG+ CpG- CHH+ . . CHH- CHH- CHH+
+        assert list(ctx) == [1, 4, 3, 0, 0, 6, 6, 3]
+        assert counts[0] == (1 | (1 << 4))  # 1 meth, 1 unmeth
+        assert counts[1] == 2              # 2 meth on the minus strand
+        assert counts[2] == 0              # quality-gated observation
+        assert counts[3] == 0 and counts[4] == 0
+
+    def test_uncalled_columns_report_nothing(self):
+        bases, quals, cover, cm, cons, ref_ext = _hand_case()
+        cons = np.full_like(cons, _N)  # vote called no base anywhere
+        planes = methyl_epilogue_host(
+            bases, quals, cover, cm, cons, ref_ext, min_q=20
+        )
+        assert not planes.any()
+
+    def test_n_reference_suppresses(self):
+        bases, quals, cover, cm, cons, ref_ext = _hand_case()
+        ref_ext = ref_ext.copy()
+        ref_ext[0, 3] = _N  # CpG+ partner of col0 becomes N
+        planes = methyl_epilogue_host(
+            bases, quals, cover, cm, cons, ref_ext, min_q=20
+        )
+        assert planes[0, 0, 0] == 0 and planes[0, 1, 0] == 0
+        # col1's reference base IS that N now -> no site at all
+        assert planes[0, 0, 1] == 0
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_device_twin_bit_identity_randomized(self, seed):
+        rng = np.random.default_rng(seed)
+        f, w = 7, 24
+        bases = rng.integers(0, 5, (f, 4, w)).astype(np.int8)
+        quals = rng.integers(0, 45, (f, 4, w)).astype(np.int8)
+        cover = rng.random((f, 4, w)) < 0.7
+        cm = rng.random((f, 4)) < 0.5
+        cons = rng.integers(0, 5, (f, 2, w)).astype(np.int8)
+        ref_ext = rng.integers(0, 5, (f, w + 4)).astype(np.int8)
+        dev = np.asarray(
+            methyl_epilogue(bases, quals, cover, cm, cons, ref_ext, 20.0)
+        )
+        host = methyl_epilogue_host(
+            bases, quals, cover, cm, cons, ref_ext, 20.0
+        )
+        assert dev.dtype == host.dtype == np.uint8
+        assert np.array_equal(dev, host)
+
+
+# ---------------------------------------------------------------------------
+# mini-genome oracle: independent string-walk classification
+
+
+def _oracle(genome: str, p: int):
+    """(context name, strand) for genome position p, or None when the
+    site is not callable — an independent re-derivation of the epilogue's
+    classification for the oracle test."""
+    n = len(genome)
+
+    def at(i):
+        return genome[i] if 0 <= i < n else "N"
+
+    b = at(p)
+    if b == "C":
+        n1, n2 = at(p + 1), at(p + 2)
+        if n1 == "G":
+            return ("CpG", "+")
+        if n1 == "N":
+            return None
+        if n2 == "G":
+            return ("CHG", "+")
+        if n2 == "N":
+            return None
+        return ("CHH", "+")
+    if b == "G":
+        m1, m2 = at(p - 1), at(p - 2)
+        if m1 == "C":
+            return ("CpG", "-")
+        if m1 == "N":
+            return None
+        if m2 == "C":
+            return ("CHG", "-")
+        if m2 == "N":
+            return None
+        return ("CHH", "-")
+    return None
+
+
+_COMP = {"A": "T", "C": "G", "G": "C", "T": "A", "N": "N"}
+
+
+def _oracle_tri(genome: str, p: int, minus: bool) -> str:
+    n = len(genome)
+    out = []
+    for k in range(3):
+        q = p - k if minus else p + k
+        c = genome[q] if 0 <= q < n else "N"
+        out.append(_COMP[c] if minus else c)
+    return "".join(out)
+
+
+class TestMiniGenomeOracle:
+    def test_bedmethyl_contexts_match_oracle_exactly(self, duplex_setup):
+        res = _run(duplex_setup, "unpacked", "oracle.bam")
+        lines = res["bed"].decode().splitlines()
+        assert len(lines) > 300
+        seen_ctx = set()
+        for ln in lines:
+            cols = ln.split("\t")
+            chrom, p0, name, strand, pct = (
+                cols[0], cols[1], cols[3], cols[5], cols[10]
+            )
+            p = int(p0)
+            got = _oracle(duplex_setup["genomes"][chrom], p)
+            assert got == (name, strand), (ln, got)
+            # the simulator methylates every CpG and converts everything
+            # else: the percent column is fully determined by the context
+            assert int(pct) == (100 if name == "CpG" else 0), ln
+            seen_ctx.add((name, strand))
+        # the fixture is large enough to exercise every context code
+        assert seen_ctx == {
+            (n, s) for n, s in CTX_NAMES.values()
+        }
+
+    def test_cx_report_matches_oracle(self, duplex_setup):
+        res = _run(
+            duplex_setup, "unpacked", "oracle_cx.bam", methyl_formats=("cx",)
+        )
+        lines = res["cx"].decode().splitlines()
+        assert len(lines) > 300
+        for ln in lines:
+            chrom, pos1, strand, m, u, name, tri = ln.split("\t")
+            p = int(pos1) - 1
+            genome = duplex_setup["genomes"][chrom]
+            assert _oracle(genome, p) == (name, strand), ln
+            assert _oracle_tri(genome, p, strand == "-") == tri, ln
+            assert int(m) + int(u) >= 1  # covered sites only
+
+
+# ---------------------------------------------------------------------------
+# engine differential: fused kernel == device epilogue == host twin ==
+# degrade path, and consensus bytes never move
+
+
+class TestEngineDifferential:
+    def test_wire_unpacked_host_byte_identical(
+        self, duplex_setup, monkeypatch
+    ):
+        wire = _run(duplex_setup, "wire", "dw.bam", methyl_formats=("bed", "cx"))
+        plain = _run(
+            duplex_setup, "unpacked", "du.bam", methyl_formats=("bed", "cx")
+        )
+        monkeypatch.setenv("BSSEQ_TPU_METHYL_ENGINE", "host")
+        host = _run(
+            duplex_setup, "wire", "dh.bam", methyl_formats=("bed", "cx")
+        )
+        assert wire["bed"] == plain["bed"] == host["bed"]
+        assert wire["cx"] == plain["cx"] == host["cx"]
+        assert wire["bam"] == plain["bam"] == host["bam"]
+        assert wire["report"]["sites"] > 0
+
+    def test_consensus_bytes_unchanged_by_methyl(self, duplex_setup):
+        with_methyl = _run(duplex_setup, "wire", "m1.bam")
+        without = _run(duplex_setup, "wire", "m0.bam", methyl_formats=())
+        assert with_methyl["bam"] == without["bam"]
+
+    def test_degrade_path_byte_identical(self, duplex_setup):
+        from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+
+        ref = _run(duplex_setup, "unpacked", "dg_ref.bam")
+        _failpoints.arm("dispatch_kernel=raise:RuntimeError@stage=duplex")
+        try:
+            stats = StageStats()
+            degraded = _run(duplex_setup, "unpacked", "dg.bam", stats=stats)
+            assert stats.batches_degraded > 0
+        finally:
+            _failpoints.disarm()
+        assert degraded["bed"] == ref["bed"]
+        assert degraded["bam"] == ref["bam"]
+
+    def test_packed_and_padded_layouts_identical(self, duplex_setup):
+        packed = _run(duplex_setup, "unpacked", "lp.bam", layout="packed")
+        padded = _run(duplex_setup, "unpacked", "lq.bam", layout="padded")
+        assert packed["bed"] == padded["bed"]
+        assert packed["bam"] == padded["bam"]
+
+    def test_merge_engines_agree_end_to_end(self, duplex_setup, monkeypatch):
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if not wirepack.available():
+            pytest.skip("wirepack library not built")
+        monkeypatch.setenv("BSSEQ_TPU_METHYL_MERGE", "python")
+        py = _run(duplex_setup, "unpacked", "mp.bam")
+        monkeypatch.setenv("BSSEQ_TPU_METHYL_MERGE", "native")
+        nat = _run(duplex_setup, "unpacked", "mn.bam")
+        assert py["bed"] == nat["bed"]
+
+
+# ---------------------------------------------------------------------------
+# byte-goldens: the fixture is fully deterministic
+
+
+class TestGoldens:
+    def test_bedmethyl_and_cx_sha_pinned(self, duplex_setup):
+        res = _run(
+            duplex_setup, "unpacked", "golden.bam",
+            methyl_formats=("bed", "cx"),
+        )
+        assert hashlib.sha256(res["bed"]).hexdigest() == (
+            "193939c45c7c8d77025524b1a12baf081bb0fbecc351ce5648fe7e8bcd6ec247"
+        )
+        assert hashlib.sha256(res["cx"]).hexdigest() == (
+            "d634997c82a7147d990bf8ae30a59b13dcf95ecc28ff219dc980bfb5912769c5"
+        )
+
+
+# ---------------------------------------------------------------------------
+# chemistry modes
+
+
+class TestChemistry:
+    def test_emseq_identical_to_bisulfite(self, duplex_setup):
+        bs = _run(duplex_setup, "unpacked", "cb.bam", chemistry="bisulfite")
+        em = _run(duplex_setup, "unpacked", "ce.bam", chemistry="emseq")
+        assert em["bam"] == bs["bam"] and em["bed"] == bs["bed"]
+
+    def test_none_runs_plain_duplex_transport_identical(self, duplex_setup):
+        """chemistry='none' (fgbio-style unconverted duplex) through the
+        identical engine: wire, unpacked and the degrade path agree."""
+        from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+
+        plain = _run(
+            duplex_setup, "unpacked", "n0.bam", methyl_formats=(),
+            chemistry="none",
+        )
+        wire = _run(
+            duplex_setup, "wire", "n1.bam", methyl_formats=(),
+            chemistry="none",
+        )
+        _failpoints.arm("dispatch_kernel=raise:RuntimeError@stage=duplex")
+        try:
+            degraded = _run(
+                duplex_setup, "unpacked", "n2.bam", methyl_formats=(),
+                chemistry="none",
+            )
+        finally:
+            _failpoints.disarm()
+        assert wire["bam"] == plain["bam"] == degraded["bam"]
+        assert len(plain["bam"]) > 200
+
+    def test_none_differs_from_bisulfite(self, duplex_setup):
+        """Disabling the conversion transform must actually change the
+        engine's reading of converted evidence — 'none' is not a no-op
+        spelling of 'bisulfite' on this fixture."""
+        bs = _run(duplex_setup, "unpacked", "d0.bam", methyl_formats=())
+        off = _run(
+            duplex_setup, "unpacked", "d1.bam", methyl_formats=(),
+            chemistry="none",
+        )
+        assert off["bam"] != bs["bam"]
+
+
+# ---------------------------------------------------------------------------
+# forbidden combinations refuse loudly
+
+
+class TestForbiddenCombos:
+    def test_unknown_chemistry(self, duplex_setup):
+        with pytest.raises(ValueError, match="chemistry"):
+            _run(duplex_setup, "unpacked", "x0.bam", chemistry="sanger")
+
+    def test_methyl_needs_converting_chemistry(self, duplex_setup):
+        with pytest.raises(ValueError, match="chemistry"):
+            _run(duplex_setup, "unpacked", "x1.bam", chemistry="none")
+
+    def test_none_refuses_passthrough(self, duplex_setup):
+        with pytest.raises(ValueError, match="passthrough"):
+            _run(
+                duplex_setup, "unpacked", "x2.bam", methyl_formats=(),
+                chemistry="none", passthrough=True,
+            )
+
+    def test_none_refuses_pos0_shift(self, duplex_setup):
+        with pytest.raises(ValueError, match="pos0"):
+            _run(
+                duplex_setup, "unpacked", "x3.bam", methyl_formats=(),
+                chemistry="none", pos0="shift",
+            )
+
+    def test_builder_validation(self, tmp_path):
+        from bsseqconsensusreads_tpu.config import FrameworkConfig
+        from bsseqconsensusreads_tpu.pipeline.stages import PipelineBuilder
+        from bsseqconsensusreads_tpu.pipeline.workflow import WorkflowError
+
+        bam = str(tmp_path / "absent.bam")
+
+        def build(**kw):
+            cfg = FrameworkConfig(aligner="self", group_umis="never", **kw)
+            return PipelineBuilder(cfg, bam, outdir=str(tmp_path)).build()
+
+        with pytest.raises(WorkflowError, match="chemistry"):
+            build(chemistry="sanger")
+        with pytest.raises(WorkflowError, match="methyl"):
+            build(methyl="wig")
+        with pytest.raises(WorkflowError, match="chemistry"):
+            build(methyl="bedmethyl", chemistry="none")
+        with pytest.raises(WorkflowError, match="single"):
+            build(methyl="bedmethyl", single_strand=True)
+
+    def test_accumulator_needs_an_output(self, duplex_setup):
+        with pytest.raises(ValueError, match="bed_path or cx_path"):
+            MethylAccumulator(duplex_setup["store"])
+
+
+# ---------------------------------------------------------------------------
+# accumulator: spill / watermark / resume protocol (in-process)
+
+
+def _mk_tallies(rng, n, span=500):
+    sites = np.sort(rng.integers(0, span, n)).astype(np.int64)
+    ctx = (sites % 6 + 1).astype(np.uint8)  # pure function of the site
+    meth = rng.integers(0, 3, n).astype(np.uint32)
+    unmeth = rng.integers(0, 3, n).astype(np.uint32)
+    return sites, ctx, meth + 1, unmeth  # cov >= 1 everywhere
+
+
+class _FakeCk:
+    def __init__(self, batches_done=0):
+        self.batches_done = batches_done
+        self.on_flush = None
+
+
+class TestAccumulatorProtocol:
+    @pytest.fixture()
+    def store(self):
+        rng = np.random.default_rng(5)
+        return RefStore(
+            ["c1"], seqs=["".join("ACGT"[i] for i in rng.integers(0, 4, 600))]
+        )
+
+    def _finalized_bytes(self, store, path, adds):
+        acc = MethylAccumulator(store, str(path))
+        for bi, t in adds:
+            acc.add(bi, *t)
+        acc.finalize()
+        return open(path, "rb").read()
+
+    def test_spill_resume_byte_identical(self, store, tmp_path):
+        rng = np.random.default_rng(9)
+        batches = {bi: _mk_tallies(rng, 40) for bi in (1, 2, 3, 4)}
+        ref = self._finalized_bytes(
+            store, tmp_path / "ref.bed", sorted(batches.items())
+        )
+        # checkpointed run: spill batches 1-2 at the committed watermark,
+        # then "crash" with 3 pending and 4 never delivered
+        bed = str(tmp_path / "r.bed")
+        acc = MethylAccumulator(store, bed)
+        acc.attach_checkpoint(_FakeCk())
+        acc.add(1, *batches[1])
+        acc.add(2, *batches[2])
+        acc.flush(2)
+        acc.add(3, *batches[3])
+        del acc
+        # resume at batches_done=2: the run survives, 3 and 4 replay
+        acc2 = MethylAccumulator(store, bed)
+        acc2.attach_checkpoint(_FakeCk(batches_done=2))
+        acc2.add(3, *batches[3])
+        acc2.add(4, *batches[4])
+        acc2.finalize()
+        assert open(bed, "rb").read() == ref
+
+    def test_orphan_run_above_watermark_dropped(self, store, tmp_path):
+        rng = np.random.default_rng(10)
+        batches = {bi: _mk_tallies(rng, 30) for bi in (1, 2, 3, 4)}
+        ref = self._finalized_bytes(
+            store, tmp_path / "ref.bed", sorted(batches.items())
+        )
+        bed = str(tmp_path / "o.bed")
+        acc = MethylAccumulator(store, bed)
+        acc.attach_checkpoint(_FakeCk())
+        for bi in (1, 2, 3, 4):
+            acc.add(bi, *batches[bi])
+        acc.flush(2)
+        acc.flush(4)  # this run's manifest entry outruns the "commit"
+        del acc
+        # the checkpoint only committed through batch 2: run 2 is an
+        # orphan and must be dropped, its batches replayed
+        acc2 = MethylAccumulator(store, bed)
+        acc2.attach_checkpoint(_FakeCk(batches_done=2))
+        run1 = bed + ".methyl.run.0001"
+        assert not os.path.exists(run1)
+        acc2.add(3, *batches[3])
+        acc2.add(4, *batches[4])
+        acc2.finalize()
+        assert open(bed, "rb").read() == ref
+
+    def test_add_is_idempotent(self, store, tmp_path):
+        rng = np.random.default_rng(11)
+        batches = {bi: _mk_tallies(rng, 25) for bi in (1, 2)}
+        ref = self._finalized_bytes(
+            store, tmp_path / "ref.bed", sorted(batches.items())
+        )
+        bed = str(tmp_path / "i.bed")
+        acc = MethylAccumulator(store, bed)
+        acc.attach_checkpoint(_FakeCk())
+        acc.add(1, *batches[1])
+        acc.add(1, *batches[1])  # redispatch replay: replaces, no double
+        acc.flush(1)
+        acc.add(1, *batches[1])  # at the watermark: ignored
+        acc.add(2, *batches[2])
+        acc.finalize()
+        assert open(bed, "rb").read() == ref
+
+    def test_uncheckpointed_threshold_spill(self, store, tmp_path):
+        rng = np.random.default_rng(12)
+        batches = {bi: _mk_tallies(rng, 50) for bi in (1, 2, 3)}
+        ref = self._finalized_bytes(
+            store, tmp_path / "ref.bed", sorted(batches.items())
+        )
+        bed = str(tmp_path / "t.bed")
+        acc = MethylAccumulator(store, bed, spill_sites=60)
+        for bi in (1, 2, 3):
+            acc.add(bi, *batches[bi])
+        report = acc.finalize()
+        assert open(bed, "rb").read() == ref
+        assert report["sites"] > 0
+        # finalize cleaned up its spill machinery
+        assert not os.path.exists(bed + ".methyl.runs.json")
+
+
+class TestMergeTallies:
+    def test_python_merge_sums_duplicates(self):
+        sites = np.array([5, 3, 5, 3, 9], np.int64)
+        ctx = np.array([2, 1, 2, 1, 4], np.uint8)
+        meth = np.array([1, 2, 3, 4, 5], np.uint32)
+        unmeth = np.array([0, 1, 0, 1, 0], np.uint32)
+        s, c, m, u = merge_tallies(sites, ctx, meth, unmeth, engine="python")
+        assert list(s) == [3, 5, 9]
+        assert list(c) == [1, 2, 4]
+        assert list(m) == [6, 4, 5]
+        assert list(u) == [2, 0, 0]
+
+    def test_native_matches_python(self):
+        from bsseqconsensusreads_tpu.io import wirepack
+
+        if not wirepack.available():
+            pytest.skip("wirepack library not built")
+        rng = np.random.default_rng(3)
+        sites = rng.integers(0, 200, 5000).astype(np.int64)
+        ctx = (sites % 6 + 1).astype(np.uint8)
+        meth = rng.integers(0, 10, 5000).astype(np.uint32)
+        unmeth = rng.integers(0, 10, 5000).astype(np.uint32)
+        py = merge_tallies(sites, ctx, meth, unmeth, engine="python")
+        nat = merge_tallies(sites, ctx, meth, unmeth, engine="native")
+        for a, b in zip(py, nat):
+            assert np.array_equal(a, b)
+
+    def test_extract_tallies_global_offsets(self):
+        from bsseqconsensusreads_tpu.methyl import extract_tallies
+
+        # store order is the REVERSE of the BAM header order: a raw
+        # ref_id would land c1 sites inside c2's global range
+        store = RefStore(["c2", "c1"], seqs=["ACGT" * 25, "ACGT" * 25])
+        rid_map = store.contig_indices(["c1", "c2"])
+        planes = np.zeros((2, 2, 6), np.uint8)
+        planes[0, 0, 2], planes[0, 1, 2] = 1, 1 | (2 << 4)
+        planes[1, 0, 4], planes[1, 1, 4] = 4, 3
+        metas = [
+            types.SimpleNamespace(ref_id=0, window_start=10),  # c1
+            types.SimpleNamespace(ref_id=-1, window_start=10),  # unmapped
+        ]
+        sites, ctx, meth, unmeth = extract_tallies(
+            planes, metas, store, rid_map
+        )
+        assert list(sites) == [100 + 10 + 2]  # c1 starts at offsets[1]
+        assert list(ctx) == [1]
+        assert list(meth) == [1] and list(unmeth) == [2]
+
+
+# ---------------------------------------------------------------------------
+# serve: mixed-chemistry tenants
+
+
+class TestServeMixedChemistry:
+    @pytest.fixture()
+    def engine(self):
+        from bsseqconsensusreads_tpu.serve import ServeEngine
+
+        engines = []
+
+        def make(**kw):
+            kw.setdefault("batch_families", 4)
+            kw.setdefault("stride", 2)
+            eng = ServeEngine(**kw)
+            engines.append(eng)
+            eng.start()
+            return eng
+
+        yield make
+        for eng in engines:
+            eng.stop(timeout=30)
+
+    @staticmethod
+    def _grouped_bam(path, seed):
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+        )
+
+        rng = np.random.default_rng(seed)
+        genome = "".join(
+            "ACGT"[i] for i in np.random.default_rng(7).integers(0, 4, 2000)
+        )
+        header, records = make_grouped_bam_records(
+            rng, f"chr{seed % 97}", genome, n_families=5, read_len=40
+        )
+        with BamWriter(path, header) as w:
+            for r in records:
+                w.write(r)
+
+    def test_mixed_chemistry_tenants_isolated(self, tmp_path, engine):
+        from bsseqconsensusreads_tpu import cli
+
+        chems = ["bisulfite", "none", "emseq"]
+        inputs, refs = [], []
+        for k in range(3):
+            inp = str(tmp_path / f"in{k}.bam")
+            self._grouped_bam(inp, seed=40 + k)
+            inputs.append(inp)
+            ref = str(tmp_path / f"ref{k}.bam")
+            assert cli.main(
+                ["molecular", "-i", inp, "-o", ref,
+                 "--batching", "sequential"]
+            ) == 0
+            refs.append(hashlib.sha256(open(ref, "rb").read()).hexdigest())
+        eng = engine()
+        jobs = []
+        for k, (inp, chem) in enumerate(zip(inputs, chems)):
+            jobs.append(eng.submit({
+                "input": inp, "output": str(tmp_path / f"out{k}.bam"),
+                "chemistry": chem,
+            }))
+        for k, job in enumerate(jobs):
+            st = eng.wait(job.id, timeout=120)
+            assert st["state"] == "done"
+            # chemistry is admission + provenance: it rides the status
+            assert st["chemistry"] == chems[k]
+            sha = hashlib.sha256(
+                open(str(tmp_path / f"out{k}.bam"), "rb").read()
+            ).hexdigest()
+            # the molecular stage is chemistry-invariant: every tenant's
+            # bytes equal its standalone run regardless of neighbors
+            assert sha == refs[k]
+
+    def test_unknown_chemistry_refused_at_admission(self, tmp_path, engine):
+        from bsseqconsensusreads_tpu.serve import AdmissionError
+
+        inp = str(tmp_path / "in.bam")
+        self._grouped_bam(inp, seed=50)
+        eng = engine()
+        with pytest.raises(AdmissionError, match="chemistry"):
+            eng.submit({
+                "input": inp, "output": str(tmp_path / "o.bam"),
+                "chemistry": "sanger",
+            })
+
+
+# ---------------------------------------------------------------------------
+# single-strand consensus mode (molecular emit without duplex pairing)
+
+
+class TestSingleStrand:
+    def test_single_strand_stops_at_molecular(self, tmp_path):
+        from bsseqconsensusreads_tpu.config import FrameworkConfig
+        from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+        from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+        from bsseqconsensusreads_tpu.utils.testing import (
+            stream_duplex_families,
+            write_fasta,
+        )
+
+        wd = str(tmp_path)
+        rng = np.random.default_rng(21)
+        codes = rng.integers(0, 4, size=6000).astype(np.int8)
+        write_fasta(os.path.join(wd, "genome.fa"), "chr1",
+                    codes_to_seq(codes))
+        header = BamHeader(
+            "@HD\tVN:1.6\tSO:coordinate\n", [("chr1", 6000)]
+        )
+        bam = os.path.join(wd, "in.bam")
+        with BamWriter(bam, header) as w:
+            for rec in stream_duplex_families(
+                codes, 12, read_len=50, bisulfite=True
+            ):
+                w.write(rec)
+
+        def run(sub, **kw):
+            cfg = FrameworkConfig(
+                genome_dir=wd, genome_fasta_file_name="genome.fa", tmp=wd,
+                aligner="self", grouping="coordinate", batch_families=4,
+                single_strand=True, **kw,
+            )
+            out = os.path.join(wd, sub)
+            target, _, _ = run_pipeline(cfg, bam, outdir=out)
+            return target, open(target, "rb").read()
+
+        t1, b1 = run("o1")
+        assert "molecular" in os.path.basename(t1)
+        assert "duplex" not in os.path.basename(t1)
+        # transport differential: the single-strand target is engine-
+        # independent like every other stage output
+        t2, b2 = run("o2", transport="unpacked")
+        assert b1 == b2 and len(b1) > 200

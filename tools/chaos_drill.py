@@ -54,6 +54,8 @@ def _child(wd: str, bam: str, outdir: str) -> None:
         aligner="self", grouping="coordinate", batch_families=8,
         checkpoint_every=2,
         sort_buffer_records=64,  # small: the raw sort must actually spill
+        methyl=os.environ.get("BSSEQ_CHAOS_METHYL", "off"),
+        methyl_out=os.environ.get("BSSEQ_CHAOS_METHYL_OUT", ""),
     )
     target, _, stats = run_pipeline(cfg, bam, outdir=outdir)
     print(json.dumps({
@@ -513,6 +515,88 @@ def run_drill(quick: bool, out_path: str) -> dict:
                             "records_quarantined"] > 0
                         and entry["resumed_batches"]
                         < _stage_counter(qref, "molecular", "batches")
+                    )
+                else:
+                    entry["error"] = (
+                        f"resume rc={cp3.returncode}: " + cp3.stderr[-500:]
+                    )
+
+        # graftmethyl (ISSUE 10): a spill io_error inside the tally
+        # accumulator AND a hard kill at the next methyl spill — i.e.
+        # in the window AFTER the checkpoint's shard write but BEFORE
+        # its manifest commit. The watermark protocol must drop the
+        # orphan run on resume and replay its batches, so the final
+        # bedMethyl is byte-identical to an uninterrupted methyl run —
+        # and the consensus BAM identical to the no-methyl reference
+        # (the fused epilogue never perturbs consensus bytes).
+        entry = {"ok": False}
+        results["methyl_spill_io_error_resume"] = entry
+        mref_dir = os.path.join(wd, "out_mref")
+        cp = _run_child(
+            wd, bam, mref_dir, os.path.join(wd, "m0.jsonl"),
+            env_extra={
+                "BSSEQ_CHAOS_METHYL": "bedmethyl",
+                "BSSEQ_CHAOS_METHYL_OUT": os.path.join(mref_dir, "methyl"),
+            },
+        )
+        if cp.returncode != 0:
+            entry["error"] = f"methyl ref rc={cp.returncode}: " + cp.stderr[-500:]
+        else:
+            mref = _child_payload(cp)
+            mref_bed = open(
+                os.path.join(mref_dir, "methyl.bedmethyl"), "rb"
+            ).read()
+            entry["bed_bytes"] = len(mref_bed)
+            entry["consensus_unperturbed"] = (
+                open(mref["target"], "rb").read() == ref_bytes
+            )
+            outdir = os.path.join(wd, "out_mkill")
+            menv = {
+                "BSSEQ_CHAOS_METHYL": "bedmethyl",
+                "BSSEQ_CHAOS_METHYL_OUT": os.path.join(outdir, "methyl"),
+            }
+            ledger = os.path.join(wd, "m1.jsonl")
+            cp2 = _run_child(
+                wd, bam, outdir, ledger,
+                "extsort_spill=io_error:times=1@stage=methyl;"
+                "extsort_spill=exit:9@stage=methyl@hit=3",
+                env_extra=menv,
+            )
+            entry["kill_rc"] = cp2.returncode
+            if cp2.returncode == 9:
+                counts = _ledger_counts(ledger)
+                entry["faults_fired"] = counts.get("failpoint_fired", 0)
+                entry["spill_retried"] = counts.get("batch_retry", 0)
+                entry["runs_committed"] = counts.get("methyl_spill", 0)
+                cp3 = _run_child(wd, bam, outdir,
+                                 os.path.join(wd, "m2.jsonl"),
+                                 env_extra=menv)
+                if cp3.returncode == 0:
+                    resumed = _child_payload(cp3)
+                    entry["bed_identical"] = (
+                        open(
+                            os.path.join(outdir, "methyl.bedmethyl"), "rb"
+                        ).read() == mref_bed
+                    )
+                    entry["bam_identical"] = (
+                        open(resumed["target"], "rb").read() == ref_bytes
+                    )
+                    entry["resumed_duplex_batches"] = _stage_counter(
+                        resumed, "duplex", "batches"
+                    )
+                    entry["reference_duplex_batches"] = _stage_counter(
+                        mref, "duplex", "batches"
+                    )
+                    entry["ok"] = (
+                        entry["consensus_unperturbed"]
+                        and len(mref_bed) > 0
+                        and entry["bed_identical"]
+                        and entry["bam_identical"]
+                        and entry["spill_retried"] >= 1
+                        and entry["runs_committed"] >= 1
+                        and entry["faults_fired"] >= 2
+                        and entry["resumed_duplex_batches"]
+                        < entry["reference_duplex_batches"]
                     )
                 else:
                     entry["error"] = (

@@ -1,0 +1,214 @@
+"""Methylation-extraction bench: sites/sec + fused-epilogue overhead.
+
+Runs the duplex stage over a deterministic two-contig mini-genome three
+ways — no methyl, fused methyl (device epilogue), host-twin methyl
+(BSSEQ_TPU_METHYL_ENGINE=host) — and writes METHYL_HEAD.json.
+
+The throughput number is ADMISSIBLE only when the run also proves it
+measured the right thing (BASELINE.md scoping):
+
+* oracle_ok      — every emitted bedMethyl row re-derived by an
+                   independent string-walk over the genome (context name,
+                   strand, and a real C/G at the position);
+* host_identical — fused bedMethyl/CX bytes == host-twin bytes;
+* bam_unperturbed — the consensus BAM with the epilogue attached is
+                   byte-identical to the no-methyl run.
+
+ok = all three gates. sites_per_sec is null when any gate fails — a fast
+wrong answer must not produce a quotable number. The fused-epilogue cost
+is reported two ways: wall delta vs the no-methyl run (noisy on small
+fixtures) and the stage ledger's own 'methyl' span attribution.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import numpy as np  # noqa: E402
+
+
+def _build_fixture(tmp, n_families: int):
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+    from bsseqconsensusreads_tpu.ops.refstore import RefStore
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_aligned_duplex_group,
+        random_genome,
+    )
+
+    rng = np.random.default_rng(23)
+    span = max(4000, (n_families // 2) * 150 + 400)
+    _, g1 = random_genome(rng, span, name="chrA")
+    _, g2 = random_genome(rng, span, name="chrB")
+    genomes = {"chrA": g1, "chrB": g2}
+    header = BamHeader(
+        "@HD\tVN:1.6\tSO:coordinate\n",
+        [("chrA", len(g1)), ("chrB", len(g2))],
+    )
+    records = []
+    for fam in range(n_families):
+        gname = ("chrA", "chrB")[fam % 2]
+        start = 50 + (fam // 2) * 150
+        records.extend(
+            make_aligned_duplex_group(
+                rng, gname, genomes[gname], fam, start, 60
+            )
+        )
+    records.sort(key=lambda r: (r.ref_id, r.pos))
+    path = os.path.join(tmp, "methyl_bench_in.bam")
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+    # store order deliberately != header order: the bench exercises the
+    # ref_id -> store-contig translation, not just the happy path
+    store = RefStore(["chrB", "chrA"], seqs=[g2, g1])
+    return path, header, genomes, store
+
+
+def _run_stage(path, header, genomes, store, tmp, tag, methyl_out=None):
+    from bsseqconsensusreads_tpu.io.bam import (
+        BamReader,
+        BamWriter,
+        write_items,
+    )
+    from bsseqconsensusreads_tpu.methyl import MethylAccumulator
+    from bsseqconsensusreads_tpu.pipeline.calling import (
+        StageStats,
+        call_duplex_batches,
+    )
+
+    def fetch(name, s, e):
+        return genomes[name][s:e]
+
+    acc = None
+    bed = cx = None
+    if methyl_out:
+        bed = os.path.join(tmp, methyl_out + ".bedmethyl")
+        cx = os.path.join(tmp, methyl_out + ".CX_report.txt")
+        acc = MethylAccumulator(store, bed, cx)
+    stats = StageStats()
+    out = os.path.join(tmp, tag + ".bam")
+    t0 = time.monotonic()
+    with BamReader(path) as reader:
+        names = [n for n, _ in reader.header.references]
+        batches = call_duplex_batches(
+            reader, fetch, names, mode="self", grouping="coordinate",
+            stats=stats, mesh=None, refstore=store, methyl=acc,
+        )
+        with BamWriter(out, header, engine="python") as w:
+            for b in batches:
+                write_items(w, b)
+    report = acc.finalize() if acc is not None else None
+    wall = time.monotonic() - t0
+    return {
+        "wall_s": wall,
+        "bam": open(out, "rb").read(),
+        "bed": open(bed, "rb").read() if bed else None,
+        "cx": open(cx, "rb").read() if cx else None,
+        "sites": report["sites"] if report else 0,
+        "methyl_span_s": stats.metrics.seconds.get("methyl", 0.0),
+    }
+
+
+def _oracle_check(bed_bytes: bytes, genomes: dict) -> dict:
+    """Independent string-walk re-derivation of every emitted row."""
+    rows = bad = 0
+    for ln in bed_bytes.decode().splitlines():
+        cols = ln.split("\t")
+        chrom, p, name, strand = cols[0], int(cols[1]), cols[3], cols[5]
+        g = genomes[chrom]
+        n = len(g)
+
+        def at(i):
+            return g[i] if 0 <= i < n else "N"
+
+        want = None
+        if at(p) == "C":
+            if at(p + 1) == "G":
+                want = ("CpG", "+")
+            elif at(p + 1) != "N" and at(p + 2) == "G":
+                want = ("CHG", "+")
+            elif at(p + 1) != "N" and at(p + 2) != "N":
+                want = ("CHH", "+")
+        elif at(p) == "G":
+            if at(p - 1) == "C":
+                want = ("CpG", "-")
+            elif at(p - 1) != "N" and at(p - 2) == "C":
+                want = ("CHG", "-")
+            elif at(p - 1) != "N" and at(p - 2) != "N":
+                want = ("CHH", "-")
+        rows += 1
+        if want != (name, strand):
+            bad += 1
+    return {"rows": rows, "mismatches": bad, "ok": rows > 0 and bad == 0}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--families", type=int, default=None)
+    ap.add_argument("--out", default="METHYL_HEAD.json")
+    args = ap.parse_args()
+    n_families = args.families or (240 if args.quick else 1200)
+
+    import tempfile
+
+    result: dict = {"quick": bool(args.quick), "n_families": n_families}
+    with tempfile.TemporaryDirectory() as tmp:
+        path, header, genomes, store = _build_fixture(tmp, n_families)
+        plain = _run_stage(path, header, genomes, store, tmp, "plain")
+        fused = _run_stage(
+            path, header, genomes, store, tmp, "fused", methyl_out="f"
+        )
+        os.environ["BSSEQ_TPU_METHYL_ENGINE"] = "host"
+        try:
+            host = _run_stage(
+                path, header, genomes, store, tmp, "host", methyl_out="h"
+            )
+        finally:
+            del os.environ["BSSEQ_TPU_METHYL_ENGINE"]
+        oracle = _oracle_check(fused["bed"], genomes)
+        gates = {
+            "oracle_ok": oracle["ok"],
+            "host_identical": (
+                fused["bed"] == host["bed"] and fused["cx"] == host["cx"]
+            ),
+            "bam_unperturbed": (
+                fused["bam"] == plain["bam"] == host["bam"]
+            ),
+        }
+        ok = all(gates.values())
+        result.update(gates)
+        result["ok"] = ok
+        result["oracle_rows"] = oracle["rows"]
+        result["sites"] = fused["sites"]
+        result["duplex_s"] = round(plain["wall_s"], 3)
+        result["duplex_methyl_s"] = round(fused["wall_s"], 3)
+        result["methyl_span_s"] = round(fused["methyl_span_s"], 3)
+        result["methyl_overhead_pct"] = round(
+            100.0 * (fused["wall_s"] - plain["wall_s"]) / plain["wall_s"], 1
+        )
+        result["sites_per_sec"] = (
+            round(fused["sites"] / fused["wall_s"], 1) if ok else None
+        )
+        result["bed_sha256"] = hashlib.sha256(fused["bed"]).hexdigest()
+        result["cx_sha256"] = hashlib.sha256(fused["cx"]).hexdigest()
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({k: result[k] for k in (
+        "ok", "sites", "sites_per_sec", "methyl_overhead_pct",
+        "methyl_span_s",
+    )}))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
