@@ -938,6 +938,46 @@ def _run_serve_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_fleet_quick() -> dict | None:
+    """tools/serve_loadgen.py --fleet 2 --quick -> FLEET_HEAD.json: the
+    replicated-serving artifact (Poisson tenants against a live `cli
+    route` fleet over TCP; aggregate jobs/hour + p50/p99 with every
+    tenant byte-identical to its input's standalone run, affinity_hits
+    > 0, and router counters reconciling with per-replica ledger
+    admissions). Best-effort and cpu-pinned like the chaos drill.
+    BSSEQ_BENCH_FLEET=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_FLEET", "1") == "0":
+        return None
+    loadgen = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "serve_loadgen.py",
+    )
+    out_path = os.path.join(os.getcwd(), "FLEET_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, loadgen, "--fleet", "2", "--quick",
+             "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_FLEET_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "jobs_per_hour": data.get("jobs_per_hour"),
+            "latency_p50_s": data.get("latency_p50_s"),
+            "latency_p99_s": data.get("latency_p99_s"),
+            "counters": data.get("counters"),
+            "counters_reconciled": data.get("counters_reconciled"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def _run_methyl_quick() -> dict | None:
     """tools/methyl_bench.py --quick -> METHYL_HEAD.json: the methylation
     subsystem artifact (sites/sec + fused-epilogue overhead, admissible
@@ -1137,6 +1177,14 @@ def main() -> None:
         observe.emit(
             "bench_serve_loadgen",
             {"ok": serve.get("ok"), "path": serve.get("path")},
+            sink=ledger_sink,
+        )
+    fleet = _run_fleet_quick()
+    if fleet is not None:
+        out["fleet"] = fleet
+        observe.emit(
+            "bench_fleet_loadgen",
+            {"ok": fleet.get("ok"), "path": fleet.get("path")},
             sink=ledger_sink,
         )
     methyl = _run_methyl_quick()

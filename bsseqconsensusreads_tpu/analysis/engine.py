@@ -517,12 +517,13 @@ def all_rules() -> dict[str, Rule]:
         rules_retry,
         rules_serve,
         rules_thread,
+        rules_transport,
     )
 
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
-                rules_pack, rules_methyl):
+                rules_pack, rules_methyl, rules_transport):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

@@ -470,6 +470,7 @@ def cmd_observe(args) -> int:
             s = ledger_tools.summarize_ledger(
                 args.ledger, rel_tol=args.tolerance,
                 job=args.job or None,
+                replica=getattr(args, "replica", "") or None,
             )
             print(ledger_tools.format_summary(s))
             return 0 if s.ok else 1
@@ -562,6 +563,9 @@ def cmd_serve(args) -> int:
 
     from bsseqconsensusreads_tpu.serve.server import ServeEngine, ServeServer
 
+    if not args.socket and not args.address:
+        observe.stderr_line("serve: need --socket and/or --address")
+        return 2
     _arm_failpoints(args)
     observe.open_ledger(component="serve")
     engine = ServeEngine(
@@ -581,7 +585,12 @@ def cmd_serve(args) -> int:
     if args.warmup:
         engine.warmup()
     engine.start()
-    server = ServeServer(engine, args.socket)
+    server = ServeServer(
+        engine,
+        args.socket or None,
+        addresses=args.address or None,
+        ready_file=args.ready_file or None,
+    )
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: server.request_drain())
     server.serve_forever()
@@ -593,6 +602,82 @@ def cmd_serve(args) -> int:
     observe.stderr_line(json.dumps(
         {"jobs": states, **engine.scheduler.counters()}
     ))
+    return 0
+
+
+def cmd_route(args) -> int:
+    """graftfleet router (serve/router + serve/fleet): supervise N
+    serve replicas (spawned same-host on kernel-assigned TCP ports, or
+    attached anywhere via --replica-address) and front them with the
+    same serve protocol a single replica speaks. Placement is input-
+    fingerprint affinity first, queue depth otherwise; a replica dying
+    mid-job has its unfinished jobs requeued to survivors (byte-
+    identical — jobs are idempotent) and is respawned warm off the
+    shared compile cache. SIGTERM/SIGINT drain the whole fleet."""
+    import os as _os
+    import signal
+
+    from bsseqconsensusreads_tpu.serve.fleet import ReplicaSet
+    from bsseqconsensusreads_tpu.serve.router import Router, RouterServer
+
+    if not args.socket and not args.address:
+        observe.stderr_line("route: need --socket and/or --address")
+        return 2
+    _arm_failpoints(args)
+    observe.open_ledger(component="route")
+    serve_args = [
+        "--batch-families", str(args.batch_families),
+        "--max-active", str(args.max_active),
+        "--stride", str(args.stride),
+        "--idle-flush-ms", str(args.idle_flush_ms),
+        "--max-pending", str(args.max_pending),
+        "--min-reads", str(args.min_reads),
+    ]
+    if args.warmup:
+        serve_args.append("--warmup")
+    fail_once: dict[str, str] = {}
+    for term in args.replica_failpoints:
+        rid, sep, schedule = term.partition(":")
+        if not sep or not rid or not schedule:
+            observe.stderr_line(
+                f"route: bad --replica-failpoints {term!r} "
+                "(want rid:schedule)"
+            )
+            return 2
+        fail_once[rid] = schedule
+    fleet = ReplicaSet(
+        n=args.replicas,
+        host=args.replica_host,
+        rundir=args.rundir or None,
+        serve_args=serve_args,
+        attach_addresses=args.replica_address or None,
+        compile_cache_dir=(
+            _os.environ.get("BSSEQ_TPU_COMPILE_CACHE_DIR") or None
+        ),
+        fail_once=fail_once,
+    )
+    router = Router(
+        fleet,
+        affinity=not args.no_affinity,
+        respawn=not args.no_respawn,
+    )
+    try:
+        router.launch()
+    except Exception as exc:  # a dead fleet at boot is an exit, not a hang
+        observe.stderr_line(f"route: fleet failed to start: {exc}")
+        fleet.stop(drain_timeout=5.0)
+        return 2
+    server = RouterServer(
+        router,
+        args.socket or None,
+        addresses=args.address or None,
+        ready_file=args.ready_file or None,
+    )
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: server.request_drain())
+    server.serve_forever()
+    observe.flush_sinks()
+    observe.stderr_line(json.dumps(router.counters))
     return 0
 
 
@@ -827,9 +912,23 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser(
         "serve",
         help="resident consensus engine: warm kernels across jobs, "
-        "cross-job continuous batching, unix-socket submit protocol",
+        "cross-job continuous batching, unix-socket/TCP submit protocol",
     )
-    p.add_argument("--socket", required=True, help="unix socket path")
+    p.add_argument(
+        "--socket", default="",
+        help="unix socket path (optional when --address is given)",
+    )
+    p.add_argument(
+        "--address", action="append", default=[],
+        help="additional listen address (repeatable): unix:<path> or "
+        "tcp:host:port (port 0 = kernel-assigned; TLS via "
+        "BSSEQ_TPU_SERVE_TLS_CERT/KEY)",
+    )
+    p.add_argument(
+        "--ready-file", default="",
+        help="write resolved bound addresses here once listening "
+        "(the fleet supervisor's ready protocol)",
+    )
     p.add_argument("--mode", choices=("unaligned", "self"), default="unaligned")
     p.add_argument(
         "--indel-policy", choices=("drop", "align"), default="drop"
@@ -859,7 +958,65 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
-        "submit", help="submit one BAM job to a running serve engine"
+        "route",
+        help="graftfleet router: N serve replicas behind affinity "
+        "placement, drain/handoff, shared compile cache",
+    )
+    p.add_argument(
+        "--socket", default="", help="router unix socket path"
+    )
+    p.add_argument(
+        "--address", action="append", default=[],
+        help="router listen address (repeatable): unix:<path> or "
+        "tcp:host:port",
+    )
+    p.add_argument(
+        "--ready-file", default="",
+        help="write the router's bound addresses here once listening",
+    )
+    p.add_argument(
+        "--replicas", type=int, default=2,
+        help="serve replicas to spawn on this host",
+    )
+    p.add_argument("--replica-host", default="127.0.0.1")
+    p.add_argument(
+        "--replica-address", action="append", default=[],
+        help="attach to an already-running replica at tcp:host:port "
+        "instead of spawning (repeatable; multihost addressing)",
+    )
+    p.add_argument(
+        "--replica-failpoints", action="append", default=[],
+        help="rid:schedule — arm BSSEQ_TPU_FAILPOINTS in ONE replica's "
+        "first life (chaos drills: r0:fleet_replica_exit=exit:9@batch=1)",
+    )
+    p.add_argument(
+        "--no-respawn", action="store_true",
+        help="do not restart dead replicas (requeue-only handoff)",
+    )
+    p.add_argument(
+        "--no-affinity", action="store_true",
+        help="place purely by queue depth",
+    )
+    p.add_argument(
+        "--rundir", default="",
+        help="supervision scratch dir (ready files; default under TMPDIR)",
+    )
+    p.add_argument("--batch-families", type=int, default=64)
+    p.add_argument("--max-active", type=int, default=4)
+    p.add_argument("--stride", type=int, default=8)
+    p.add_argument("--idle-flush-ms", type=float, default=20.0)
+    p.add_argument("--max-pending", type=int, default=64)
+    p.add_argument("--min-reads", type=int, default=1)
+    p.add_argument(
+        "--warmup", action="store_true",
+        help="each replica compiles kernels before accepting jobs",
+    )
+    _add_failpoints(p)
+    p.set_defaults(fn=cmd_route)
+
+    p = sub.add_parser(
+        "submit", help="submit one BAM job to a running serve engine "
+        "or router (--socket accepts unix paths and tcp:host:port)"
     )
     p.add_argument("--socket", required=True)
     p.add_argument("-i", "--input", required=True)
@@ -940,6 +1097,11 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument(
         "--job", default="",
         help="scope to one serve tenant's lines (job id)",
+    )
+    s.add_argument(
+        "--replica", default="",
+        help="scope to one fleet replica's sub-stream (replica id, "
+        "e.g. r0 — fleet ledgers interleave N replica processes)",
     )
     s.set_defaults(fn=cmd_observe)
     d = op.add_parser(

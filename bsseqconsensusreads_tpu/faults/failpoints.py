@@ -83,6 +83,12 @@ SITES = frozenset(
         "serve_submit",
         "serve_ingest",
         "serve_retire",
+        # serve.router / fleet — the routed-submit forward path (armed
+        # in the router process) and the replica retire loop (armed in
+        # ONE replica's env via `cli route --replica-failpoints`, so a
+        # chaos drill can kill a replica mid-job: exit:9@batch=N)
+        "fleet_route",
+        "fleet_replica_exit",
     }
 )
 

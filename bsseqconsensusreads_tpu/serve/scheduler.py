@@ -356,6 +356,12 @@ class Scheduler:
             )
             for bi, recs in enumerate(batches):
                 _failpoints.fire("serve_retire", stage="serve", batch=bi)
+                # the fleet kill switch: exit:9@batch=N here is a
+                # replica dying MID-JOB with retired batches unswept —
+                # exactly the handoff the router must survive
+                _failpoints.fire(
+                    "fleet_replica_exit", stage="serve", batch=bi
+                )
                 self._demux(bi, recs)
                 self._sweep()
         except BaseException as exc:

@@ -1,10 +1,10 @@
-"""ServeEngine (in-process API) + ServeServer (unix-socket protocol).
+"""ServeEngine (in-process API) + ServeServer (socket protocol front).
 
 The engine is the embeddable form — tests and the tier-1 smoke drive
 it directly: submit/wait/drain with no sockets. The server wraps it in
-a local unix-socket JSONL protocol for `cli submit` / `cli serve-ctl`:
+the serve protocol for `cli submit` / `cli serve-ctl`:
 
-    one connection = one request = one JSON line each way
+    one connection = one request = one JSON message each way
 
     {"op": "ping"}                          → {"ok": true, "pong": true}
     {"op": "submit", "spec": {...JobSpec}}  → {"ok": true, "job": {...}}
@@ -15,12 +15,19 @@ a local unix-socket JSONL protocol for `cli submit` / `cli serve-ctl`:
     {"op": "drain", "timeout": 600}         → {"ok": true, "drained": b}
                                               (server exits afterwards)
 
+How the message crosses the wire is serve/transport.py's business: a
+server listens on one or more addresses — ``unix:<path>`` (newline
+JSONL, the PR 8 wire format) and/or ``tcp:host:port`` (length-framed,
+optional TLS) — with identical semantics on every transport. Garbage
+frames and oversized payloads are refused with typed GuardErrors and
+ledgered (`serve_frame_refused`), never a crash.
+
 Admission failures answer {"ok": false, "error": ...} — a refused job
 is the submitter's problem, never the server's. SIGTERM/SIGINT request
 a graceful drain: stop admitting, finish every admitted job, exit 0
 (tests/test_serve.py proves no job is lost).
 
-The accept loop polls with a socket timeout and each connection rides
+Each accept loop polls with a socket timeout and each connection rides
 its own daemon thread, so a tenant parked on a long `wait` never
 blocks another tenant's submit (and the blocking-scheduler-loop lint
 rule holds the loop itself to bounded waits).
@@ -28,7 +35,6 @@ rule holds the loop itself to bounded waits).
 
 from __future__ import annotations
 
-import json
 import os
 import socket
 import threading
@@ -36,6 +42,7 @@ import time
 
 from bsseqconsensusreads_tpu.serve import jobs as _jobs
 from bsseqconsensusreads_tpu.serve import scheduler as _scheduler
+from bsseqconsensusreads_tpu.serve import transport as _transport
 from bsseqconsensusreads_tpu.utils import compilecache as _compilecache
 from bsseqconsensusreads_tpu.utils import observe
 
@@ -179,75 +186,149 @@ class ServeEngine:
         }
 
 
-class ServeServer:
-    """Unix-socket front of a ServeEngine. `serve_forever()` owns the
-    calling thread until a drain request (socket op or request_drain(),
-    e.g. from a SIGTERM handler) completes."""
+class ProtocolServer:
+    """Accept/frame/refuse machinery for the serve protocol on one or
+    more transport addresses. Subclasses supply `_dispatch` (the op
+    table) and `_on_drain` (what "stop serving" means for their
+    backend) — ServeServer fronts one engine, router.RouterServer
+    fronts a replica fleet, same wire behavior. `serve_forever()` owns
+    the calling thread until a drain request (socket op or
+    request_drain(), e.g. from a SIGTERM handler) completes."""
 
-    def __init__(self, engine: ServeEngine, socket_path: str):
-        self.engine = engine
-        self.socket_path = socket_path
+    def __init__(self, socket_path=None, *, addresses=None,
+                 ready_file: str | None = None):
+        self.ready_file = ready_file
+        addrs: list[str] = []
+        if socket_path is not None:
+            addrs.append(str(socket_path))
+        if addresses:
+            addrs.extend(str(a) for a in addresses)
+        if not addrs:
+            raise ValueError("server needs at least one address")
+        self.addresses = addrs
+        # back-compat attribute: the first unix path, if any
+        self.socket_path = next(
+            (
+                _transport.parse_address(a)[1]
+                for a in addrs
+                if _transport.parse_address(a)[0] == "unix"
+            ),
+            addrs[0],
+        )
+        #: resolved listen addresses (port-0 binds get the real port)
+        self.bound: list[str] = []
         self._drain_requested = threading.Event()
         self._drained = threading.Event()
+        #: in-flight connection handlers; _idle is set while zero so
+        #: shutdown can wait for the drain op's own response to flush
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
 
     def request_drain(self) -> None:
-        """Signal-handler safe: ask the accept loop to drain and exit."""
+        """Signal-handler safe: ask the accept loops to drain and exit."""
         self._drain_requested.set()
 
     def serve_forever(self) -> None:
+        listeners = []
         try:
-            os.unlink(self.socket_path)
-        except OSError:
-            pass
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        try:
-            sock.bind(self.socket_path)
-            sock.listen(16)
-            sock.settimeout(0.25)
-            observe.emit("serve_listening", {"socket": self.socket_path})
-            while not self._drain_requested.is_set():
-                try:
-                    conn, _ = sock.accept()
-                except socket.timeout:
-                    continue
-                except OSError:
-                    break
-                # graftlint: owned-thread -- one connection = one
-                # request; the handler owns conn and only calls the
-                # lock-guarded engine API
+            for address in self.addresses:
+                sock, kind, resolved = _transport.listen(address)
+                listeners.append((sock, kind, resolved))
+                self.bound.append(resolved)
+                observe.emit("serve_listening", {"socket": resolved})
+            if self.ready_file:
+                # the fleet supervisor's ready protocol: bound addresses,
+                # one per line, atomically visible (port 0 is resolved)
+                tmp = self.ready_file + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write("\n".join(self.bound) + "\n")
+                os.replace(tmp, self.ready_file)
+            threads = [
+                # graftlint: owned-thread -- accept pump per listener:
+                # it only polls its own socket and hands each conn to a
+                # per-connection handler; shared state stays lock-guarded
                 threading.Thread(
-                    target=self._handle, args=(conn,),
-                    name="serve-conn", daemon=True,
-                ).start()
+                    target=self._accept_loop, args=(sock, kind),
+                    name=f"serve-accept-{i}", daemon=True,
+                )
+                for i, (sock, kind, _) in enumerate(listeners)
+            ]
+            for t in threads:
+                t.start()
+            while not self._drain_requested.is_set():
+                self._drain_requested.wait(timeout=0.25)
         finally:
-            sock.close()
-            try:
-                os.unlink(self.socket_path)
-            except OSError:
-                pass
+            self._drain_requested.set()
+            for sock, kind, resolved in listeners:
+                sock.close()
+                if kind == "unix":
+                    try:
+                        os.unlink(_transport.parse_address(resolved)[1])
+                    except OSError:
+                        pass
         # graceful drain: every admitted job completes before we return
-        self.engine.drain(timeout=None)
+        self._on_drain()
         self._drained.set()
-        observe.emit("serve_drained", {"socket": self.socket_path})
+        # let in-flight handlers (the drain op itself included) write
+        # their responses before the process goes away
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if self._idle.wait(timeout=0.1):
+                break
+        observe.emit("serve_drained", {"socket": self.bound or self.addresses})
         observe.flush_sinks()
+
+    def _accept_loop(self, sock: socket.socket, kind: str) -> None:
+        while not self._drain_requested.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            # graftlint: owned-thread -- one connection = one
+            # request; the handler owns conn and only calls the
+            # lock-guarded engine API
+            threading.Thread(
+                target=self._handle, args=(conn, kind),
+                name="serve-conn", daemon=True,
+            ).start()
 
     # -- one connection = one request ------------------------------------
 
-    def _handle(self, conn: socket.socket) -> None:
+    def _handle(self, conn: socket.socket, kind: str) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+            self._idle.clear()
         try:
             conn.settimeout(10.0)
-            fh = conn.makefile("rwb")
-            line = fh.readline()
-            if not line:
+            try:
+                conn = _transport.server_wrap(conn, kind)
+            except OSError:
+                return  # failed TLS handshake: refused client
+            try:
+                req = _transport.recv_message(conn, kind)
+            except _transport.TransportError as exc:
+                # hostile/garbage frame: typed refusal, ledgered, answered
+                observe.emit(
+                    "serve_frame_refused",
+                    {"reason": exc.reason, "error": str(exc)},
+                )
+                self._answer(conn, kind, {
+                    "ok": False, "error": f"refused: {exc}",
+                    "guard": exc.reason,
+                })
+                return
+            if req is None:
                 return
             try:
-                req = json.loads(line)
                 resp = self._dispatch(req)
             except Exception as exc:  # protocol errors answer, not crash
                 resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
             conn.settimeout(10.0)
-            fh.write((json.dumps(resp) + "\n").encode())
-            fh.flush()
+            self._answer(conn, kind, resp)
         except OSError:
             pass
         finally:
@@ -255,6 +336,38 @@ class ServeServer:
                 conn.close()
             except OSError:
                 pass
+            with self._inflight_lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._idle.set()
+
+    @staticmethod
+    def _answer(conn: socket.socket, kind: str, resp: dict) -> None:
+        try:
+            _transport.send_message(conn, kind, resp)
+        except OSError:
+            pass
+
+    # -- subclass surface ------------------------------------------------
+
+    def _dispatch(self, req: dict) -> dict:
+        raise NotImplementedError
+
+    def _on_drain(self) -> None:
+        raise NotImplementedError
+
+
+class ServeServer(ProtocolServer):
+    """Socket front of one ServeEngine."""
+
+    def __init__(self, engine: ServeEngine, socket_path=None, *,
+                 addresses=None, ready_file: str | None = None):
+        super().__init__(socket_path, addresses=addresses,
+                         ready_file=ready_file)
+        self.engine = engine
+
+    def _on_drain(self) -> None:
+        self.engine.drain(timeout=None)
 
     def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
@@ -298,17 +411,7 @@ class ServeServer:
 
 
 def request(socket_path: str, payload: dict, timeout: float = 600.0) -> dict:
-    """One client request against a running ServeServer."""
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    try:
-        s.settimeout(timeout)
-        s.connect(socket_path)
-        fh = s.makefile("rwb")
-        fh.write((json.dumps(payload) + "\n").encode())
-        fh.flush()
-        line = fh.readline()
-    finally:
-        s.close()
-    if not line:
-        raise ConnectionError(f"no response from {socket_path}")
-    return json.loads(line)
+    """One client request against a running ServeServer (or router).
+    `socket_path` is any transport address — a bare unix path (PR 8
+    callers), ``unix:<path>``, or ``tcp:host:port``."""
+    return _transport.request(socket_path, payload, timeout=timeout)

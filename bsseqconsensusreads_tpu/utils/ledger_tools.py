@@ -44,6 +44,18 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "serve_listening": ("socket",),
     "serve_drained": ("socket",),
     "serve_warmup": ("families",),
+    "serve_frame_refused": ("reason",),
+    # graftfleet (serve/fleet + serve/router): replica processes stamp
+    # every line with a 'replica' field (BSSEQ_TPU_REPLICA_ID); the
+    # router's own lines reconcile placement with per-replica counts
+    "fleet_replica_spawn": ("replica_id", "generation"),
+    "fleet_replica_down": ("replica_id",),
+    "fleet_route": ("rjob", "replica_id"),
+    "fleet_requeue": ("rjob", "from_replica", "to_replica"),
+    "fleet_counters": (
+        "jobs_routed", "jobs_requeued", "affinity_hits",
+        "replica_restarts",
+    ),
 }
 
 #: Default closure tolerance: relative share of the wall allowed to go
@@ -60,6 +72,7 @@ class LedgerError(RuntimeError):
 class LedgerSummary:
     path: str = ""
     job: str | None = None  # serve tenant the view is scoped to
+    replica: str | None = None  # fleet replica the view is scoped to
     manifest: dict = field(default_factory=dict)
     stages: dict = field(default_factory=dict)  # stage -> stage_stats line
     rules: list = field(default_factory=list)  # rule_complete lines
@@ -68,6 +81,7 @@ class LedgerSummary:
     notes: list = field(default_factory=list)  # overlap disables etc.
     problems: list = field(default_factory=list)  # schema/invariant breaks
     jobs: dict = field(default_factory=dict)  # job id -> tagged-line count
+    replicas: dict = field(default_factory=dict)  # replica -> line count
 
     @property
     def ok(self) -> bool:
@@ -163,6 +177,7 @@ def summarize_ledger(
     rel_tol: float = CLOSURE_REL_TOL,
     abs_tol: float = CLOSURE_ABS_TOL,
     job: str | None = None,
+    replica: str | None = None,
 ) -> LedgerSummary:
     """Summarize one ledger.
 
@@ -172,19 +187,38 @@ def summarize_ledger(
     whole-ledger schema checks are skipped (a BSSEQ_TPU_STATS_JOBS
     sub-sink, which has no run_manifest, summarizes cleanly too).
 
+    replica: scope the view to one fleet replica's sub-stream the same
+    way (a shared fleet ledger interleaves N replica processes; each
+    stamps its lines via BSSEQ_TPU_REPLICA_ID). Composable with job —
+    `--replica r1 --job j0003` is one tenant as served by one replica.
+
     Untargeted (job=None) views of a shared serve ledger tally
-    job-tagged lines per tenant in `.jobs` instead of merging them into
-    the engine's stages — one tenant's numbers never masquerade as the
-    run's."""
+    job-tagged lines per tenant in `.jobs` (and replica-tagged lines
+    per replica in `.replicas`) instead of merging them into the
+    engine's stages — one tenant's or one replica's numbers never
+    masquerade as the run's."""
     lines, problems = parse_ledger(path)
-    s = LedgerSummary(path=path, job=job, problems=problems)
-    if job is None:
+    s = LedgerSummary(path=path, job=job, replica=replica,
+                      problems=problems)
+    if job is None and replica is None:
         s.problems.extend(_schema_problems(lines))
     for d in lines:
         ev = d.get("event")
         if not isinstance(ev, str):
             continue
         line_job = d.get("job")
+        line_replica = d.get("replica")
+        if replica is not None:
+            if line_replica != replica:
+                if ev == "run_manifest" and not s.manifest:
+                    s.manifest = d
+                continue
+        elif line_replica is not None:
+            s.replicas[str(line_replica)] = (
+                s.replicas.get(str(line_replica), 0) + 1
+            )
+            s.events[ev] = s.events.get(ev, 0) + 1
+            continue
         if job is not None:
             if ev == "run_manifest":
                 if not s.manifest:
@@ -212,6 +246,8 @@ def summarize_ledger(
             )
     if job is not None and not s.events:
         s.problems.append(f"no ledger lines tagged job={job!r}")
+    if replica is not None and not s.events:
+        s.problems.append(f"no ledger lines tagged replica={replica!r}")
     s.problems.extend(_closure_problems(s, rel_tol, abs_tol))
     return s
 
@@ -272,10 +308,17 @@ def format_summary(s: LedgerSummary) -> str:
         )
     if s.job is not None:
         out.append(f"scoped to job: {s.job}")
+    if s.replica is not None:
+        out.append(f"scoped to replica: {s.replica}")
     if s.jobs:
         out.append(
             f"serve jobs in ledger: {len(s.jobs)} "
             f"({', '.join(sorted(s.jobs))}) — scope with --job"
+        )
+    if s.replicas:
+        out.append(
+            f"fleet replicas in ledger: {len(s.replicas)} "
+            f"({', '.join(sorted(s.replicas))}) — scope with --replica"
         )
     if s.stages:
         rows = []

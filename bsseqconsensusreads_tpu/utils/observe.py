@@ -75,6 +75,34 @@ def job_sink(job: str) -> str | None:
     return os.path.join(directory, f"{safe}.jsonl")
 
 
+def replica_id() -> str | None:
+    """This process's fleet replica identity (BSSEQ_TPU_REPLICA_ID, set
+    by serve.fleet when it spawns a replica). When present, every emit
+    is stamped with a 'replica' field — one shared fleet ledger carries
+    N replicas as separable sub-streams (`observe summarize
+    --replica`)."""
+    return os.environ.get("BSSEQ_TPU_REPLICA_ID") or None
+
+
+def replica_sink_dir() -> str | None:
+    """Directory for per-replica ledger sub-sinks
+    (BSSEQ_TPU_STATS_REPLICAS): when set, every replica-tagged emit is
+    mirrored to <dir>/<replica>.jsonl — one standalone-shaped ledger
+    per replica — in addition to the tag in the shared fleet ledger."""
+    return os.environ.get("BSSEQ_TPU_STATS_REPLICAS") or None
+
+
+def replica_sink(replica: str) -> str | None:
+    """The sub-sink path for one replica id, sanitized like job_sink."""
+    directory = replica_sink_dir()
+    if directory is None:
+        return None
+    safe = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in str(replica)
+    ) or "_"
+    return os.path.join(directory, f"{safe}.jsonl")
+
+
 def trace_dir() -> str | None:
     return os.environ.get("BSSEQ_TPU_TRACE") or None
 
@@ -196,10 +224,18 @@ def emit(
     and mirror it to the job's sub-sink when BSSEQ_TPU_STATS_JOBS is
     set. Job-tagged lines in the shared ledger are ignored by untargeted
     summaries, so one serve ledger carries every tenant without
-    cross-talk."""
+    cross-talk.
+
+    Fleet replicas (BSSEQ_TPU_REPLICA_ID in the environment) stamp
+    every line with a 'replica' field the same way — the shared fleet
+    ledger separates per replica (`observe summarize --replica`), and
+    BSSEQ_TPU_STATS_REPLICAS mirrors each replica's lines to its own
+    sub-sink."""
     sink = sink if sink is not None else stats_sink()
     sub = job_sink(job) if job is not None else None
-    if sink is None and sub is None:
+    replica = replica_id()
+    rsub = replica_sink(replica) if replica is not None else None
+    if sink is None and sub is None and rsub is None:
         return
     record = {"ts": round(time.time(), 3), "event": event}
     cur = threading.current_thread()
@@ -208,12 +244,15 @@ def emit(
     record.update(payload)
     if job is not None:
         record["job"] = job
+    if replica is not None:
+        record["replica"] = replica
     line = json.dumps(record)
     if sink is not None:
         _writer(sink).write_line(line)
-    if sub is not None:
-        os.makedirs(os.path.dirname(sub), exist_ok=True)
-        _writer(sub).write_line(line)
+    for mirror in (sub, rsub):
+        if mirror is not None:
+            os.makedirs(os.path.dirname(mirror), exist_ok=True)
+            _writer(mirror).write_line(line)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,704 @@
+"""graftfleet tests: transport framing, router placement, fleet lifecycle.
+
+* transport — one framing per transport (bounded JSONL on unix, u32
+  length-prefix on tcp), every hostile shape (oversized/empty/garbage/
+  truncated frame) a typed `TransportError` refusal with a `.reason`,
+  never a crash and never an unbounded read; a protocol server answers
+  refusals with the `guard` key on the wire;
+* placement — affinity pins a repeat input to the replica that saw it
+  last, fresh inputs go to the least-outstanding replica, the pin moves
+  with a requeue; counters (`jobs_routed`/`jobs_requeued`/
+  `affinity_hits`/`replica_restarts`) reconcile against per-replica
+  admissions;
+* fleet lifecycle — a real 2-replica fleet behind `cli route` serves
+  byte-identical outputs over tcp, survives a SIGKILL-grade replica
+  death with requeue+respawn, optionally speaks TLS, and warm-starts
+  from the shared compile cache across fleet boots.
+
+In-process tests (socketpairs, fake fleets) stay tier-1; subprocess
+fleet tests are marked slow, same split as tests/test_serve.py.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu import cli
+from bsseqconsensusreads_tpu.faults.guard import GuardError
+from bsseqconsensusreads_tpu.io.bam import BamWriter
+from bsseqconsensusreads_tpu.serve import router as router_mod
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.serve.router import Router, RouterServer
+from bsseqconsensusreads_tpu.serve.server import ProtocolServer
+from bsseqconsensusreads_tpu.utils.testing import make_grouped_bam_records
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+GENOME = "".join(
+    "ACGT"[i] for i in np.random.default_rng(7).integers(0, 4, size=2000)
+)
+
+
+def _grouped_bam(path: str, seed: int, n_families: int = 6,
+                 read_len: int = 40) -> None:
+    header, records = make_grouped_bam_records(
+        np.random.default_rng(seed), f"chr{seed % 97}", GENOME,
+        n_families=n_families, reads_per_strand=(2, 3), read_len=read_len,
+    )
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write(r)
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def _standalone(inp: str, out: str) -> str:
+    rc = cli.main(
+        ["molecular", "-i", inp, "-o", out, "--batching", "sequential"]
+    )
+    assert rc == 0
+    return _sha(out)
+
+
+# ---------------------------------------------------------------------------
+# address grammar
+
+
+class TestAddressGrammar:
+    def test_bare_path_and_unix_scheme_are_unix(self):
+        assert transport.parse_address("/tmp/x.sock") == (
+            "unix", "/tmp/x.sock"
+        )
+        assert transport.parse_address("unix:/tmp/x.sock") == (
+            "unix", "/tmp/x.sock"
+        )
+        assert not transport.is_tcp("/tmp/x.sock")
+
+    def test_tcp_form(self):
+        assert transport.parse_address("tcp:127.0.0.1:8641") == (
+            "tcp", "127.0.0.1", 8641
+        )
+        assert transport.is_tcp("tcp:localhost:0")
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["", "unix:", "tcp:", "tcp:nohost", "tcp::123", "tcp:h:",
+         "tcp:h:notaport", "tcp:h:70000"],
+    )
+    def test_bad_addresses_are_typed_refusals(self, bad):
+        with pytest.raises(transport.TransportError) as ei:
+            transport.parse_address(bad)
+        assert ei.value.reason == "bad_address"
+        # typed both ways: guard contract AND socket-failure handlers
+        assert isinstance(ei.value, GuardError)
+        assert isinstance(ei.value, ConnectionError)
+
+
+# ---------------------------------------------------------------------------
+# wire framing (socketpair: no server process involved)
+
+
+class TestFraming:
+    def _pair(self):
+        a, b = socket.socketpair()
+        a.settimeout(5.0)
+        b.settimeout(5.0)
+        return a, b
+
+    @pytest.mark.parametrize("kind", ["unix", "tcp"])
+    def test_roundtrip_parity_across_transports(self, kind):
+        """The same payload crosses both framings unchanged — a client
+        cannot tell the transports apart above the frame layer."""
+        payload = {"op": "submit", "spec": {"input": "x", "n": [1, 2, 3]}}
+        a, b = self._pair()
+        try:
+            transport.send_message(a, kind, payload)
+            assert transport.recv_message(b, kind) == payload
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_is_none(self):
+        a, b = self._pair()
+        a.close()
+        try:
+            assert transport.recv_message(b, "tcp") is None
+            c, d = self._pair()
+            c.close()
+            assert transport.recv_message(d, "unix") is None
+            d.close()
+        finally:
+            b.close()
+
+    def test_oversized_declared_length_refused_before_body(self):
+        """The length header is the admission gate: a hostile declared
+        size is refused with ZERO payload bytes buffered."""
+        a, b = self._pair()
+        try:
+            a.sendall(struct.pack("!I", transport.MAX_FRAME + 1))
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "tcp")
+            assert ei.value.reason == "oversized_frame"
+        finally:
+            a.close()
+            b.close()
+
+    def test_empty_frame_refused(self):
+        a, b = self._pair()
+        try:
+            a.sendall(struct.pack("!I", 0))
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "tcp")
+            assert ei.value.reason == "empty_frame"
+        finally:
+            a.close()
+            b.close()
+
+    def test_garbage_body_refused(self):
+        a, b = self._pair()
+        try:
+            body = b"\xff\xfe not json at all"
+            a.sendall(struct.pack("!I", len(body)) + body)
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "tcp")
+            assert ei.value.reason == "bad_json"
+        finally:
+            a.close()
+            b.close()
+
+    def test_non_object_json_refused(self):
+        a, b = self._pair()
+        try:
+            body = b"[1, 2, 3]"
+            a.sendall(struct.pack("!I", len(body)) + body)
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "tcp")
+            assert ei.value.reason == "bad_json"
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncated_body_refused(self):
+        a, b = self._pair()
+        try:
+            a.sendall(struct.pack("!I", 100) + b"only ten b")
+            a.close()
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "tcp")
+            assert ei.value.reason == "truncated_frame"
+        finally:
+            b.close()
+
+    def test_unix_line_without_newline_is_bounded(self):
+        """A peer that never sends '\\n' is refused at max_bytes, not
+        buffered forever — the PR 8 JSONL reader is bounded now."""
+        a, b = self._pair()
+        try:
+            a.sendall(b"x" * 8192)
+            with pytest.raises(transport.TransportError) as ei:
+                transport.recv_message(b, "unix", max_bytes=1024)
+            assert ei.value.reason == "oversized_frame"
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# protocol server refusals on the wire (in-process server thread)
+
+
+class _EchoServer(ProtocolServer):
+    def _dispatch(self, req: dict) -> dict:
+        return {"ok": True, "echo": req}
+
+    def _on_drain(self) -> None:
+        pass
+
+
+@pytest.fixture
+def echo_server():
+    srv = _EchoServer(addresses=["tcp:127.0.0.1:0"])
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not srv.bound and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.bound, "server never bound"
+    yield srv
+    srv.request_drain()
+    t.join(timeout=10)
+
+
+class TestServerRefusals:
+    def test_tcp_request_roundtrip(self, echo_server):
+        resp = transport.request(
+            echo_server.bound[0], {"op": "ping", "k": 1}, timeout=5.0
+        )
+        assert resp == {"ok": True, "echo": {"op": "ping", "k": 1}}
+
+    def test_hostile_length_header_answered_with_guard_reason(
+        self, echo_server
+    ):
+        sock, kind = transport.connect(echo_server.bound[0], timeout=5.0)
+        try:
+            sock.sendall(struct.pack("!I", transport.MAX_FRAME + 7))
+            resp = transport.recv_message(sock, kind)
+        finally:
+            sock.close()
+        assert resp["ok"] is False
+        assert resp["guard"] == "oversized_frame"
+
+    def test_garbage_frame_answered_with_guard_reason(self, echo_server):
+        sock, kind = transport.connect(echo_server.bound[0], timeout=5.0)
+        try:
+            body = b"<html>not a protocol message</html>"
+            sock.sendall(struct.pack("!I", len(body)) + body)
+            resp = transport.recv_message(sock, kind)
+        finally:
+            sock.close()
+        assert resp["ok"] is False
+        assert resp["guard"] == "bad_json"
+
+
+# ---------------------------------------------------------------------------
+# router placement (fake fleet + monkeypatched forward: no sockets)
+
+
+class _FakeReplica:
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.address = f"tcp:127.0.0.1:1{rid[1:]}"
+        self.proc = None
+        self.generation = 0
+        self.up = True
+
+    @property
+    def supervised(self) -> bool:
+        return True
+
+    def alive(self) -> bool:
+        return self.up
+
+
+class _FakeFleet:
+    def __init__(self, n: int = 2):
+        self.replicas = [_FakeReplica(f"r{i}") for i in range(n)]
+        self.restarted: list[str] = []
+
+    def alive(self):
+        return [r for r in self.replicas if r.alive()]
+
+    def lookup(self, rid):
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        return None
+
+    def restart(self, replica):
+        self.restarted.append(replica.rid)
+        replica.generation += 1
+        replica.up = True
+
+
+@pytest.fixture
+def routed(monkeypatch, tmp_path):
+    """A Router over a 2-replica fake fleet whose forward path records
+    placements instead of opening sockets."""
+    placements: list[tuple[str, str]] = []  # (replica_id, input)
+    seq = {"n": 0}
+
+    def fake_request(address, payload, timeout=0.0):
+        if payload.get("op") == "submit":
+            seq["n"] += 1
+            rid = next(
+                r.rid for r in fleet.replicas if r.address == address
+            )
+            placements.append((rid, payload["spec"]["input"]))
+            return {"ok": True,
+                    "job": {"id": f"j{seq['n']:04d}", "state": "queued"}}
+        return {"ok": True, "stats": {"jobs": [], "counters": {}}}
+
+    fleet = _FakeFleet(2)
+    monkeypatch.setattr(router_mod._transport, "request", fake_request)
+    router = Router(replicas=fleet)  # no launch(): no monitor thread
+    inputs = []
+    for k in range(2):
+        p = str(tmp_path / f"in{k}.bin")
+        with open(p, "wb") as fh:
+            fh.write(bytes([k]) * 64)
+        inputs.append(p)
+    return router, fleet, placements, inputs
+
+
+class TestRouterPlacement:
+    def test_repeat_input_pins_fresh_input_balances(self, routed):
+        router, fleet, placements, inputs = routed
+        for _ in range(3):
+            assert router.submit({"input": inputs[0], "output": "a"})["ok"]
+        # all three on one replica: 1 fresh placement + 2 affinity hits
+        assert len({rid for rid, _ in placements}) == 1
+        pinned = placements[0][0]
+        assert router.counters["affinity_hits"] == 2
+        # a fresh input lands on the OTHER replica (least outstanding)
+        assert router.submit({"input": inputs[1], "output": "b"})["ok"]
+        assert placements[-1][0] != pinned
+        assert router.counters["jobs_routed"] == 4
+        assert router.counters["jobs_requeued"] == 0
+
+    def test_no_affinity_places_purely_by_depth(
+        self, routed, monkeypatch
+    ):
+        router, fleet, placements, inputs = routed
+        router.affinity_enabled = False
+        for _ in range(2):
+            assert router.submit({"input": inputs[0], "output": "a"})["ok"]
+        # same input, but depth placement spreads it across both
+        assert {rid for rid, _ in placements} == {"r0", "r1"}
+        assert router.counters["affinity_hits"] == 0
+
+    def test_replica_death_requeues_moves_pin_and_respawns(self, routed):
+        router, fleet, placements, inputs = routed
+        for _ in range(2):
+            assert router.submit({"input": inputs[0], "output": "a"})["ok"]
+        dead = fleet.lookup(placements[0][0])
+        survivor = next(r.rid for r in fleet.replicas if r is not dead)
+        dead.up = False
+        router._handle_death(dead)
+        # both open jobs re-placed on the survivor, pin moved with them
+        assert [rid for rid, _ in placements[2:]] == [survivor, survivor]
+        jobs = list(router._jobs.values())
+        assert all(j.replica_id == survivor for j in jobs)
+        assert all(j.requeues == 1 for j in jobs)
+        assert router.counters["jobs_requeued"] == 2
+        # jobs_routed counts every placement, requeues included
+        assert router.counters["jobs_routed"] == 4
+        assert router.counters["replica_restarts"] == 1
+        assert fleet.restarted == [dead.rid]
+        assert router._affinity[jobs[0].digest] == survivor
+
+    def test_no_live_replicas_is_a_refusal_not_a_crash(self, routed):
+        router, fleet, _, inputs = routed
+        for r in fleet.replicas:
+            r.up = False
+        resp = router.submit({"input": inputs[0], "output": "a"})
+        assert resp["ok"] is False
+        assert "no live replicas" in resp["error"]
+
+    def test_router_server_answers_ping_and_unknown_op(self, routed):
+        router, _, _, _ = routed
+        srv = RouterServer(router, addresses=["tcp:127.0.0.1:0"])
+        assert srv._dispatch({"op": "ping"}) == {
+            "ok": True, "pong": True, "router": True
+        }
+        resp = srv._dispatch({"op": "frobnicate"})
+        assert resp["ok"] is False and "unknown op" in resp["error"]
+
+
+# ---------------------------------------------------------------------------
+# real fleet (subprocess): identity, handoff, TLS, warm compile cache
+
+
+def _fleet_env(tmp_path, **extra):
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+        BSSEQ_TPU_STATS=str(tmp_path / "fleet_ledger.jsonl"),
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+    )
+    env.update(extra)
+    return env
+
+
+def _spawn_route(tmp_path, extra_args=(), env=None):
+    rundir = str(tmp_path / "rundir")
+    os.makedirs(rundir, exist_ok=True)
+    ready = os.path.join(rundir, "router.addr")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "route",
+         "--replicas", "2",
+         "--address", "tcp:127.0.0.1:0",
+         "--ready-file", ready,
+         "--rundir", rundir,
+         "--batch-families", "4",
+         *extra_args],
+        env=env or _fleet_env(tmp_path),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"router died rc={proc.returncode}: "
+                f"{proc.stderr.read().decode()[-2000:]}"
+            )
+        if os.path.exists(ready):
+            address = open(ready).read().strip().splitlines()[0]
+            try:
+                if transport.request(
+                    address, {"op": "ping"}, timeout=2.0
+                ).get("ok"):
+                    return proc, address
+            except (OSError, ConnectionError):
+                pass
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("router never became ready")
+
+
+def _drain_route(proc, address) -> int:
+    try:
+        transport.request(
+            address, {"op": "drain", "timeout": 300}, timeout=360
+        )
+    except (OSError, ConnectionError):
+        pass
+    return proc.wait(timeout=120)
+
+
+def _ledger_event_count(ledger: str, event: str) -> int:
+    n = 0
+    with open(ledger) as fh:
+        for line in fh:
+            if json.loads(line).get("event") == event:
+                n += 1
+    return n
+
+
+@pytest.mark.slow
+class TestFleetProcess:
+    def test_tcp_parity_affinity_and_reconciliation(self, tmp_path):
+        """2 distinct tenants x 2 submits through a 2-replica fleet:
+        every output byte-identical to the standalone CLI, repeat
+        inputs hit affinity, and the router's jobs_routed reconciles
+        against both the per-replica job counts and the fleet ledger's
+        job_admitted lines."""
+        inputs, refs = [], []
+        for k in range(2):
+            inp = str(tmp_path / f"in{k}.bam")
+            _grouped_bam(inp, seed=910 + k)
+            inputs.append(inp)
+            refs.append(_standalone(inp, str(tmp_path / f"ref{k}.bam")))
+        proc, address = _spawn_route(tmp_path)
+        try:
+            outs, jobs = [], []
+            for n, k in enumerate([0, 0, 1, 1]):
+                out = str(tmp_path / f"out{n}.bam")
+                outs.append((out, refs[k]))
+                resp = transport.request(address, {
+                    "op": "submit",
+                    "spec": {"input": inputs[k], "output": out},
+                })
+                assert resp["ok"], resp
+                jobs.append(resp["job"]["id"])
+            for jid in jobs:
+                resp = transport.request(
+                    address, {"op": "wait", "job": jid, "timeout": 120},
+                    timeout=180,
+                )
+                assert resp["job"]["state"] == "done", resp
+            stats = transport.request(
+                address, {"op": "fleet"}, timeout=30
+            )["stats"]
+            rc = _drain_route(proc, address)
+            assert rc == 0
+            for out, ref in outs:
+                assert _sha(out) == ref
+            counters = stats["counters"]
+            assert counters["jobs_routed"] == 4
+            assert counters["jobs_requeued"] == 0
+            # the second submit of each input rode the affinity pin
+            assert counters["affinity_hits"] >= 2
+            # reconciliation, both ways: replica-reported job counts and
+            # the shared ledger's admission lines both sum to jobs_routed
+            per_replica = sum(
+                e.get("jobs", 0) for e in stats["replicas"].values()
+            )
+            assert per_replica == counters["jobs_routed"]
+            ledger = str(tmp_path / "fleet_ledger.jsonl")
+            assert _ledger_event_count(
+                ledger, "job_admitted"
+            ) == counters["jobs_routed"]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    def test_replica_kill_handoff_byte_identical(self, tmp_path):
+        """r0 is armed to die mid-stream on its first life; every
+        tenant completes byte-identical on the survivor (requeue), the
+        dead replica respawns, and the drained router exits 0."""
+        inp = str(tmp_path / "in.bam")
+        _grouped_bam(inp, seed=920, n_families=8)
+        ref = _standalone(inp, str(tmp_path / "ref.bam"))
+        proc, address = _spawn_route(
+            tmp_path,
+            extra_args=["--replica-failpoints",
+                        "r0:fleet_replica_exit=exit:9@batch=1"],
+        )
+        try:
+            outs, jobs = [], []
+            for n in range(3):
+                out = str(tmp_path / f"out{n}.bam")
+                outs.append(out)
+                resp = transport.request(address, {
+                    "op": "submit", "spec": {"input": inp, "output": out},
+                })
+                assert resp["ok"], resp
+                jobs.append(resp["job"]["id"])
+            for jid in jobs:
+                resp = transport.request(
+                    address, {"op": "wait", "job": jid, "timeout": 180},
+                    timeout=240,
+                )
+                assert resp["job"]["state"] == "done", resp
+            stats = transport.request(
+                address, {"op": "fleet"}, timeout=30
+            )["stats"]
+            rc = _drain_route(proc, address)
+            assert rc == 0
+            for out in outs:
+                assert _sha(out) == ref
+            counters = stats["counters"]
+            assert counters["jobs_requeued"] >= 1
+            assert counters["replica_restarts"] >= 1
+            # every placement (initial + requeue) is a routed job
+            assert counters["jobs_routed"] == 3 + counters["jobs_requeued"]
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    @pytest.mark.skipif(
+        shutil.which("openssl") is None, reason="openssl not available"
+    )
+    def test_tls_roundtrip_byte_identical(self, tmp_path, monkeypatch):
+        """A serve replica behind TLS (env-armed cert/key): ping +
+        submit + wait over the encrypted tcp transport, output
+        byte-identical to the standalone CLI."""
+        cert = str(tmp_path / "cert.pem")
+        key = str(tmp_path / "key.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+             "-subj", "/CN=127.0.0.1"],
+            check=True, capture_output=True, timeout=120,
+        )
+        inp = str(tmp_path / "in.bam")
+        _grouped_bam(inp, seed=930)
+        ref = _standalone(inp, str(tmp_path / "ref.bam"))
+        ready = str(tmp_path / "serve.addr")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "serve",
+             "--address", "tcp:127.0.0.1:0", "--ready-file", ready,
+             "--batch-families", "4"],
+            env=_fleet_env(
+                tmp_path,
+                BSSEQ_TPU_SERVE_TLS_CERT=cert,
+                BSSEQ_TPU_SERVE_TLS_KEY=key,
+            ),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        # the CLIENT reads the same env pair to verify the server cert
+        monkeypatch.setenv("BSSEQ_TPU_SERVE_TLS_CERT", cert)
+        monkeypatch.setenv("BSSEQ_TPU_SERVE_TLS_KEY", key)
+        try:
+            deadline = time.monotonic() + 120
+            address = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"server died rc={proc.returncode}: "
+                        f"{proc.stderr.read().decode()[-2000:]}"
+                    )
+                if os.path.exists(ready):
+                    address = open(ready).read().strip().splitlines()[0]
+                    try:
+                        if transport.request(
+                            address, {"op": "ping"}, timeout=2.0
+                        ).get("ok"):
+                            break
+                    except (OSError, ConnectionError):
+                        pass
+                time.sleep(0.1)
+            else:
+                raise AssertionError("TLS server never became ready")
+            out = str(tmp_path / "out.bam")
+            resp = transport.request(address, {
+                "op": "submit", "spec": {"input": inp, "output": out},
+            })
+            assert resp["ok"], resp
+            resp = transport.request(
+                address,
+                {"op": "wait", "job": resp["job"]["id"], "timeout": 120},
+                timeout=180,
+            )
+            assert resp["job"]["state"] == "done", resp
+            transport.request(
+                address, {"op": "drain", "timeout": 120}, timeout=180
+            )
+            assert proc.wait(timeout=60) == 0
+            assert _sha(out) == ref
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+    def test_compile_cache_warm_start_across_fleet_boots(self, tmp_path):
+        """Two fleet boots sharing BSSEQ_TPU_COMPILE_CACHE_DIR: the
+        first boot's compiles are misses; the second boot's replicas
+        start warm (cache hits in the second ledger)."""
+        inp = str(tmp_path / "in.bam")
+        _grouped_bam(inp, seed=940)
+        cache = str(tmp_path / "xla_cache")
+
+        def boot_and_run(tag):
+            ledger = str(tmp_path / f"ledger_{tag}.jsonl")
+            env = _fleet_env(
+                tmp_path,
+                BSSEQ_TPU_STATS=ledger,
+                BSSEQ_TPU_COMPILE_CACHE_DIR=cache,
+            )
+            proc, address = _spawn_route(tmp_path, env=env)
+            try:
+                out = str(tmp_path / f"out_{tag}.bam")
+                resp = transport.request(address, {
+                    "op": "submit", "spec": {"input": inp, "output": out},
+                })
+                assert resp["ok"], resp
+                resp = transport.request(
+                    address,
+                    {"op": "wait", "job": resp["job"]["id"],
+                     "timeout": 120},
+                    timeout=180,
+                )
+                assert resp["job"]["state"] == "done", resp
+                assert _drain_route(proc, address) == 0
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+            counts = {"compile_cache_hit": 0, "compile_cache_miss": 0}
+            with open(ledger) as fh:
+                for line in fh:
+                    d = json.loads(line)
+                    for k in counts:
+                        counts[k] += int(d.get(k, 0) or 0)
+            return counts
+
+        c1 = boot_and_run("cold")
+        assert c1["compile_cache_miss"] > 0, c1
+        c2 = boot_and_run("warm")
+        assert c2["compile_cache_hit"] > 0, c2

@@ -40,6 +40,7 @@ FIXTURES = {
     "blocking-scheduler-loop": "fx_blocking_scheduler_loop.py",
     "padded-batch-flops": "fx_padded_batch_flops.py",
     "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
+    "unframed-socket-read": "fx_unframed_socket_read.py",
 }
 
 

@@ -189,7 +189,15 @@ class TestEncoder:
 def test_bucketed_batching_cuts_pad_waste_same_output():
     """Depth-homogeneous chunking (_group_batches_bucketed) must reduce
     template-padding waste on a cfDNA-like depth mixture while emitting
-    exactly the same consensus records (order may differ across chunks)."""
+    exactly the same consensus records (order may differ across chunks).
+
+    The pad-waste claim is about the PADDED [F,T,2,W] envelope, so both
+    modes pin layout="padded" explicitly: under the segment-packed
+    default (PR 9) pad_waste's denominator is packed rows actually
+    issued, where depth bucketing has nothing left to cut (bucketing
+    under packed exists to bound compile shapes, not FLOPs — ROADMAP
+    "Packed everywhere"). A packed-layout leg still pins the identity
+    half: bucketed == sequential bytes on the default layout too."""
     import numpy as np
 
     from bsseqconsensusreads_tpu.pipeline.calling import (
@@ -207,21 +215,30 @@ def test_bucketed_batching_cuts_pad_waste_same_output():
     )
     results = {}
     for mode in ("sequential", "bucketed"):
-        stats = StageStats()
-        out = [
-            r
-            for b in call_molecular_batches(
-                iter(recs), grouping="adjacent", stats=stats, mesh=None,
-                batching=mode,
+        for layout in ("padded", "packed"):
+            stats = StageStats()
+            out = [
+                r
+                for b in call_molecular_batches(
+                    iter(recs), grouping="adjacent", stats=stats,
+                    mesh=None, batching=mode, layout=layout,
+                )
+                for r in b
+            ]
+            results[(mode, layout)] = (
+                stats.pad_waste,
+                sorted(
+                    (r.qname, r.flag, r.seq, bytes(r.qual)) for r in out
+                ),
             )
-            for r in b
-        ]
-        results[mode] = (
-            stats.pad_waste,
-            sorted((r.qname, r.flag, r.seq, bytes(r.qual)) for r in out),
-        )
-    assert results["bucketed"][0] < results["sequential"][0] - 0.05
-    assert results["bucketed"][1] == results["sequential"][1]
+    assert (
+        results[("bucketed", "padded")][0]
+        < results[("sequential", "padded")][0] - 0.05
+    )
+    # identity holds per layout AND across layouts
+    expected = results[("sequential", "padded")][1]
+    for key, (_, recs_out) in results.items():
+        assert recs_out == expected, key
 
 
 def test_interior_nocall_emits_contiguous_N_not_compacted():

@@ -259,6 +259,59 @@ def _ledger_quarantined(path: str) -> int:
     return n
 
 
+def _spawn_route(wd: str, tag: str, ledger: str, replicas: int = 2,
+                 extra: list | None = None, env_extra: dict | None = None):
+    """A `cli route` fleet under test: TCP front (kernel-assigned port,
+    read back from the ready file), `replicas` spawned TCP replicas."""
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    rundir = os.path.join(wd, f"fleet_{tag}")
+    os.makedirs(rundir, exist_ok=True)
+    ready = os.path.join(rundir, "router.addr")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "route",
+         "--replicas", str(replicas),
+         "--address", "tcp:127.0.0.1:0",
+         "--ready-file", ready,
+         "--rundir", rundir,
+         "--batch-families", "4",
+         *(extra or [])],
+        env=_serve_env(ledger, env_extra),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                "router died at startup: "
+                + proc.stderr.read().decode()[-1000:]
+            )
+        if os.path.exists(ready):
+            address = open(ready).read().strip().splitlines()[0]
+            try:
+                if request(address, {"op": "ping"}, timeout=2.0).get("ok"):
+                    return proc, address
+            except (OSError, ConnectionError):
+                pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("router never became ready")
+
+
+def _stop_route(proc, address: str) -> int:
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    try:
+        request(address, {"op": "drain", "timeout": 600}, timeout=660)
+    except (OSError, ConnectionError):
+        pass
+    try:
+        return proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait(timeout=30)
+
+
 #: Scenario table: fault schedule + what must have happened (beyond the
 #: universal byte-identity check). `expect` maps to (source, key, min):
 #: source 'stage:<name>' reads the child's stage stats, 'ledger' the
@@ -723,6 +776,126 @@ def run_drill(quick: bool, out_path: str) -> dict:
                     and ss["job"]["state"] == "done"
                     and entry["other_identical"]
                     and entry["stalled_identical"]
+                    and rc == 0
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftfleet: replica r0 is armed (via the router's per-replica
+        # failpoint plumbing) to die with SIGKILL-grade exit mid-stream,
+        # on its first life only. Affinity pins every tenant to r0, so
+        # the kill strands queued AND in-flight jobs; the monitor must
+        # requeue them to the survivor and respawn r0. Every tenant
+        # byte-identical to the standalone reference, tail latency
+        # bounded — a requeue is a re-placement, not a tenant-visible
+        # timeout — and the drained router exits 0.
+        entry = {"ok": False}
+        results["fleet_replica_kill_requeue"] = entry
+        ledger = os.path.join(wd, "fleet_kill.jsonl")
+        t0 = time.monotonic()
+        proc, address = _spawn_route(
+            wd, "kill", ledger,
+            extra=["--replica-failpoints",
+                   "r0:fleet_replica_exit=exit:9@batch=1"],
+        )
+        try:
+            outs = [os.path.join(wd, f"fleet_kill_{k}.out.bam")
+                    for k in range(6)]
+            jobs = []
+            refused = None
+            for out in outs:
+                r = request(address, {"op": "submit", "spec": {
+                    "input": bam, "output": out,
+                }})
+                if not r.get("ok"):
+                    refused = r
+                    break
+                jobs.append(r["job"]["id"])
+            if refused is not None:
+                entry["error"] = f"submit refused: {refused}"
+            else:
+                waits = []
+                states = []
+                for jid in jobs:
+                    t_w = time.monotonic()
+                    rw = request(address, {"op": "wait", "job": jid,
+                                           "timeout": 300}, timeout=360)
+                    waits.append(time.monotonic() - t_w)
+                    states.append(rw.get("job", {}).get("state"))
+                stats = request(
+                    address, {"op": "fleet"}, timeout=30
+                ).get("stats", {})
+                rc = _stop_route(proc, address)
+                counters = stats.get("counters", {})
+                entry["counters"] = counters
+                entry["states"] = states
+                entry["max_wait_s"] = round(max(waits), 2)
+                entry["identical"] = [
+                    open(o, "rb").read() == clean_ref for o in outs
+                ]
+                entry["ok"] = (
+                    all(s == "done" for s in states)
+                    and all(entry["identical"])
+                    and counters.get("jobs_requeued", 0) >= 1
+                    and counters.get("replica_restarts", 0) >= 1
+                    and entry["max_wait_s"] < 120.0
+                    and rc == 0
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftfleet: one transient io_error on the router's own
+        # forward path (fleet_route failpoint). The bounded in-router
+        # retry absorbs it — the tenant sees a clean submit, zero jobs
+        # are requeued, and the fired failpoint lands in the ledger as
+        # the audit trail.
+        entry = {"ok": False}
+        results["fleet_router_transient_io"] = entry
+        ledger = os.path.join(wd, "fleet_io.jsonl")
+        t0 = time.monotonic()
+        proc, address = _spawn_route(
+            wd, "io", ledger,
+            extra=["--failpoints", "fleet_route=io_error:times=1"],
+        )
+        try:
+            outs = [os.path.join(wd, f"fleet_io_{k}.out.bam")
+                    for k in range(2)]
+            subs = [request(address, {"op": "submit", "spec": {
+                "input": bam, "output": out,
+            }}) for out in outs]
+            if not all(r.get("ok") for r in subs):
+                entry["error"] = f"submit refused: {subs}"
+            else:
+                states = []
+                for r in subs:
+                    rw = request(address,
+                                 {"op": "wait", "job": r["job"]["id"],
+                                  "timeout": 300}, timeout=360)
+                    states.append(rw.get("job", {}).get("state"))
+                stats = request(
+                    address, {"op": "fleet"}, timeout=30
+                ).get("stats", {})
+                rc = _stop_route(proc, address)
+                counters = stats.get("counters", {})
+                entry["counters"] = counters
+                entry["states"] = states
+                entry["faults_fired"] = _ledger_counts(ledger).get(
+                    "failpoint_fired", 0
+                )
+                entry["identical"] = [
+                    open(o, "rb").read() == clean_ref for o in outs
+                ]
+                entry["ok"] = (
+                    all(s == "done" for s in states)
+                    and all(entry["identical"])
+                    and entry["faults_fired"] >= 1
+                    and counters.get("jobs_requeued", 0) == 0
                     and rc == 0
                 )
         finally:

@@ -16,6 +16,21 @@ Writes SERVE_HEAD.json (committed denominator; bench.py's
 BSSEQ_BENCH_SERVE leg runs the --quick form). The server runs as a
 real subprocess (`cli serve`) so the measurement includes socket,
 admission, and demux overheads — everything a tenant would feel.
+
+Fleet mode (`--fleet N`) drives a `cli route` fleet instead: hundreds
+of tenants at 10–100× the single-engine arrival rate, drawn from a
+small pool of distinct inputs so repeat inputs exercise the router's
+fingerprint affinity (`affinity_hits > 0` is a gate — a fleet that
+never routes warm is just N cold engines). Standalone references are
+computed once per distinct input; every tenant's bytes must match its
+input's reference regardless of which replica ran it, and the tenant
+edge runs over the TCP transport (router front + router→replica).
+
+    python tools/serve_loadgen.py --fleet 2 [--tenants 200]
+                                  [--distinct 8] [--out FLEET_HEAD.json]
+
+Writes FLEET_HEAD.json (committed denominator; bench.py's
+BSSEQ_BENCH_FLEET leg runs the --fleet --quick form).
 """
 
 import argparse
@@ -118,6 +133,54 @@ def _wait_server(sock: str, proc) -> None:
         except (OSError, ConnectionError):
             time.sleep(0.1)
     raise SystemExit("server socket never came up")
+
+
+def _spawn_router(rundir: str, ledger: str, replicas: int,
+                  batch_families: int, cache_dir: str):
+    """The fleet under test: a `cli route` subprocess supervising
+    `replicas` TCP serve replicas, fronted on a TCP port of its own
+    (kernel-assigned; read back from the ready file)."""
+    ready = os.path.join(rundir, "router.addr")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+        BSSEQ_TPU_STATS=ledger,
+        BSSEQ_TPU_COMPILE_CACHE_DIR=cache_dir,
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "route",
+         "--replicas", str(replicas),
+         "--address", "tcp:127.0.0.1:0",
+         "--ready-file", ready,
+         "--rundir", rundir,
+         "--batch-families", str(batch_families),
+         "--warmup"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    return proc, ready
+
+
+def _wait_router(ready: str, proc) -> str:
+    """Ready protocol: the router writes its bound addresses once the
+    whole fleet answers pings. Returns the tenant-facing address."""
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    deadline = time.monotonic() + 2 * SERVER_START_TIMEOUT
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise SystemExit(
+                "router died during startup: "
+                + proc.stderr.read().decode()[-2000:]
+            )
+        if os.path.exists(ready):
+            address = open(ready).read().strip().splitlines()[0]
+            try:
+                resp = request(address, {"op": "ping"}, timeout=2.0)
+                if resp.get("ok"):
+                    return address
+            except (OSError, ConnectionError):
+                pass
+        time.sleep(0.1)
+    raise SystemExit("router never became ready")
 
 
 def _drive_load(sock: str, inputs, wd: str, rate: float, seed: int):
@@ -268,6 +331,133 @@ def run_load(n_jobs: int, n_families: int, rate: float, seed: int,
         shutil.rmtree(wd, ignore_errors=True)
 
 
+def _replica_admissions(ledger: str) -> dict:
+    """job_admitted counts per replica sub-stream — the reconciliation
+    denominator: their sum must equal the router's jobs_routed."""
+    counts: dict = {}
+    try:
+        with open(ledger) as fh:
+            for line in fh:
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                if d.get("event") == "job_admitted" and d.get("replica"):
+                    counts[d["replica"]] = counts.get(d["replica"], 0) + 1
+    except OSError:
+        pass
+    return counts
+
+
+def run_fleet_load(replicas: int, tenants: int, distinct: int,
+                   n_families: int, rate: float, seed: int,
+                   batch_families: int, out_path: str) -> dict:
+    wd = tempfile.mkdtemp(prefix="fleet_loadgen_")
+    rundir = os.path.join(wd, "fleet")
+    cache_dir = os.path.join(wd, "compile_cache")
+    ledger = os.path.join(wd, "fleet_ledger.jsonl")
+    os.makedirs(rundir)
+    os.makedirs(cache_dir)
+    proc = None
+    try:
+        inputs = _build_inputs(wd, distinct, n_families, seed)
+        refs = _standalone_refs(inputs, wd)
+        proc, ready = _spawn_router(
+            rundir, ledger, replicas, batch_families, cache_dir
+        )
+        address = _wait_router(ready, proc)
+        tenant_inputs = [inputs[k % distinct] for k in range(tenants)]
+        results, wall = _drive_load(address, tenant_inputs, wd, rate, seed)
+
+        from bsseqconsensusreads_tpu.serve.server import request
+
+        fleet_stats = request(address, {"op": "fleet"}, timeout=30).get(
+            "stats", {}
+        )
+        request(address, {"op": "drain", "timeout": 600}, timeout=660)
+        rc = proc.wait(timeout=180)
+
+        jobs = []
+        latencies = []
+        for k, r in enumerate(results):
+            entry = {"input": os.path.basename(tenant_inputs[k])}
+            if r is None or r.get("latency_s") is None:
+                entry.update({"ok": False, "error": (r or {}).get("error")})
+            else:
+                identical = (
+                    os.path.exists(r["output"])
+                    and _sha(r["output"]) == refs[k % distinct]
+                )
+                entry.update({
+                    "job": r["job"],
+                    "state": r["state"],
+                    "latency_s": round(r["latency_s"], 4),
+                    "identical": identical,
+                    "ok": r["state"] == "done" and identical,
+                })
+                latencies.append(r["latency_s"])
+            jobs.append(entry)
+        latencies.sort()
+        counters = fleet_stats.get("counters", {})
+        admissions = _replica_admissions(ledger)
+        per_replica = {
+            rid: {
+                "alive": entry.get("alive"),
+                "generation": entry.get("generation"),
+                "jobs": entry.get("jobs"),
+            }
+            for rid, entry in fleet_stats.get("replicas", {}).items()
+        }
+        all_ok = bool(jobs) and all(j.get("ok") for j in jobs)
+        affinity_hits = counters.get("affinity_hits", 0)
+        reconciled = (
+            sum(admissions.values()) == counters.get("jobs_routed", -1)
+        )
+        head = {
+            "suite": "fleet_loadgen",
+            "config": {
+                "replicas": replicas,
+                "tenants": tenants,
+                "distinct_inputs": distinct,
+                "families_per_job": n_families,
+                "arrival_rate_jobs_per_s": rate,
+                "seed": seed,
+                "batch_families": batch_families,
+                "backend": "cpu",
+                "transport": "tcp",
+            },
+            "wall_seconds": round(wall, 3),
+            "jobs_per_hour": (
+                round(tenants / wall * 3600.0, 1) if wall else 0
+            ),
+            "latency_p50_s": round(_percentile(latencies, 0.50), 4),
+            "latency_p99_s": round(_percentile(latencies, 0.99), 4),
+            "counters": counters,
+            "replicas": per_replica,
+            "replica_admissions": admissions,
+            "counters_reconciled": reconciled,
+            "router_exit_code": rc,
+            # 200 identical job_detail dicts say nothing a failure list
+            # doesn't; keep the artifact reviewable
+            "failed_jobs": [j for j in jobs if not j.get("ok")],
+            "ok": (
+                all_ok and rc == 0 and affinity_hits > 0 and reconciled
+            ),
+        }
+        with open(out_path, "w") as fh:
+            json.dump(head, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        return head
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        shutil.rmtree(wd, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="Poisson load against a live graftserve engine"
@@ -275,21 +465,53 @@ def main() -> int:
     ap.add_argument("--jobs", type=int, default=8)
     ap.add_argument("--families", type=int, default=24,
                     help="duplex families per job")
-    ap.add_argument("--rate", type=float, default=25.0,
+    ap.add_argument("--rate", type=float, default=None,
                     help="Poisson arrival rate, jobs/second (high enough "
                          "that tenants overlap — an idle engine shares "
-                         "no batches and proves nothing)")
+                         "no batches and proves nothing). Default 25; "
+                         "fleet mode defaults to 10x that")
     ap.add_argument("--seed", type=int, default=1302)
     ap.add_argument("--batch-families", type=int, default=16)
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="drive a cli route fleet of N replicas instead "
+                         "of one cli serve engine")
+    ap.add_argument("--tenants", type=int, default=200,
+                    help="fleet mode: concurrent tenants (jobs)")
+    ap.add_argument("--distinct", type=int, default=8,
+                    help="fleet mode: distinct inputs the tenants draw "
+                         "from (repeats exercise affinity)")
     ap.add_argument("--quick", action="store_true",
-                    help="small fleet for the bench leg (4 jobs)")
-    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_HEAD.json"))
+                    help="small run for the bench leg")
+    ap.add_argument("--out", default=None,
+                    help="default SERVE_HEAD.json / FLEET_HEAD.json")
     args = ap.parse_args()
+    if args.fleet:
+        rate = args.rate if args.rate is not None else 250.0
+        tenants, distinct, families = (
+            args.tenants, args.distinct, args.families
+        )
+        if args.quick:
+            tenants, distinct, families = (
+                min(tenants, 16), min(distinct, 4), min(families, 8)
+            )
+        out = args.out or os.path.join(REPO, "FLEET_HEAD.json")
+        head = run_fleet_load(
+            args.fleet, tenants, distinct, families, rate,
+            args.seed, args.batch_families, out,
+        )
+        summary = {
+            k: head[k]
+            for k in ("jobs_per_hour", "latency_p50_s", "latency_p99_s",
+                      "counters", "counters_reconciled", "ok")
+        }
+        print(json.dumps(summary))
+        return 0 if head["ok"] else 1
+    rate = args.rate if args.rate is not None else 25.0
     if args.quick:
         args.jobs, args.families = min(args.jobs, 4), min(args.families, 8)
     head = run_load(
-        args.jobs, args.families, args.rate, args.seed,
-        args.batch_families, args.out,
+        args.jobs, args.families, rate, args.seed,
+        args.batch_families, args.out or os.path.join(REPO, "SERVE_HEAD.json"),
     )
     summary = {
         k: head[k]
