@@ -581,6 +581,100 @@ def _child_hostscale() -> None:
     }))
 
 
+#: Engine x worker grid for the bucket-emit leg: 0 pins the fully
+#: serial path (inline sorts, serial BGZF), 4 enables the hostpool
+#: bucket sorts plus the pbgzf deflate tier.
+_BUCKET_SORT_WORKERS = (0, 4)
+
+
+def _child_bucket() -> None:
+    """BSSEQ_BENCH_BUCKET quick leg: the graftbucket emit tail vs the
+    external-sort reference engine over one shuffled emit-order record
+    stream. Byte-identity across every engine x worker combo is
+    asserted in-artifact (a sort number for wrong bytes is not a
+    number); per-combo walls plus the bucket/deflate sub-phases and
+    worker counts land beside it, so the artifact shows WHERE the merge
+    tail went, not just that it shrank."""
+    jax.config.update("jax_platforms", "cpu")
+    import hashlib
+    import random
+
+    from bsseqconsensusreads_tpu.io import native as _ionative
+    from bsseqconsensusreads_tpu.io import wirepack
+    from bsseqconsensusreads_tpu.io.bam import (
+        BamHeader,
+        BamWriter,
+        encode_record,
+    )
+    from bsseqconsensusreads_tpu.pipeline import extsort
+    from bsseqconsensusreads_tpu.utils import observe
+    from bsseqconsensusreads_tpu.utils.testing import stream_duplex_families
+
+    n_families = int(os.environ.get("BSSEQ_BENCH_BUCKET_FAMILIES", "4000"))
+    genome_len = max(120_000, n_families * 30)
+    rng = np.random.default_rng(23)
+    codes = rng.integers(0, 4, size=genome_len).astype(np.int8)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", genome_len)])
+    blobs = [
+        encode_record(r)
+        for r in stream_duplex_families(
+            codes, n_families, read_len=80, bisulfite=True,
+            templates_for=lambda f: 1 if f % 3 else 2,
+        )
+    ]
+    random.Random(23).shuffle(blobs)  # emit order, not coordinate order
+    ref_engine = (
+        "native"
+        if (wirepack.available() and _ionative.available())
+        else "python"
+    )
+    workdir = tempfile.mkdtemp(prefix="bsseq_bucketbench_")
+    _progress("input-done", records=len(blobs))
+
+    runs: dict = {}
+    digests = set()
+    for engine in (ref_engine, "bucket"):
+        for workers in _BUCKET_SORT_WORKERS:
+            os.environ["BSSEQ_TPU_HOST_WORKERS"] = str(workers)
+            metrics = observe.Metrics()
+            out_path = os.path.join(workdir, f"{engine}_w{workers}.bam")
+            t0 = time.monotonic()
+            with BamWriter(out_path, header) as w:
+                extsort.external_sort_raw_to_writer(
+                    iter(blobs), w, header, workdir=workdir,
+                    metrics=metrics, engine=engine,
+                )
+            wall = time.monotonic() - t0
+            with open(out_path, "rb") as fh:
+                digests.add(hashlib.sha256(fh.read()).hexdigest())
+            os.unlink(out_path)
+            secs = metrics.seconds
+            runs[f"{engine}_w{workers}"] = {
+                "wall_s": round(wall, 3),
+                "records_per_s": (
+                    round(len(blobs) / wall, 1) if wall else 0.0
+                ),
+                "subphases": {
+                    k: round(v, 3)
+                    for k, v in sorted(secs.items(), key=lambda kv: -kv[1])
+                    if "." in k
+                },
+                "deflate_workers": metrics.counters.get("pbgzf_workers", 0),
+                "buckets": metrics.counters.get("bucket_count", 0),
+                "spill_runs": metrics.counters.get("bucket_spill_runs", 0),
+            }
+            _progress("bucket-run-done", engine=engine, workers=workers,
+                      wall_s=round(wall, 2))
+    print(json.dumps({
+        "bucket_emit": {
+            "records": len(blobs),
+            "reference_engine": ref_engine,
+            "byte_identical_across_engines": len(digests) == 1,
+            "runs": runs,
+        }
+    }))
+
+
 def _child(backend: str) -> None:
     """Device-measurement child: prints ONE JSON line {"rate", "backend"}.
 
@@ -721,6 +815,7 @@ def _run_child(mode: str, tmo: int) -> tuple[dict | None, str | None, str]:
                 if isinstance(d, dict) and (
                     "rate" in d
                     or "host_scaling" in d
+                    or "bucket_emit" in d
                     or d.get("probe") is True
                 ):
                     return d, None, last_phase
@@ -831,6 +926,21 @@ def _measure_host_scaling() -> dict | None:
     )
     if payload is not None:
         return payload.get("host_scaling")
+    return {"error": failure}
+
+
+def _measure_bucket_emit() -> dict | None:
+    """The ISSUE-12 bucket-emit leg: graftbucket vs the external-sort
+    reference engine at 0/4 host workers over the same shuffled record
+    stream, byte-identity asserted in-child, cpu-pinned.
+    BSSEQ_BENCH_BUCKET=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_BUCKET", "1") == "0":
+        return None
+    payload, failure, _ = _run_child(
+        "bucket", _env_timeout("BSSEQ_BENCH_BUCKET_TIMEOUT", 900)
+    )
+    if payload is not None:
+        return payload.get("bucket_emit")
     return {"error": failure}
 
 
@@ -1022,6 +1132,8 @@ def main() -> None:
             _child_xla_cpu()
         elif sys.argv[2] == "hostscale":
             _child_hostscale()
+        elif sys.argv[2] == "bucket":
+            _child_bucket()
         else:
             _child(sys.argv[2])
         return
@@ -1152,6 +1264,19 @@ def main() -> None:
                     "byte_identical_across_workers"
                 ),
                 "cores": scaling.get("cores"),
+            },
+            sink=ledger_sink,
+        )
+    bucket = _measure_bucket_emit()
+    if bucket is not None:
+        out["bucket_emit"] = bucket
+        observe.emit(
+            "bench_bucket_emit",
+            {
+                "byte_identical": bucket.get(
+                    "byte_identical_across_engines"
+                ),
+                "reference_engine": bucket.get("reference_engine"),
             },
             sink=ledger_sink,
         )

@@ -154,6 +154,12 @@ def cmd_run(args) -> int:
         cfg.methyl_out = args.methyl_out
     if args.single_strand:
         cfg.single_strand = True
+    if args.sort_engine:
+        cfg.sort_engine = args.sort_engine
+    if args.sort_buckets:
+        cfg.sort_buckets = args.sort_buckets
+    if args.stream_interstage:
+        cfg.stream_interstage = True
     target, results, stats = run_pipeline(
         cfg, args.bam, outdir=args.outdir, force=args.force
     )
@@ -767,6 +773,22 @@ def main(argv: list[str] | None = None) -> int:
         "--single-strand", action="store_true",
         help="molecular emit without duplex pairing: stop after the "
         "molecular consensus stage",
+    )
+    p.add_argument(
+        "--sort-engine", choices=("auto", "native", "python", "bucket"),
+        default="",
+        help="raw coordinate-sort engine for stage outputs (overrides "
+        "config; byte-identical output across engines)",
+    )
+    p.add_argument(
+        "--sort-buckets", type=int, default=0,
+        help="bucket count for --sort-engine bucket (0 = engine default)",
+    )
+    p.add_argument(
+        "--stream-interstage", action="store_true",
+        help="with the bucket engine, stream molecular consensus records "
+        "straight into duplex grouping per bucket (falls back loudly "
+        "when the configuration does not support fusion)",
     )
     _add_failpoints(p)
     p.set_defaults(fn=cmd_run)

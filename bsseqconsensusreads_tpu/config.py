@@ -72,13 +72,33 @@ class FrameworkConfig:
     #: directly (pipeline.extsort.external_sort_raw).
     emit: str = "auto"
     #: raw coordinate-sort engine for the 'self' stage outputs — the same
-    #: auto|native|python contract as `emit`: 'native' keys, sorts, and
-    #: k-way-merges the encoded record blobs in C
+    #: auto|native|python contract as `emit`, plus 'bucket': 'native'
+    #: keys, sorts, and k-way-merges the encoded record blobs in C
     #: (pipeline.extsort.resolve_sort_engine; merge BGZF compression rides
     #: the mt-writer threadpool), 'python' keeps the blob-generator +
-    #: heapq parity twin, 'auto' picks native when built. Output bytes are
-    #: identical across engines. BSSEQ_TPU_SORT_ENGINE overrides.
+    #: heapq parity twin, 'bucket' drops the merge tail entirely —
+    #: records route into coordinate-range buckets at emit time, each
+    #: bucket sorts independently (in-core, hostpool-parallel) and the
+    #: output concatenates sorted-by-construction (pipeline.bucketemit),
+    #: 'auto' picks native when built. Output bytes are identical across
+    #: all engines. BSSEQ_TPU_SORT_ENGINE overrides.
     sort_engine: str = "auto"
+    #: bucket count for sort_engine='bucket' (0 = the engine default,
+    #: pipeline.bucketemit.DEFAULT_BUCKETS). Boundaries are planned at
+    #: equal cumulative-genome-length strides from the header's reference
+    #: dictionary; output bytes are identical for ANY count — this only
+    #: trades in-core sort size against per-bucket bookkeeping.
+    #: BSSEQ_TPU_SORT_BUCKETS overrides.
+    sort_buckets: int = 0
+    #: inter-stage streaming under sort_engine='bucket' (stretch knob,
+    #: off by default): when the molecular stage's output buckets are
+    #: sorted in-core, their records can flow straight into duplex
+    #: grouping per bucket while the molecular BAM writes, skipping the
+    #: intermediate read-back (pipeline.stages). Requires the narrow
+    #: configuration the fused path supports (self aligner, no
+    #: mid-stage checkpoint) — anything else falls back LOUDLY to the
+    #: two-pass path. Output bytes are identical either way.
+    stream_interstage: bool = False
     #: BGZF deflate level for INTERMEDIATE stage outputs — the durable
     #: rule-boundary checkpoints between stages (e.g. the molecular output
     #: feeding the duplex stage), which stay on disk like the reference's

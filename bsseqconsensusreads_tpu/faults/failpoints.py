@@ -66,6 +66,10 @@ SITES = frozenset(
         # pipeline.extsort — spill runs + merge passes
         "extsort_spill",
         "extsort_merge",
+        # pipeline.bucketemit — bucket run spills + per-bucket finalize
+        # writes (the two durable windows of sort_engine=bucket)
+        "bucket_spill",
+        "bucket_finalize",
         # pipeline.checkpoint — durable state
         "ckpt_shard_write",
         "ckpt_manifest_rename",

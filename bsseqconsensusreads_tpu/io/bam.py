@@ -411,9 +411,30 @@ def _create_bgzf(path: str, engine: str, level: int):
 
         return NativeBgzfWriter(path, level)
 
-    return _select_bgzf(
-        engine, native_factory, lambda: BgzfWriter.open(path, level=level)
-    )
+    def python_factory():
+        # the python codec tier shards deflate across the hostpool when
+        # workers are available (io.pbgzf; BSSEQ_TPU_PBGZF overrides) —
+        # byte-identical to the serial BgzfWriter for any worker count
+        from bsseqconsensusreads_tpu.io import pbgzf
+
+        workers = pbgzf.default_workers()
+        if workers >= 2:
+            return pbgzf.PBgzfWriter.open(path, level=level, workers=workers)
+        return BgzfWriter.open(path, level=level)
+
+    return _select_bgzf(engine, native_factory, python_factory)
+
+
+def attach_codec_metrics(writer: "BamWriter", metrics) -> None:
+    """Point a writer's parallel-deflate codec (io.pbgzf) at a stage's
+    metrics so its worker-busy seconds and block counts land in the
+    ledger ('sort_write.deflate' sub-phase, pbgzf_* counters). No-op for
+    the serial python codec and the native codec (the native mt writer
+    accounts its own threads C-side)."""
+    codec = getattr(writer, "_bgzf", None)
+    if codec is not None and hasattr(codec, "workers") \
+            and hasattr(codec, "metrics"):
+        codec.metrics = metrics
 
 
 _REC_FIXED = struct.Struct("<iiBBHHHIiii")  # refID..tlen after block_size (32 bytes)

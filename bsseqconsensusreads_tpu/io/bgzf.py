@@ -261,6 +261,34 @@ class BgzfReader:
         self.close()
 
 
+def deflate_block(data: bytes, level: int = 6) -> bytes:
+    """Compress one <=MAX_BLOCK_SIZE payload into a complete framed BGZF
+    block (gzip member with the BC/BSIZE FEXTRA subfield + CRC32/ISIZE
+    footer). THE one block encoder — BgzfWriter and the parallel codec
+    (io.pbgzf) both call it, so the incompressible-payload retry and the
+    frame bytes cannot drift between the serial and sharded paths: the
+    same payload sequence always produces the same file bytes, whatever
+    codec or worker count wrote it. Each block is an independent deflate
+    stream, which is exactly what makes sharding deflate across threads
+    byte-identical to the serial writer."""
+    if _failpoints.ARMED:  # guarded: this runs once per 64K block
+        _failpoints.fire("bgzf_write")
+    co = zlib.compressobj(level, zlib.DEFLATED, -15)
+    cdata = co.compress(data) + co.flush()
+    bsize = len(cdata) + 12 + 6 + 8  # header + xtra + footer
+    if bsize > 65536:
+        # Incompressible payload: store with minimal compression instead.
+        co = zlib.compressobj(0, zlib.DEFLATED, -15)
+        cdata = co.compress(data) + co.flush()
+        bsize = len(cdata) + 12 + 6 + 8
+    return (
+        _HEADER.pack(0x1F, 0x8B, 8, 4, 0, 0, 0xFF, 6)
+        + struct.pack("<2BHH", 0x42, 0x43, 2, bsize - 1)
+        + cdata
+        + struct.pack("<II", zlib.crc32(data), len(data))
+    )
+
+
 class BgzfWriter:
     """Streaming BGZF compressor; writes the EOF marker on close."""
 
@@ -284,23 +312,7 @@ class BgzfWriter:
             del self._buf[:MAX_BLOCK_SIZE]
 
     def _flush_block(self, data: bytes) -> None:
-        if _failpoints.ARMED:  # guarded: this runs once per 64K block
-            _failpoints.fire("bgzf_write")
-        co = zlib.compressobj(self._level, zlib.DEFLATED, -15)
-        cdata = co.compress(data) + co.flush()
-        bsize = len(cdata) + 12 + 6 + 8  # header + xtra + footer
-        if bsize > 65536:
-            # Incompressible payload: store with minimal compression instead.
-            co = zlib.compressobj(0, zlib.DEFLATED, -15)
-            cdata = co.compress(data) + co.flush()
-            bsize = len(cdata) + 12 + 6 + 8
-        block = (
-            _HEADER.pack(0x1F, 0x8B, 8, 4, 0, 0, 0xFF, 6)
-            + struct.pack("<2BHH", 0x42, 0x43, 2, bsize - 1)
-            + cdata
-            + struct.pack("<II", zlib.crc32(data), len(data))
-        )
-        self._fh.write(block)
+        self._fh.write(deflate_block(data, self._level))
 
     def flush(self) -> None:
         if self._buf:

@@ -46,6 +46,8 @@ def _try_load():
             "wirepack_duplex_retire",
             "wirepack_emit_consensus_records_v4",
             "wirepack_sort_raw_records",
+            "wirepack_bucket_assign",
+            "wirepack_bucket_scatter",
             "wirepack_strand_calls",
             "wirepack_bcount_sparse",
             "wirepack_methyl_tally_merge",
@@ -100,6 +102,16 @@ def _try_load():
     lib.wirepack_sort_raw_records.argtypes = [
         C.c_void_p, C.c_int64, C.c_void_p,
         C.POINTER(C.c_double), C.POINTER(C.c_double),
+    ]
+    lib.wirepack_bucket_assign.restype = C.c_int64
+    lib.wirepack_bucket_assign.argtypes = [
+        C.c_void_p, C.c_int64, C.c_void_p, C.c_int32,
+        C.c_int64, C.c_void_p, C.c_void_p, C.c_void_p,
+    ]
+    lib.wirepack_bucket_scatter.restype = C.c_int64
+    lib.wirepack_bucket_scatter.argtypes = [
+        C.c_void_p, C.c_int64, C.c_void_p, C.c_void_p, C.c_void_p,
+        C.c_int32, C.c_void_p, C.c_int64, C.c_void_p,
     ]
     lib.wirepack_strand_calls.restype = None
     lib.wirepack_strand_calls.argtypes = (
@@ -539,6 +551,66 @@ def sort_raw_records(blob) -> tuple[bytes, int, float, float]:
             f"(rc={n}) — the emit stream is corrupt"
         )
     return out.tobytes(), int(n), key_s.value, sort_s.value
+
+
+def bucket_split(blob, boundaries: np.ndarray) -> tuple[list[bytes], np.ndarray]:
+    """Native bucket pass for one routing chunk (pipeline.bucketemit).
+
+    blob: concatenated encoded record frames (4-byte block_size prefix
+    each). boundaries: int64 ascending combined-key lower bounds
+    (boundaries[0] == 0; combined key = mapped_ref * 2^31 + mapped_pos,
+    the (ref, pos) prefix of raw_coordinate_key). Returns
+    (per-bucket byte strings preserving input order, per-bucket record
+    counts int64[nbuckets]) — one frame scan (wirepack_bucket_assign)
+    plus one gather (wirepack_bucket_scatter), no per-record Python.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    src = np.frombuffer(blob, dtype=np.uint8)
+    bounds = np.ascontiguousarray(boundaries, dtype=np.int64)
+    nbuckets = int(bounds.size)
+    cap = src.size // 36 + 1  # min frame = 4-byte prefix + 32-byte record
+    offs = np.empty(cap, np.int64)
+    sizes = np.empty(cap, np.int32)
+    buckets = np.empty(cap, np.int32)
+    n = _lib.wirepack_bucket_assign(
+        src.ctypes.data_as(C.c_void_p), src.size,
+        bounds.ctypes.data_as(C.c_void_p), nbuckets,
+        cap, offs.ctypes.data_as(C.c_void_p),
+        sizes.ctypes.data_as(C.c_void_p),
+        buckets.ctypes.data_as(C.c_void_p),
+    )
+    if n < 0:
+        raise ValueError(
+            "native bucket assign found a malformed record frame "
+            f"(rc={n}) — the emit stream is corrupt"
+        )
+    n = int(n)
+    offs, sizes, buckets = offs[:n], sizes[:n], buckets[:n]
+    byte_totals = np.bincount(
+        buckets, weights=sizes, minlength=nbuckets
+    ).astype(np.int64)
+    counts = np.bincount(buckets, minlength=nbuckets).astype(np.int64)
+    starts = np.zeros(nbuckets, np.int64)
+    np.cumsum(byte_totals[:-1], out=starts[1:])
+    out = np.empty(src.size, np.uint8)
+    rc = _lib.wirepack_bucket_scatter(
+        src.ctypes.data_as(C.c_void_p), n,
+        offs.ctypes.data_as(C.c_void_p),
+        sizes.ctypes.data_as(C.c_void_p),
+        buckets.ctypes.data_as(C.c_void_p),
+        nbuckets, starts.ctypes.data_as(C.c_void_p),
+        out.size, out.ctypes.data_as(C.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"native bucket scatter failed (rc={rc})")
+    ends = starts + byte_totals
+    parts = [
+        out[starts[b] : ends[b]].tobytes() if byte_totals[b] else b""
+        for b in range(nbuckets)
+    ]
+    return parts, counts
 
 
 def bcount_sparse(bases, quals, cons, params) -> np.ndarray:

@@ -58,7 +58,7 @@ def host_workers() -> int:
     if env is not None:
         try:
             return max(0, int(env))
-        except ValueError:
+        except ValueError:  # graftlint: disable=swallowed-exception -- a malformed worker-count env var falls back to the cpu-count default by design; not a worker failure
             pass
     cores = os.cpu_count() or 1
     return min(4, max(0, cores - 1))

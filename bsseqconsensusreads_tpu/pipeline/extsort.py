@@ -352,20 +352,27 @@ def external_sort_raw(
 
 def resolve_sort_engine(engine: str = "auto") -> str:
     """THE sort-engine resolution for the raw coordinate sort — the same
-    auto|native|python contract as the emit knob (calling._resolve_emit).
+    auto|native|python contract as the emit knob (calling._resolve_emit),
+    plus the bucketed engine.
 
     'native' runs the whole record path in C: in-RAM run sorts
     (wirepack_sort_raw_records), k-way merges whose BGZF compression
     rides the mt-writer threadpool (bamio_merge_runs), zero per-record
     Python between spill and bytes-on-disk. 'python' keeps the blob
-    generator + heapq engine (the parity twin). 'auto' picks native when
-    both native libraries are built. BSSEQ_TPU_SORT_ENGINE overrides the
-    passed value (experiments/bench A-B runs)."""
+    generator + heapq engine (the parity twin). 'bucket' skips the
+    global merge entirely: records route into coordinate-range buckets
+    at emit time, each bucket sorts independently, and the output is
+    concatenation (pipeline.bucketemit — byte-identical to the merge
+    engines, using the native sweeps when built). 'auto' picks native
+    when both native libraries are built. BSSEQ_TPU_SORT_ENGINE
+    overrides the passed value (experiments/bench A-B runs)."""
     engine = os.environ.get("BSSEQ_TPU_SORT_ENGINE", engine)
-    if engine not in ("auto", "native", "python"):
+    if engine not in ("auto", "native", "python", "bucket"):
         raise ValueError(
-            f"unknown sort engine {engine!r}; use auto|native|python"
+            f"unknown sort engine {engine!r}; use auto|native|python|bucket"
         )
+    if engine == "bucket":
+        return "bucket"
     if engine == "python":
         return "python"
     from bsseqconsensusreads_tpu.io import native as _native
@@ -406,6 +413,7 @@ def external_sort_raw_to_writer(
     buffer_records: int = DEFAULT_BUFFER_RECORDS,
     metrics=None,
     engine: str = "auto",
+    sort_buckets: int = 0,
 ) -> int:
     """Coordinate-sort a mixed item stream (RawRecords blocks / encoded
     blobs / BamRecord objects) into an open BamWriter whose header is
@@ -416,12 +424,25 @@ def external_sort_raw_to_writer(
     Under the native engine no per-record Python executes between the
     producer's batches and bytes-on-disk: native-emit RawRecords blocks
     append to the run buffer whole, runs sort in C, and the merge loop +
-    its BGZF compression run in C through the writer's codec. Spill CRC
+    its BGZF compression run in C through the writer's codec. The bucket
+    engine routes records to coordinate-range buckets at emit time and
+    concatenates independent in-core sorts — no merge tail at all
+    (pipeline.bucketemit; `sort_buckets` sizes its plan). Spill CRC
     (BSSEQ_TPU_VERIFY_SPILLS), the background spill writer
     (BSSEQ_TPU_HOST_WORKERS >= 1), and the extsort_spill/extsort_merge
     failpoints carry over from the Python core. Output bytes are
-    identical across engines (tests/test_nativesort.py pins it)."""
-    if resolve_sort_engine(engine) != "native":
+    identical across engines (tests/test_nativesort.py and
+    tests/test_bucketemit.py pin it)."""
+    resolved = resolve_sort_engine(engine)
+    if resolved == "bucket":
+        from bsseqconsensusreads_tpu.pipeline import bucketemit as _bucketemit
+
+        return _bucketemit.bucket_sort_to_writer(
+            items, writer, header, workdir=workdir,
+            buffer_records=buffer_records, metrics=metrics,
+            buckets=sort_buckets,
+        )
+    if resolved != "native":
         return writer.write_raw_many(
             external_sort_raw(
                 iter_record_blobs(items), header, workdir=workdir,
@@ -662,6 +683,7 @@ def write_batch_stream(
     level: int = 6,
     metrics=None,
     sort_engine: str = "auto",
+    sort_buckets: int = 0,
 ) -> None:
     """Write a consensus batch stream (lists of BamRecord / RawRecords) to
     a BAM: straight through when order-preserving, or via the raw-blob
@@ -671,14 +693,19 @@ def write_batch_stream(
     level; see FrameworkConfig.intermediate_level). `metrics` attributes
     the sort's in-stream spill time ('sort_write' — see
     _external_sort_core). `sort_engine` selects the raw-sort engine
-    (resolve_sort_engine: auto|native|python, byte-identical output)."""
+    (resolve_sort_engine: auto|native|python|bucket, byte-identical
+    output; `sort_buckets` sizes the bucket engine's plan)."""
     with BamWriter(out_path, header, level=level) as writer:
+        if metrics is not None:
+            from bsseqconsensusreads_tpu.io.bam import attach_codec_metrics
+
+            attach_codec_metrics(writer, metrics)
         if mode == "self":
             external_sort_raw_to_writer(
                 (item for batch in batches for item in batch),
                 writer, header, workdir=workdir,
                 buffer_records=buffer_records, metrics=metrics,
-                engine=sort_engine,
+                engine=sort_engine, sort_buckets=sort_buckets,
             )
         else:
             for batch in batches:

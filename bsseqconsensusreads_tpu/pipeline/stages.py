@@ -283,7 +283,24 @@ class PipelineBuilder:
         if ck is not None:
             ck.write_batches(batches)
             if mode == "self":
-                if resolve_sort_engine(self.cfg.sort_engine) == "native":
+                engine = resolve_sort_engine(self.cfg.sort_engine)
+                if engine == "bucket":
+                    # bucketed two-phase finalize: per-bucket sorted runs
+                    # become durable state beside the shards (their
+                    # manifest rides the same CRC/fingerprint machinery),
+                    # so a kill inside finalize replays only unverified
+                    # buckets on resume (pipeline.bucketemit)
+                    from bsseqconsensusreads_tpu.pipeline import (
+                        bucketemit as _bucketemit,
+                    )
+
+                    _bucketemit.finalize_checkpoint(
+                        ck, header,
+                        workdir=self.cfg.tmp or None,
+                        buffer_records=self.cfg.sort_buffer_records,
+                        metrics=metrics, buckets=self.cfg.sort_buckets,
+                    )
+                elif engine == "native":
                     # native raw sort writes its merged stream straight
                     # through the finalize writer's codec — no per-record
                     # Python between the durable shards and the target
@@ -310,6 +327,7 @@ class PipelineBuilder:
                 level=self._out_level(out_path),
                 metrics=metrics,
                 sort_engine=self.cfg.sort_engine,
+                sort_buckets=self.cfg.sort_buckets,
             )
         if stats is not None:
             # the remainder: post-stream merge + writer finalize, with
@@ -605,6 +623,122 @@ class PipelineBuilder:
         finally:
             g.close()
 
+    def _interstage_blocked(self) -> str:
+        """Why the fused molecular->duplex streaming path cannot engage
+        ('' when it can): it needs the bucket engine's in-plan-order
+        bucket emit (the tee rides BucketRouter.stream_to) and no
+        mid-stage checkpoint (shard replay would re-enter the tee)."""
+        if resolve_sort_engine(self.cfg.sort_engine) != "bucket":
+            return "sort_engine must resolve to 'bucket'"
+        if self.cfg.checkpoint_every > 0:
+            return "checkpoint_every > 0 (batch shards cannot tee)"
+        if self.cfg.methyl != "off":
+            return "methyl extraction rides the duplex checkpoint protocol"
+        if self.cfg.duplex_passthrough:
+            return "duplex_passthrough is validated on the two-pass path"
+        return ""
+
+    def run_fused(self, rule) -> None:
+        """The fused molecular->duplex rule (stream_interstage): the
+        molecular batch stream routes into coordinate buckets, and as
+        each sorted bucket writes to the molecular BAM its records ALSO
+        decode straight into duplex grouping — the intermediate file is
+        still produced (same bytes: one continuous writer in plan
+        order), but the duplex stage never re-reads it from disk."""
+        from bsseqconsensusreads_tpu.io.bam import (
+            attach_codec_metrics,
+            decode_record,
+        )
+        from bsseqconsensusreads_tpu.pipeline import bucketemit as _bucketemit
+
+        mol_out, duplex_out = rule.outputs
+        mol_stats = self.stats.setdefault(
+            "molecular", StageStats(stage="molecular")
+        )
+        dstats = self.stats.setdefault("duplex", StageStats(stage="duplex"))
+        fasta = FastaFile(self.cfg.genome_fasta)
+        g = _guard.Guard.from_env(mol_stats)
+        try:
+            with open_guarded_reader(rule.inputs[0], g) as reader, \
+                    observe.maybe_trace("fused"):
+                mol_header = self._pg(reader.header, "molecular")
+                batches = call_molecular_batches(
+                    molecular_ingest_stream(
+                        rule.inputs[0], reader, mol_stats,
+                        ingest_choice=self.cfg.ingest,
+                        grouping=self.molecular_grouping,
+                        indel_policy=self.cfg.indel_policy,
+                        guard=g,
+                    ),
+                    params=self.cfg.molecular,
+                    mode="self",
+                    batch_families=self.cfg.batch_families,
+                    max_window=self.cfg.max_window,
+                    grouping=self.molecular_grouping,
+                    stats=mol_stats,
+                    indel_policy=self.cfg.indel_policy,
+                    emit=self.cfg.emit,
+                    transport=self.cfg.transport,
+                    batching=self.cfg.batching,
+                    base_counts=self.cfg.base_count_tags,
+                    guard=g,
+                )
+                plan = _bucketemit.BucketPlan.from_header(
+                    mol_header, self.cfg.sort_buckets
+                )
+                mol_stats.metrics.count("bucket_count", plan.nbuckets)
+                router = _bucketemit.BucketRouter(
+                    plan, mol_header, workdir=self.cfg.tmp or None,
+                    buffer_records=self.cfg.sort_buffer_records,
+                    metrics=mol_stats.metrics,
+                )
+
+                def fused_records():
+                    """Pull-driven tee: consuming this generator runs the
+                    molecular stage, writes its BAM, and hands every
+                    sorted record on as a decoded object."""
+                    for batch in batches:
+                        for item in batch:
+                            router.route(item)
+                    with BamWriter(
+                        mol_out, mol_header, level=self._out_level(mol_out)
+                    ) as w:
+                        attach_codec_metrics(w, mol_stats.metrics)
+                        for blob in router.stream_to(w):
+                            # stream_to yields the prefixed frame;
+                            # decode_record wants the body past the
+                            # 4-byte block_size
+                            yield decode_record(blob[4:])
+
+                names = [n for n, _ in mol_header.references]
+                dheader = self._pg(
+                    mol_header, "duplex"
+                ).with_sort_order("coordinate")
+                dstats.metrics.count("ingest_native", 0)
+                dstats.metrics.count("group_native", 0)
+                dbatches = call_duplex_batches(
+                    fused_records(),
+                    fasta.fetch,
+                    names,
+                    params=self.cfg.duplex,
+                    mode="self",
+                    batch_families=self.cfg.batch_families,
+                    max_window=self.cfg.max_window,
+                    grouping=self.cfg.grouping,
+                    stats=dstats,
+                    emit=self.cfg.emit,
+                    refstore=self.cfg.genome_fasta,
+                    transport=self.cfg.transport,
+                    pos0=self.cfg.pos0,
+                    strand_tags=self.cfg.duplex_strand_tags,
+                    chemistry=self.cfg.chemistry,
+                )
+                self._write_stage_output(
+                    dbatches, duplex_out, dheader, "self", None, dstats
+                )
+        finally:
+            g.close()
+
     def run_sam_to_fastq(self, rule) -> None:
         with BamReader(rule.inputs[0]) as reader:
             sam_to_fastq(reader, rule.outputs[0], rule.outputs[1])
@@ -749,19 +883,41 @@ class PipelineBuilder:
             return wf, target
         if cfg.aligner == "self":
             aligned = self.out("_consensus_unfiltered_aunamerged_aligned.bam")
-            wf.rule(
-                "call_consensus_molecular_tpu",
-                [consensus_input],
-                [aligned],
-                lambda r: self.run_molecular(r, mode="self"),
-            )
             target = self.out("_consensus_duplex_unfiltered.bam")
-            wf.rule(
-                "call_duplex_tpu",
-                [aligned],
-                [target],
-                lambda r: self.run_duplex(r, mode="self"),
-            )
+            fused = False
+            if cfg.stream_interstage:
+                blocked = self._interstage_blocked()
+                if blocked:
+                    # the fallback must be LOUD: an operator who asked for
+                    # fusion and got the two-pass path should see why
+                    observe.emit(
+                        "interstage_fallback", {"reason": blocked}
+                    )
+                    observe.stderr_line(
+                        f"stream_interstage disabled: {blocked}"
+                    )
+                else:
+                    fused = True
+            if fused:
+                wf.rule(
+                    "call_consensus_molecular_duplex_fused",
+                    [consensus_input],
+                    [aligned, target],
+                    self.run_fused,
+                )
+            else:
+                wf.rule(
+                    "call_consensus_molecular_tpu",
+                    [consensus_input],
+                    [aligned],
+                    lambda r: self.run_molecular(r, mode="self"),
+                )
+                wf.rule(
+                    "call_duplex_tpu",
+                    [aligned],
+                    [target],
+                    lambda r: self.run_duplex(r, mode="self"),
+                )
             if cfg.filter is not None:
                 self._filter_params()  # fail fast on a bad dict
                 if cfg.duplex_passthrough:

@@ -296,6 +296,51 @@ def _table(headers: list[str], rows: list[list[str]]) -> str:
     return "\n".join(out)
 
 
+_SW_PREFIX = "sort_write."
+
+
+def _emit_breakdown_rows(s: LedgerSummary) -> list[list[str]]:
+    """Per-stage sort_write sub-phase rows (bucket_route/bucket_sort/
+    bucket_concat, deflate, merge...) plus a deflate-worker utilization
+    row when the parallel codec tier ran — busy worker-seconds over
+    workers x active span, so a 4-worker tier compressing 10% of the
+    time reads 10%, not "4 workers". Empty when no stage attributed
+    emit sub-phases (old ledgers stay byte-stable)."""
+    rows: list[list[str]] = []
+    for stage, st in sorted(s.stages.items()):
+        subs = {
+            k[len(_SW_PREFIX):-len("_seconds")]: st[k]
+            for k in st
+            if k.startswith(_SW_PREFIX)
+            and k.endswith("_seconds")
+            and isinstance(st[k], (int, float))
+        }
+        workers = st.get("pbgzf_workers")
+        if not subs and not workers:
+            continue
+        for name in sorted(subs, key=lambda n: -subs[n]):
+            rows.append([stage, name, _fmt(float(subs[name]))])
+        if isinstance(workers, (int, float)) and workers:
+            busy = subs.get("deflate", 0.0)
+            span = subs.get("deflate_span", 0.0)
+            util = f"{busy / (span * workers):.0%}" if span else "-"
+            blocks = st.get("pbgzf_blocks")
+            rows.append([
+                stage,
+                f"deflate workers={int(workers)} blocks={blocks or 0}",
+                f"util {util}",
+            ])
+        buckets = st.get("bucket_count")
+        if isinstance(buckets, (int, float)) and buckets:
+            detail = f"buckets={int(buckets)}"
+            if st.get("bucket_spill_runs"):
+                detail += f" spill_runs={int(st['bucket_spill_runs'])}"
+            if st.get("bucket_replayed"):
+                detail += f" replayed={int(st['bucket_replayed'])}"
+            rows.append([stage, detail, ""])
+    return rows
+
+
 def format_summary(s: LedgerSummary) -> str:
     out: list[str] = []
     m = s.manifest
@@ -328,6 +373,12 @@ def format_summary(s: LedgerSummary) -> str:
             )
         out.append("")
         out.append(_table(["stage"] + [h for _, h in _STAGE_COLS], rows))
+    emit_rows = _emit_breakdown_rows(s)
+    if emit_rows:
+        out.append("")
+        out.append(
+            _table(["stage", "sort_write sub-phase", "seconds"], emit_rows)
+        )
     if s.rules:
         rows = [
             [

@@ -895,6 +895,76 @@ int64_t wirepack_sort_raw_records(const uint8_t* blob, int64_t nbytes,
   return int64_t(keys.size());
 }
 
+// ---- coordinate-bucketed emit sweeps (pipeline/bucketemit.py) ------------
+//
+// The bucket router's native pass beside the raw sort: one frame scan
+// assigns every record in a concatenated blob to a contig/position-range
+// bucket, one scatter concatenates the records per bucket in input
+// order. The bucket key is the (ref, pos) PREFIX of raw_coordinate_key
+// folded into one int64 — ref * 2^31 + pos with the same -1 -> 1<<30
+// mapping — so a bucket boundary can never split a full-key tie (qname/
+// flag only break ties at one (ref, pos)) and the concatenation of
+// per-bucket stable sorts in plan order is byte-identical to the global
+// stable sort.
+//
+// wirepack_bucket_assign: boundaries int64 ascending, boundaries[0]==0
+// (bucket i covers [bounds[i], bounds[i+1]), the last to +inf — which
+// includes the unmapped sentinel key). Writes per-record off/size/bucket
+// into caller arrays of capacity `cap` (nbytes/36 bounds the record
+// count: min frame is 4 + kMinRecordSize). Returns the record count,
+// -2 on a malformed frame, -3 if cap is exceeded.
+int64_t wirepack_bucket_assign(const uint8_t* blob, int64_t nbytes,
+                               const int64_t* bounds, int32_t nbounds,
+                               int64_t cap, int64_t* offs, int32_t* sizes,
+                               int32_t* buckets) {
+  int64_t n = 0;
+  int64_t off = 0;
+  while (off < nbytes) {
+    RawRecKey k;
+    if (!scan_raw_key(blob, nbytes, off, k)) return -2;
+    if (n >= cap) return -3;
+    const int64_t key = int64_t(k.ref) * (int64_t(1) << 31) + k.pos;
+    // upper_bound - 1: the rightmost boundary <= key
+    int32_t lo = 0, hi = nbounds;
+    while (lo < hi) {
+      const int32_t mid = (lo + hi) / 2;
+      if (bounds[mid] <= key)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    offs[n] = off;
+    sizes[n] = k.size;
+    buckets[n] = lo - 1;
+    ++n;
+    off += k.size;
+  }
+  return n;
+}
+
+// wirepack_bucket_scatter: copy n records (assign's off/size/bucket
+// arrays) into `out` — records of bucket b land contiguously starting
+// at starts[b] (caller-computed exclusive prefix sums of per-bucket
+// byte totals), preserving input order within each bucket. Returns 0,
+// or -2 if any record would overrun starts[b+1] (a stale plan — the
+// caller's totals must come from the same assign pass).
+int64_t wirepack_bucket_scatter(const uint8_t* blob, int64_t n,
+                                const int64_t* offs, const int32_t* sizes,
+                                const int32_t* buckets, int32_t nbuckets,
+                                const int64_t* starts, int64_t out_bytes,
+                                uint8_t* out) {
+  std::vector<int64_t> cursor(starts, starts + nbuckets);
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t b = buckets[i];
+    const int64_t end =
+        b + 1 < nbuckets ? starts[b + 1] : out_bytes;
+    if (b < 0 || b >= nbuckets || cursor[b] + sizes[i] > end) return -2;
+    std::memcpy(out + cursor[b], blob + offs[i], size_t(sizes[i]));
+    cursor[b] += sizes[i];
+  }
+  return 0;
+}
+
 // ---- sparse cB dissent histogram (models/molecular.py twin) --------------
 //
 // The molecular emit path's tag prologue: overlap co-call

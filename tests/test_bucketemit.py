@@ -1,0 +1,533 @@
+"""Sort-free bucketed emit vs the external-sort engines (ISSUE 12).
+
+sort_engine=bucket replaces the k-way merge tail with per-bucket in-core
+sorts concatenated in plan order — its ONLY correctness claim is byte
+identity with the python/native external sorts, for any bucket count and
+any hostpool worker count. These tests pin that matrix over the
+adversarial shapes named in the issue (records straddling a bucket
+boundary, unmapped/ref_id=-1, empty contigs, the single-bucket
+degenerate plan, heavy positional skew), across both item packings
+(single blobs = the python emitter, RawRecords blocks = the native
+emitter), through the spill path, under the bucket_spill failpoint,
+through the durable two-phase checkpointed finalize (damaged-run
+replay), through the fused inter-stage stream, and through the parallel
+BGZF codec tier.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.io import native, wirepack
+from bsseqconsensusreads_tpu.io.bam import (
+    BamHeader,
+    BamRecord,
+    BamWriter,
+    CMATCH,
+    RawRecords,
+    encode_record,
+)
+from bsseqconsensusreads_tpu.pipeline import bucketemit, extsort
+from bsseqconsensusreads_tpu.pipeline.extsort import raw_coordinate_key
+
+HEADER = BamHeader("@HD\tVN:1.6\n", [("chr1", 1 << 20), ("chr2", 1 << 20)])
+
+#: zero-length contigs interleaved with real ones: the planner must not
+#: waste boundaries on them and the router must not misassign neighbours
+HEADER_EMPTY = BamHeader(
+    "@HD\tVN:1.6\n",
+    [("chrE0", 0), ("chr1", 1 << 20), ("chrE1", 0), ("chr2", 1 << 20),
+     ("chrE2", 0)],
+)
+
+#: identity reference: the native engine when its libs are built (the CI
+#: image builds them), else the python engine — the two are pinned
+#: byte-identical to each other by tests/test_nativesort.py
+REF_ENGINE = (
+    "native" if (wirepack.available() and native.available()) else "python"
+)
+
+
+def _rec(rng: random.Random, qname: str, ref_id: int, pos: int) -> bytes:
+    ln = rng.choice((8, 12, 20))
+    r = BamRecord(
+        qname=qname,
+        flag=rng.choice((99, 147, 83, 163, 0, 4)),
+        ref_id=ref_id,
+        pos=pos,
+        mapq=60,
+        cigar=[(CMATCH, ln)],
+        seq="ACGT" * (ln // 4),
+        qual=bytes([rng.randrange(2, 40)] * ln),
+    )
+    return encode_record(r)
+
+
+def _case_blobs(case: str) -> tuple[list[bytes], BamHeader, int]:
+    """(encoded records, header, bucket count) for one adversarial shape."""
+    rng = random.Random(hash(case) & 0xFFFF)
+    blobs: list[bytes] = []
+    if case == "straddle":
+        # clusters of SAME-qname records at boundary-1 / boundary /
+        # boundary+1 around every interior plan boundary: equal full keys
+        # must never split across buckets
+        plan = bucketemit.BucketPlan.from_header(HEADER, 8)
+        for key in plan.boundaries[1:]:
+            ref, pos = key >> bucketemit.REF_SHIFT, key & ((1 << 31) - 1)
+            for d in (-1, 0, 0, 0, 1):
+                for _ in range(4):
+                    blobs.append(_rec(rng, f"q{key}", ref, pos + d))
+        for _ in range(400):
+            blobs.append(_rec(rng, f"f{rng.randrange(40)}",
+                              rng.randrange(2), rng.randrange(1 << 20)))
+        return blobs, HEADER, 8
+    if case == "unmapped":
+        # every sentinel combination: fully unmapped, mapped ref with
+        # pos=-1 (buckets WITHIN its contig, not at the end), pos with
+        # ref=-1 — mixed with mapped records
+        for i in range(600):
+            ref, pos = rng.choice(
+                ((-1, -1), (-1, rng.randrange(1000)),
+                 (0, -1), (1, -1),
+                 (0, rng.randrange(1 << 20)), (1, rng.randrange(1 << 20)))
+            )
+            blobs.append(_rec(rng, f"u{i % 30}", ref, pos))
+        return blobs, HEADER, 8
+    if case == "empty_contigs":
+        for i in range(600):
+            blobs.append(_rec(rng, f"e{i % 25}", rng.choice((1, 3)),
+                              rng.randrange(1 << 20)))
+        return blobs, HEADER_EMPTY, 8
+    if case == "single_bucket":
+        for i in range(500):
+            blobs.append(_rec(rng, f"s{i % 20}", rng.randrange(2),
+                              rng.choice((-1, rng.randrange(1 << 20)))))
+        return blobs, HEADER, 1
+    if case == "skew":
+        # 90% of records in a 100bp window of chr2: one hot bucket among
+        # 64 mostly-empty ones, with heavy key ties
+        for i in range(900):
+            if i % 10:
+                blobs.append(_rec(rng, f"k{i % 15}", 1,
+                                  1000 + rng.randrange(100)))
+            else:
+                blobs.append(_rec(rng, f"k{i % 15}", rng.randrange(2),
+                                  rng.randrange(1 << 20)))
+        return blobs, HEADER, 64
+    raise AssertionError(case)
+
+
+def _pack_raw(blobs: list[bytes], seed: int) -> list[RawRecords]:
+    """Chunk single blobs into RawRecords blocks (the native emitter's
+    item shape) without reordering."""
+    rng = random.Random(seed)
+    items, i = [], 0
+    while i < len(blobs):
+        k = rng.randrange(1, 9)
+        items.append(RawRecords(b"".join(blobs[i : i + k]),
+                                len(blobs[i : i + k])))
+        i += k
+    return items
+
+
+def _engine_bytes(items, engine: str, buffer_records: int, tmp_path,
+                  tag: str, header: BamHeader = HEADER, buckets: int = 0,
+                  metrics=None) -> bytes:
+    path = str(tmp_path / f"{tag}_{engine}.bam")
+    with BamWriter(path, header) as w:
+        extsort.external_sort_raw_to_writer(
+            iter(items), w, header, workdir=str(tmp_path),
+            buffer_records=buffer_records, engine=engine,
+            sort_buckets=buckets, metrics=metrics,
+        )
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+CASES = ("straddle", "unmapped", "empty_contigs", "single_bucket", "skew")
+
+
+class TestPlanUnit:
+    def test_resolve_buckets(self, monkeypatch):
+        monkeypatch.delenv(bucketemit.ENV_BUCKETS, raising=False)
+        assert bucketemit.resolve_buckets() == bucketemit.DEFAULT_BUCKETS
+        assert bucketemit.resolve_buckets(7) == 7
+        monkeypatch.setenv(bucketemit.ENV_BUCKETS, "3")
+        assert bucketemit.resolve_buckets(7) == 3
+        monkeypatch.setenv(bucketemit.ENV_BUCKETS, "junk")
+        assert bucketemit.resolve_buckets(7) == bucketemit.DEFAULT_BUCKETS
+
+    def test_bucket_key_orders_like_sort_key(self):
+        """Combined-key order must equal the (ref, pos) prefix order of
+        raw_coordinate_key — including the INDEPENDENT unmapped
+        sentinels (a mapped-ref/pos=-1 record sorts within its contig)."""
+        rng = random.Random(5)
+        blobs = [
+            _rec(rng, "k", ref, pos)
+            for ref, pos in ((-1, -1), (0, 5), (0, -1), (1, 0), (-1, 7),
+                             (1, -1), (0, 0), (1, (1 << 20) - 1))
+        ]
+        by_bucket_key = sorted(blobs, key=bucketemit.blob_bucket_key)
+        by_sort_key = sorted(blobs, key=lambda b: raw_coordinate_key(b)[:2])
+        assert [raw_coordinate_key(b)[:2] for b in by_bucket_key] == [
+            raw_coordinate_key(b)[:2] for b in by_sort_key
+        ]
+
+    def test_plan_shape_and_ownership(self):
+        plan = bucketemit.BucketPlan.from_header(HEADER, 8)
+        assert plan.boundaries[0] == 0
+        assert plan.boundaries == sorted(set(plan.boundaries))
+        assert 2 <= plan.nbuckets <= 8
+        # every key has exactly one owner, in ascending bucket order
+        keys = [0, 1, 5000, (1 << bucketemit.REF_SHIFT) + 3,
+                (bucketemit.UNMAPPED_SENTINEL << bucketemit.REF_SHIFT)
+                + bucketemit.UNMAPPED_SENTINEL]
+        owners = [plan.bucket_of(k) for k in keys]
+        assert owners == sorted(owners)
+        assert all(0 <= b < plan.nbuckets for b in owners)
+
+    def test_plan_degenerate_and_empty_contigs(self):
+        assert bucketemit.BucketPlan.from_header(HEADER, 1).boundaries == [0]
+        empty = BamHeader("@HD\tVN:1.6\n", [("chrE", 0)])
+        assert bucketemit.BucketPlan.from_header(empty, 8).boundaries == [0]
+        plan = bucketemit.BucketPlan.from_header(HEADER_EMPTY, 8)
+        assert plan.boundaries[0] == 0 and plan.nbuckets >= 2
+
+    def test_plan_validation(self):
+        with pytest.raises(ValueError, match="start at key 0"):
+            bucketemit.BucketPlan([5, 10])
+        with pytest.raises(ValueError, match="strictly ascending"):
+            bucketemit.BucketPlan([0, 10, 10])
+
+
+class TestBucketIdentityMatrix:
+    """The issue's core matrix: every adversarial shape x hostpool worker
+    count x item packing, byte-identical to the external-sort engine."""
+
+    @pytest.mark.parametrize("workers", (0, 1, 4))
+    @pytest.mark.parametrize("case", CASES)
+    def test_identity(self, tmp_path, monkeypatch, case, workers):
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", str(workers))
+        blobs, header, buckets = _case_blobs(case)
+        ref = _engine_bytes(blobs, REF_ENGINE, 10_000, tmp_path, "ref",
+                            header)
+        for packing in ("blobs", "raw"):
+            items = blobs if packing == "blobs" else _pack_raw(blobs, 5)
+            got = _engine_bytes(items, "bucket", 10_000, tmp_path,
+                                f"{packing}{workers}", header, buckets)
+            assert hashlib.sha256(got).hexdigest() == hashlib.sha256(
+                ref
+            ).hexdigest(), f"{case}/{packing}/workers={workers}"
+
+    @pytest.mark.parametrize("workers", (0, 4))
+    def test_spill_path_identity(self, tmp_path, monkeypatch, workers):
+        """A tiny buffer forces repeated largest-bucket spills (the hot
+        skew bucket accumulates several runs) — the per-bucket run merge
+        must still reproduce the reference bytes."""
+        from bsseqconsensusreads_tpu.utils import observe
+
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", str(workers))
+        blobs, header, buckets = _case_blobs("skew")
+        ref = _engine_bytes(blobs, REF_ENGINE, 10_000, tmp_path, "ref",
+                            header)
+        metrics = observe.Metrics()
+        got = _engine_bytes(blobs, "bucket", 150, tmp_path, f"sp{workers}",
+                            header, buckets, metrics=metrics)
+        assert got == ref
+        assert metrics.counters.get("bucket_spill_runs", 0) > 0
+        assert "sort_write.bucket_spill" in metrics.seconds
+
+    def test_python_routing_fallback(self, tmp_path, monkeypatch):
+        """With the native sweeps stubbed out the pure-python router must
+        produce the same bytes (the no-native-libs deployment)."""
+        blobs, header, buckets = _case_blobs("straddle")
+        ref = _engine_bytes(_pack_raw(blobs, 9), "bucket", 10_000, tmp_path,
+                            "nat", header, buckets)
+        monkeypatch.setattr(bucketemit, "_use_native", lambda: False)
+        got = _engine_bytes(_pack_raw(blobs, 9), "bucket", 10_000, tmp_path,
+                            "py", header, buckets)
+        assert got == ref
+
+    def test_sub_phase_attribution_lands(self, tmp_path):
+        from bsseqconsensusreads_tpu.utils import observe
+
+        metrics = observe.Metrics()
+        blobs, header, buckets = _case_blobs("straddle")
+        _engine_bytes(blobs, "bucket", 10_000, tmp_path, "attr", header,
+                      buckets, metrics=metrics)
+        secs = metrics.seconds
+        assert "sort_write.bucket_route" in secs
+        assert "sort_write.bucket_sort" in secs
+        assert "sort_write.bucket_concat" in secs
+        assert metrics.counters["bucket_count"] >= 2
+        assert metrics.counters["bucket_records"] == len(blobs)
+        # dotted sub-phases must not inflate the host phase summary
+        summary = metrics.phase_summary(1.0)
+        assert summary["host_s"] == pytest.approx(
+            secs.get("sort_write", 0.0), abs=2e-3
+        )
+
+
+class TestResolveEngine:
+    def test_bucket_accepted_and_env_override(self, monkeypatch):
+        monkeypatch.delenv("BSSEQ_TPU_SORT_ENGINE", raising=False)
+        assert extsort.resolve_sort_engine("bucket") == "bucket"
+        monkeypatch.setenv("BSSEQ_TPU_SORT_ENGINE", "bucket")
+        assert extsort.resolve_sort_engine("native") == "bucket"
+        monkeypatch.delenv("BSSEQ_TPU_SORT_ENGINE")
+        with pytest.raises(ValueError, match="unknown sort engine"):
+            extsort.resolve_sort_engine("frobnicate")
+
+
+class TestSpillFault:
+    def test_spill_io_error_retried_byte_identical(self, tmp_path):
+        """One injected IO error on a bucket run write: retried whole,
+        byte-identical output, retry counted."""
+        from bsseqconsensusreads_tpu.faults import failpoints
+        from bsseqconsensusreads_tpu.utils import observe
+
+        blobs, header, buckets = _case_blobs("skew")
+        clean = _engine_bytes(blobs, "bucket", 150, tmp_path, "clean",
+                              header, buckets)
+        metrics = observe.Metrics()
+        failpoints.arm("bucket_spill=io_error:times=1")
+        try:
+            faulted = _engine_bytes(blobs, "bucket", 150, tmp_path, "flt",
+                                    header, buckets, metrics=metrics)
+        finally:
+            failpoints.disarm()
+        assert faulted == clean
+        assert metrics.counters.get("batches_retried", 0) == 1
+
+
+class TestDurableFinalize:
+    def _blobs(self) -> list[bytes]:
+        rng = random.Random(41)
+        return [
+            _rec(rng, f"d{i % 20}", rng.choice((-1, 0, 1)),
+                 rng.choice((-1, rng.randrange(1 << 20))))
+            for i in range(500)
+        ]
+
+    def _checkpoint(self, tmp_path, blobs):
+        from bsseqconsensusreads_tpu.pipeline.checkpoint import (
+            BatchCheckpoint,
+        )
+
+        target = str(tmp_path / "out.bam")
+        ck = BatchCheckpoint(target, HEADER, every=2, fingerprint={"p": 1})
+        ck.write_batches(
+            [RawRecords(b"".join(blobs[i : i + 25]), 25)]
+            for i in range(0, len(blobs), 25)
+        )
+        return ck, target
+
+    def test_finalize_matches_reference(self, tmp_path):
+        blobs = self._blobs()
+        ref = _engine_bytes(blobs, REF_ENGINE, 10_000, tmp_path, "ref")
+        ck, target = self._checkpoint(tmp_path, blobs)
+        n = bucketemit.finalize_checkpoint(ck, HEADER,
+                                           workdir=str(tmp_path))
+        assert n == len(blobs)
+        with open(target, "rb") as fh:
+            assert fh.read() == ref
+        assert not os.path.exists(target + ".bucketruns")
+
+    def test_crash_in_finalize_replays_only_damaged(self, tmp_path):
+        """Crash mid-Phase B, corrupt one bucket run on disk: the resume
+        verifies every run CRC, replays ONLY the damaged bucket from the
+        durable shards, and still produces the reference bytes."""
+        from bsseqconsensusreads_tpu.faults import failpoints
+        from bsseqconsensusreads_tpu.pipeline.checkpoint import (
+            BatchCheckpoint,
+        )
+        from bsseqconsensusreads_tpu.utils import observe
+
+        blobs = self._blobs()
+        ref = _engine_bytes(blobs, REF_ENGINE, 10_000, tmp_path, "ref")
+        ck, target = self._checkpoint(tmp_path, blobs)
+        failpoints.arm("bucket_finalize=raise:RuntimeError@hit=2")
+        try:
+            with pytest.raises(RuntimeError):
+                bucketemit.finalize_checkpoint(ck, HEADER,
+                                               workdir=str(tmp_path))
+        finally:
+            failpoints.disarm()
+        rundir = target + ".bucketruns"
+        doc = bucketemit._load_manifest(rundir)
+        assert doc is not None and doc["complete"]
+        # flip a byte in the first registered bucket run
+        victim = next(
+            os.path.join(rundir, runs[0][0])
+            for runs in doc["buckets"] if runs
+        )
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        with open(victim, "wb") as fh:
+            fh.write(data)
+
+        ck2 = BatchCheckpoint(target, HEADER, every=2,
+                              fingerprint={"p": 1})
+        metrics = observe.Metrics()
+        n = bucketemit.finalize_checkpoint(ck2, HEADER,
+                                           workdir=str(tmp_path),
+                                           metrics=metrics)
+        assert n == len(blobs)
+        assert metrics.counters.get("bucket_replayed", 0) >= 1
+        with open(target, "rb") as fh:
+            assert fh.read() == ref
+
+    def test_stale_manifest_discarded(self, tmp_path):
+        """A manifest whose fingerprint (e.g. bucket plan) no longer
+        matches must be discarded, not spliced: Phase A redoes cleanly."""
+        blobs = self._blobs()
+        ck, target = self._checkpoint(tmp_path, blobs)
+        rundir = target + ".bucketruns"
+        os.makedirs(rundir, exist_ok=True)
+        bucketemit._save_manifest(
+            rundir,
+            {"fingerprint": {"stale": True}, "boundaries": [0],
+             "complete": True, "buckets": [[]]},
+        )
+        ref = _engine_bytes(blobs, REF_ENGINE, 10_000, tmp_path, "ref")
+        bucketemit.finalize_checkpoint(ck, HEADER, workdir=str(tmp_path))
+        with open(target, "rb") as fh:
+            assert fh.read() == ref
+
+
+def _pipeline_digests(tmp_path, tag: str, records, name: str, genome: str,
+                      **cfg_kw) -> dict[str, str]:
+    """Run the full self-aligned pipeline; digest EVERY output BAM (the
+    molecular intermediate rides the sort too)."""
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import write_fasta
+
+    wd = tmp_path / tag
+    wd.mkdir()
+    fa = str(wd / "g.fa")
+    write_fasta(fa, name, genome)
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [(name, len(genome))])
+    inbam = str(wd / "in.bam")
+    with BamWriter(inbam, header) as w:
+        for r in records:
+            w.write(r)
+    cfg = FrameworkConfig(
+        genome_dir=str(wd), genome_fasta_file_name="g.fa", tmp=str(wd),
+        aligner="self", grouping="coordinate", batch_families=7,
+        sort_buffer_records=40, **cfg_kw,
+    )
+    run_pipeline(cfg, inbam, outdir=str(wd / "out"))
+    out = {}
+    for f in sorted(os.listdir(wd / "out")):
+        if f.endswith(".bam"):
+            with open(wd / "out" / f, "rb") as fh:
+                out[f] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+class TestPipelineIdentity:
+    """Both consensus stages through the real pipeline: the bucket
+    engine, the checkpointed bucket engine, and the fused inter-stage
+    stream must all reproduce the reference engine's BAMs exactly."""
+
+    @pytest.fixture(scope="class")
+    def family_input(self):
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records,
+            random_genome,
+        )
+
+        rng = np.random.default_rng(61)
+        name, genome = random_genome(rng, 6000)
+        _, records = make_grouped_bam_records(rng, name, genome,
+                                              n_families=12)
+        return name, genome, records
+
+    def test_engine_and_fused_identity(self, tmp_path, family_input):
+        name, genome, records = family_input
+        ref = _pipeline_digests(tmp_path, "ref", records, name, genome,
+                                sort_engine=REF_ENGINE)
+        bucket = _pipeline_digests(tmp_path, "bkt", records, name, genome,
+                                   sort_engine="bucket")
+        fused = _pipeline_digests(tmp_path, "fus", records, name, genome,
+                                  sort_engine="bucket",
+                                  stream_interstage=True)
+        assert bucket == ref
+        assert fused == ref
+
+    def test_checkpointed_bucket_identity(self, tmp_path, family_input):
+        name, genome, records = family_input
+        ref = _pipeline_digests(tmp_path, "ref", records, name, genome,
+                                sort_engine=REF_ENGINE)
+        ck = _pipeline_digests(tmp_path, "ck", records, name, genome,
+                               sort_engine="bucket", checkpoint_every=2)
+        assert ck == ref
+
+    def test_fused_fallback_is_loud_and_identical(self, tmp_path,
+                                                  family_input, capfd):
+        """stream_interstage on a non-fusable config (checkpointing on)
+        must fall back to the two-stage path LOUDLY and still produce
+        identical bytes."""
+        name, genome, records = family_input
+        ref = _pipeline_digests(tmp_path, "ref", records, name, genome,
+                                sort_engine="bucket", checkpoint_every=2)
+        fb = _pipeline_digests(tmp_path, "fb", records, name, genome,
+                               sort_engine="bucket", checkpoint_every=2,
+                               stream_interstage=True)
+        assert fb == ref
+        assert "interstage" in capfd.readouterr().err
+
+
+class TestPbgzfCodec:
+    def test_parallel_bytes_identical_to_serial(self, tmp_path):
+        """Any worker count, any chunking: PBgzfWriter's output is the
+        serial BgzfWriter's, byte for byte (same block cutting, same
+        deflate, in-order delivery)."""
+        from bsseqconsensusreads_tpu.io.bgzf import BgzfWriter
+        from bsseqconsensusreads_tpu.io.pbgzf import PBgzfWriter
+
+        rng = random.Random(3)
+        chunks = [
+            os.urandom(rng.choice((10, 1000, 70_000))) for _ in range(40)
+        ] + [b"A" * 200_000]
+        serial = str(tmp_path / "s.bgzf")
+        with BgzfWriter.open(serial) as w:
+            for c in chunks:
+                w.write(c)
+        for workers in (1, 2, 4):
+            par = str(tmp_path / f"p{workers}.bgzf")
+            with PBgzfWriter.open(par, workers=workers) as w:
+                for c in chunks:
+                    w.write(c)
+            assert open(par, "rb").read() == open(serial, "rb").read()
+
+    def test_default_workers_env_gate(self, monkeypatch):
+        from bsseqconsensusreads_tpu.io import pbgzf
+
+        monkeypatch.setenv("BSSEQ_TPU_PBGZF", "3")
+        assert pbgzf.default_workers() == 3
+        monkeypatch.setenv("BSSEQ_TPU_PBGZF", "0")
+        assert pbgzf.default_workers() == 0
+        monkeypatch.delenv("BSSEQ_TPU_PBGZF", raising=False)
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "1")
+        assert pbgzf.default_workers() == 0
+        monkeypatch.setenv("BSSEQ_TPU_HOST_WORKERS", "4")
+        assert pbgzf.default_workers() == 4
+
+    def test_pbgzf_metrics_attribution(self, tmp_path):
+        from bsseqconsensusreads_tpu.io.pbgzf import PBgzfWriter
+        from bsseqconsensusreads_tpu.utils import observe
+
+        metrics = observe.Metrics()
+        path = str(tmp_path / "m.bgzf")
+        with PBgzfWriter.open(path, workers=2, metrics=metrics) as w:
+            w.write(os.urandom(300_000))
+        assert metrics.counters["pbgzf_workers"] == 2
+        assert metrics.counters["pbgzf_blocks"] >= 4
+        assert "sort_write.deflate" in metrics.seconds

@@ -41,6 +41,7 @@ FIXTURES = {
     "padded-batch-flops": "fx_padded_batch_flops.py",
     "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
     "unframed-socket-read": "fx_unframed_socket_read.py",
+    "serial-deflate": "fx_serial_deflate.py",
 }
 
 

@@ -358,6 +358,16 @@ SCENARIOS = [
         "expect": [("ledger", "batch_retry", 1)],
     },
     {
+        # ISSUE 12: transient io_error on a graftbucket run write — the
+        # spill's guarded retry rewrites the same run file whole
+        # (payload stays in memory) and the bucket concatenation stays
+        # byte-identical to the reference engine's output
+        "name": "bucket_spill_io_error",
+        "failpoints": "bucket_spill=io_error:times=1",
+        "env": {"BSSEQ_TPU_SORT_ENGINE": "bucket"},
+        "expect": [("ledger", "batch_retry", 1)],
+    },
+    {
         # ISSUE 4: a fault INSIDE a host-pool task (worker-side
         # fetch/rawize/emit) is retried by the task's own guarded
         # wrapper — byte-identity proves the ordered retire replays it
@@ -517,6 +527,53 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 )
                 entry["ok"] = (
                     entry["byte_identical"] and entry["quarantined"] >= 1
+                )
+            else:
+                entry["error"] = (
+                    f"resume rc={cp2.returncode}: " + cp2.stderr[-500:]
+                )
+
+        # graftbucket (ISSUE 12): exit:9 in Phase B of the bucketed
+        # finalize — AFTER the bucket-run manifest committed, at the
+        # second bucket's stream-out — then corrupt one committed run
+        # on disk. The resume must find the complete manifest, CRC-fail
+        # exactly the damaged bucket, replay only it from the durable
+        # shards (`bucket_replayed`) and re-finalize byte-identical.
+        benv = {"BSSEQ_TPU_SORT_ENGINE": "bucket"}
+        outdir = os.path.join(wd, "out_bucketfin")
+        cp = _run_child(wd, bam, outdir, os.path.join(wd, "bf0.jsonl"),
+                        "bucket_finalize=exit:9@hit=2", env_extra=benv)
+        entry = {"ok": False, "kill_rc": cp.returncode}
+        results["bucket_finalize_kill_resume"] = entry
+        if cp.returncode == 9:
+            rundirs = [
+                os.path.join(outdir, d) for d in sorted(os.listdir(outdir))
+                if d.endswith(".bucketruns")
+            ]
+            runs = [
+                os.path.join(rd, f) for rd in rundirs
+                for f in sorted(os.listdir(rd)) if f.endswith(".bam")
+            ]
+            entry["durable_runs"] = len(runs)
+            if runs:
+                blob = bytearray(open(runs[0], "rb").read())
+                blob[len(blob) // 2] ^= 0xFF
+                open(runs[0], "wb").write(bytes(blob))
+            ledger = os.path.join(wd, "bf1.jsonl")
+            cp2 = _run_child(wd, bam, outdir, ledger, env_extra=benv)
+            if cp2.returncode == 0:
+                resumed = _child_payload(cp2)
+                entry["bucket_replayed"] = sum(
+                    _stage_counter(resumed, s, "bucket_replayed")
+                    for s in resumed["stages"]
+                )
+                entry["byte_identical"] = (
+                    open(resumed["target"], "rb").read() == ref_bytes
+                )
+                entry["ok"] = (
+                    entry["byte_identical"]
+                    and entry["durable_runs"] > 0
+                    and entry["bucket_replayed"] >= 1
                 )
             else:
                 entry["error"] = (

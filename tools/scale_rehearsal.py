@@ -57,8 +57,11 @@ def _records_for(n_families: int) -> int:
 
 
 def _child(workdir: str, n_families: int, raw_umis: bool = False,
-           backend: str = "cpu") -> None:
-    """Generate + run; prints one JSON line with stats."""
+           backend: str = "cpu", tag: str = "", reuse: bool = False) -> None:
+    """Generate + run; prints one JSON line with stats. `tag` namespaces
+    the output dir and `reuse` skips generation when the input BAM is
+    already on disk — the --engines mode runs the pipeline once per sort
+    engine over ONE shared generated input."""
     import jax
 
     if backend == "cpu":
@@ -135,19 +138,27 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         return qual_pool[(fam + ti * 13 + flag) & 63]
 
     bam = os.path.join(workdir, "input", "scale.bam")
+    meta = bam + ".meta.json"
     os.makedirs(os.path.dirname(bam), exist_ok=True)
     t0 = time.monotonic()
-    n_records = 0
-    with BamWriter(bam, header) as w:
-        for rec in stream_duplex_families(
-            codes, n_families, read_len=READ_LEN,
-            frag_extra=FRAG_LEN - READ_LEN,
-            templates_for=templates_for, qual_for=qual_for, mutate=mutate,
-            raw_umis=raw_umis,
-        ):
-            w.write(rec)
-            n_records += 1
-    gen_s = time.monotonic() - t0
+    if reuse and os.path.exists(bam) and os.path.exists(meta):
+        with open(meta) as fh:
+            n_records = json.load(fh)["n_records"]
+        gen_s = 0.0
+    else:
+        n_records = 0
+        with BamWriter(bam, header) as w:
+            for rec in stream_duplex_families(
+                codes, n_families, read_len=READ_LEN,
+                frag_extra=FRAG_LEN - READ_LEN,
+                templates_for=templates_for, qual_for=qual_for, mutate=mutate,
+                raw_umis=raw_umis,
+            ):
+                w.write(rec)
+                n_records += 1
+        with open(meta, "w") as fh:
+            json.dump({"n_records": n_records}, fh)
+        gen_s = time.monotonic() - t0
     gen_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
     # engine overrides for the A-B identity leg (--verify-identity):
@@ -168,9 +179,8 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         emit=emit_engine,
     )
     t0 = time.monotonic()
-    target, results, stats = run_pipeline(
-        cfg, bam, outdir=os.path.join(workdir, "output")
-    )
+    outdir = os.path.join(workdir, "output_" + tag if tag else "output")
+    target, results, stats = run_pipeline(cfg, bam, outdir=outdir)
     pipe_s = time.monotonic() - t0
     import hashlib
 
@@ -208,12 +218,35 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
     print(json.dumps(out))
 
 
+class _Done(Exception):
+    """Control-flow sentinel: the --engines branch finished its own
+    reporting; skip the single-run body but still hit the finally."""
+
+
+def _largest_host_phase(st: dict) -> str:
+    """Name of the largest HOST phase in a stage-stats dict (device-facing
+    kernel/fetch and the wall itself excluded; dotted sub-phases roll up
+    into their parent and are skipped)."""
+    skip = ("wall_seconds", "kernel_seconds", "fetch_seconds")
+    best, best_v = "", -1.0
+    for k, v in st.items():
+        if not k.endswith("_seconds") or k in skip or "." in k:
+            continue
+        if isinstance(v, (int, float)) and v > best_v:
+            best, best_v = k[: -len("_seconds")], float(v)
+    return best
+
+
 def main() -> int:
     if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        tag = ""
+        if "--tag" in sys.argv:
+            tag = sys.argv[sys.argv.index("--tag") + 1]
         _child(
             sys.argv[2], int(sys.argv[3]),
             raw_umis="--raw-umis" in sys.argv,
             backend="tpu" if "--tpu" in sys.argv else "cpu",
+            tag=tag, reuse="--reuse-input" in sys.argv,
         )
         return 0
     ap = argparse.ArgumentParser()
@@ -238,6 +271,14 @@ def main() -> int:
         help="generate a RAW aligned BAM (RX only, no MI) so the run "
         "exercises the full standalone path: GroupReadsByUmi-equivalent "
         "pre-stage (auto-prepended) -> molecular -> duplex",
+    )
+    ap.add_argument(
+        "--engines", default="", metavar="E1,E2",
+        help="comma-separated sort engines (e.g. native,bucket): run the "
+        "full pipeline once per engine over ONE shared generated input, "
+        "recording each engine's stage metrics (sort_write sub-phases, "
+        "deflate worker counters) and asserting the final BAMs are "
+        "byte-identical in-artifact — the SCALECPU r07 per-engine mode",
     )
     ap.add_argument(
         "--verify-identity", type=int, default=0, metavar="FAMILIES",
@@ -313,6 +354,54 @@ def main() -> int:
         )
         report["engine_identity"] = ident
     try:
+        if args.engines:
+            engines = [e.strip() for e in args.engines.split(",") if e.strip()]
+            report["config"]["engines"] = engines
+            per: dict = {}
+            for eng in engines:
+                cp = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), "--child",
+                     workdir, str(args.families), "--tag", eng,
+                     "--reuse-input"]
+                    + (["--raw-umis"] if args.raw_umis else [])
+                    + (["--tpu"] if args.backend == "tpu" else []),
+                    stdout=subprocess.PIPE, text=True, timeout=args.timeout,
+                    env=dict(child_env, BSSEQ_TPU_SORT_ENGINE=eng),
+                )
+                if cp.returncode != 0:
+                    report["error"] = f"child[{eng}] rc={cp.returncode}"
+                    break
+                per[eng] = json.loads(cp.stdout.strip().splitlines()[-1])
+            report["wall_s"] = round(time.monotonic() - t0, 1)
+            report["engines"] = per
+            report["engine_identity"] = {
+                "shas": {e: c.get("output_sha256") for e, c in per.items()},
+                "identical": len(per) == len(engines) and len({
+                    c.get("output_sha256") for c in per.values()
+                }) == 1,
+            }
+            report["rss_ok"] = bool(per) and all(
+                c["rss_mb"] / 1024.0 < args.rss_limit_gb
+                for c in per.values()
+            )
+            # self-describing acceptance check: which host phase dominates
+            # each stage, per engine (the bucket engine's goal is that this
+            # stops being sort_write on multi-core hosts)
+            report["largest_host_phase"] = {
+                e: {s: _largest_host_phase(st)
+                    for s, st in c["stages"].items()}
+                for e, c in per.items()
+            }
+            for e, c in per.items():
+                report[f"{e}_records_per_s"] = round(
+                    c["n_records"] / c["pipeline_s"], 1
+                )
+            report["ok"] = (
+                "error" not in report
+                and bool(report["rss_ok"])
+                and report["engine_identity"]["identical"]
+            )
+            raise _Done
         cp = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", workdir,
              str(args.families)]
@@ -362,6 +451,8 @@ def main() -> int:
             report["ok"] = bool(report["rss_ok"]) and (
                 args.backend != "tpu" or child.get("backend") == "tpu"
             )
+    except _Done:
+        pass
     except subprocess.TimeoutExpired:
         report["error"] = f"child timed out after {args.timeout}s"
         report["wall_s"] = round(time.monotonic() - t0, 1)
