@@ -675,6 +675,117 @@ def _child_bucket() -> None:
     }))
 
 
+def _child_routes() -> None:
+    """BSSEQ_BENCH_ROUTES quick leg (ISSUE 13): per-route pad-waste
+    attribution. The same skewed molecular corpus through every dispatch
+    route (single device, sharded mesh, wire, wire round-robin) under
+    both kernel layouts, byte-identity asserted across ALL runs
+    in-artifact. Per route the block carries the issued-cell pad
+    fraction for each layout (device-issued denominator — the
+    `stage_stats` definition), the packed-rows-issued ledger counters,
+    and the collapse (padded pad_fraction minus packed) — the
+    ISSUE-13 claim, measured, not projected."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    jax.config.update("jax_platforms", "cpu")
+    import hashlib
+
+    from bsseqconsensusreads_tpu.io.bam import RawRecords, encode_record
+    from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+    from bsseqconsensusreads_tpu.pipeline.calling import (
+        StageStats,
+        call_molecular_batches,
+    )
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_grouped_bam_records,
+        random_genome,
+    )
+
+    n_families = int(os.environ.get("BSSEQ_BENCH_ROUTES_FAMILIES", "400"))
+    rng = np.random.default_rng(29)
+    gname, genome = random_genome(rng, max(20_000, n_families * 50))
+    # heavy-tailed family sizes — the UMI reality the packed layout
+    # exists for: a sparse giant tail drags the padded [F,T,2,W]
+    # envelope's T bucket up for every small family in the batch
+    n_giant = max(1, n_families // 16)
+    records = make_grouped_bam_records(
+        rng, gname, genome, n_families=n_families - n_giant,
+        reads_per_strand=(1, 2),
+    )[1]
+    giants = make_grouped_bam_records(
+        rng, gname, genome, n_families=n_giant, reads_per_strand=(16, 24)
+    )[1]
+    for r in giants:
+        r.set_tag("MI", "G" + str(r.get_tag("MI")), "Z")
+    records = records + giants
+    # stream order (batching='sequential' below): giants interleave with
+    # small families exactly as a sorted stream delivers them, so the
+    # padded envelope's per-batch T bucket is set by the deepest family
+    # in each batch — the waste the packed layout deletes. The bucketed
+    # batcher would hide this by re-sorting families into depth-
+    # homogeneous batches, which streaming/serve dispatch cannot do.
+    rng.shuffle(records)
+    # no singleton host diversion: every batch shows its device layout
+    os.environ["BSSEQ_TPU_SINGLETON"] = "0"
+    mesh = make_mesh(n_data=8, n_reads=1)
+    route_cfg = {
+        "single": {},
+        "sharded": {"mesh": mesh},
+        "wire": {"transport": "wire"},
+        "wire_mc": {"mesh": mesh, "transport": "wire"},
+    }
+    _progress("input-done", records=len(records))
+    digests = set()
+    per_route: dict = {}
+    for name, kw in route_cfg.items():
+        entry: dict = {}
+        for layout in ("padded", "packed"):
+            os.environ["BSSEQ_TPU_KERNEL_LAYOUT"] = layout
+            st = StageStats(stage="molecular")
+            h = hashlib.sha256()
+            t0 = time.monotonic()
+            for batch in call_molecular_batches(
+                list(records), batch_families=64, mesh=kw.get("mesh"),
+                transport=kw.get("transport", "unpacked"), stats=st,
+                batching="sequential",
+            ):
+                for item in batch:
+                    h.update(
+                        item.blob if isinstance(item, RawRecords)
+                        else encode_record(item)
+                    )
+            wall = time.monotonic() - t0
+            digests.add(h.hexdigest())
+            entry[layout] = {
+                "wall_s": round(wall, 3),
+                "cells_issued": int(st.pad_cells + st.used_cells),
+                "pad_fraction": round(st.pad_waste, 4),
+            }
+            if layout == "packed":
+                c = st.metrics.counters
+                entry["route_batches"] = c.get(f"route_batches_{name}", 0)
+                entry["packed_rows_issued"] = c.get(
+                    f"packed_rows_issued_{name}", 0
+                )
+        entry["pad_fraction_collapse"] = round(
+            entry["padded"]["pad_fraction"]
+            - entry["packed"]["pad_fraction"], 4,
+        )
+        per_route[name] = entry
+        _progress("route-done", route=name)
+    print(json.dumps({
+        "routes": {
+            "records": len(records),
+            "families": n_families,
+            "batching": "sequential",
+            "byte_identical_across_routes_and_layouts": len(digests) == 1,
+            "per_route": per_route,
+        }
+    }))
+
+
 def _child(backend: str) -> None:
     """Device-measurement child: prints ONE JSON line {"rate", "backend"}.
 
@@ -816,6 +927,7 @@ def _run_child(mode: str, tmo: int) -> tuple[dict | None, str | None, str]:
                     "rate" in d
                     or "host_scaling" in d
                     or "bucket_emit" in d
+                    or "routes" in d
                     or d.get("probe") is True
                 ):
                     return d, None, last_phase
@@ -942,6 +1054,56 @@ def _measure_bucket_emit() -> dict | None:
     if payload is not None:
         return payload.get("bucket_emit")
     return {"error": failure}
+
+
+def _measure_routes() -> dict | None:
+    """The ISSUE-13 per-route pad-waste leg: the same skewed molecular
+    corpus through single/sharded/wire/wire_mc under both kernel
+    layouts, byte-identity asserted in-child, pad_fraction + packed-rows
+    ledger counters attributed per route. BSSEQ_BENCH_ROUTES=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_ROUTES", "1") == "0":
+        return None
+    payload, failure, _ = _run_child(
+        "routes", _env_timeout("BSSEQ_BENCH_ROUTES_TIMEOUT", 900)
+    )
+    if payload is not None:
+        return payload.get("routes")
+    return {"error": failure}
+
+
+def _run_pallas_interp_quick() -> dict | None:
+    """tools/pallas_tpu_parity.py --interpret -> PALLAS_INTERP_HEAD.json:
+    the Mosaic-targeted case matrix through the Pallas interpreter on
+    CPU — the committed evidence that the kernels stay runnable at HEAD
+    without an accelerator (on-chip stays the one-command default form
+    of the same tool). Best-effort and cpu-pinned like the chaos drill.
+    BSSEQ_BENCH_PALLAS_INTERP=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_PALLAS_INTERP", "1") == "0":
+        return None
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "pallas_tpu_parity.py",
+    )
+    out_path = os.path.join(os.getcwd(), "PALLAS_INTERP_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, tool, "--interpret", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_PALLAS_INTERP_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "cases": len(data.get("cases") or []),
+            "max_qual_delta": data.get("max_qual_delta"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
 def _run_chaos_quick() -> dict | None:
@@ -1134,6 +1296,8 @@ def main() -> None:
             _child_hostscale()
         elif sys.argv[2] == "bucket":
             _child_bucket()
+        elif sys.argv[2] == "routes":
+            _child_routes()
         else:
             _child(sys.argv[2])
         return
@@ -1277,6 +1441,30 @@ def main() -> None:
                     "byte_identical_across_engines"
                 ),
                 "reference_engine": bucket.get("reference_engine"),
+            },
+            sink=ledger_sink,
+        )
+    routes = _measure_routes()
+    if routes is not None:
+        out["routes"] = routes
+        observe.emit(
+            "bench_routes",
+            {
+                "byte_identical": routes.get(
+                    "byte_identical_across_routes_and_layouts"
+                ),
+                "routes": sorted(routes.get("per_route") or {}),
+            },
+            sink=ledger_sink,
+        )
+    pallas_interp = _run_pallas_interp_quick()
+    if pallas_interp is not None:
+        out["pallas_interp"] = pallas_interp
+        observe.emit(
+            "bench_pallas_interp",
+            {
+                "ok": pallas_interp.get("ok"),
+                "path": pallas_interp.get("path"),
             },
             sink=ledger_sink,
         )

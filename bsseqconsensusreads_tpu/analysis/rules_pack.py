@@ -87,11 +87,100 @@ def check_padded_batch_flops(
         )
 
 
+#: Attributes that mark a segment-packed plan in scope on the batch object.
+_PLAN_ATTRS = frozenset({"packed", "packed_shards"})
+
+
+def _is_envelope_dispatcher(basename: str | None) -> bool:
+    """Call basenames that ship input tensors to a multi-device or wire
+    route: the sharded shard_map wrappers, the wire input packers, and
+    the mesh family padder. Packed-aware callees ('packed'/'rows' in the
+    name — pack_molecular_rows_wire, sharded_molecular_rows) are the fix,
+    not the finding."""
+    if not basename or "packed" in basename or "rows" in basename:
+        return False
+    return (
+        basename.startswith(("sharded_", "pack_"))
+        or "wire" in basename
+        or basename == "pad_families"
+    )
+
+
+def _own_nodes(func: ast.AST):
+    """Walk a function body without descending into nested defs — plan
+    availability is judged per closure, not per module."""
+    stack = list(ast.iter_child_nodes(func))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_padded_envelope_dispatch(
+    sf: SourceFile, index: PackageIndex
+) -> Iterator[Finding]:
+    """padded-envelope-dispatch: a hot-path multi-device/wire dispatch
+    handed the dense `[F, T, 2, W]` tensors (`<batch>.bases`) inside a
+    function where that batch's segment-packed plan (`<batch>.packed` /
+    `.packed_shards`) is available."""
+    for func in ast.walk(sf.tree):
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        own = list(_own_nodes(func))
+        plan_objs = {
+            n.value.id
+            for n in own
+            if isinstance(n, ast.Attribute)
+            and n.attr in _PLAN_ATTRS
+            and isinstance(n.value, ast.Name)
+        }
+        if not plan_objs:
+            continue
+        for call in own:
+            if not isinstance(call, ast.Call):
+                continue
+            if not _is_envelope_dispatcher(call_basename(call)):
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            envelope = any(
+                isinstance(sub, ast.Attribute)
+                and sub.attr == "bases"
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id in plan_objs
+                for a in args
+                for sub in ast.walk(a)
+            )
+            if not envelope or not index.in_hot_path(sf, call):
+                continue
+            yield Finding(
+                rule="padded-envelope-dispatch",
+                path=sf.display,
+                line=call.lineno,
+                col=call.col_offset,
+                message=(
+                    "padded-envelope dispatch: this multi-device/wire "
+                    "call ships the dense [F, T, 2, W] tensors while the "
+                    "batch's segment-packed plan (.packed) is in scope — "
+                    "dispatch the packed rows instead "
+                    "(parallel.sharding.sharded_molecular_rows / "
+                    "ops.wire.pack_molecular_rows_wire)"
+                ),
+            )
+
+
 RULES = [
     Rule(
         name="padded-batch-flops",
         summary="3+ ragged dims padded to batch maxima in one hot-path "
         "allocation",
         check=check_padded_batch_flops,
+    ),
+    Rule(
+        name="padded-envelope-dispatch",
+        summary="dense [F,T,2,W] tensors handed to a multi-device/wire "
+        "dispatch while a segment-packed plan is in scope",
+        check=check_padded_envelope_dispatch,
     ),
 ]

@@ -40,6 +40,7 @@ def _try_load():
         env_flag="BSSEQ_TPU_NATIVE_WIRE",
         required_symbols=(
             "wirepack_pack_duplex",
+            "wirepack_pack_rows",
             "wirepack_unpack_duplex_outputs",
             "wirepack_unpack_duplex_b0",
             "wirepack_duplex_rawize",
@@ -60,6 +61,11 @@ def _try_load():
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
         C.c_int64, C.c_int64, C.c_int64, C.c_int,
         C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
+    ]
+    lib.wirepack_pack_rows.restype = C.c_int
+    lib.wirepack_pack_rows.argtypes = [
+        C.c_void_p, C.c_void_p, C.c_int64, C.c_int64, C.c_int,
+        C.c_void_p, C.c_void_p, C.c_void_p, C.c_void_p,
     ]
     lib.wirepack_unpack_duplex_outputs.restype = None
     lib.wirepack_unpack_duplex_outputs.argtypes = [
@@ -204,6 +210,57 @@ def pack_duplex(bases, quals, cover, convert_mask, eligible, qual_mode):
         nib.view(np.uint32),
         qual[: qual_len.value].view(np.uint32).copy(),
         meta.view(np.uint32),
+        _BITS_MODE[bits],
+    )
+
+
+def pack_rows(bases, quals, qual_mode):
+    """Native pack of segment-packed rows -> (nib, qual u32 arrays, mode).
+
+    bases int8 [n, 2, w], quals uint8 [n, 2, w]; cover is derived in the C
+    sweep (base != NBASE) so no [n, 2, w] bool plane is ever materialized.
+    The nib/qual bytes are identical to pack_duplex on the same rows with
+    derived cover — the ops.wire packed wire v2 body sections.
+    """
+    _try_load()
+    if _lib is None:
+        raise OSError(_load_error or "native wirepack unavailable")
+    n, _, w = bases.shape
+    cells = n * 2 * w
+    if cells % 2:
+        raise ValueError(f"rows wire pack needs an even n*2*w, got {cells}")
+    bases = np.ascontiguousarray(bases, dtype=np.int8)
+    quals = np.ascontiguousarray(quals, dtype=np.uint8)
+    nib = np.empty((cells // 2 + 3) // 4 * 4, dtype=np.uint8)
+    qual = np.empty(cells + 24, dtype=np.uint8)
+    qual_len = C.c_int64(0)
+    nlevels = C.c_int(0)
+    bits = _lib.wirepack_pack_rows(
+        bases.ctypes.data_as(C.c_void_p),
+        quals.ctypes.data_as(C.c_void_p),
+        n, w, _MODE_BITS[qual_mode],
+        nib.ctypes.data_as(C.c_void_p),
+        qual.ctypes.data_as(C.c_void_p),
+        C.byref(qual_len),
+        C.byref(nlevels),
+    )
+    if bits == _ERR_QUAL_TOO_HIGH:
+        raise ValueError(
+            "covered qual > 93 (BAM printable max) cannot ride a "
+            f"{qual_mode} codebook; use qual_mode='q8' or 'auto'"
+        )
+    if bits == _ERR_TOO_MANY_LEVELS:
+        raise ValueError(
+            f"{nlevels.value} distinct covered quals exceed {qual_mode}'s "
+            f"{1 << _MODE_BITS[qual_mode]}-entry codebook; use "
+            "qual_mode='auto'"
+        )
+    if bits < 0:
+        raise ValueError(f"native wirepack error {bits}")
+    nib[cells // 2 :] = 0
+    return (
+        nib.view(np.uint32),
+        qual[: qual_len.value].view(np.uint32).copy(),
         _BITS_MODE[bits],
     )
 

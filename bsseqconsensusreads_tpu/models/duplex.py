@@ -331,13 +331,16 @@ def unpack_duplex_b0_outputs(packed, f: int, w: int) -> dict:
     return _decode_b0(u8[: f * 2 * w].reshape(f, 2, w), np)
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "vote_kernel"))
+@partial(jax.jit, static_argnames=(
+    "f", "w", "params", "qual_mode", "vote_kernel", "layout"
+))
 def duplex_call_wire(
     nib, qual, meta, starts, limits, genome,
     f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """The tunnel-optimal fused duplex stage: ONE flat u32 array each way.
 
@@ -360,19 +363,22 @@ def duplex_call_wire(
     ref = gather_windows(genome, starts, limits, w + 1)
     out = duplex_call_pipeline(
         bases, quals, cover, ref, convert_mask, eligible, params=params,
-        vote_kernel=vote_kernel,
+        vote_kernel=vote_kernel, layout=layout,
     )
     packed = pack_duplex_b0_outputs(out)
     return jnp.concatenate([packed, pack_lard(out["la"], out["rd"])])
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r", "vote_kernel"))
+@partial(jax.jit, static_argnames=(
+    "f", "w", "params", "qual_mode", "r", "vote_kernel", "layout"
+))
 def duplex_call_wire_fused(
     words, genome, f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
     r: int = 4,
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """duplex_call_wire with ONE u32 input array (DuplexWire.to_words()).
 
@@ -393,7 +399,7 @@ def duplex_call_wire_fused(
     )
     return duplex_call_wire(
         nib, qual, meta, starts, limits, genome, f, w, params, qual_mode,
-        vote_kernel,
+        vote_kernel, layout,
     )
 
 
@@ -468,13 +474,16 @@ def duplex_call_pipeline_packed_methyl(
     return pack_duplex_outputs(out), out["la"], out["rd"], planes
 
 
-@partial(jax.jit, static_argnames=("f", "w", "params", "qual_mode", "r", "vote_kernel"))
+@partial(jax.jit, static_argnames=(
+    "f", "w", "params", "qual_mode", "r", "vote_kernel", "layout"
+))
 def duplex_call_wire_fused_methyl(
     words, genome, f: int, w: int,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     qual_mode: str = "q8",
     r: int = 4,
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """duplex_call_wire_fused + fused methyl epilogue, one wire each way.
 
@@ -516,7 +525,7 @@ def duplex_call_wire_fused_methyl(
     ref_ext = gather_windows_ext(genome, starts, los, limits, w + 4)
     out = duplex_call_pipeline(
         bases, quals, cover, ref, convert_mask, eligible, params=params,
-        vote_kernel=vote_kernel,
+        vote_kernel=vote_kernel, layout=layout,
     )
     planes = methyl_epilogue(
         bases, quals, cover, convert_mask, out["base"], ref_ext,

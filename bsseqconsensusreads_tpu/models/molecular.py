@@ -722,3 +722,49 @@ def molecular_wire_kernel(kernel_fn=None):
     packed wire on a transfer-bound link, bit-identical results (the
     codebook is lossless, the counts are exact integer tallies)."""
     return _wire_kernel_cached(kernel_fn or molecular_consensus)
+
+
+@lru_cache(maxsize=8)
+def _rows_wire_kernel_cached(vote_kernel: str):
+    @partial(jax.jit, static_argnames=(
+        "n_rows", "num_families", "w", "params", "qual_mode"
+    ))
+    def fn(
+        words, n_rows: int, num_families: int, w: int,
+        params: ConsensusParams = ConsensusParams(),
+        qual_mode: str = "q8",
+    ):
+        from bsseqconsensusreads_tpu.ops.wire import (
+            split_molecular_rows_wire,
+            unpack_rows_wire_inputs,
+        )
+
+        nib, qual, seg, _offsets = split_molecular_rows_wire(
+            words, n_rows, num_families, w, qual_mode=qual_mode
+        )
+        bases, quals = unpack_rows_wire_inputs(
+            nib, qual, n_rows, w, qual_mode=qual_mode
+        )
+        out = molecular_consensus_packed(
+            bases, quals, seg.astype(jnp.int32), num_families, params,
+            vote_kernel,
+        )
+        return pack_molecular_slim_outputs(out)
+
+    return fn
+
+
+def molecular_wire_packed_kernel(vote_kernel: str = "xla"):
+    """Jitted `fn(words, n_rows, num_families, w, params, qual_mode) ->
+    slim u32 wire`: the wire route on the segment-packed row layout.
+
+    Input is ops.wire.pack_molecular_rows_wire's v2 wire (header +
+    offsets/seg planes + the dense-row nib/qual body) — the wire's cell
+    count tracks real reads instead of the [F, T, 2, W] bucket ceiling,
+    so round-robin dispatch ships and votes only what was sequenced. The
+    vote is the stock segment-sum kernel (molecular_consensus_packed,
+    bit-identical to the padded envelope); the output is the same SLIM
+    wire as molecular_wire_kernel, so the retire path
+    (recompute_molecular_counts against the host envelope) is shared
+    verbatim across wire versions."""
+    return _rows_wire_kernel_cached(vote_kernel)

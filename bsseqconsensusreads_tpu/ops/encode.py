@@ -135,6 +135,10 @@ class MolecularBatch:
     #: segment-packed twin (pack_molecular_rows), filled by the encode phase
     #: when the packed kernel layout is active; None under layout=padded
     packed: "PackedRows | None" = None
+    #: mesh-sharded split of `packed` (shard_packed_rows), filled by the
+    #: encode phase when the packed layout dispatches on a sharded mesh;
+    #: None on single-device / wire routes and under layout=padded
+    packed_shards: "ShardedPackedRows | None" = None
 
     @property
     def shape(self) -> tuple[int, int, int]:
@@ -209,6 +213,63 @@ def pack_molecular_rows(batch: "MolecularBatch") -> PackedRows | None:
         seg = seg.copy()
     # real-family ids stay < f <= f_pad; only pad rows use the sentinel
     return PackedRows(rows_b, rows_q, seg, f_pad, n)
+
+
+@dataclasses.dataclass
+class ShardedPackedRows:
+    """A PackedRows plan split across mesh devices at FAMILY boundaries.
+
+    Shard s owns the contiguous family range [s * fams_per_shard,
+    (s + 1) * fams_per_shard); because PackedRows.seg is ascending, each
+    shard's rows are one contiguous slice of the packed row axis — no
+    family ever straddles a device split, so every shard runs the plain
+    single-device segment-sum on LOCAL family ids with zero collectives
+    and the reduction stays bit-identical to the unsharded pack. Shards
+    share one row bucket (the pow2 ceiling of the fullest shard): uneven
+    shards pad with sentinel rows exactly like the single-device pack.
+    """
+
+    bases: np.ndarray  # int8 [S, R, 2, W]
+    quals: np.ndarray  # uint8 [S, R, 2, W]
+    seg: np.ndarray  # int32 [S, R] LOCAL ids; pad rows = fams_per_shard
+    fams_per_shard: int  # families each shard votes (pow2-bucket / S, ceil)
+    n_shards: int
+    total_families: int  # n_shards * fams_per_shard — what the fetch trims
+    n_real_rows: int  # rows carrying data across all shards
+
+
+def shard_packed_rows(packed: PackedRows, n_shards: int) -> ShardedPackedRows:
+    """Split a packed plan across `n_shards` devices at family boundaries.
+
+    Row ranges come from one searchsorted over the ascending seg ids; the
+    original plan's trailing sentinel rows are dropped and each shard
+    re-pads to the shared row bucket. Local ids are global ids minus the
+    shard's family offset, so concatenating the per-shard outputs
+    family-major reproduces the single-device output order exactly.
+    """
+    n = packed.n_real_rows
+    seg = packed.seg[:n]
+    _, _, w = packed.bases.shape
+    fs = -(-packed.num_families // n_shards)  # ceil: every family owned once
+    cuts = np.searchsorted(
+        seg, np.arange(n_shards + 1, dtype=np.int64) * fs, side="left"
+    )
+    widest = int(np.max(cuts[1:] - cuts[:-1])) if n else 0
+    r = bucket_pow2(widest, MIN_PACKED_ROWS)
+    # graftlint: disable=padded-batch-flops -- this IS the packed plan:
+    # the row axis is dense reads (bucket-rounded), not a template envelope
+    bases = np.full((n_shards, r, 2, w), NBASE, np.int8)
+    # graftlint: disable=padded-batch-flops -- same packed-plan allocation
+    quals = np.zeros((n_shards, r, 2, w), np.uint8)
+    seg_out = np.full((n_shards, r), fs, np.int32)
+    for s in range(n_shards):
+        i, j = int(cuts[s]), int(cuts[s + 1])
+        bases[s, : j - i] = packed.bases[i:j]
+        quals[s, : j - i] = packed.quals[i:j]
+        seg_out[s, : j - i] = seg[i:j] - s * fs
+    return ShardedPackedRows(
+        bases, quals, seg_out, fs, n_shards, fs * n_shards, n
+    )
 
 
 def _round_up(n: int, multiple: int) -> int:

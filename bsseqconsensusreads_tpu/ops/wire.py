@@ -173,7 +173,22 @@ def wire_section_sizes(
 
 def split_duplex_wire(words, f: int, w: int, r: int = 4, qual_mode: str = "q8"):
     """Device-side (jit-traceable) split of DuplexWire.to_words() back into
-    the (nib, qual, meta, starts, limits) section arrays."""
+    the (nib, qual, meta, starts, limits) section arrays.
+
+    Version refusal: a packed-rows wire (v2, pack_molecular_rows_wire)
+    leads with PACKED_WIRE_MAGIC where a v1 wire carries starts[0]; when
+    called host-side with a numpy array the magic is rejected here instead
+    of parsing the v2 header planes as genome offsets. Under jit the
+    argument is a tracer (not np.ndarray), so the traced program is
+    unchanged — the guard runs where the bytes are still host-visible.
+    """
+    if isinstance(words, np.ndarray) and words.size and (
+        int(words[0]) == PACKED_WIRE_MAGIC
+    ):
+        raise ValueError(
+            "packed rows wire (v2 magic word) passed to the v1 duplex wire "
+            "splitter; unpack with split_molecular_rows_wire"
+        )
     sizes = wire_section_sizes(f, w, r, qual_mode)
     offs = [0]
     for s in sizes:
@@ -298,6 +313,148 @@ def pack_molecular_inputs(
         np.zeros(f, dtype=np.uint32),
         qual_mode=qual_mode,
     )
+
+
+# ---- packed wire v2: segment-packed rows ---------------------------------
+#
+# The v1 wire above ships the [F, T, 2, W] padding envelope (r = 2T rows
+# per family, pad templates and all). v2 ships the segment-packed row plan
+# instead: a version-tagged header, the per-family row-offset plane, the
+# per-row segment-id plane, then the v1 nib/qual body for the dense
+# [N, 2, W] row axis — the wire's cell count tracks real reads, not the
+# bucket ceiling. v1 wires still parse everywhere they did (nothing about
+# their layout changed); the two formats refuse each other by the magic
+# word (split_duplex_wire / split_molecular_rows_wire guards).
+
+#: Leading word of every packed-rows wire ("2QSB" little-endian — chosen
+#: never to collide with a v1 MOLECULAR wire, whose first word is
+#: starts[0] == 0 by construction in pack_molecular_inputs).
+PACKED_WIRE_MAGIC = 0x42535132
+
+#: Header words: magic, n_rows, num_families, n_real_rows, w, qual-mode
+#: code (_ROWS_QUAL_CODE), 2 reserved zeros.
+PACKED_WIRE_HDR = 8
+
+_ROWS_QUAL_CODE = {"q8": 0, "q2": 1, "q4": 2}
+_ROWS_CODE_QUAL = {v: k for k, v in _ROWS_QUAL_CODE.items()}
+
+
+def rows_wire_section_sizes(
+    n_rows: int, num_families: int, w: int, qual_mode: str = "q8"
+) -> tuple[int, ...]:
+    """u32 word counts of the packed-rows wire sections, in order:
+    header, row offsets, segment ids, nib, qual."""
+    v1 = wire_section_sizes(n_rows, w, r=2, qual_mode=qual_mode)
+    return (PACKED_WIRE_HDR, num_families + 1, n_rows, v1[3], v1[4])
+
+
+def pack_molecular_rows_wire(
+    bases: np.ndarray,
+    quals: np.ndarray,
+    seg: np.ndarray,
+    num_families: int,
+    n_real_rows: int,
+    qual_mode: str = "auto",
+) -> tuple[np.ndarray, str]:
+    """Pack a segment-packed row plan (ops.encode.PackedRows arrays) into
+    ONE flat u32 wire — the packed wire v2.
+
+    bases int8 [N, 2, W] (row-bucketed, pad rows all-NBASE), quals uint8
+    [N, 2, W], seg int32 [N] ascending family ids (pad rows carry the
+    sentinel `num_families`). Returns (words, resolved_qual_mode); the
+    resolved mode plus (N, num_families, w) are the static split keys the
+    device kernel needs (models.molecular.molecular_wire_packed_kernel) —
+    the header carries them too, for host-side validation.
+
+    Layout: header ++ row offsets u32 [num_families + 1] (family i's rows
+    are [off[i], off[i+1]); off[num_families] == n_real_rows) ++ seg u32
+    [N] ++ the v1 nib/qual body of the [N, 2, W] rows (native
+    wirepack_pack_rows sweep when built — cover derives from the bases, so
+    no bool plane is materialized; numpy pack_duplex_inputs otherwise).
+    """
+    n, _, w = bases.shape
+    if qual_mode not in ("q8", "auto", "q2", "q4"):
+        raise ValueError(
+            f"qual_mode must be one of 'q8', 'auto', 'q2', 'q4'; "
+            f"got {qual_mode!r}"
+        )
+    seg = np.ascontiguousarray(seg, dtype=np.int32)
+    offsets = np.searchsorted(
+        seg, np.arange(num_families + 1, dtype=np.int64), side="left"
+    ).astype(np.uint32)
+    from bsseqconsensusreads_tpu.io import wirepack as _native
+
+    if _native.available():
+        nib, qual, resolved = _native.pack_rows(bases, quals, qual_mode)
+    else:
+        from bsseqconsensusreads_tpu.alphabet import NBASE
+
+        dw = pack_duplex_inputs(
+            bases, quals, bases != NBASE,
+            np.zeros((n, 2), dtype=bool), np.zeros(n, dtype=bool),
+            np.zeros(n, dtype=np.uint32), np.zeros(n, dtype=np.uint32),
+            qual_mode=qual_mode,
+        )
+        nib, qual, resolved = dw.nib, dw.qual, dw.qual_mode
+    header = np.array(
+        [
+            PACKED_WIRE_MAGIC, n, num_families, n_real_rows, w,
+            _ROWS_QUAL_CODE[resolved], 0, 0,
+        ],
+        dtype=np.uint32,
+    )
+    return (
+        np.concatenate([header, offsets, seg.astype(np.uint32), nib, qual]),
+        resolved,
+    )
+
+
+def split_molecular_rows_wire(
+    words, n_rows: int, num_families: int, w: int, qual_mode: str = "q8"
+):
+    """Device-side (jit-traceable) split of a packed-rows wire (v2) into
+    (nib, qual, seg u32 [n_rows], offsets u32 [num_families + 1]).
+
+    Version refusal: called host-side with a numpy array, a wire whose
+    leading word is not PACKED_WIRE_MAGIC (e.g. a v1 DuplexWire) or whose
+    header disagrees with the static split keys is rejected before any
+    section is mis-sliced. Under jit the words are a tracer and the traced
+    slicing is unconditional — validate at the host boundary.
+    """
+    if isinstance(words, np.ndarray):
+        if not words.size or int(words[0]) != PACKED_WIRE_MAGIC:
+            raise ValueError(
+                "not a packed rows wire (v2): leading magic word missing "
+                "— v1 wires unpack with split_duplex_wire"
+            )
+        hdr = (int(words[1]), int(words[2]), int(words[4]),
+               _ROWS_CODE_QUAL.get(int(words[5])))
+        want = (n_rows, num_families, w, qual_mode)
+        if hdr != want:
+            raise ValueError(
+                f"packed rows wire header {hdr} does not match the split "
+                f"keys {want}"
+            )
+    sizes = rows_wire_section_sizes(n_rows, num_families, w, qual_mode)
+    offs = [0]
+    for s in sizes:
+        offs.append(offs[-1] + s)
+    _, offsets, seg, nib, qual = (
+        words[offs[i] : offs[i + 1]] for i in range(5)
+    )
+    return nib, qual, seg, offsets
+
+
+def unpack_rows_wire_inputs(nib, qual, n_rows: int, w: int,
+                            qual_mode: str = "q8"):
+    """Device-side unpack of the v2 body -> (bases int8 [n_rows, 2, w],
+    quals uint8 [n_rows, 2, w]). The meta/cover planes of the duplex
+    unpack don't exist here: observation is NBASE-coded in the bases."""
+    bases, quals, _, _, _ = unpack_duplex_inputs(
+        nib, qual, jnp.zeros((n_rows + 3) // 4, jnp.uint32), n_rows, w,
+        r=2, qual_mode=qual_mode,
+    )
+    return bases, quals
 
 
 def unpack_duplex_inputs(nib, qual, meta, f: int, w: int, r: int = 4,

@@ -24,11 +24,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from bsseqconsensusreads_tpu.models.molecular import (
+    _vote_finalize_dispatch,
     count_errors,
+    errors_from_counts,
     narrow_outputs,
     overlap_cocall,
     vote_finalize,
     vote_partials,
+    vote_partials_segments,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
 from bsseqconsensusreads_tpu.parallel.mesh import (
@@ -73,5 +76,60 @@ def deep_family_consensus(mesh: Mesh, params: ConsensusParams = ConsensusParams(
             return jax.tree.map(lambda a, c: jnp.stack([a, c]), outs[0], outs[1])
 
         return narrow_outputs(jax.vmap(one_family)(bases, quals))
+
+    return fn
+
+
+@functools.lru_cache(maxsize=16)
+def deep_family_consensus_rows(
+    mesh: Mesh,
+    params: ConsensusParams = ConsensusParams(),
+    vote_kernel: str = "xla",
+):
+    """deep_family_consensus on the segment-packed row layout.
+
+    Same sharding contract — bases/quals [F, T, 2, W], families over
+    'data', templates over 'reads' — but each device votes its local
+    template slab as packed rows (seg = family id per row, ONE
+    segment-sum for the whole shard) instead of vmapping a per-family
+    vote, then psums the partial ll/count/depth planes over the reads
+    axis exactly like the padded deep route. Template-pad rows stay in
+    the row set: _vote_contrib gives unobserved cells exact-0.0
+    contributions, the same zeros the padded sum adds, so the packed
+    deep route is bit-identical to deep_family_consensus (and carries
+    the same documented qual ±1 relaxation vs the single-device kernel
+    — the finalize runs on psum'd sums either way). The errors plane
+    derives from the psum'd per-base counts (errors_from_counts), which
+    drops the padded route's second reads-axis sweep + third psum.
+    """
+    in_spec = P(DATA_AXIS, READS_AXIS)
+    out_spec = P(DATA_AXIS)
+
+    # check_vma=False: the only collectives are the explicit psums; the
+    # pallas finalize leg's outputs carry no vma metadata for the checker
+    @jax.jit
+    @shard_map(
+        mesh=mesh, in_specs=(in_spec, in_spec), out_specs=out_spec,
+        check_vma=False,
+    )
+    def fn(bases, quals):
+        quals = quals.astype(jnp.float32)
+        if params.consensus_call_overlapping_bases:
+            # co-call is within-template: local to each reads shard
+            bases, quals = overlap_cocall(bases, quals)
+        f, t, _, w = bases.shape
+        seg = jnp.repeat(jnp.arange(f, dtype=jnp.int32), t)
+        ll, cnt, depth = vote_partials_segments(
+            bases.reshape(f * t, 2, w), quals.reshape(f * t, 2, w),
+            seg, f, params,
+        )
+        ll = jax.lax.psum(ll, READS_AXIS)
+        cnt = jax.lax.psum(cnt, READS_AXIS)
+        depth = jax.lax.psum(depth, READS_AXIS)
+        cons, qual = _vote_finalize_dispatch(ll, depth, params, vote_kernel)
+        errors = errors_from_counts(cnt, depth, cons)
+        return narrow_outputs(
+            {"base": cons, "qual": qual, "depth": depth, "errors": errors}
+        )
 
     return fn

@@ -19,6 +19,7 @@ from bsseqconsensusreads_tpu.models.duplex import (
 )
 from bsseqconsensusreads_tpu.models.molecular import (
     molecular_consensus,
+    molecular_consensus_packed,
     pack_molecular_outputs,
 )
 from bsseqconsensusreads_tpu.models.params import ConsensusParams
@@ -60,15 +61,22 @@ def sharded_molecular_consensus(
 
 
 @functools.lru_cache(maxsize=64)
-def sharded_molecular_packed(
+def sharded_molecular_outwire(
     mesh: Mesh,
     params: ConsensusParams = ConsensusParams(),
     kernel_fn=None,
 ):
-    """sharded_molecular_consensus with the packed planar output wire
+    """sharded_molecular_consensus with the packed planar OUTPUT wire
     (models.molecular.pack_molecular_outputs): each device packs its family
     shard, and the family-major layout makes the gathered concatenation
-    identical to a single-device pack — one D2H array instead of four."""
+    identical to a single-device pack — one D2H array instead of four.
+
+    Naming note: "outwire" is the transport pack of the result planes.
+    The segment-packed INPUT layout (ragged rows, no [F, T, 2, W]
+    envelope) is sharded_molecular_rows below — the two "packed" senses
+    used to share this function's old name, sharded_molecular_packed,
+    which survives as a deprecated alias.
+    """
     kernel_fn = kernel_fn or molecular_consensus
     spec = P(DATA_AXIS)
 
@@ -84,15 +92,59 @@ def sharded_molecular_packed(
 
 
 @functools.lru_cache(maxsize=64)
-def sharded_duplex_packed(
+def sharded_molecular_rows(
+    mesh: Mesh,
+    fams_per_shard: int,
+    params: ConsensusParams = ConsensusParams(),
+    vote_kernel: str = "xla",
+):
+    """Segment-packed molecular consensus over a family-sharded row plan.
+
+    Takes ops.encode.shard_packed_rows arrays — bases int8 [S, R, 2, W],
+    quals [S, R, 2, W], seg int32 [S, R] of LOCAL family ids — with the
+    shard axis split over the mesh's data axis. Every shard owns whole
+    families (the plan cuts the packed row axis at family boundaries), so
+    each device runs the stock single-device segment-sum on its slice:
+    zero collectives, bit-identical to the unsharded packed kernel, and
+    no [F, T, 2, W] envelope anywhere. Returns the 12-plane output wire
+    concatenated family-major — [S * fams_per_shard, 12, W], the same
+    bytes unpack_molecular_outputs expects from the outwire path.
+    """
+    spec = P(DATA_AXIS)
+
+    # check_vma=False: collective-free map (same rationale as above)
+    @jax.jit
+    @shard_map(
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    def fn(bases, quals, seg):
+        return pack_molecular_outputs(
+            molecular_consensus_packed(
+                bases[0], quals[0], seg[0], fams_per_shard, params,
+                vote_kernel,
+            )
+        )
+
+    return fn
+
+
+@functools.lru_cache(maxsize=64)
+def sharded_duplex_outwire(
     mesh: Mesh,
     params: ConsensusParams = ConsensusParams(min_reads=0),
     vote_kernel: str = "xla",
+    layout: str = "padded",
 ):
     """duplex_call_pipeline_packed (the production fused duplex stage with
     packed transport outputs) sharded over families — what
     pipeline.calling.call_duplex_batches dispatches on a multi-device
-    backend. Returns (packed, la, rd), all family-sharded."""
+    backend. Returns (packed, la, rd), all family-sharded.
+
+    layout selects the merge layout per shard ('packed' = the segment
+    pair-sum merge, duplex_consensus_packed); the wire bytes are identical
+    either way. See sharded_molecular_outwire for the "outwire" naming.
+    """
     spec = P(DATA_AXIS)
 
     # check_vma=False: collective-free map; pallas_call outputs carry no
@@ -107,10 +159,16 @@ def sharded_duplex_packed(
     def fn(bases, quals, cover, ref, convert_mask, extend_eligible):
         return duplex_call_pipeline_packed(
             bases, quals, cover, ref, convert_mask, extend_eligible,
-            params=params, vote_kernel=vote_kernel,
+            params=params, vote_kernel=vote_kernel, layout=layout,
         )
 
     return fn
+
+
+#: Deprecated aliases (pre-PR-13 names): "packed" here always meant the
+#: transport pack of the OUTPUT planes, not the segment-packed row layout.
+sharded_molecular_packed = sharded_molecular_outwire
+sharded_duplex_packed = sharded_duplex_outwire
 
 
 def sharded_duplex_pipeline(

@@ -1363,12 +1363,16 @@ def call_molecular_batches(
     (parallel.deep_family) — instead of being skipped; only beyond
     DEEP_TEMPLATE_CAP (int16 transport ceiling) are they skipped+reported.
 
-    transport: 'wire' packs each batch's input tensors into ONE u32 array
-    (ops.wire.pack_molecular_inputs — ~4x fewer H2D bytes, bit-identical
-    results); on a mesh it round-robins whole batches across the devices
-    (zero collectives, pipeline depth = device count). 'auto' engages the
-    single-device wire on accelerator runs, like call_duplex_batches;
-    'unpacked' forces plain tensors.
+    transport: 'wire' packs each batch's input into ONE u32 array — under
+    the packed layout the versioned packed-rows wire
+    (ops.wire.pack_molecular_rows_wire: segment ids + row offsets on the
+    u32 planes, then the dense-row body — the wire ships real reads, not
+    the envelope), under layout=padded the v1 envelope wire
+    (pack_molecular_inputs); bit-identical results either way. On a mesh
+    it round-robins whole batches across the devices (zero collectives,
+    pipeline depth = device count). 'auto' engages the single-device wire
+    on accelerator runs, like call_duplex_batches; 'unpacked' forces
+    plain tensors.
 
     base_counts: emit the cB per-column raw base histogram tag
     (models.molecular.molecular_base_counts) — the duplex stage's input
@@ -1382,12 +1386,17 @@ def call_molecular_batches(
 
     layout: 'packed' (default, or BSSEQ_TPU_KERNEL_LAYOUT) votes on
     segment-packed ragged rows (ops.encode.pack_molecular_rows — the
-    padding envelope never reaches the device; row/family counts bucket
+    padding envelope never reaches the kernel; row/family counts bucket
     to powers of two so compiles stay bounded, ledgered per batch as
     `bucket_*` counters); 'padded' keeps the [F, T, 2, W] envelope. The
-    packed route engages on single-device non-wire dispatch — the mesh
-    and wire transports keep the envelope (their pack formats are
-    envelope-shaped), documented in README "Kernel layout".
+    packed layout engages on EVERY route: single-device (the segment
+    kernel), mesh shard_map (the row axis split at family boundaries —
+    ops.encode.shard_packed_rows + parallel.sharding
+    sharded_molecular_rows), wire and wire round-robin (the packed-rows
+    wire v2), and the deep-family psum
+    (parallel.deep_family.deep_family_consensus_rows). Byte-identical
+    to the padded envelope on each route (tests/test_packed.py), with
+    per-route `route_batches_*`/`packed_rows_issued_*` ledger counters.
     """
     import os
 
@@ -1412,6 +1421,19 @@ def call_molecular_batches(
     sharded_fn = None
     deep_state: dict = {}
     wire_rr = _WireRoundRobin(mesh) if wire_mc else None
+    kernel_layout = _resolve_kernel_layout(layout)
+    singleton_on = os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
+    # the packed layout engages on EVERY dispatch route — single-device,
+    # mesh shard_map, wire, wire round-robin, deep-family — each voting on
+    # segment-packed rows, byte-identical to the padded envelope
+    # (tests/test_packed.py route matrix)
+    use_packed_rows = kernel_layout == "packed"
+    if use_packed_rows:
+        from bsseqconsensusreads_tpu.models.molecular import (
+            packed_molecular_segment_kernel,
+        )
+
+        seg_fn = packed_molecular_segment_kernel(kernel_choice)
     if use_wire:
         from bsseqconsensusreads_tpu.models.molecular import (
             molecular_wire_kernel,
@@ -1419,27 +1441,37 @@ def call_molecular_batches(
         from bsseqconsensusreads_tpu.ops.wire import pack_molecular_inputs
 
         wire_fn = molecular_wire_kernel(consensus_fn)
-    kernel_layout = _resolve_kernel_layout(layout)
-    singleton_on = os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
-    use_packed_rows = (
-        kernel_layout == "packed" and mesh is None and not use_wire
-    )
-    if use_packed_rows:
-        from bsseqconsensusreads_tpu.models.molecular import (
-            packed_molecular_segment_kernel,
-        )
+        if use_packed_rows:
+            from bsseqconsensusreads_tpu.models.molecular import (
+                molecular_wire_packed_kernel,
+            )
+            from bsseqconsensusreads_tpu.ops.wire import (
+                pack_molecular_rows_wire,
+            )
 
-        seg_fn = packed_molecular_segment_kernel(kernel_choice)
+            rows_wire_fn = molecular_wire_packed_kernel(kernel_choice)
     if mesh is None:
         packed_fn = packed_molecular_kernel(consensus_fn)
     elif not wire_mc:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
         from bsseqconsensusreads_tpu.parallel.sharding import (
-            sharded_molecular_packed,
+            sharded_molecular_outwire,
+            sharded_molecular_rows,
         )
 
         data_size = mesh.shape[DATA_AXIS]
-        sharded_fn = sharded_molecular_packed(mesh, params, kernel_fn=consensus_fn)
+        sharded_fn = sharded_molecular_outwire(
+            mesh, params, kernel_fn=consensus_fn
+        )
+    # dispatch-route label for the per-route ledger counters
+    # (route_batches_* / packed_rows_issued_* — bench's pad_fraction
+    # attribution reads these)
+    route_name = (
+        "sharded" if sharded_fn is not None
+        else "wire_mc" if wire_rr is not None
+        else "wire" if use_wire
+        else "single"
+    )
     pool, pool_depth = _make_overlap_pool(
         wire_rr, sharded_fn, stats, stats.stage or "molecular"
     )
@@ -1485,7 +1517,29 @@ def call_molecular_batches(
             return ("host", out), f
         if sharded_fn is None:
             pk = batch.packed if use_packed_rows else None
-            if pk is not None:
+            if pk is not None and use_wire:
+                # packed wire v2: the segment ids + row offsets ride the
+                # u32 planes ahead of the dense-row nib/qual body
+                # (ops.wire.pack_molecular_rows_wire) — the wire ships
+                # real reads, not the envelope. Output is the same slim
+                # wire as v1, so the retire path below is shared.
+                w = batch.bases.shape[-1]
+                words, qmode = pack_molecular_rows_wire(
+                    pk.bases, pk.quals, pk.seg, pk.num_families,
+                    pk.n_real_rows, qual_mode="auto",
+                )
+                if wire_rr is not None:  # round-robin device placement
+                    words = jax.device_put(words, wire_rr.next_device())
+                wire = (
+                    "slim",
+                    rows_wire_fn(
+                        words, n_rows=pk.bases.shape[0],
+                        num_families=pk.num_families, w=w, params=params,
+                        qual_mode=qmode,
+                    ),
+                )
+                pf = pk.num_families
+            elif pk is not None:
                 # segment-packed route: only the real read rows (bucket-
                 # padded) go to the device; outputs ride the same planar
                 # wire with pf = the pow2-bucketed family count, so the
@@ -1496,6 +1550,8 @@ def call_molecular_batches(
                 pf = pk.num_families
             elif use_wire:
                 t, w = batch.bases.shape[1], batch.bases.shape[-1]
+                # graftlint: disable=padded-envelope-dispatch -- the
+                # sanctioned layout='padded' wire: pk is None here
                 win = pack_molecular_inputs(
                     batch.bases, batch.quals, qual_mode="auto"
                 )
@@ -1513,7 +1569,22 @@ def call_molecular_batches(
             else:
                 wire = packed_fn(batch.bases, batch.quals, params)
                 pf = f
+        elif batch.packed_shards is not None and use_packed_rows:
+            # sharded segment-sum: the packed row axis split across the
+            # mesh at family boundaries (ops.encode.shard_packed_rows,
+            # built in the encode phase), each device voting its whole
+            # families on LOCAL segment ids — zero collectives, and the
+            # family-major output concat matches the outwire layout, so
+            # the fetch below trims exactly like the padded sharded path
+            sp = batch.packed_shards
+            rows_fn = sharded_molecular_rows(
+                mesh, sp.fams_per_shard, params, kernel_choice
+            )
+            wire = rows_fn(sp.bases, sp.quals, sp.seg)
+            pf = sp.total_families
         else:
+            # graftlint: disable=padded-envelope-dispatch -- the
+            # sanctioned layout='padded' sharded envelope fallback
             (pb, pq), pf = pad_families(
                 (batch.bases, batch.quals), f, data_size
             )
@@ -1728,14 +1799,22 @@ def call_molecular_batches(
         if "fn" not in deep_state:
             from bsseqconsensusreads_tpu.parallel.deep_family import (
                 deep_family_consensus,
+                deep_family_consensus_rows,
             )
             from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
 
             devices = list(mesh.devices.flat)
             deep_state["n"] = len(devices)
-            deep_state["fn"] = deep_family_consensus(
-                make_mesh(n_data=1, n_reads=len(devices), devices=devices),
-                params,
+            deep_mesh = make_mesh(
+                n_data=1, n_reads=len(devices), devices=devices
+            )
+            # packed layout: each device votes its template slab as
+            # segment-packed rows before the psum — bit-identical to
+            # the padded deep route (parallel.deep_family)
+            deep_state["fn"] = (
+                deep_family_consensus_rows(deep_mesh, params, kernel_choice)
+                if use_packed_rows
+                else deep_family_consensus(deep_mesh, params)
             )
         n = deep_state["n"]
         b, q = batch.bases, batch.quals
@@ -1783,16 +1862,25 @@ def call_molecular_batches(
                 max_templates=min(deep_threshold, DEEP_TEMPLATE_CAP),
                 indel_policy=indel_policy,
             )
-            if (
-                use_packed_rows
-                and batch.meta
-                and not (batch.bases.shape[1] == 1 and singleton_on)
-            ):
+            will_host_vote = (
+                batch.bases.shape[1] == 1
+                and singleton_on
+                and sharded_fn is None
+                and wire_rr is None
+            )
+            if use_packed_rows and batch.meta and not will_host_vote:
                 # segment-pack here, in the timed encode phase on the
-                # host pool — the dispatch thread stays free. T==1
-                # batches skip the pack: the singleton host vote
-                # absorbs them before dispatch ever sees them.
+                # host pool — the dispatch thread stays free. Batches
+                # the singleton host vote will absorb skip the pack
+                # (same condition as is_singleton_batch): dispatch
+                # never sees them.
                 batch.packed = encode_mod.pack_molecular_rows(batch)
+                if sharded_fn is not None and batch.packed is not None:
+                    # the mesh route's shard plan is host work too:
+                    # build it here so dispatch only launches
+                    batch.packed_shards = encode_mod.shard_packed_rows(
+                        batch.packed, data_size
+                    )
         return bi, batch, skipped, deep
 
     def numbered_chunks():
@@ -1848,20 +1936,28 @@ def call_molecular_batches(
             if not is_singleton_batch(batch):
                 # device-issued batches only (the unified pad_waste
                 # definition — see StageStats): the denominator is what
-                # the kernel actually sees, packed rows when packed
-                issued = (
-                    batch.packed.bases
-                    if batch.packed is not None and use_packed_rows
-                    else batch.bases
-                )
+                # the kernel actually sees, packed rows when packed —
+                # the sharded plan's re-bucketed rows on the mesh route,
+                # so per-route accounting stays truthful
+                issued = batch.bases
+                if use_packed_rows:
+                    if batch.packed_shards is not None:
+                        issued = batch.packed_shards.bases
+                    elif batch.packed is not None:
+                        issued = batch.packed.bases
                 used = int((issued != NBASE).sum())
                 stats.pad_cells += issued.size - used
                 stats.used_cells += used
-                if batch.packed is not None and use_packed_rows:
-                    pk = batch.packed
+                stats.metrics.count(f"route_batches_{route_name}")
+                if issued is not batch.bases:
+                    # rows = leading axes of [..., 2, W]: N single/wire,
+                    # S*R on the sharded plan
+                    rows = issued.size // (2 * issued.shape[-1])
                     stats.metrics.count(
-                        "bucket_rows"
-                        f"{pk.bases.shape[0]}_w{pk.bases.shape[-1]}"
+                        f"packed_rows_issued_{route_name}", rows
+                    )
+                    stats.metrics.count(
+                        f"bucket_rows{rows}_w{issued.shape[-1]}"
                     )
             if pool is not None:
                 fut = pool.submit(dispatch_fetch_guarded, batch, batch_index)
@@ -2124,10 +2220,12 @@ def call_duplex_batches(
     sharded_fn = None
     if mesh is not None and not wire_mc:
         from bsseqconsensusreads_tpu.parallel.mesh import DATA_AXIS, pad_families
-        from bsseqconsensusreads_tpu.parallel.sharding import sharded_duplex_packed
+        from bsseqconsensusreads_tpu.parallel.sharding import sharded_duplex_outwire
 
         data_size = mesh.shape[DATA_AXIS]
-        sharded_fn = sharded_duplex_packed(mesh, params, vote_kernel=kernel)
+        sharded_fn = sharded_duplex_outwire(
+            mesh, params, vote_kernel=kernel, layout=kernel_layout
+        )
 
     if transport == "wire" and refstore is None:
         raise ValueError(
@@ -2319,12 +2417,14 @@ def call_duplex_batches(
                 packed = duplex_call_wire_fused_methyl(
                     words, genome, f, w, params=params,
                     qual_mode=wire.qual_mode, vote_kernel=kernel,
+                    layout=kernel_layout,
                 )
             else:
                 words, genome = _wire_device_args(host_words)
                 packed = duplex_call_wire_fused(
                     words, genome, f, w, params=params,
                     qual_mode=wire.qual_mode, vote_kernel=kernel,
+                    layout=kernel_layout,
                 )
             pf = f
         else:

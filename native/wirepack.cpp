@@ -236,6 +236,102 @@ int wirepack_pack_duplex(const int8_t* bases, const uint8_t* quals,
   return bits;
 }
 
+// Pack segment-packed molecular rows (the ops/wire.py packed wire v2
+// body): the nib + qual planes of wirepack_pack_duplex for an [n, 2, w]
+// row batch, with cover derived inline (base != NBASE) so the caller
+// never materializes the [n, 2, w] cover plane, and no meta section —
+// the v2 header planes carry segment ids + row offsets instead of the
+// duplex convert/eligible bytes. mode / qual_out sizing / return code
+// contract as wirepack_pack_duplex (qual_out needs >= n*2*w + 16 bytes).
+int wirepack_pack_rows(const int8_t* bases, const uint8_t* quals,
+                       int64_t n, int64_t w, int mode, uint8_t* nib_out,
+                       uint8_t* qual_out, int64_t* qual_len_out,
+                       int* nlevels_out) {
+  if (mode != 0 && mode != 2 && mode != 4 && mode != 8) return kErrBadMode;
+  constexpr int8_t kNBase = 4;  // framework "no observation" code
+  const int64_t cells = n * 2 * w;
+
+  // Sweep 1: nibble plane + covered-qual histogram, cover on the fly.
+  int64_t hist[256];
+  const bool need_hist = mode != 8;
+  if (need_hist) std::memset(hist, 0, sizeof(hist));
+  for (int64_t i = 0; i < cells; i += 2) {
+    const uint8_t c0 = bases[i] != kNBase ? 1 : 0;
+    const uint8_t c1 = bases[i + 1] != kNBase ? 1 : 0;
+    const uint8_t n0 = (uint8_t(bases[i]) & 0x7) | uint8_t(c0 << 3);
+    const uint8_t n1 = (uint8_t(bases[i + 1]) & 0x7) | uint8_t(c1 << 3);
+    nib_out[i >> 1] = uint8_t(n0 | (n1 << 4));
+    if (need_hist) {
+      if (c0) hist[quals[i]]++;
+      if (c1) hist[quals[i + 1]]++;
+    }
+  }
+
+  // Codebook resolution: identical to wirepack_pack_duplex.
+  uint8_t levels[256];
+  int nlevels = 0;
+  bool has_255 = false;
+  int max_level = 0;
+  if (need_hist) {
+    for (int v = 0; v < 255; ++v)
+      if (hist[v]) {
+        levels[nlevels++] = uint8_t(v);
+        max_level = v;
+      }
+    has_255 = hist[255] != 0;
+    if (nlevels == 0) {
+      levels[0] = 0;
+      nlevels = 1;
+      max_level = 0;
+    }
+  }
+  if (nlevels_out) *nlevels_out = nlevels;
+
+  int bits = mode;
+  if (mode == 0) bits = resolve_auto(nlevels, has_255, max_level);
+  if (bits == 2 || bits == 4) {
+    if (has_255 || max_level > 93) return kErrQualTooHigh;
+    if (nlevels > (1 << bits)) return kErrTooManyLevels;
+  }
+
+  if (bits == 8) {
+    std::memcpy(qual_out, quals, size_t(cells));
+    int64_t len = cells;
+    while (len & 3) qual_out[len++] = 0;
+    *qual_len_out = len;
+    return 8;
+  }
+
+  const int book = 1 << bits;
+  std::memset(qual_out, 0, size_t(book));
+  std::memcpy(qual_out, levels, size_t(nlevels));
+  uint8_t lut[256];
+  std::memset(lut, 0, sizeof(lut));
+  for (int i = 0; i < nlevels; ++i) lut[levels[i]] = uint8_t(i);
+
+  // Sweep 2: packed qual indices, same bit layout as wirepack_pack_duplex
+  // (uncovered cells carry index 0 — the sentinel->0 LUT contract).
+  uint8_t* dst = qual_out + book;
+  const int per = 8 / bits;
+  int64_t nbytes = (cells + per - 1) / per;
+  int64_t i = 0, b = 0;
+  for (; b < cells / per; ++b) {
+    uint8_t acc = 0;
+    for (int s = 0; s < per; ++s, ++i)
+      acc |= uint8_t((bases[i] != kNBase ? lut[quals[i]] : 0) << (bits * s));
+    dst[b] = acc;
+  }
+  if (i < cells) {
+    uint8_t acc = 0;
+    for (int s = 0; i < cells; ++i, ++s)
+      acc |= uint8_t((bases[i] != kNBase ? lut[quals[i]] : 0) << (bits * s));
+    dst[b++] = acc;
+  }
+  while (nbytes & 3) dst[nbytes++] = 0;
+  *qual_len_out = book + nbytes;
+  return bits;
+}
+
 // Emit one consensus batch as ready-to-write BAM record bytes.
 //
 // The per-record Python path (pipeline.calling._emit_* + io.bam

@@ -39,6 +39,7 @@ FIXTURES = {
     "per-record-alloc": "fx_per_record_alloc.py",
     "blocking-scheduler-loop": "fx_blocking_scheduler_loop.py",
     "padded-batch-flops": "fx_padded_batch_flops.py",
+    "padded-envelope-dispatch": "fx_padded_envelope_dispatch.py",
     "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
     "unframed-socket-read": "fx_unframed_socket_read.py",
     "serial-deflate": "fx_serial_deflate.py",

@@ -101,16 +101,18 @@ MIXES = ("mixed", "all_singleton", "giant_plus_singletons",
 
 
 def _run_molecular(records, monkeypatch, layout, *, emit="python",
-                   vote_kernel=None, singleton="1", stats=None):
+                   vote_kernel=None, singleton="1", stats=None,
+                   mesh=None, transport="unpacked", deep_threshold=None):
     monkeypatch.setenv("BSSEQ_TPU_KERNEL_LAYOUT", layout)
     monkeypatch.setenv("BSSEQ_TPU_SINGLETON", singleton)
     out = []
-    # mesh=None: the packed route engages on single-device dispatch (the
-    # conftest forces 8 host devices, which would select the sharded
-    # envelope path and compare padded against itself)
+    # mesh=None by default: the single-device routes (the conftest forces
+    # 8 host devices, which 'auto' would turn into the sharded route —
+    # TestRouteMatrix passes an explicit mesh to exercise that on purpose)
     for batch in call_molecular_batches(
         list(records), batch_families=6, emit=emit,
-        vote_kernel=vote_kernel, mesh=None,
+        vote_kernel=vote_kernel, mesh=mesh, transport=transport,
+        deep_threshold=deep_threshold,
         stats=stats if stats is not None else StageStats(),
     ):
         out.extend(batch)
@@ -298,3 +300,248 @@ class TestPadWasteReconciliation:
         assert st.batches > 0
         assert st.pad_cells + st.used_cells > 0
         assert st.pad_waste + st.effective_flop_utilization == 1.0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the packed layout on EVERY dispatch route. Each route must be
+# byte-identical both to its own padded run AND to the single-device packed
+# baseline, and must ledger its per-route counters.
+
+
+def _mesh_all():
+    import jax
+
+    if jax.device_count() < 2:
+        pytest.skip("needs multi-device (conftest forces 8 host devices)")
+    from bsseqconsensusreads_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(n_data=jax.device_count(), n_reads=1)
+
+
+ROUTES = {
+    "sharded": lambda mesh: dict(mesh=mesh),
+    "wire": lambda mesh: dict(transport="wire"),
+    "wire_mc": lambda mesh: dict(mesh=mesh, transport="wire"),
+    "deep": lambda mesh: dict(deep_threshold=3),
+}
+
+
+class TestRouteMatrix:
+    @pytest.mark.parametrize("route", sorted(ROUTES))
+    def test_route_packed_matches_padded_and_single(
+        self, route, monkeypatch
+    ):
+        records = _mix("mixed")
+        mesh = _mesh_all() if route in ("sharded", "wire_mc") else None
+        kw = ROUTES[route](mesh)
+        base = _run_molecular(records, monkeypatch, "packed",
+                              singleton="0")
+        st = StageStats(stage="molecular")
+        got = _run_molecular(records, monkeypatch, "packed", singleton="0",
+                             stats=st, **kw)
+        ref = _run_molecular(records, monkeypatch, "padded", singleton="0",
+                             **kw)
+        assert got == ref  # packed vs padded, same route
+        if route != "deep":
+            # deep-family routing changes which kernel owns a family (its
+            # psum carries a documented qual-rounding tolerance vs the
+            # single-device vote), so only the transport routes must also
+            # match the single-device packed baseline byte-for-byte
+            assert got == base
+        counter_route = {"sharded": "sharded", "wire": "wire",
+                         "wire_mc": "wire_mc", "deep": "single"}[route]
+        assert st.metrics.counters[f"route_batches_{counter_route}"] > 0
+        assert (
+            st.metrics.counters[f"packed_rows_issued_{counter_route}"] > 0
+        )
+
+    def test_sharded_uneven_family_boundaries(self, monkeypatch):
+        # family count not divisible by the device count, with skewed
+        # depths: shard_packed_rows must cut the row axis exactly at
+        # family boundaries (no family straddles two devices), and the
+        # widest shard sets the shared row bucket
+        mesh = _mesh_all()
+        records = _mix("giant_plus_singletons")
+        a = _run_molecular(records, monkeypatch, "padded", singleton="0",
+                           mesh=mesh)
+        b = _run_molecular(records, monkeypatch, "packed", singleton="0",
+                           mesh=mesh)
+        base = _run_molecular(records, monkeypatch, "packed",
+                              singleton="0")
+        assert a == b == base
+
+    def test_overlap_pool_composes_with_packed_wire_mc(self, monkeypatch):
+        # overlap workers + round-robin wire + packed rows in one run:
+        # the pool composition must not reorder or corrupt retirement
+        mesh = _mesh_all()
+        records = _mix("mixed")
+        base = _run_molecular(records, monkeypatch, "packed",
+                              singleton="0")
+        monkeypatch.setenv("BSSEQ_TPU_OVERLAP_THREADS", "2")
+        st = StageStats(stage="molecular")
+        got = _run_molecular(records, monkeypatch, "packed", singleton="0",
+                             mesh=mesh, transport="wire", stats=st)
+        assert got == base
+        assert st.metrics.counters.get("overlap_rr_composed", 0) > 0
+
+    def test_degrade_to_host_twin_stays_packed_per_route(self, monkeypatch):
+        # persistent dispatch failure on the wire route: the CPU twin
+        # votes on the batch's packed plan and the run stays byte-exact
+        from bsseqconsensusreads_tpu.faults import failpoints
+
+        records = _mix("mixed")
+        base = _run_molecular(records, monkeypatch, "packed",
+                              singleton="0", transport="wire")
+        failpoints.arm("dispatch_kernel=raise:RuntimeError@batch=1")
+        try:
+            st = StageStats(stage="molecular")
+            got = _run_molecular(records, monkeypatch, "packed",
+                                 singleton="0", transport="wire", stats=st)
+        finally:
+            failpoints.disarm()
+        assert got == base
+        assert st.batches_degraded == 1
+
+    def test_serve_resident_engine_inherits_packed(self, tmp_path,
+                                                   monkeypatch):
+        # the resident scheduler dispatches through the same stage
+        # callers, so the packed layout rides along: one job under each
+        # layout, byte-identical output BAMs
+        import hashlib
+
+        from bsseqconsensusreads_tpu.io.bam import BamWriter
+        from bsseqconsensusreads_tpu.serve import ServeEngine
+        from bsseqconsensusreads_tpu.utils.testing import (
+            make_grouped_bam_records as mk,
+        )
+
+        rng = np.random.default_rng(23)
+        gname, genome = random_genome(rng, 2000)
+        header, records = mk(rng, gname, genome, n_families=6,
+                            reads_per_strand=(2, 3), read_len=40)
+        inp = str(tmp_path / "in.bam")
+        with BamWriter(inp, header) as w:
+            for r in records:
+                w.write(r)
+
+        def run(layout):
+            monkeypatch.setenv("BSSEQ_TPU_KERNEL_LAYOUT", layout)
+            out = str(tmp_path / f"out_{layout}.bam")
+            eng = ServeEngine(batch_families=4, stride=2)
+            eng.start()
+            try:
+                job = eng.submit({"input": inp, "output": out})
+                assert eng.wait(job.id, timeout=60)["state"] == "done"
+            finally:
+                eng.stop(timeout=30)
+            return hashlib.sha256(open(out, "rb").read()).hexdigest()
+
+        assert run("packed") == run("padded")
+
+    def test_outwire_aliases_preserved(self):
+        # satellite (a): sharded_*_packed meant "packed OUTPUT wire", not
+        # packed input rows — renamed *_outwire, old names kept as aliases
+        from bsseqconsensusreads_tpu.parallel import sharding
+
+        assert sharding.sharded_molecular_packed \
+            is sharding.sharded_molecular_outwire
+        assert sharding.sharded_duplex_packed \
+            is sharding.sharded_duplex_outwire
+
+
+class TestWireVersionRefusal:
+    """v1 and v2 wires refuse each other's splitters at the host boundary
+    (the leading word: v1 carries starts[0], v2 the magic)."""
+
+    def _packed_plan(self):
+        from bsseqconsensusreads_tpu.ops.encode import (
+            MIN_PACKED_ROWS,
+            PackedRows,
+            bucket_pow2,
+        )
+
+        rng = np.random.default_rng(3)
+        t_real = np.array([2, 4, 1], np.int32)
+        n = int(t_real.sum())
+        n_pad = bucket_pow2(n, MIN_PACKED_ROWS)
+        f_pad = bucket_pow2(len(t_real))
+        bases = np.full((n_pad, 2, 16), 4, np.int8)  # pad rows all-NBASE
+        quals = np.zeros((n_pad, 2, 16), np.uint8)
+        bases[:n] = rng.integers(0, 5, size=(n, 2, 16)).astype(np.int8)
+        quals[:n] = rng.integers(0, 40, size=(n, 2, 16)).astype(np.uint8)
+        quals[:n][bases[:n] == 4] = 0  # uncovered cells carry no qual
+        seg = np.full(n_pad, f_pad, np.int32)
+        seg[:n] = np.repeat(np.arange(len(t_real), dtype=np.int32), t_real)
+        return PackedRows(bases, quals, seg, f_pad, n)
+
+    def test_v1_splitter_refuses_v2_wire(self):
+        from bsseqconsensusreads_tpu.ops.wire import (
+            pack_molecular_rows_wire,
+            split_duplex_wire,
+        )
+
+        pk = self._packed_plan()
+        words, _mode = pack_molecular_rows_wire(
+            pk.bases, pk.quals, pk.seg, pk.num_families, pk.n_real_rows
+        )
+        with pytest.raises(ValueError, match="v2 magic"):
+            split_duplex_wire(words, f=3, w=16)
+
+    def test_v2_splitter_refuses_v1_wire(self):
+        from bsseqconsensusreads_tpu.ops.wire import (
+            pack_molecular_inputs,
+            split_molecular_rows_wire,
+        )
+
+        rng = np.random.default_rng(4)
+        bases = rng.integers(0, 5, size=(3, 4, 2, 16)).astype(np.int8)
+        quals = rng.integers(0, 40, size=(3, 4, 2, 16)).astype(np.uint8)
+        words = pack_molecular_inputs(bases, quals).to_words()
+        with pytest.raises(ValueError, match="magic word missing"):
+            split_molecular_rows_wire(words, n_rows=24, num_families=3,
+                                      w=16)
+
+    def test_v2_splitter_refuses_header_mismatch(self):
+        from bsseqconsensusreads_tpu.ops.wire import (
+            pack_molecular_rows_wire,
+            split_molecular_rows_wire,
+        )
+
+        pk = self._packed_plan()
+        words, mode = pack_molecular_rows_wire(
+            pk.bases, pk.quals, pk.seg, pk.num_families, pk.n_real_rows
+        )
+        with pytest.raises(ValueError, match="header"):
+            split_molecular_rows_wire(
+                words, n_rows=pk.bases.shape[0],
+                num_families=pk.num_families + 1, w=16, qual_mode=mode,
+            )
+
+    def test_v2_roundtrip_bitwise(self):
+        import jax.numpy as jnp
+
+        from bsseqconsensusreads_tpu.ops.wire import (
+            pack_molecular_rows_wire,
+            split_molecular_rows_wire,
+            unpack_rows_wire_inputs,
+        )
+
+        pk = self._packed_plan()
+        n, _, w = pk.bases.shape
+        words, mode = pack_molecular_rows_wire(
+            pk.bases, pk.quals, pk.seg, pk.num_families, pk.n_real_rows
+        )
+        nib, qual, seg, offsets = split_molecular_rows_wire(
+            words, n_rows=n, num_families=pk.num_families, w=w,
+            qual_mode=mode,
+        )
+        bases, quals = unpack_rows_wire_inputs(nib, qual, n, w, mode)
+        cover = pk.bases != 4  # NBASE: quals only defined under cover
+        np.testing.assert_array_equal(np.asarray(bases), pk.bases)
+        np.testing.assert_array_equal(
+            np.asarray(quals) * cover, pk.quals * cover
+        )
+        np.testing.assert_array_equal(
+            np.asarray(seg).astype(np.int32), pk.seg
+        )
+        assert jnp.asarray(offsets).shape == (pk.num_families + 1,)
