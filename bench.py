@@ -1286,6 +1286,48 @@ def _run_methyl_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_elastic_quick() -> dict | None:
+    """tools/elastic_scale.py --quick -> ELASTIC_HEAD.json: the
+    graftswarm artifact (1/2/4-worker elastic fleets all pinned to the
+    single-process SHA with counters reconciling, per-worker chip_busy
+    from the worker-scoped ledger sub-streams, and a worker-kill
+    requeue drill proving loss recovery). Best-effort and cpu-pinned
+    like the chaos drill. BSSEQ_BENCH_ELASTIC=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_ELASTIC", "1") == "0":
+        return None
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "elastic_scale.py",
+    )
+    out_path = os.path.join(os.getcwd(), "ELASTIC_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, tool, "--quick", "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_ELASTIC_TIMEOUT", 900),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        fleets = data.get("fleet", {})
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "single_wall_s": data.get("single_process", {}).get("wall_s"),
+            "fleet_wall_s": {
+                k: v.get("wall_s") for k, v in fleets.items()
+            },
+            "byte_identical": all(
+                v.get("byte_identical") for v in fleets.values()
+            ) if fleets else False,
+            "requeue_drill_ok": data.get("requeue_drill", {}).get("ok"),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -1506,6 +1548,14 @@ def main() -> None:
         observe.emit(
             "bench_methyl",
             {"ok": methyl.get("ok"), "path": methyl.get("path")},
+            sink=ledger_sink,
+        )
+    elastic = _run_elastic_quick()
+    if elastic is not None:
+        out["elastic"] = elastic
+        observe.emit(
+            "bench_elastic_scale",
+            {"ok": elastic.get("ok"), "path": elastic.get("path")},
             sink=ledger_sink,
         )
     observe.flush_sinks()

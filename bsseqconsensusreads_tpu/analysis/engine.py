@@ -508,6 +508,7 @@ class PackageIndex:
 def all_rules() -> dict[str, Rule]:
     from bsseqconsensusreads_tpu.analysis import (
         rules_deflate,
+        rules_elastic,
         rules_emit,
         rules_hostphase,
         rules_input,
@@ -524,7 +525,8 @@ def all_rules() -> dict[str, Rule]:
     rules: dict[str, Rule] = {}
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
-                rules_pack, rules_methyl, rules_transport, rules_deflate):
+                rules_pack, rules_methyl, rules_transport, rules_deflate,
+                rules_elastic):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

@@ -477,6 +477,7 @@ def cmd_observe(args) -> int:
                 args.ledger, rel_tol=args.tolerance,
                 job=args.job or None,
                 replica=getattr(args, "replica", "") or None,
+                worker=getattr(args, "worker", "") or None,
             )
             print(ledger_tools.format_summary(s))
             return 0 if s.ok else 1
@@ -501,6 +502,61 @@ def cmd_observe(args) -> int:
         print(json.dumps({"ok": False, "problems": len(problems)}))
         return 1
     print(json.dumps({"ok": True, "problems": 0}))
+    return 0
+
+
+def cmd_elastic(args) -> int:
+    """graftswarm elastic execution (elastic/): `run` is the
+    one-command local launch — split the grouped input into base-family
+    slices, spawn N worker subprocesses against an in-process
+    coordinator, merge byte-identical to single-process; `worker
+    --join` is the real-multihost leg, one process joining a remote
+    coordinator over the framed transport."""
+    import os
+
+    _arm_failpoints(args)
+    if args.op == "worker":
+        from bsseqconsensusreads_tpu.elastic import worker as _worker
+
+        n = _worker.work_loop(args.join, worker_id=args.worker_id or None)
+        print(json.dumps({
+            "worker": os.environ.get("BSSEQ_TPU_WORKER_ID", ""),
+            "slices": n,
+        }))
+        return 0
+    from bsseqconsensusreads_tpu import elastic
+
+    cfg = (
+        FrameworkConfig.from_yaml(args.config)
+        if args.config
+        else FrameworkConfig()
+    )
+    if args.reference:
+        cfg.genome_dir = os.path.dirname(args.reference) or "."
+        cfg.genome_fasta_file_name = os.path.basename(args.reference)
+    if args.sort_buckets:
+        cfg.sort_buckets = args.sort_buckets
+    worker_failpoints = {}
+    for term in args.worker_failpoints:
+        wid, sep, schedule = term.partition(":")
+        if not sep or not wid or not schedule:
+            observe.stderr_line(
+                f"--worker-failpoints: bad term {term!r} (want wid:schedule)"
+            )
+            return 2
+        worker_failpoints[wid] = schedule
+    try:
+        target, report = elastic.run_elastic(
+            cfg, args.bam, outdir=args.outdir,
+            workers=args.workers, slices=args.slices,
+            address=args.address, inline=args.inline,
+            worker_failpoints=worker_failpoints,
+            max_restarts=args.max_restarts, timeout_s=args.timeout,
+        )
+    except elastic.ElasticError as exc:
+        observe.stderr_line(f"elastic: {exc}")
+        return 1
+    print(json.dumps({"target": target, "report": report}))
     return 0
 
 
@@ -1076,6 +1132,71 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_serve_ctl)
 
     p = sub.add_parser(
+        "elastic",
+        help="graftswarm: coordinator/worker sharded runs with loss "
+        "recovery, byte-identical to single-process",
+    )
+    eop = p.add_subparsers(dest="op", required=True)
+    r = eop.add_parser(
+        "run",
+        help="one-command elastic run: split the grouped input into "
+        "base-family slices, lease them to N local worker "
+        "subprocesses, merge byte-identical to single-process",
+    )
+    r.add_argument("--config", default="", help="YAML config")
+    r.add_argument("--bam", required=True, help="GroupReadsByUmi output BAM")
+    r.add_argument("--outdir", default="output")
+    r.add_argument("--reference", default="", help="genome FASTA (overrides config)")
+    r.add_argument("--workers", type=int, default=2)
+    r.add_argument(
+        "--slices", type=int, default=0,
+        help="work-unit count (default: workers*4 — small slices keep "
+        "requeue cheap and the tail short)",
+    )
+    r.add_argument(
+        "--address", default="tcp:127.0.0.1:0",
+        help="coordinator listen address, tcp:host:port (port 0 = "
+        "kernel-assigned; TLS via BSSEQ_TPU_SERVE_TLS_CERT/KEY)",
+    )
+    r.add_argument(
+        "--inline", action="store_true",
+        help="process every slice sequentially in this process (no "
+        "subprocesses/sockets; same bytes — the debug/test mode)",
+    )
+    r.add_argument(
+        "--worker-failpoints", action="append", default=[],
+        help="wid:schedule — arm BSSEQ_TPU_FAILPOINTS in ONE worker's "
+        "first life (chaos drills: w0:elastic_slice=exit:9@hit=2)",
+    )
+    r.add_argument(
+        "--max-restarts", type=int, default=2,
+        help="respawn budget per worker id",
+    )
+    r.add_argument("--timeout", type=float, default=3600.0)
+    r.add_argument(
+        "--sort-buckets", type=int, default=0,
+        help="bucket count for the merge reconciliation geometry "
+        "(0 = engine default)",
+    )
+    _add_failpoints(r)
+    r.set_defaults(fn=cmd_elastic)
+    w = eop.add_parser(
+        "worker",
+        help="join a (possibly remote) coordinator and process leased "
+        "slices until it reports done",
+    )
+    w.add_argument(
+        "--join", required=True, help="coordinator address tcp:host:port"
+    )
+    w.add_argument(
+        "--worker-id", default="",
+        help="identity stamped into ledger sub-streams (default: "
+        "BSSEQ_TPU_WORKER_ID or pid<N>)",
+    )
+    _add_failpoints(w)
+    w.set_defaults(fn=cmd_elastic)
+
+    p = sub.add_parser(
         "lint",
         help="graftlint static analysis: TPU-hostile / thread-unsafe "
         "code checkers over the package (or given paths)",
@@ -1124,6 +1245,11 @@ def main(argv: list[str] | None = None) -> int:
         "--replica", default="",
         help="scope to one fleet replica's sub-stream (replica id, "
         "e.g. r0 — fleet ledgers interleave N replica processes)",
+    )
+    s.add_argument(
+        "--worker", default="",
+        help="scope to one elastic worker's sub-stream (worker id, "
+        "e.g. w0 — elastic ledgers interleave N worker processes)",
     )
     s.set_defaults(fn=cmd_observe)
     d = op.add_parser(

@@ -1,0 +1,51 @@
+"""graftswarm: elastic multi-process orchestration.
+
+Coordinator/worker sharded runs over the PR 11 framed transport, with
+loss recovery (lease expiry → checkpoint-prefix requeue) and a merge
+byte-identical to the single-process pipeline. See coordinator.py for
+the ledger/durability design and merge.py for the determinism proof.
+"""
+
+from bsseqconsensusreads_tpu.elastic import merge
+from bsseqconsensusreads_tpu.elastic.coordinator import (
+    DEFAULT_LEASE_S,
+    ENV_COORDINATOR_ADDR,
+    ENV_LEASE_S,
+    ENV_WORKER_ID,
+    Coordinator,
+    ElasticError,
+    SliceLedger,
+    base_mi,
+    config_doc,
+    config_from_doc,
+    lease_seconds,
+    run_elastic,
+    slice_name,
+    split_input,
+)
+from bsseqconsensusreads_tpu.elastic.worker import (
+    process_slice,
+    slice_config,
+    work_loop,
+)
+
+__all__ = [
+    "DEFAULT_LEASE_S",
+    "ENV_COORDINATOR_ADDR",
+    "ENV_LEASE_S",
+    "ENV_WORKER_ID",
+    "Coordinator",
+    "ElasticError",
+    "SliceLedger",
+    "base_mi",
+    "config_doc",
+    "config_from_doc",
+    "lease_seconds",
+    "merge",
+    "process_slice",
+    "run_elastic",
+    "slice_config",
+    "slice_name",
+    "split_input",
+    "work_loop",
+]

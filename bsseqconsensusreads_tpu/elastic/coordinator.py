@@ -1,0 +1,765 @@
+"""graftswarm coordinator: the work-unit ledger behind `cli elastic run`.
+
+An elastic run shards one grouped input across worker processes and
+merges their outputs back into bytes identical to the single-process
+pipeline. The coordinator owns three things:
+
+* **the split** — `split_input` partitions the input into contiguous
+  base-family (MI with the /A|/B strand suffix stripped) ordinal
+  ranges, one slice BAM per range, same header bytes. Contiguity is
+  what makes the merge exact: per-slice coordinate-sorted outputs
+  merged in slice order reproduce the stable global sort the
+  single-process run performs over the same emission stream. Each
+  slice carries a family-hash fingerprint (CRC over its member base-MI
+  ids) that every downstream commit must echo back.
+* **the lease table** — slices are leased to workers over the PR 11
+  framed transport (`tcp:` with optional TLS; hostile frames get the
+  same typed `TransportError` refusal matrix every serve front has).
+  Leases expire against worker heartbeats; an expired lease or a dead
+  worker requeues the slice (`slice_requeued` / `worker_lost` ledger
+  events). Requeue loses nothing recomputable: the slice's work dir is
+  keyed by slice id, not worker id, so the next holder's
+  BatchCheckpoint resume keeps the longest verified CRC shard prefix
+  and recomputes only the remainder — exactly-once emit per family.
+* **durable truth** — the filesystem, not this process. A slice is
+  done iff its dir holds a committed `manifest.json` whose fingerprint
+  matches and whose output CRC verifies. The in-memory lease table is
+  volatile by design: a restarted coordinator rescans the slice dirs
+  and re-enqueues only the incomplete slices (the
+  `elastic_coordinator_restart` chaos scenario drills this window).
+
+`run_elastic` is the one-command front: split, serve, supervise N
+local workers (`BSSEQ_TPU_WORKER_ID=w<i>`, fleet-style respawn with a
+one-shot first-life failpoint override for the chaos drill), then
+finalize through elastic.merge and refuse to declare the run ok until
+the counters reconcile.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from collections import deque
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import integrity as _integrity
+from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+from bsseqconsensusreads_tpu.serve.server import ProtocolServer
+from bsseqconsensusreads_tpu.utils import observe
+
+ENV_WORKER_ID = "BSSEQ_TPU_WORKER_ID"
+ENV_COORDINATOR_ADDR = "BSSEQ_TPU_COORDINATOR_ADDR"
+ENV_LEASE_S = "BSSEQ_TPU_ELASTIC_LEASE_S"
+
+#: Default lease duration. Workers renew at a third of this, so only a
+#: hung or dead worker lets a lease lapse.
+DEFAULT_LEASE_S = 30.0
+
+SLICES_DOC = "slices.json"
+CFG_DOC = "cfg.json"
+MANIFEST_NAME = "manifest.json"
+
+
+class ElasticError(RuntimeError):
+    """Unrunnable elastic configuration, exhausted workers, or a merge
+    whose counters refuse to reconcile."""
+
+
+def lease_seconds(default: float = DEFAULT_LEASE_S) -> float:
+    try:
+        return float(os.environ.get(ENV_LEASE_S, default))
+    except ValueError:
+        return default
+
+
+def base_mi(mi: str) -> str:
+    """Duplex family id: the MI with its /A | /B strand suffix stripped
+    (the fgbio convention). Slicing on the BASE id keeps both strands
+    of a duplex family in one slice, so per-slice duplex calling sees
+    exactly the families the single-process run sees."""
+    return mi.split("/", 1)[0]
+
+
+def slice_name(sid: int) -> str:
+    return f"s{sid:04d}"
+
+
+def config_doc(cfg: FrameworkConfig) -> dict:
+    """JSON-serializable form of a FrameworkConfig, shipped to workers
+    at join time (and written to `<rundir>/cfg.json` for `--join`
+    workers on another host reading the shared rundir)."""
+    return dataclasses.asdict(cfg)
+
+
+def config_from_doc(doc: dict) -> FrameworkConfig:
+    from bsseqconsensusreads_tpu.models.params import ConsensusParams
+
+    d = dict(doc)
+    for key in ("molecular", "duplex"):
+        if isinstance(d.get(key), dict):
+            d[key] = ConsensusParams(**d[key])
+    return FrameworkConfig(**d)
+
+
+def _load_json(path: str) -> dict | None:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_json_atomic(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _input_fingerprint(path: str) -> dict:
+    st = os.stat(path)
+    return {
+        "path": os.path.abspath(path),
+        "size": st.st_size,
+        "mtime": st.st_mtime,
+    }
+
+
+# --------------------------------------------------------------------- split
+
+
+def split_input(bam_path: str, rundir: str, n_slices: int) -> list[dict]:
+    """Partition a grouped BAM into contiguous base-family ordinal
+    ranges, one slice BAM per range (same header bytes). Idempotent: a
+    rerun over an unchanged input with intact slice files reuses them
+    (the coordinator-restart resume path); anything stale is rebuilt.
+
+    Returns the slice descriptors: sid, rundir-relative path, record /
+    family counts, the family-hash fingerprint (CRC over member base-MI
+    ids in ordinal order), and the slice file's own CRC.
+    """
+    slicedir = os.path.join(rundir, "slices")
+    os.makedirs(slicedir, exist_ok=True)
+    fp = _input_fingerprint(bam_path)
+    doc_path = os.path.join(rundir, SLICES_DOC)
+    doc = _load_json(doc_path)
+    if (
+        doc
+        and doc.get("input_fingerprint") == fp
+        and doc.get("n_slices_requested") == n_slices
+    ):
+        try:
+            for sl in doc["slices"]:
+                _integrity.verify_file_crc32(
+                    os.path.join(rundir, sl["path"]), sl["input_crc"],
+                    what=f"slice input {slice_name(sl['sid'])}",
+                )
+        except OSError:
+            pass  # damaged or missing slice file: rebuild the split
+        else:
+            observe.emit(
+                "elastic_split",
+                {"slices": len(doc["slices"]), "families": doc["families"],
+                 "records": doc["records"], "resumed": True},
+            )
+            return doc["slices"]
+
+    # pass 1: base-family ordinals in first-seen order (= the order the
+    # single-process grouped stream meets them)
+    ordinals: dict[str, int] = {}
+    records = 0
+    with BamReader(bam_path) as reader:
+        header = reader.header
+        for rec in reader:
+            if not rec.has_tag("MI"):
+                raise ElasticError(
+                    "elastic runs shard by MI family and need grouped "
+                    f"input (record {rec.qname!r} carries no MI tag) — "
+                    "run the grouping pre-stage first (group_umis=always) "
+                    "and hand the grouped BAM to `cli elastic run`"
+                )
+            fam = base_mi(str(rec.get_tag("MI")))
+            if fam not in ordinals:
+                ordinals[fam] = len(ordinals)
+            records += 1
+    families = len(ordinals)
+    if not families:
+        raise ElasticError(f"no records in {bam_path!r} — nothing to shard")
+    n = max(1, min(n_slices, families))
+    bounds = [families * i // n for i in range(n + 1)]
+
+    # pass 2: write each record to the slice owning its family ordinal
+    paths = [os.path.join(slicedir, f"{slice_name(s)}.bam") for s in range(n)]
+    counts = [0] * n
+    writers = [BamWriter(p + ".tmp", header, level=1) for p in paths]
+    try:
+        with BamReader(bam_path) as reader:
+            for rec in reader:
+                o = ordinals[base_mi(str(rec.get_tag("MI")))]
+                s = bisect.bisect_right(bounds, o) - 1
+                writers[s].write(rec)
+                counts[s] += 1
+    finally:
+        for w in writers:
+            w.close()
+    for p in paths:
+        os.replace(p + ".tmp", p)
+
+    fam_ids = sorted(ordinals, key=ordinals.get)
+    slices = []
+    for s in range(n):
+        members = fam_ids[bounds[s]:bounds[s + 1]]
+        slices.append({
+            "sid": s,
+            "path": os.path.join("slices", f"{slice_name(s)}.bam"),
+            "records": counts[s],
+            "families": len(members),
+            "family_crc": zlib.crc32("\x00".join(members).encode())
+            & 0xFFFFFFFF,
+            "input_crc": _integrity.file_crc32(paths[s]),
+        })
+    _save_json_atomic(doc_path, {
+        "input_fingerprint": fp,
+        "n_slices_requested": n_slices,
+        "records": records,
+        "families": families,
+        "slices": slices,
+    })
+    observe.emit(
+        "elastic_split",
+        {"slices": n, "families": families, "records": records,
+         "resumed": False},
+    )
+    return slices
+
+
+# -------------------------------------------------------------------- ledger
+
+
+class SliceLedger:
+    """Lease table over the durable slice state. Every mutation holds
+    the one lock; durable commits (manifest writes) happen outside it.
+    Restart-safe by construction: __init__ rescans the slice dirs and
+    enqueues only slices without a verified committed manifest."""
+
+    def __init__(self, rundir: str, slices: list[dict],
+                 lease_s: float | None = None):
+        self.rundir = rundir
+        self.slices = {sl["sid"]: sl for sl in slices}
+        self.lease_s = lease_s if lease_s is not None else lease_seconds()
+        self._lock = threading.Lock()
+        self._pending: deque[int] = deque()
+        self._leases: dict[str, dict] = {}
+        self._done: dict[int, dict] = {}
+        self._seq = 0
+        self.requeues = 0
+        self.workers_lost = 0
+        self.workers: set[str] = set()
+        for sl in slices:
+            m = self._verified_manifest(sl)
+            if m is not None:
+                self._done[sl["sid"]] = m
+            else:
+                self._pending.append(sl["sid"])
+        if self._done:
+            observe.emit(
+                "elastic_ledger_resumed",
+                {"done": len(self._done), "pending": len(self._pending)},
+            )
+
+    def _slice_dir(self, sid: int) -> str:
+        return os.path.join(self.rundir, "slices", slice_name(sid))
+
+    def _manifest_path(self, sid: int) -> str:
+        return os.path.join(self._slice_dir(sid), MANIFEST_NAME)
+
+    def _verified_manifest(self, sl: dict) -> dict | None:
+        """A committed manifest counts only if its family fingerprint
+        matches this split AND its output bytes still verify."""
+        m = _load_json(self._manifest_path(sl["sid"]))
+        if not m or m.get("family_crc") != sl["family_crc"]:
+            return None
+        out = os.path.join(self._slice_dir(sl["sid"]), m.get("output", ""))
+        try:
+            _integrity.verify_file_crc32(
+                out, int(m.get("crc", -1)),
+                what=f"slice {slice_name(sl['sid'])} output",
+            )
+        except (OSError, ValueError):
+            return None
+        return m
+
+    # -- worker-facing ops ----------------------------------------------
+
+    def join(self, worker: str) -> None:
+        with self._lock:
+            fresh = worker not in self.workers
+            self.workers.add(worker)
+        if fresh:
+            observe.emit("elastic_join", {"worker": worker})
+
+    def lease(self, worker: str) -> dict:
+        """Grant the next pending slice, or report wait/done. The grant
+        carries the lease id + duration the holder must renew against —
+        and echo back at publish."""
+        with self._lock:
+            if not self._pending:
+                if not self._leases and len(self._done) == len(self.slices):
+                    return {"done": True}
+                return {"wait": True}
+            sid = self._pending.popleft()
+            self._seq += 1
+            lease_id = f"{slice_name(sid)}.{self._seq}"
+            self._leases[lease_id] = {
+                "sid": sid,
+                "worker": worker,
+                "expires": time.monotonic() + self.lease_s,
+            }
+            grant = {
+                "slice": dict(self.slices[sid]),
+                "lease_id": lease_id,
+                "lease_s": self.lease_s,
+            }
+        observe.emit(
+            "elastic_lease",
+            {"slice": slice_name(sid), "worker": worker,
+             "lease_id": lease_id},
+        )
+        return grant
+
+    def heartbeat(self, worker: str, lease_id: str) -> bool:
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or lease["worker"] != worker:
+                return False
+            lease["expires"] = time.monotonic() + self.lease_s
+            return True
+
+    def commit(self, lease_id: str, sid: int, manifest: dict,
+               worker: str = "") -> dict:
+        """Publish: validate the lease and fingerprint, verify the
+        output bytes, then commit the manifest atomically. A publish
+        under a lapsed lease is refused (its slice was requeued; the
+        durable checkpoint keeps the work) unless the requeued twin
+        already committed identical output."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or lease["sid"] != sid:
+                done = self._done.get(sid)
+                if done is not None and done.get("crc") == manifest.get("crc"):
+                    return {"ok": True, "duplicate": True}
+                return {"ok": False, "reason": "lease_expired"}
+            sl = self.slices.get(sid)
+        if sl is None:
+            return {"ok": False, "reason": "unknown_slice"}
+        if manifest.get("family_crc") != sl["family_crc"]:
+            return {"ok": False, "reason": "fingerprint_mismatch"}
+        out = os.path.join(self._slice_dir(sid), str(manifest.get("output")))
+        try:
+            _integrity.verify_file_crc32(
+                out, int(manifest.get("crc", -1)),
+                what=f"slice {slice_name(sid)} output",
+            )
+        except (OSError, ValueError) as exc:
+            return {"ok": False, "reason": f"output_integrity: {exc}"}
+        _failpoints.fire("elastic_manifest_commit", slice=slice_name(sid))
+        _save_json_atomic(self._manifest_path(sid), manifest)
+        with self._lock:
+            self._leases.pop(lease_id, None)
+            self._done[sid] = manifest
+        observe.emit(
+            "elastic_slice_done",
+            {"slice": slice_name(sid),
+             "worker": worker or str(manifest.get("worker", "")),
+             "records": manifest.get("records_out")},
+        )
+        return {"ok": True}
+
+    # -- liveness --------------------------------------------------------
+
+    def _requeue_locked(self, lease: dict, reason: str) -> None:
+        sid = lease["sid"]
+        self._pending.appendleft(sid)
+        self.requeues += 1
+        observe.emit(
+            "slice_requeued",
+            {"slice": slice_name(sid), "worker": lease["worker"],
+             "reason": reason, "batches_kept": self._batches_kept(sid)},
+        )
+
+    def _batches_kept(self, sid: int) -> int:
+        """Batches the lost worker left durable in the slice's stage
+        checkpoints — the prefix the next holder keeps (its resume
+        re-verifies every shard CRC; a corrupt shard truncates the
+        prefix further, pipeline.checkpoint._verify_shards)."""
+        total = 0
+        try:
+            names = os.listdir(self._slice_dir(sid))
+        except OSError:
+            return 0
+        for name in names:
+            if name.endswith(".ckpt.json"):
+                m = _load_json(os.path.join(self._slice_dir(sid), name))
+                total += int((m or {}).get("batches_done") or 0)
+        return total
+
+    def expire_scan(self) -> int:
+        """Requeue every lapsed lease; returns how many. A lapsed lease
+        means the holder stopped renewing — hung or dead either way, it
+        is presumed lost."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [
+                (lid, lease) for lid, lease in self._leases.items()
+                if lease["expires"] <= now
+            ]
+            for lid, lease in expired:
+                self._leases.pop(lid)
+                self.workers_lost += 1
+                observe.emit(
+                    "worker_lost",
+                    {"worker": lease["worker"], "reason": "lease_expired",
+                     "leases": 1},
+                )
+                self._requeue_locked(lease, "lease_expired")
+        return len(expired)
+
+    def note_worker_dead(self, worker: str) -> None:
+        """Supervisor fast path: a reaped worker process requeues its
+        leases immediately instead of waiting out the lease clock."""
+        with self._lock:
+            held = [
+                (lid, lease) for lid, lease in self._leases.items()
+                if lease["worker"] == worker
+            ]
+            self.workers_lost += 1
+            observe.emit(
+                "worker_lost",
+                {"worker": worker, "reason": "process_exit",
+                 "leases": len(held)},
+            )
+            for lid, lease in held:
+                self._leases.pop(lid)
+                self._requeue_locked(lease, "worker_lost")
+
+    # -- progress --------------------------------------------------------
+
+    def all_done(self) -> bool:
+        with self._lock:
+            return len(self._done) == len(self.slices)
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._pending)
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {
+                "slices": len(self.slices),
+                "done": len(self._done),
+                "pending": len(self._pending),
+                "leased": len(self._leases),
+                "requeues": self.requeues,
+                "workers_lost": self.workers_lost,
+            }
+
+    def manifests(self) -> dict[int, dict]:
+        with self._lock:
+            return dict(self._done)
+
+
+# -------------------------------------------------------------------- server
+
+
+class Coordinator(ProtocolServer):
+    """Framed-transport front of one SliceLedger: the elastic op table
+    over the same accept/refuse machinery every serve front shares
+    (typed TransportError refusals, TLS via the serve env vars)."""
+
+    def __init__(self, ledger: SliceLedger, cfg_doc: dict, *,
+                 addresses, ready_file: str | None = None):
+        super().__init__(addresses=addresses, ready_file=ready_file)
+        self.ledger = ledger
+        self.cfg_doc = cfg_doc
+        self._monitor_stop = threading.Event()
+        self._monitor_thread: threading.Thread | None = None
+
+    def start_monitor(self, interval_s: float = 0.25) -> None:
+        if self._monitor_thread is not None:
+            return
+        # graftlint: owned-thread -- lease-expiry pump: it only calls
+        # the lock-guarded ledger API on a fixed cadence
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, args=(interval_s,),
+            name="elastic-lease-monitor", daemon=True,
+        )
+        self._monitor_thread.start()
+
+    def _monitor(self, interval_s: float) -> None:
+        while not self._monitor_stop.wait(interval_s):
+            self.ledger.expire_scan()
+
+    def _on_drain(self) -> None:
+        self._monitor_stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=5.0)
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "elastic_join":
+            worker = str(req.get("worker") or "")
+            self.ledger.join(worker)
+            return {
+                "ok": True,
+                "rundir": self.ledger.rundir,
+                "cfg": self.cfg_doc,
+                "slices": len(self.ledger.slices),
+                "lease_s": self.ledger.lease_s,
+            }
+        if op == "lease":
+            return {"ok": True, **self.ledger.lease(str(req.get("worker") or ""))}
+        if op == "heartbeat":
+            ok = self.ledger.heartbeat(
+                str(req.get("worker") or ""), str(req.get("lease_id") or "")
+            )
+            if not ok:
+                return {"ok": False, "reason": "lease_expired"}
+            return {"ok": True, "lease_s": self.ledger.lease_s}
+        if op == "publish":
+            return self.ledger.commit(
+                str(req.get("lease_id") or ""),
+                int(req.get("slice", -1)),
+                req.get("manifest") or {},
+                worker=str(req.get("worker") or ""),
+            )
+        if op == "status":
+            return {"ok": True, **self.ledger.counts()}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+# ----------------------------------------------------------------- run front
+
+
+def _check_runnable(cfg: FrameworkConfig) -> None:
+    """Loud scope refusals: elastic covers the self-mode molecular →
+    duplex chain; anything narrower must say so instead of producing
+    output that silently differs from the single-process run."""
+    problems = []
+    if cfg.aligner != "self":
+        problems.append(
+            f"aligner={cfg.aligner!r} (elastic runs the self-mode "
+            "molecular->duplex chain only)"
+        )
+    if getattr(cfg, "filter", None):
+        problems.append("the filter stage is single-process only")
+    if getattr(cfg, "single_strand", False):
+        problems.append("single_strand consensus is single-process only")
+    if getattr(cfg, "methyl", "off") != "off":
+        problems.append(
+            "methyl tallies are per-process accumulators with no "
+            "cross-worker merge yet (methyl=off to run elastic)"
+        )
+    if problems:
+        raise ElasticError("elastic run refused: " + "; ".join(problems))
+
+
+def _run_inline(cfg: FrameworkConfig, ledger: SliceLedger) -> None:
+    """Sequential in-process execution of every pending slice — the
+    tier-1 test mode. Byte-identity is concurrency-independent (the
+    merge consumes committed slice outputs in slice order), so inline
+    runs pin exactly the bytes the subprocess fleet produces."""
+    from bsseqconsensusreads_tpu.elastic import worker as _worker
+
+    wid = os.environ.get(ENV_WORKER_ID) or "inline"
+    while True:
+        grant = ledger.lease(wid)
+        if grant.get("done"):
+            return
+        if grant.get("wait"):
+            ledger.expire_scan()
+            time.sleep(0.01)
+            continue
+        manifest = _worker.process_slice(
+            cfg, ledger.rundir, grant["slice"], worker=wid
+        )
+        resp = ledger.commit(
+            grant["lease_id"], grant["slice"]["sid"], manifest, worker=wid
+        )
+        if not resp.get("ok"):
+            # lapsed lease: the slice went back to pending and the next
+            # loop pass resumes it from its checkpoint
+            if resp.get("reason") == "lease_expired":
+                continue
+            raise ElasticError(f"inline commit refused: {resp}")
+
+
+def _run_fleet(
+    ledger: SliceLedger,
+    cfg_doc_: dict,
+    *,
+    workers: int,
+    address: str,
+    worker_failpoints: dict,
+    max_restarts: int,
+    timeout_s: float,
+) -> None:
+    """Coordinator in-process + N worker subprocesses (the fleet spawn
+    idiom: identity env var, one-shot first-life failpoint override,
+    respawn budget)."""
+    server = Coordinator(ledger, cfg_doc_, addresses=[address])
+    server.start_monitor()
+    # graftlint: owned-thread -- the accept loop owns the socket; this
+    # thread exists so the supervisor below can poll worker processes
+    thread = threading.Thread(
+        target=server.serve_forever, name="elastic-coordinator", daemon=True
+    )
+    thread.start()
+    deadline = time.monotonic() + timeout_s
+    try:
+        while not server.bound:
+            if time.monotonic() > deadline:
+                raise ElasticError("coordinator failed to bind in time")
+            time.sleep(0.02)
+        addr = server.bound[0]
+        fail_once = dict(worker_failpoints)
+        procs: dict[str, subprocess.Popen | None] = {}
+        restarts: dict[str, int] = {}
+
+        def spawn(wid: str) -> None:
+            env = dict(os.environ)
+            env[ENV_WORKER_ID] = wid
+            env[ENV_COORDINATOR_ADDR] = addr
+            # failpoints arm per worker FIRST LIFE only (the chaos
+            # drill's kill must not be inherited by the respawn — or by
+            # every worker when the parent itself is under failpoints)
+            schedule = fail_once.pop(wid, None)
+            if schedule:
+                env["BSSEQ_TPU_FAILPOINTS"] = schedule
+            else:
+                env.pop("BSSEQ_TPU_FAILPOINTS", None)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+                 "elastic", "worker", "--join", addr],
+                env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            procs[wid] = proc
+            observe.emit(
+                "elastic_worker_spawn",
+                {"worker": wid, "pid": proc.pid,
+                 "generation": restarts.get(wid, 0)},
+            )
+
+        for i in range(workers):
+            wid = f"w{i}"
+            restarts[wid] = 0
+            spawn(wid)
+
+        while not ledger.all_done():
+            if time.monotonic() > deadline:
+                raise ElasticError(
+                    f"elastic run timed out ({timeout_s:.0f}s) with "
+                    f"{ledger.counts()}"
+                )
+            for wid, proc in list(procs.items()):
+                if proc is None or proc.poll() is None:
+                    continue
+                rc = proc.returncode
+                procs[wid] = None
+                if rc != 0:
+                    ledger.note_worker_dead(wid)
+                if ledger.all_done():
+                    continue
+                if restarts[wid] < max_restarts:
+                    restarts[wid] += 1
+                    spawn(wid)
+            if all(p is None for p in procs.values()) and not ledger.all_done():
+                raise ElasticError(
+                    "all workers exited with work pending "
+                    f"(restart budget {max_restarts} exhausted): "
+                    f"{ledger.counts()}"
+                )
+            time.sleep(0.05)
+
+        # every slice durable: live workers see done=True and exit 0
+        for proc in procs.values():
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=60.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+    finally:
+        server.request_drain()
+        thread.join(timeout=10.0)
+
+
+def run_elastic(
+    cfg: FrameworkConfig,
+    bam_path: str,
+    outdir: str = "output",
+    *,
+    workers: int = 2,
+    slices: int = 0,
+    address: str = "tcp:127.0.0.1:0",
+    inline: bool = False,
+    worker_failpoints: dict | None = None,
+    max_restarts: int = 2,
+    lease_s: float | None = None,
+    timeout_s: float = 3600.0,
+) -> tuple[str, dict]:
+    """One elastic run end to end: split → lease/execute → merge →
+    reconcile. Returns (final target path, reconciliation report).
+    Raises ElasticError when the counters refuse to reconcile — a
+    faster wrong answer is not a result."""
+    _check_runnable(cfg)
+    os.makedirs(outdir, exist_ok=True)
+    rundir = os.path.join(outdir, "elastic")
+    os.makedirs(rundir, exist_ok=True)
+    n_slices = slices if slices >= 1 else max(1, workers) * 4
+    t0 = time.monotonic()
+    specs = split_input(bam_path, rundir, n_slices)
+    doc = config_doc(cfg)
+    _save_json_atomic(os.path.join(rundir, CFG_DOC), doc)
+    ledger = SliceLedger(rundir, specs, lease_s=lease_s)
+    if inline or workers < 1:
+        _run_inline(cfg, ledger)
+    else:
+        _run_fleet(
+            ledger, doc,
+            workers=workers, address=address,
+            worker_failpoints=worker_failpoints or {},
+            max_restarts=max_restarts, timeout_s=timeout_s,
+        )
+    from bsseqconsensusreads_tpu.elastic import merge as _merge
+
+    target, report = _merge.finalize(cfg, bam_path, outdir, specs,
+                                     ledger.manifests())
+    report["requeues"] = ledger.requeues
+    report["workers_lost"] = ledger.workers_lost
+    report["wall_s"] = round(time.monotonic() - t0, 3)
+    observe.emit(
+        "elastic_run_complete",
+        {"slices": len(specs), "records": report["records"],
+         "requeues": ledger.requeues, "workers_lost": ledger.workers_lost,
+         "ok": report["ok"]},
+    )
+    observe.flush_sinks()
+    if not report["ok"]:
+        raise ElasticError(
+            f"elastic run did not reconcile: {report['checks']}"
+        )
+    return target, report

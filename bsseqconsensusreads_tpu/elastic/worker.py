@@ -1,0 +1,251 @@
+"""graftswarm worker: the thin process side of `cli elastic`.
+
+A worker is deliberately boring — it joins a coordinator, then loops
+lease → run the EXISTING pipeline/stages.py chain over the leased
+slice → publish a manifest. All elastic intelligence (splitting,
+requeue, merge, reconciliation) lives coordinator-side; this module
+adds nothing to the science path, which is the whole byte-identity
+argument: the records a slice emits are the records the single-process
+run emits for those families, produced by the same code.
+
+Per slice the worker runs `run_pipeline` in a SLICE-KEYED work dir
+(`<rundir>/slices/s<NNNN>/`). Keying by slice rather than worker is
+the loss-recovery mechanism: when a lease lapses and the slice is
+requeued, the next holder resumes from the same dir, where
+BatchCheckpoint keeps the longest verified CRC shard prefix and
+recomputes only the remainder — the dead worker's finished batches are
+never redone and never double-emitted.
+
+The published manifest carries the slice's family fingerprint, output
+CRC, per-stage StageStats, and the per-bucket record counts of its
+coordinate-bucketed output (BucketPlan over the final header), which
+the coordinator's merge reconciles against the merged stream before
+the run may call itself ok.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import threading
+import time
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
+from bsseqconsensusreads_tpu.faults import integrity as _integrity
+from bsseqconsensusreads_tpu.io.bam import BamReader
+from bsseqconsensusreads_tpu.parallel.multihost import WorkerHeartbeat
+from bsseqconsensusreads_tpu.pipeline.bucketemit import (
+    BucketPlan,
+    blob_bucket_key,
+    resolve_buckets,
+)
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.utils import observe
+
+from bsseqconsensusreads_tpu.elastic.coordinator import (
+    ENV_COORDINATOR_ADDR,
+    ENV_WORKER_ID,
+    ElasticError,
+    config_from_doc,
+    slice_name,
+)
+
+
+def slice_config(cfg: FrameworkConfig) -> FrameworkConfig:
+    """The per-slice pipeline config. Grouping is forced off (slices
+    are cut FROM grouped input; regrouping a shard could renumber
+    families), interstage streaming off (checkpointing requires the
+    materialized interstage, stages._interstage_blocked), and
+    checkpoints on — they are what makes requeue cheap."""
+    return dataclasses.replace(
+        cfg,
+        group_umis="never",
+        stream_interstage=False,
+        checkpoint_every=cfg.checkpoint_every if cfg.checkpoint_every >= 1
+        else 4,
+    )
+
+
+def _bucket_manifest(path: str, buckets: int) -> tuple[list[int], int]:
+    """Per-bucket record counts of one coordinate-sorted slice output
+    (the PR 12 bucket geometry over the output's own header). The merge
+    recomputes the same vector over the merged stream; equality means
+    no record moved buckets and none vanished."""
+    with BamReader(path, threads=1) as reader:
+        plan = BucketPlan.from_header(reader.header, buckets)
+        counts = [0] * plan.nbuckets
+        total = 0
+        for blob in reader.raw_records():
+            counts[plan.bucket_of(blob_bucket_key(blob))] += 1
+            total += 1
+    return counts, total
+
+
+def _reset_stale_finals(sdir: str, sname: str, worker: str) -> None:
+    """A leased slice has NO committed manifest (the coordinator only
+    leases unverified slices), so a durable stage FINAL in its work dir
+    is the orphan of a holder that died between a stage finalize and
+    the manifest commit. Stage stats are not durable: resuming past
+    such a final would skip the stage whole (mtime rerun semantics) and
+    the published manifest could never reconcile its ingest counters
+    against the split. Finals appear atomically (tmp+rename at
+    ckpt_finalize), so their presence is exact — clear the work dir and
+    recompute the slice. Mid-stage deaths leave .ckpt/.part shards,
+    never a final, so the cheap batches_kept resume path is untouched."""
+    stale = sorted(
+        f for f in os.listdir(sdir)
+        if f.endswith(".bam") and ".ckpt" not in f and ".part" not in f
+    )
+    if not stale:
+        return
+    for f in os.listdir(sdir):
+        path = os.path.join(sdir, f)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.unlink(path)
+    observe.emit(
+        "elastic_slice_reset",
+        {"slice": sname, "worker": worker, "stale": stale},
+    )
+
+
+def process_slice(cfg: FrameworkConfig, rundir: str, sl: dict,
+                  worker: str = "") -> dict:
+    """Run the standard pipeline chain over one leased slice; returns
+    the publishable manifest. Work dir is keyed by SLICE id so a
+    requeued slice resumes its own checkpoints."""
+    sname = slice_name(sl["sid"])
+    _failpoints.fire("elastic_slice", slice=sname, worker=worker)
+    sdir = os.path.join(rundir, "slices", sname)
+    os.makedirs(sdir, exist_ok=True)
+    _reset_stale_finals(sdir, sname, worker)
+    scfg = dataclasses.replace(slice_config(cfg), tmp=sdir)
+    slice_bam = os.path.join(rundir, sl["path"])
+    _integrity.verify_file_crc32(
+        slice_bam, sl["input_crc"], what=f"slice input {sname}"
+    )
+    # deferred: run_pipeline pulls the jax stack in; workers that only
+    # join/poll must stay cheap to import
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    t0 = time.monotonic()
+    target, _results, stats = run_pipeline(scfg, slice_bam, outdir=sdir)
+    wall_s = time.monotonic() - t0
+    buckets, records_out = _bucket_manifest(
+        target, resolve_buckets(cfg.sort_buckets)
+    )
+    manifest = {
+        "slice": sname,
+        "worker": worker,
+        "output": os.path.basename(target),
+        "crc": _integrity.file_crc32(target),
+        "family_crc": sl["family_crc"],
+        "records_in": sl["records"],
+        "records_out": records_out,
+        "buckets": buckets,
+        "wall_s": round(wall_s, 3),
+        "stats": {stage: s.as_dict() for stage, s in stats.items()},
+    }
+    observe.emit(
+        "elastic_slice_processed",
+        {"slice": sname, "worker": worker, "records_out": records_out,
+         "wall_s": manifest["wall_s"]},
+    )
+    return manifest
+
+
+def _renew_lease(address: str, worker: str, lease_id: str, lease_s: float,
+                 stop: threading.Event, hb: WorkerHeartbeat) -> None:
+    """Renewal pump for one held lease: a third of the lease period, so
+    only a hung or dead process lets the lease lapse. A refused renewal
+    means the coordinator already requeued us — stop renewing and let
+    the publish refusal surface it."""
+    interval = max(0.05, lease_s / 3.0)
+    while not stop.wait(interval):
+        hb.beat(phase="lease_renew", lease_id=lease_id)
+        try:
+            resp = transport.request(
+                address,
+                {"op": "heartbeat", "worker": worker, "lease_id": lease_id},
+                timeout=max(5.0, lease_s),
+            )
+        except (OSError, transport.TransportError):
+            continue  # transient: the next tick retries; expiry is the floor
+        if not resp.get("ok"):
+            return
+
+
+def work_loop(address: str, worker_id: str | None = None,
+              poll_s: float = 0.2) -> int:
+    """Join a coordinator and process leased slices until it says done.
+    Returns the number of slices this process published."""
+    wid = worker_id or os.environ.get(ENV_WORKER_ID) or f"pid{os.getpid()}"
+    os.environ[ENV_WORKER_ID] = wid
+    os.environ[ENV_COORDINATOR_ADDR] = address
+    joined = transport.request(
+        address, {"op": "elastic_join", "worker": wid}, timeout=60.0
+    )
+    if not joined.get("ok"):
+        raise ElasticError(f"join refused by {address}: {joined}")
+    cfg = config_from_doc(joined["cfg"])
+    rundir = joined["rundir"]
+    lease_default = float(joined.get("lease_s") or 30.0)
+    hb = WorkerHeartbeat(component="elastic")
+    hb.start()
+    processed = 0
+    try:
+        while True:
+            hb.beat(phase="lease_poll")
+            grant = transport.request(
+                address, {"op": "lease", "worker": wid}, timeout=60.0
+            )
+            if grant.get("done"):
+                return processed
+            if grant.get("wait") or "slice" not in grant:
+                time.sleep(poll_s)
+                continue
+            sl = grant["slice"]
+            lease_id = grant["lease_id"]
+            lease_s = float(grant.get("lease_s") or lease_default)
+            stop = threading.Event()
+            # graftlint: owned-thread -- lease-renewal pump for the
+            # slice this loop iteration is processing; joined below
+            renewer = threading.Thread(
+                target=_renew_lease,
+                args=(address, wid, lease_id, lease_s, stop, hb),
+                name=f"lease-renew-{lease_id}", daemon=True,
+            )
+            renewer.start()
+            try:
+                manifest = process_slice(cfg, rundir, sl, worker=wid)
+            finally:
+                stop.set()
+                renewer.join(timeout=5.0)
+            _failpoints.fire("elastic_publish", slice=manifest["slice"],
+                             worker=wid)
+            resp = transport.request(
+                address,
+                {"op": "publish", "worker": wid, "lease_id": lease_id,
+                 "slice": sl["sid"], "manifest": manifest},
+                timeout=600.0,
+            )
+            if resp.get("ok"):
+                processed += 1
+                continue
+            if resp.get("reason") == "lease_expired":
+                # our lease lapsed mid-slice and the slice was requeued;
+                # the durable checkpoints keep the work — go get a new
+                # lease (possibly for this same slice)
+                observe.emit(
+                    "elastic_publish_refused",
+                    {"slice": manifest["slice"], "worker": wid,
+                     "reason": "lease_expired"},
+                )
+                continue
+            raise ElasticError(f"publish refused: {resp}")
+    finally:
+        hb.stop()
+        observe.flush_sinks()

@@ -93,6 +93,16 @@ SITES = frozenset(
         # chaos drill can kill a replica mid-job: exit:9@batch=N)
         "fleet_route",
         "fleet_replica_exit",
+        # elastic — coordinator/worker sharded runs: the start of slice
+        # processing in a worker (exit:9@hit=N kills a worker mid-run,
+        # the slice_requeued drill), the publish edge (work durable but
+        # unpublished), the coordinator's manifest commit (crash after
+        # output verified but before durable commit — the
+        # coordinator-restart drill window), and the final merge.
+        "elastic_slice",
+        "elastic_publish",
+        "elastic_manifest_commit",
+        "elastic_merge",
     }
 )
 

@@ -103,6 +103,34 @@ def replica_sink(replica: str) -> str | None:
     return os.path.join(directory, f"{safe}.jsonl")
 
 
+def worker_id() -> str | None:
+    """This process's elastic worker identity (BSSEQ_TPU_WORKER_ID, set
+    by elastic.coordinator when it spawns a worker, or by the worker
+    itself on `cli elastic worker --join`). When present, every emit is
+    stamped with a 'worker' field — one shared elastic ledger carries N
+    workers as separable sub-streams (`observe summarize --worker`)."""
+    return os.environ.get("BSSEQ_TPU_WORKER_ID") or None
+
+
+def worker_sink_dir() -> str | None:
+    """Directory for per-worker ledger sub-sinks
+    (BSSEQ_TPU_STATS_WORKERS): when set, every worker-tagged emit is
+    mirrored to <dir>/<worker>.jsonl — one standalone-shaped ledger per
+    worker — in addition to the tag in the shared elastic ledger."""
+    return os.environ.get("BSSEQ_TPU_STATS_WORKERS") or None
+
+
+def worker_sink(worker: str) -> str | None:
+    """The sub-sink path for one worker id, sanitized like job_sink."""
+    directory = worker_sink_dir()
+    if directory is None:
+        return None
+    safe = "".join(
+        c if c.isalnum() or c in "._-" else "_" for c in str(worker)
+    ) or "_"
+    return os.path.join(directory, f"{safe}.jsonl")
+
+
 def trace_dir() -> str | None:
     return os.environ.get("BSSEQ_TPU_TRACE") or None
 
@@ -230,12 +258,16 @@ def emit(
     every line with a 'replica' field the same way — the shared fleet
     ledger separates per replica (`observe summarize --replica`), and
     BSSEQ_TPU_STATS_REPLICAS mirrors each replica's lines to its own
-    sub-sink."""
+    sub-sink. Elastic workers (BSSEQ_TPU_WORKER_ID) stamp 'worker'
+    identically (`observe summarize --worker`,
+    BSSEQ_TPU_STATS_WORKERS)."""
     sink = sink if sink is not None else stats_sink()
     sub = job_sink(job) if job is not None else None
     replica = replica_id()
     rsub = replica_sink(replica) if replica is not None else None
-    if sink is None and sub is None and rsub is None:
+    worker = worker_id()
+    wsub = worker_sink(worker) if worker is not None else None
+    if sink is None and sub is None and rsub is None and wsub is None:
         return
     record = {"ts": round(time.time(), 3), "event": event}
     cur = threading.current_thread()
@@ -246,10 +278,12 @@ def emit(
         record["job"] = job
     if replica is not None:
         record["replica"] = replica
+    if worker is not None:
+        record["worker"] = worker
     line = json.dumps(record)
     if sink is not None:
         _writer(sink).write_line(line)
-    for mirror in (sub, rsub):
+    for mirror in (sub, rsub, wsub):
         if mirror is not None:
             os.makedirs(os.path.dirname(mirror), exist_ok=True)
             _writer(mirror).write_line(line)
@@ -338,6 +372,15 @@ def run_manifest(
         "argv": " ".join(sys.argv[:6]),
         "env": _env_flags(),
     }
+    # elastic identity: stamped so `observe diff` can line up worker
+    # sub-streams across hosts (the replica id gets the same treatment
+    # implicitly via _env_flags; these two are first-class because the
+    # worker/coordinator pairing is what the diff joins on)
+    if worker_id() is not None:
+        payload["worker_id"] = worker_id()
+    coord = os.environ.get("BSSEQ_TPU_COORDINATOR_ADDR")
+    if coord:
+        payload["coordinator_addr"] = coord
     if extra:
         payload.update(extra)
     return payload

@@ -1,0 +1,29 @@
+"""Seeded fixture: unleased-work-dispatch.
+
+A dispatch loop handing work slices to a transport send with no lease
+in scope, next to its leased twin that must stay clean.
+"""
+
+from bsseqconsensusreads_tpu.serve import transport
+
+
+def dispatch_all(address, slices):
+    results = []
+    for sl in slices:
+        resp = transport.request(address, {"op": "assign", "slice": sl})  # seeded: unleased-work-dispatch
+        results.append(resp)
+    return results
+
+
+def dispatch_leased(address, slices, ledger):
+    results = []
+    for sl in slices:
+        lease_id = ledger.lease(sl)
+        lease_expires = ledger.expiry_of(lease_id)
+        resp = transport.request(
+            address,
+            {"op": "assign", "slice": sl, "lease_id": lease_id,
+             "until": lease_expires},
+        )
+        results.append(resp)
+    return results

@@ -194,3 +194,60 @@ def test_subprocess_crash_in_finalize_with_corrupt_shard(crash_env):
     # the corrupt shard's batches (and the orphaned suffix) re-executed
     assert resumed["batches"]["duplex"] > 0
     assert _scraps(out_crash) == []
+
+
+@pytest.mark.slow
+def test_elastic_worker_crash_hands_checkpoints_to_respawn(crash_env):
+    """graftswarm leg: an elastic worker hard-killed at a checkpoint
+    shard write (ckpt_shard_write exit, same site as the single-process
+    drills) is respawned; the requeued slice resumes from the dead
+    worker's durable shard prefix in the slice-keyed work dir, and the
+    merged output is byte-identical to the uninterrupted single-process
+    run. The `slice_requeued` ledger line records the checkpoint
+    fingerprint handoff (batches_kept > 0)."""
+    wd = crash_env["wd"]
+    cfgfile = wd / "elastic_cfg.yaml"
+    cfgfile.write_text(
+        "backend: cpu\naligner: self\ngrouping: coordinate\n"
+        "batch_families: 8\ncheckpoint_every: 2\n"
+    )
+    outdir = str(wd / "out_elastic_crash")
+    ledger = str(wd / "elastic_crash_ledger.jsonl")
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        BSSEQ_TPU_BACKEND="cpu",
+        JAX_PLATFORMS="cpu",
+        BSSEQ_TPU_STATS=ledger,
+    )
+    env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+         "elastic", "run",
+         "--config", str(cfgfile),
+         "--bam", str(wd / "input" / "in.bam"),
+         "--reference", str(wd / "genome.fa"),
+         "--outdir", outdir,
+         "--workers", "1", "--slices", "2",
+         # hit=3: two checkpoint manifests (every=2) are durable first
+         "--worker-failpoints", "w0:ckpt_shard_write=exit:9@hit=3"],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert cp.returncode == 0, cp.stdout + cp.stderr[-2000:]
+    out = json.loads(cp.stdout)
+    assert open(out["target"], "rb").read() == crash_env["plain_bytes"]
+    report = out["report"]
+    assert report["ok"], report["checks"]
+    assert report["requeues"] >= 1 and report["workers_lost"] >= 1
+
+    requeued = [
+        json.loads(line)
+        for line in open(ledger)
+        if '"slice_requeued"' in line
+    ]
+    assert requeued and requeued[0]["worker"] == "w0"
+    assert requeued[0]["batches_kept"] > 0
+    spawns = sum(
+        1 for line in open(ledger) if '"elastic_worker_spawn"' in line
+    )
+    assert spawns >= 2  # w0's first life + its respawn

@@ -1,0 +1,656 @@
+"""graftswarm (elastic/) tests: split geometry, lease ledger, wire ops,
+byte-identity, and loss recovery.
+
+* split — contiguous base-family ordinal ranges: uneven boundaries,
+  families never cut across slices, idempotent resume, damaged-slice
+  rebuild, ungrouped-input refusal;
+* ledger — lease/commit happy path, expiry → requeue, heartbeat
+  renewal, lapsed-lease publish refusal + duplicate-commit tolerance,
+  fingerprint/integrity refusals, crash-only restart rescan;
+* coordinator wire — the elastic op table over the framed transport;
+* byte-identity — inline runs over 1/3/4/7 slices and an in-process
+  work_loop over real tcp all produce the single-process SHA, and the
+  per-slice StageStats sums reconcile against the single-process run;
+* loss recovery (slow) — `cli elastic run` fleets (2 and 4 workers),
+  a worker killed mid-slice by failpoint (requeue + respawn, same
+  bytes), and a TLS coordinator join.
+
+In-process tests stay tier-1; subprocess fleet tests are marked slow,
+same split as tests/test_fleet.py.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.elastic import (
+    Coordinator,
+    ElasticError,
+    SliceLedger,
+    base_mi,
+    config_doc,
+    config_from_doc,
+    merge as merge_mod,
+    run_elastic,
+    slice_name,
+    split_input,
+    worker as worker_mod,
+)
+from bsseqconsensusreads_tpu.elastic.coordinator import (
+    ENV_COORDINATOR_ADDR,
+    ENV_WORKER_ID,
+)
+from bsseqconsensusreads_tpu.faults import integrity
+from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.utils import ledger_tools
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+N_FAMILIES = 10
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+@pytest.fixture(scope="module")
+def swarm_env(tmp_path_factory):
+    """One grouped input + its single-process pipeline run: the byte
+    and counter baseline every elastic test reconciles against."""
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    tmp = tmp_path_factory.mktemp("swarm")
+    rng = np.random.default_rng(905)
+    name, genome = random_genome(rng, 6000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=N_FAMILIES, error_rate=0.01
+    )
+    bam = str(tmp / "input" / "swarm.bam")
+    os.makedirs(os.path.dirname(bam), exist_ok=True)
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    cfg = FrameworkConfig(
+        genome_dir=os.path.dirname(fasta),
+        genome_fasta_file_name=os.path.basename(fasta),
+        aligner="self",
+    )
+    sp_out = str(tmp / "single")
+    sp_cfg = dataclasses.replace(cfg, tmp=str(tmp / "single_tmp"))
+    target, _results, stats = run_pipeline(sp_cfg, bam, outdir=sp_out)
+    return {
+        "tmp": tmp,
+        "fasta": fasta,
+        "bam": bam,
+        "cfg": cfg,
+        "records": len(records),
+        "sp_target": target,
+        "sp_sha": _sha(target),
+        "sp_stats": {stage: s.as_dict() for stage, s in stats.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# split geometry
+
+
+class TestSplit:
+    def test_uneven_bounds_partition_families(self, swarm_env, tmp_path):
+        """10 families over 4 slices: 2/3/2/3, contiguous first-seen
+        ordinal ranges, no family cut across slices, no record lost."""
+        rundir = str(tmp_path / "run")
+        specs = split_input(swarm_env["bam"], rundir, 4)
+        assert [sl["families"] for sl in specs] == [2, 3, 2, 3]
+        assert sum(sl["records"] for sl in specs) == swarm_env["records"]
+        seen_order = []
+        with BamReader(swarm_env["bam"]) as r:
+            for rec in r:
+                fam = base_mi(str(rec.get_tag("MI")))
+                if fam not in seen_order:
+                    seen_order.append(fam)
+        families = []
+        for sl in specs:
+            fams = []
+            with BamReader(os.path.join(rundir, sl["path"])) as r:
+                for rec in r:
+                    fam = base_mi(str(rec.get_tag("MI")))
+                    if fam not in fams:
+                        fams.append(fam)
+            assert len(fams) == sl["families"]
+            families.append(fams)
+        flat = [f for fams in families for f in fams]
+        # disjoint, complete, and in global first-seen order = contiguous
+        assert flat == seen_order
+
+    def test_resume_reuses_intact_slices(self, swarm_env, tmp_path):
+        rundir = str(tmp_path / "run")
+        specs = split_input(swarm_env["bam"], rundir, 3)
+        mtimes = {
+            sl["sid"]: os.path.getmtime(os.path.join(rundir, sl["path"]))
+            for sl in specs
+        }
+        again = split_input(swarm_env["bam"], rundir, 3)
+        assert again == specs
+        for sl in again:
+            assert os.path.getmtime(
+                os.path.join(rundir, sl["path"])
+            ) == mtimes[sl["sid"]]
+
+    def test_damaged_slice_rebuilds(self, swarm_env, tmp_path):
+        rundir = str(tmp_path / "run")
+        specs = split_input(swarm_env["bam"], rundir, 3)
+        victim = os.path.join(rundir, specs[1]["path"])
+        with open(victim, "r+b") as fh:
+            fh.seek(0)
+            fh.write(b"\x00\x00\x00\x00")
+        rebuilt = split_input(swarm_env["bam"], rundir, 3)
+        assert rebuilt == specs
+        integrity.verify_file_crc32(victim, specs[1]["input_crc"])
+
+    def test_slice_count_clamps_to_families(self, swarm_env, tmp_path):
+        specs = split_input(swarm_env["bam"], str(tmp_path / "run"), 99)
+        assert len(specs) == N_FAMILIES
+        assert all(sl["families"] == 1 for sl in specs)
+
+    def test_single_slice_is_whole_input(self, swarm_env, tmp_path):
+        (sl,) = split_input(swarm_env["bam"], str(tmp_path / "run"), 1)
+        assert sl["records"] == swarm_env["records"]
+        assert sl["families"] == N_FAMILIES
+
+    def test_ungrouped_input_refused(self, swarm_env, tmp_path):
+        ungrouped = str(tmp_path / "ungrouped.bam")
+        with BamReader(swarm_env["bam"]) as r:
+            header = r.header
+            recs = list(r)
+        for rec in recs:
+            rec.tags.pop("MI", None)
+        with BamWriter(ungrouped, header) as w:
+            w.write_all(recs)
+        with pytest.raises(ElasticError, match="grouped"):
+            split_input(ungrouped, str(tmp_path / "run"), 2)
+
+
+# ---------------------------------------------------------------------------
+# lease ledger (fake slices: no pipeline involved)
+
+
+def _fake_rundir(tmp_path, n=2):
+    """A rundir with n fake slice specs + committed-output scaffolding:
+    slice dirs exist, and _out writes a publishable output file."""
+    rundir = str(tmp_path / "run")
+    specs = []
+    for sid in range(n):
+        sdir = os.path.join(rundir, "slices", slice_name(sid))
+        os.makedirs(sdir, exist_ok=True)
+        specs.append({
+            "sid": sid,
+            "path": os.path.join("slices", f"{slice_name(sid)}.bam"),
+            "records": 5 + sid,
+            "families": 2,
+            "family_crc": 1000 + sid,
+            "input_crc": 0,
+        })
+    return rundir, specs
+
+
+def _out(rundir, sid, payload=b"consensus-bytes"):
+    """Drop a fake slice output and return its publishable manifest."""
+    sdir = os.path.join(rundir, "slices", slice_name(sid))
+    path = os.path.join(sdir, "out.bam")
+    with open(path, "wb") as fh:
+        fh.write(payload)
+    return {
+        "slice": slice_name(sid),
+        "output": "out.bam",
+        "crc": integrity.file_crc32(path),
+        "family_crc": 1000 + sid,
+        "records_out": 2,
+    }
+
+
+class TestSliceLedger:
+    def test_lease_commit_done(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=2)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        sids = []
+        for _ in range(2):
+            grant = ledger.lease("w0")
+            sid = grant["slice"]["sid"]
+            sids.append(sid)
+            assert grant["lease_id"].startswith(slice_name(sid))
+            resp = ledger.commit(
+                grant["lease_id"], sid, _out(rundir, sid), worker="w0"
+            )
+            assert resp == {"ok": True}
+        assert sorted(sids) == [0, 1]
+        assert ledger.all_done()
+        assert ledger.lease("w0") == {"done": True}
+        assert ledger.counts()["requeues"] == 0
+
+    def test_outstanding_lease_means_wait_not_done(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        grant = ledger.lease("w0")
+        assert ledger.lease("w1") == {"wait": True}
+        ledger.commit(grant["lease_id"], 0, _out(rundir, 0))
+        assert ledger.lease("w1") == {"done": True}
+
+    def test_expiry_requeues_and_relets(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=0.05)
+        grant = ledger.lease("w0")
+        time.sleep(0.12)
+        assert ledger.expire_scan() == 1
+        counts = ledger.counts()
+        assert counts["requeues"] == 1 and counts["workers_lost"] == 1
+        regrant = ledger.lease("w1")
+        assert regrant["slice"]["sid"] == grant["slice"]["sid"]
+        assert regrant["lease_id"] != grant["lease_id"]
+
+    def test_heartbeat_extends_lease(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=0.2)
+        grant = ledger.lease("w0")
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            assert ledger.heartbeat("w0", grant["lease_id"])
+            assert ledger.expire_scan() == 0
+            time.sleep(0.05)
+        # wrong holder never renews someone else's lease
+        assert not ledger.heartbeat("w1", grant["lease_id"])
+
+    def test_lapsed_publish_refused_then_duplicate_tolerated(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=0.05)
+        stale = ledger.lease("w0")
+        time.sleep(0.12)
+        ledger.expire_scan()
+        assert not ledger.heartbeat("w0", stale["lease_id"])
+        manifest = _out(rundir, 0)
+        refusal = ledger.commit(stale["lease_id"], 0, manifest, worker="w0")
+        assert refusal == {"ok": False, "reason": "lease_expired"}
+        # the requeued twin commits; the stale holder's late publish of
+        # identical bytes is then a tolerated duplicate
+        fresh = ledger.lease("w1")
+        assert ledger.commit(fresh["lease_id"], 0, manifest, worker="w1") == {
+            "ok": True
+        }
+        assert ledger.commit(stale["lease_id"], 0, manifest, worker="w0") == {
+            "ok": True, "duplicate": True
+        }
+
+    def test_fingerprint_and_integrity_refusals(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        grant = ledger.lease("w0")
+        bad_fam = dict(_out(rundir, 0), family_crc=999)
+        assert ledger.commit(grant["lease_id"], 0, bad_fam)["reason"] == (
+            "fingerprint_mismatch"
+        )
+        bad_crc = dict(_out(rundir, 0), crc=12345)
+        resp = ledger.commit(grant["lease_id"], 0, bad_crc)
+        assert not resp["ok"] and resp["reason"].startswith("output_integrity")
+        assert not ledger.all_done()
+
+    def test_worker_death_fast_path(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=2)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        g0 = ledger.lease("w0")
+        ledger.lease("w1")
+        ledger.note_worker_dead("w0")
+        counts = ledger.counts()
+        assert counts["requeues"] == 1 and counts["pending"] == 1
+        assert not ledger.heartbeat("w0", g0["lease_id"])
+
+    def test_restart_rescan_keeps_verified_manifests(self, tmp_path):
+        """Crash-only coordinator: a fresh ledger over the same rundir
+        trusts only manifests whose fingerprint matches AND whose output
+        bytes still verify."""
+        rundir, specs = _fake_rundir(tmp_path, n=2)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        grant = ledger.lease("w0")
+        sid = grant["slice"]["sid"]
+        ledger.commit(grant["lease_id"], sid, _out(rundir, sid))
+
+        reborn = SliceLedger(rundir, specs, lease_s=30.0)
+        counts = reborn.counts()
+        assert counts["done"] == 1 and counts["pending"] == 1
+        assert reborn.lease("w0")["slice"]["sid"] != sid
+
+        # tamper with the committed output: the next restart distrusts it
+        out = os.path.join(rundir, "slices", slice_name(sid), "out.bam")
+        with open(out, "wb") as fh:
+            fh.write(b"bitrot")
+        third = SliceLedger(rundir, specs, lease_s=30.0)
+        assert third.counts()["done"] == 0
+
+
+# ---------------------------------------------------------------------------
+# coordinator wire ops (in-process server, real tcp)
+
+
+class TestCoordinatorWire:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, {"doc": True}, addresses=["tcp:127.0.0.1:0"]
+        )
+        # graftlint: owned-thread -- test fixture accept loop, drained
+        # in teardown
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        deadline = time.monotonic() + 10.0
+        while not server.bound and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.bound
+        yield server.bound[0], rundir, ledger
+        server.request_drain()
+        thread.join(timeout=10.0)
+
+    def test_op_table(self, served):
+        addr, rundir, _ledger = served
+        assert transport.request(addr, {"op": "ping"})["pong"]
+        joined = transport.request(
+            addr, {"op": "elastic_join", "worker": "wt"}
+        )
+        assert joined["ok"] and joined["rundir"] == rundir
+        assert joined["cfg"] == {"doc": True} and joined["slices"] == 1
+
+        grant = transport.request(addr, {"op": "lease", "worker": "wt"})
+        assert grant["ok"] and grant["slice"]["sid"] == 0
+        hb = transport.request(
+            addr,
+            {"op": "heartbeat", "worker": "wt", "lease_id": grant["lease_id"]},
+        )
+        assert hb["ok"]
+        status = transport.request(addr, {"op": "status"})
+        assert status["leased"] == 1 and status["pending"] == 0
+
+        manifest = _out(rundir, 0)
+        pub = transport.request(addr, {
+            "op": "publish", "worker": "wt",
+            "lease_id": grant["lease_id"], "slice": 0, "manifest": manifest,
+        })
+        assert pub == {"ok": True}
+        assert transport.request(addr, {"op": "lease", "worker": "wt"}) == {
+            "ok": True, "done": True
+        }
+
+    def test_unknown_op_is_a_refusal(self, served):
+        addr, _rundir, _ledger = served
+        resp = transport.request(addr, {"op": "frobnicate"})
+        assert not resp["ok"] and "unknown op" in resp["error"]
+
+    def test_bad_publish_refused_over_wire(self, served):
+        addr, rundir, _ledger = served
+        grant = transport.request(addr, {"op": "lease", "worker": "wt"})
+        bad = dict(_out(rundir, 0), family_crc=31337)
+        resp = transport.request(addr, {
+            "op": "publish", "worker": "wt",
+            "lease_id": grant["lease_id"], "slice": 0, "manifest": bad,
+        })
+        assert resp == {"ok": False, "reason": "fingerprint_mismatch"}
+
+
+# ---------------------------------------------------------------------------
+# byte-identity + reconciliation (inline + in-process work_loop)
+
+
+class TestByteIdentity:
+    @pytest.mark.parametrize("slices", [1, 3, 4, 7])
+    def test_inline_matches_single_process(self, swarm_env, tmp_path, slices):
+        outdir = str(tmp_path / "out")
+        cfg = swarm_env["cfg"]
+        target, report = run_elastic(
+            cfg, swarm_env["bam"], outdir, inline=True, slices=slices
+        )
+        assert _sha(target) == swarm_env["sp_sha"]
+        assert report["ok"] and all(report["checks"].values())
+        assert report["requeues"] == 0
+
+    def test_counters_reconcile_with_single_process(self, swarm_env, tmp_path):
+        """Summed per-slice StageStats equal the single-process run's
+        content counters. 'batches' is excluded by design: slicing
+        changes batch composition, never record content."""
+        outdir = str(tmp_path / "out")
+        _target, report = run_elastic(
+            swarm_env["cfg"], swarm_env["bam"], outdir, inline=True, slices=4
+        )
+        content_keys = [
+            k for k in merge_mod.SUMMABLE_STATS if k != "batches"
+        ]
+        for stage in ("molecular", "duplex"):
+            sp = swarm_env["sp_stats"][stage]
+            summed = report["stats"][stage]
+            for key in content_keys:
+                assert summed[key] == int(sp.get(key, 0)), (stage, key)
+        assert report["records_split"] == swarm_env["records"]
+
+    def test_work_loop_over_tcp(self, swarm_env, tmp_path, monkeypatch):
+        """A real worker loop (join → lease → pipeline → publish) over
+        tcp against a real coordinator, then the real merge: the full
+        protocol path in one process."""
+        monkeypatch.setenv(ENV_WORKER_ID, "wl0")
+        monkeypatch.setenv(ENV_COORDINATOR_ADDR, "")
+        outdir = str(tmp_path / "out")
+        rundir = os.path.join(outdir, "elastic")
+        os.makedirs(rundir, exist_ok=True)
+        cfg = swarm_env["cfg"]
+        specs = split_input(swarm_env["bam"], rundir, 3)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, config_doc(cfg), addresses=["tcp:127.0.0.1:0"]
+        )
+        server.start_monitor()
+        # graftlint: owned-thread -- test coordinator accept loop,
+        # drained below
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not server.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            processed = worker_mod.work_loop(server.bound[0], worker_id="wl0")
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)
+        assert processed == 3
+        target, report = merge_mod.finalize(
+            cfg, swarm_env["bam"], outdir, specs, ledger.manifests()
+        )
+        assert report["ok"], report["checks"]
+        assert _sha(target) == swarm_env["sp_sha"]
+        for m in ledger.manifests().values():
+            assert m["worker"] == "wl0"
+
+    def test_stale_final_reset_recomputes_with_full_stats(
+        self, swarm_env, tmp_path
+    ):
+        """A slice whose previous holder finished the pipeline but died
+        before the manifest commit leaves a durable final in the work
+        dir. Resuming past it would skip the stages whole (mtime rerun)
+        and publish a stats-empty manifest that cannot reconcile — the
+        reset must recompute and republish identical bytes WITH full
+        ingest counters."""
+        rundir = str(tmp_path / "run")
+        specs = split_input(swarm_env["bam"], rundir, 3)
+        first = worker_mod.process_slice(
+            swarm_env["cfg"], rundir, specs[0], worker="wa"
+        )
+        assert first["stats"]["molecular"]["records_in"] > 0
+        # the re-lease: same slice, no committed manifest, final present
+        second = worker_mod.process_slice(
+            swarm_env["cfg"], rundir, specs[0], worker="wb"
+        )
+        assert second["crc"] == first["crc"]
+        assert second["buckets"] == first["buckets"]
+        assert (
+            second["stats"]["molecular"]["records_in"]
+            == first["stats"]["molecular"]["records_in"]
+        )
+
+    def test_scope_refusals(self, swarm_env):
+        cfg = dataclasses.replace(swarm_env["cfg"], aligner="bwameth")
+        with pytest.raises(ElasticError, match="aligner"):
+            run_elastic(cfg, swarm_env["bam"], "unused")
+        cfg = dataclasses.replace(swarm_env["cfg"], methyl="cpg")
+        with pytest.raises(ElasticError, match="methyl"):
+            run_elastic(cfg, swarm_env["bam"], "unused")
+
+    def test_config_doc_roundtrip(self, swarm_env):
+        cfg = swarm_env["cfg"]
+        assert config_from_doc(config_doc(cfg)) == cfg
+
+
+# ---------------------------------------------------------------------------
+# subprocess fleets (slow): cli elastic run, chaos kill, TLS join
+
+
+def _elastic_env(tmp_path, **extra):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        BSSEQ_TPU_STATS=str(tmp_path / "elastic_ledger.jsonl"),
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+    )
+    env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    env.update(extra)
+    return env
+
+
+def _run_cli_elastic(swarm_env, tmp_path, *extra_args, env=None):
+    outdir = str(tmp_path / "out")
+    cp = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+         "elastic", "run",
+         "--bam", swarm_env["bam"],
+         "--reference", swarm_env["fasta"],
+         "--outdir", outdir,
+         *extra_args],
+        capture_output=True, text=True, cwd=REPO,
+        env=env or _elastic_env(tmp_path),
+        timeout=900,
+    )
+    return cp, outdir
+
+
+def _ledger_events(tmp_path):
+    counts = {}
+    with open(str(tmp_path / "elastic_ledger.jsonl")) as fh:
+        for line in fh:
+            ev = json.loads(line).get("event")
+            counts[ev] = counts.get(ev, 0) + 1
+    return counts
+
+
+@pytest.mark.slow
+class TestFleetSubprocess:
+    def test_two_worker_fleet_matches_single_process(
+        self, swarm_env, tmp_path
+    ):
+        cp, _outdir = _run_cli_elastic(
+            swarm_env, tmp_path, "--workers", "2", "--slices", "4"
+        )
+        assert cp.returncode == 0, cp.stdout + cp.stderr
+        out = json.loads(cp.stdout)
+        assert _sha(out["target"]) == swarm_env["sp_sha"]
+        assert out["report"]["ok"] and out["report"]["requeues"] == 0
+        events = _ledger_events(tmp_path)
+        assert events.get("elastic_worker_spawn") == 2
+        assert events.get("elastic_slice_done") == 4
+        assert events.get("elastic_run_complete") == 1
+
+        # worker-scoped observe views line up per process
+        ledger = str(tmp_path / "elastic_ledger.jsonl")
+        s = ledger_tools.summarize_ledger(ledger)
+        assert set(s.workers) >= {"w0", "w1"}
+        done_per_worker = 0
+        for wid in ("w0", "w1"):
+            sw = ledger_tools.summarize_ledger(ledger, worker=wid)
+            assert sw.worker == wid and not sw.problems
+            done_per_worker += sw.events.get("elastic_slice_processed", 0)
+        assert done_per_worker == 4
+
+    def test_four_worker_fleet_matches_single_process(
+        self, swarm_env, tmp_path
+    ):
+        """The acceptance gate: `--workers 4` byte-identical (SHA) to
+        the single-process run."""
+        cp, _outdir = _run_cli_elastic(
+            swarm_env, tmp_path, "--workers", "4", "--slices", "8"
+        )
+        assert cp.returncode == 0, cp.stdout + cp.stderr
+        out = json.loads(cp.stdout)
+        assert _sha(out["target"]) == swarm_env["sp_sha"]
+        assert out["report"]["ok"], out["report"]["checks"]
+        assert out["report"]["records"] == 2 * N_FAMILIES  # R1+R2 per family
+
+    def test_worker_kill_requeues_and_bytes_hold(self, swarm_env, tmp_path):
+        """Chaos leg: w0 dies mid-slice (failpoint exit:9 on its second
+        slice pickup); the slice requeues, a respawn or the survivor
+        finishes it, and the merged bytes still equal single-process."""
+        cp, _outdir = _run_cli_elastic(
+            swarm_env, tmp_path,
+            "--workers", "2", "--slices", "4",
+            "--worker-failpoints", "w0:elastic_slice=exit:9@hit=2",
+        )
+        assert cp.returncode == 0, cp.stdout + cp.stderr
+        out = json.loads(cp.stdout)
+        assert _sha(out["target"]) == swarm_env["sp_sha"]
+        report = out["report"]
+        assert report["ok"], report["checks"]
+        assert report["requeues"] >= 1 and report["workers_lost"] >= 1
+        events = _ledger_events(tmp_path)
+        assert events.get("slice_requeued", 0) >= 1
+        assert events.get("worker_lost", 0) >= 1
+        assert events.get("elastic_worker_spawn", 0) >= 3  # w0 respawned
+        assert events.get("failpoint_fired", 0) >= 1
+
+    def test_tls_join(self, swarm_env, tmp_path):
+        """TLS on the coordinator socket: the spawned workers inherit
+        the cert env and join over TLS; bytes still match."""
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl not available")
+        cert = str(tmp_path / "elastic.crt")
+        key = str(tmp_path / "elastic.key")
+        gen = subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", key, "-out", cert, "-days", "1", "-nodes",
+             "-subj", "/CN=127.0.0.1"],
+            capture_output=True, timeout=120,
+        )
+        assert gen.returncode == 0, gen.stderr
+        env = _elastic_env(
+            tmp_path,
+            BSSEQ_TPU_SERVE_TLS_CERT=cert,
+            BSSEQ_TPU_SERVE_TLS_KEY=key,
+        )
+        cp, _outdir = _run_cli_elastic(
+            swarm_env, tmp_path, "--workers", "2", "--slices", "2", env=env
+        )
+        assert cp.returncode == 0, cp.stdout + cp.stderr
+        out = json.loads(cp.stdout)
+        assert _sha(out["target"]) == swarm_env["sp_sha"]
+        assert out["report"]["ok"]

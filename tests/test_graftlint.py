@@ -43,6 +43,7 @@ FIXTURES = {
     "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
     "unframed-socket-read": "fx_unframed_socket_read.py",
     "serial-deflate": "fx_serial_deflate.py",
+    "unleased-work-dispatch": "fx_unleased_work_dispatch.py",
 }
 
 

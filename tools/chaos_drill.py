@@ -146,6 +146,52 @@ def _run_child(wd: str, bam: str, outdir: str, ledger: str,
     )
 
 
+def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
+                 workers: int, slices: int,
+                 worker_failpoints: str = "",
+                 coordinator_failpoints: str = ""):
+    """One `cli elastic run` over the drill input with the drill's
+    pipeline geometry (same cfg the _child runs use, so the merged
+    output must equal the fault-free reference bytes)."""
+    cfgfile = os.path.join(wd, "elastic_cfg.yaml")
+    if not os.path.exists(cfgfile):
+        with open(cfgfile, "w") as fh:
+            fh.write(
+                "backend: cpu\naligner: self\ngrouping: coordinate\n"
+                "batch_families: 8\ncheckpoint_every: 2\n"
+                "sort_buffer_records: 64\n"
+            )
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BSSEQ_TPU_BACKEND="cpu",
+        BSSEQ_TPU_STATS=ledger,
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+    )
+    # coordinator-side failpoints ride the env; worker-side ones go
+    # through --worker-failpoints (the spawner strips the env from its
+    # children either way)
+    if coordinator_failpoints:
+        env["BSSEQ_TPU_FAILPOINTS"] = coordinator_failpoints
+    else:
+        env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    cmd = [
+        sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+        "elastic", "run",
+        "--config", cfgfile,
+        "--bam", bam,
+        "--reference", os.path.join(wd, "genome.fa"),
+        "--outdir", outdir,
+        "--workers", str(workers), "--slices", str(slices),
+    ]
+    if worker_failpoints:
+        cmd += ["--worker-failpoints", worker_failpoints]
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT,
+    )
+
+
 def _ledger_counts(path: str) -> dict:
     counts: dict[str, int] = {}
     if not os.path.exists(path):
@@ -959,6 +1005,115 @@ def run_drill(quick: bool, out_path: str) -> dict:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=30)
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftswarm (ISSUE 14): worker w0 is hard-killed (exit:9) as it
+        # picks up its second slice. The supervisor requeues the slice
+        # (`slice_requeued`/`worker_lost`), respawns w0 without the
+        # failpoint, and the merged output must be byte-identical to the
+        # single-process reference with every reconciliation check true.
+        entry = {"ok": False}
+        results["elastic_worker_kill_requeue"] = entry
+        ledger = os.path.join(wd, "ew.jsonl")
+        t0 = time.monotonic()
+        cp = _run_elastic(
+            wd, bam, os.path.join(wd, "out_elastic_kill"), ledger,
+            workers=2, slices=4,
+            worker_failpoints="w0:elastic_slice=exit:9@hit=2",
+        )
+        if cp.returncode != 0:
+            entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+        else:
+            out = json.loads(cp.stdout)
+            report = out["report"]
+            counts = _ledger_counts(ledger)
+            entry["byte_identical"] = (
+                open(out["target"], "rb").read() == ref_bytes
+            )
+            entry["slice_requeued"] = counts.get("slice_requeued", 0)
+            entry["worker_lost"] = counts.get("worker_lost", 0)
+            entry["worker_spawns"] = counts.get("elastic_worker_spawn", 0)
+            entry["requeues"] = report.get("requeues", 0)
+            entry["counters_reconciled"] = report.get("ok", False)
+            entry["checks"] = report.get("checks", {})
+            entry["ok"] = (
+                entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["slice_requeued"] >= 1
+                and entry["worker_lost"] >= 1
+                and entry["worker_spawns"] >= 3  # w0, w1, w0 respawn
+            )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftswarm: the COORDINATOR is hard-killed at its second
+        # manifest commit (one slice durably committed, the rest in
+        # flight). Durable truth is the filesystem: the re-run's ledger
+        # rescan trusts the verified manifest (`elastic_ledger_resumed`
+        # with done>=1), re-executes only the incomplete slices, and
+        # still merges byte-identical.
+        entry = {"ok": False}
+        results["elastic_coordinator_restart"] = entry
+        outdir = os.path.join(wd, "out_elastic_coord")
+        ledger = os.path.join(wd, "ec0.jsonl")
+        t0 = time.monotonic()
+        cp = _run_elastic(
+            wd, bam, outdir, ledger, workers=2, slices=4,
+            coordinator_failpoints="elastic_manifest_commit=exit:9@hit=2",
+        )
+        entry["kill_rc"] = cp.returncode
+        if cp.returncode != 9:
+            entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+        else:
+            counts = _ledger_counts(ledger)
+            entry["committed_before_kill"] = counts.get(
+                "elastic_slice_done", 0
+            )
+            # the killed coordinator's workers are orphans finishing
+            # their in-flight slice; wait for the rundir to go quiet so
+            # the restart never races a dying twin over the slice dirs
+            rund = os.path.join(outdir, "elastic")
+            quiet_since = time.monotonic()
+            hard_stop = time.monotonic() + 120.0
+            last = -1.0
+            while (time.monotonic() - quiet_since < 5.0
+                   and time.monotonic() < hard_stop):
+                newest = max(
+                    (os.path.getmtime(os.path.join(root, f))
+                     for root, _dirs, files in os.walk(rund)
+                     for f in files),
+                    default=0.0,
+                )
+                if newest != last:
+                    last = newest
+                    quiet_since = time.monotonic()
+                time.sleep(0.5)
+            ledger2 = os.path.join(wd, "ec1.jsonl")
+            cp2 = _run_elastic(wd, bam, outdir, ledger2,
+                               workers=2, slices=4)
+            if cp2.returncode != 0:
+                entry["error"] = (
+                    f"restart rc={cp2.returncode}: {cp2.stderr[-500:]}"
+                )
+            else:
+                out = json.loads(cp2.stdout)
+                counts2 = _ledger_counts(ledger2)
+                entry["byte_identical"] = (
+                    open(out["target"], "rb").read() == ref_bytes
+                )
+                entry["ledger_resumed"] = counts2.get(
+                    "elastic_ledger_resumed", 0
+                )
+                entry["slices_rerun"] = counts2.get(
+                    "elastic_slice_processed", 0
+                )
+                entry["counters_reconciled"] = out["report"].get("ok", False)
+                entry["ok"] = (
+                    entry["byte_identical"]
+                    and entry["counters_reconciled"]
+                    and entry["committed_before_kill"] >= 1
+                    and entry["ledger_resumed"] >= 1
+                    and entry["slices_rerun"] < 4  # done slice not redone
+                )
         entry["seconds"] = round(time.monotonic() - t0, 1)
 
     ok = all(v.get("ok") for v in results.values())

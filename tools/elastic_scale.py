@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""graftswarm scaling bench: elastic fleets vs the single process.
+
+Runs one grouped input through `cli elastic run` at 1/2/4 workers plus
+the single-process pipeline, and writes ELASTIC_HEAD.json:
+
+* wall seconds per worker count (split + leased execution + merge);
+* the output SHA-256 pin per run — every fleet size must produce the
+  single-process bytes (the scaling number is INADMISSIBLE otherwise,
+  BASELINE.md "elastic denominators");
+* counter reconciliation per run (split == per-slice sums == merge);
+* per-worker chip_busy from the worker-scoped ledger sub-streams
+  (`observe summarize --worker wN` surface);
+* a requeue drill: worker w0 hard-killed mid-slice, slice requeued,
+  bytes still identical — loss recovery measured, not assumed.
+
+`--quick` shrinks the input for the bench.py ride-along; the run
+matrix is the same.
+
+Usage:
+    python tools/elastic_scale.py [--quick] [--out ELASTIC_HEAD.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RUN_TIMEOUT = 900
+
+
+def _build_input(wd: str, n_families: int, genome_len: int) -> str:
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+    from bsseqconsensusreads_tpu.ops.encode import codes_to_seq
+    from bsseqconsensusreads_tpu.utils.testing import (
+        stream_duplex_families,
+        write_fasta,
+    )
+
+    rng = np.random.default_rng(88)
+    codes = rng.integers(0, 4, size=genome_len).astype(np.int8)
+    write_fasta(os.path.join(wd, "genome.fa"), "chr1", codes_to_seq(codes))
+    header = BamHeader("@HD\tVN:1.6\tSO:coordinate\n", [("chr1", genome_len)])
+    bam = os.path.join(wd, "input", "in.bam")
+    os.makedirs(os.path.dirname(bam), exist_ok=True)
+    with BamWriter(bam, header) as w:
+        for rec in stream_duplex_families(
+            codes, n_families, read_len=60, bisulfite=True,
+            templates_for=lambda f: 1 if f % 3 else 2,
+        ):
+            w.write(rec)
+    return bam
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def _cfg_file(wd: str) -> str:
+    path = os.path.join(wd, "elastic_cfg.yaml")
+    with open(path, "w") as fh:
+        fh.write(
+            "backend: cpu\naligner: self\ngrouping: coordinate\n"
+            "batch_families: 32\ncheckpoint_every: 4\n"
+        )
+    return path
+
+
+def _env(ledger: str) -> dict:
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        JAX_PLATFORMS="cpu",
+        BSSEQ_TPU_BACKEND="cpu",
+        BSSEQ_TPU_STATS=ledger,
+        BSSEQ_TPU_RETRY_BACKOFF_S="0.01",
+    )
+    env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    return env
+
+
+def _single_process(wd: str, bam: str, outdir: str, ledger: str) -> dict:
+    """The denominator: one uninterrupted run of the same pipeline
+    geometry through `cli elastic run --inline --slices 1` is NOT used —
+    the reference is the plain pipeline entry, no elastic layer at all."""
+    script = (
+        "import json, os, sys\n"
+        "os.environ['BSSEQ_TPU_BACKEND'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from bsseqconsensusreads_tpu.config import FrameworkConfig\n"
+        "from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline\n"
+        "wd, bam, outdir = sys.argv[1:4]\n"
+        "cfg = FrameworkConfig(genome_dir=wd, genome_fasta_file_name="
+        "'genome.fa', tmp=wd, aligner='self', grouping='coordinate',"
+        " batch_families=32, checkpoint_every=4)\n"
+        "target, _, stats = run_pipeline(cfg, bam, outdir=outdir)\n"
+        "print(json.dumps({'target': target}))\n"
+    )
+    t0 = time.monotonic()
+    cp = subprocess.run(
+        [sys.executable, "-c", script, wd, bam, outdir],
+        env=_env(ledger), capture_output=True, text=True,
+        timeout=RUN_TIMEOUT,
+    )
+    if cp.returncode != 0:
+        raise RuntimeError(f"single-process run failed: {cp.stderr[-2000:]}")
+    target = json.loads(cp.stdout.strip().splitlines()[-1])["target"]
+    return {
+        "wall_s": round(time.monotonic() - t0, 2),
+        "sha256": _sha(target),
+    }
+
+
+def _elastic_run(wd: str, bam: str, outdir: str, ledger: str, cfgfile: str,
+                 workers: int, slices: int,
+                 worker_failpoints: str = "") -> tuple[dict, str]:
+    cmd = [
+        sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+        "elastic", "run",
+        "--config", cfgfile,
+        "--bam", bam,
+        "--reference", os.path.join(wd, "genome.fa"),
+        "--outdir", outdir,
+        "--workers", str(workers), "--slices", str(slices),
+    ]
+    if worker_failpoints:
+        cmd += ["--worker-failpoints", worker_failpoints]
+    t0 = time.monotonic()
+    cp = subprocess.run(
+        cmd, env=_env(ledger), capture_output=True, text=True,
+        timeout=RUN_TIMEOUT,
+    )
+    if cp.returncode != 0:
+        raise RuntimeError(
+            f"elastic run (workers={workers}) failed rc={cp.returncode}: "
+            f"{cp.stderr[-2000:]}"
+        )
+    out = json.loads(cp.stdout)
+    report = out["report"]
+    return {
+        "wall_s": round(time.monotonic() - t0, 2),
+        "run_wall_s": report.get("wall_s"),
+        "sha256": _sha(out["target"]),
+        "records": report.get("records"),
+        "requeues": report.get("requeues"),
+        "workers_lost": report.get("workers_lost"),
+        "counters_reconciled": report.get("ok", False),
+        "checks": report.get("checks", {}),
+    }, out["target"]
+
+
+def _worker_busy(ledger: str, workers: int) -> dict:
+    """Mean chip_busy per worker sub-stream (the `observe summarize
+    --worker wN` surface)."""
+    from bsseqconsensusreads_tpu.utils import ledger_tools
+
+    out = {}
+    for i in range(workers):
+        wid = f"w{i}"
+        try:
+            s = ledger_tools.summarize_ledger(ledger, worker=wid)
+        except ledger_tools.LedgerError:
+            continue
+        vals = [
+            st.get("chip_busy") for st in s.stages.values()
+            if isinstance(st.get("chip_busy"), (int, float))
+        ]
+        out[wid] = {
+            "slices": s.events.get("elastic_slice_processed", 0),
+            "chip_busy": round(sum(vals) / len(vals), 4) if vals else None,
+        }
+    return out
+
+
+def run_bench(quick: bool, out_path: str) -> dict:
+    import tempfile
+
+    n_families, genome_len = (60, 20_000) if quick else (240, 60_000)
+    doc: dict = {
+        "suite": "elastic_scale",
+        "quick": quick,
+        "config": {
+            "families": n_families,
+            "genome_len": genome_len,
+            "backend": "cpu",
+            "batch_families": 32,
+            "checkpoint_every": 4,
+        },
+    }
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="bsseq_elastic_") as wd:
+        bam = _build_input(wd, n_families, genome_len)
+        cfgfile = _cfg_file(wd)
+        single = _single_process(
+            wd, bam, os.path.join(wd, "out_single"),
+            os.path.join(wd, "single.jsonl"),
+        )
+        doc["single_process"] = single
+
+        fleets: dict[str, dict] = {}
+        for workers in (1, 2, 4):
+            ledger = os.path.join(wd, f"w{workers}.jsonl")
+            entry, _target = _elastic_run(
+                wd, bam, os.path.join(wd, f"out_w{workers}"), ledger,
+                cfgfile, workers, slices=max(workers * 2, 4),
+            )
+            entry["byte_identical"] = entry["sha256"] == single["sha256"]
+            entry["speedup_vs_single"] = (
+                round(single["wall_s"] / entry["wall_s"], 3)
+                if entry["wall_s"] else None
+            )
+            entry["per_worker"] = _worker_busy(ledger, workers)
+            ok = ok and entry["byte_identical"] and entry["counters_reconciled"]
+            fleets[f"workers_{workers}"] = entry
+        doc["fleet"] = fleets
+
+        ledger = os.path.join(wd, "requeue.jsonl")
+        drill, _target = _elastic_run(
+            wd, bam, os.path.join(wd, "out_requeue"), ledger, cfgfile,
+            workers=2, slices=4,
+            worker_failpoints="w0:elastic_slice=exit:9@hit=2",
+        )
+        drill["byte_identical"] = drill["sha256"] == single["sha256"]
+        drill["ok"] = (
+            drill["byte_identical"]
+            and drill["counters_reconciled"]
+            and drill["requeues"] >= 1
+            and drill["workers_lost"] >= 1
+        )
+        ok = ok and drill["ok"]
+        doc["requeue_drill"] = drill
+
+    doc["ok"] = ok
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+    return doc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller input (the bench.py ride-along)")
+    ap.add_argument("--out", default=os.path.join(REPO, "ELASTIC_HEAD.json"))
+    args = ap.parse_args()
+    doc = run_bench(args.quick, args.out)
+    print(json.dumps(doc, indent=1, sort_keys=True))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
