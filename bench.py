@@ -1286,6 +1286,100 @@ def _run_methyl_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_trace_quick() -> dict | None:
+    """grafttrace quick leg: a tiny inline elastic run (coordinator +
+    slices in one process, the tier-1 path) leaves a real multi-slice
+    ledger; `cli observe trace` must reassemble the WHOLE span forest
+    from it (exit 0, zero orphans, every slice trace terminal), and must
+    exit non-zero on a deliberately truncated copy with the root spans
+    dropped — proving the checker the HEAD artifacts gate on actually
+    detects ledger damage. BSSEQ_BENCH_TRACE=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_TRACE", "1") == "0":
+        return None
+    script = (
+        "import json, os, sys\n"
+        "os.environ['BSSEQ_TPU_BACKEND'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from bsseqconsensusreads_tpu.config import FrameworkConfig\n"
+        "from bsseqconsensusreads_tpu.elastic import run_elastic\n"
+        "from bsseqconsensusreads_tpu.io.bam import BamWriter\n"
+        "from bsseqconsensusreads_tpu.utils.testing import ("
+        "make_grouped_bam_records, random_genome, write_fasta)\n"
+        "wd = sys.argv[1]\n"
+        "rng = np.random.default_rng(61)\n"
+        "name, genome = random_genome(rng, 4000)\n"
+        "write_fasta(os.path.join(wd, 'genome.fa'), name, genome)\n"
+        "header, records = make_grouped_bam_records("
+        "rng, name, genome, n_families=8)\n"
+        "bam = os.path.join(wd, 'in.bam')\n"
+        "with BamWriter(bam, header) as w:\n"
+        "    w.write_all(records)\n"
+        "cfg = FrameworkConfig(genome_dir=wd, "
+        "genome_fasta_file_name='genome.fa', tmp=wd, aligner='self')\n"
+        "target, rep = run_elastic(cfg, bam, os.path.join(wd, 'out'), "
+        "inline=True, slices=2)\n"
+        "print(json.dumps({'ok': bool(rep.get('ok'))}))\n"
+    )
+    try:
+        with tempfile.TemporaryDirectory(prefix="bsseq_trace_") as wd:
+            ledger = os.path.join(wd, "run.jsonl")
+            env = dict(
+                os.environ, JAX_PLATFORMS="cpu", BSSEQ_TPU_BACKEND="cpu",
+                BSSEQ_TPU_STATS=ledger,
+            )
+            tmo = _env_timeout("BSSEQ_BENCH_TRACE_TIMEOUT", 600)
+            cp = subprocess.run(
+                [sys.executable, "-c", script, wd],
+                capture_output=True, text=True, timeout=tmo, env=env,
+            )
+            if cp.returncode != 0:
+                return {"ok": False,
+                        "error": f"inline run rc={cp.returncode}: "
+                                 + cp.stderr[-300:]}
+            check = subprocess.run(
+                [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+                 "observe", "trace", ledger],
+                capture_output=True, text=True, timeout=tmo,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            # drop the root spans: every surviving child is an orphan
+            # and the checker MUST refuse the remainder
+            truncated = os.path.join(wd, "truncated.jsonl")
+            with open(ledger) as src, open(truncated, "w") as dst:
+                for line in src:
+                    if "slice_admit" not in line:
+                        dst.write(line)
+            refuse = subprocess.run(
+                [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+                 "observe", "trace", truncated],
+                capture_output=True, text=True, timeout=tmo,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            from bsseqconsensusreads_tpu.utils import trace_tools
+
+            summary = trace_tools.trace_summary(ledger)
+            return {
+                "ok": (
+                    check.returncode == 0
+                    and refuse.returncode != 0
+                    and summary["ok"]
+                ),
+                "whole_forest_rc": check.returncode,
+                "truncated_rc": refuse.returncode,
+                "traces": summary["traces"],
+                "spans": summary["spans"],
+                "orphans": summary["orphans"],
+                "buckets_top": sorted(
+                    summary["buckets"],
+                    key=lambda k: -summary["buckets"][k]["total_s"],
+                )[:5],
+            }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def _run_elastic_quick() -> dict | None:
     """tools/elastic_scale.py --quick -> ELASTIC_HEAD.json: the
     graftswarm artifact (1/2/4-worker elastic fleets all pinned to the
@@ -1556,6 +1650,18 @@ def main() -> None:
         observe.emit(
             "bench_elastic_scale",
             {"ok": elastic.get("ok"), "path": elastic.get("path")},
+            sink=ledger_sink,
+        )
+    trace = _run_trace_quick()
+    if trace is not None:
+        out["trace"] = trace
+        observe.emit(
+            "bench_trace",
+            {
+                "ok": trace.get("ok"),
+                "orphans": trace.get("orphans"),
+                "truncated_rc": trace.get("truncated_rc"),
+            },
             sink=ledger_sink,
         )
     observe.flush_sinks()

@@ -519,6 +519,7 @@ def all_rules() -> dict[str, Rule]:
         rules_retry,
         rules_serve,
         rules_thread,
+        rules_trace,
         rules_transport,
     )
 
@@ -526,7 +527,7 @@ def all_rules() -> dict[str, Rule]:
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
                 rules_pack, rules_methyl, rules_transport, rules_deflate,
-                rules_elastic):
+                rules_elastic, rules_trace):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

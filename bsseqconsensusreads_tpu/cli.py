@@ -460,17 +460,69 @@ def cmd_filter_mapped(args) -> int:
     return 0
 
 
+def _poll_metrics(args) -> int:
+    """Live metrics plane: poll a running serve/router/coordinator over
+    the framed transport ('metrics' op) and print one JSON line per
+    sample — a `top` you can pipe. --count 0 polls until interrupted."""
+    import time as _time
+
+    from bsseqconsensusreads_tpu.serve.server import request
+
+    n = 0
+    while True:
+        try:
+            resp = request(args.address, {"op": "metrics"}, timeout=10.0)
+        except (OSError, ConnectionError) as exc:
+            observe.stderr_line(f"observe top: {exc}")
+            return 1
+        if not resp.get("ok") or "metrics" not in resp:
+            observe.stderr_line(
+                f"observe top: {args.address} does not export metrics "
+                f"({resp})"
+            )
+            return 1
+        print(json.dumps(resp["metrics"], sort_keys=True), flush=True)
+        n += 1
+        if args.count and n >= args.count:
+            return 0
+        _time.sleep(args.interval)
+
+
 def cmd_observe(args) -> int:
-    """Run-ledger consumer (utils.ledger_tools): summarize / diff / check
-    over BSSEQ_TPU_STATS JSONL ledgers. `check` exits non-zero on any
-    schema or closure-invariant violation so CI and round verdicts can
-    gate on ledger integrity instead of re-deriving the numbers.
+    """Run-ledger consumer (utils.ledger_tools + utils.trace_tools):
+    summarize / diff / check over BSSEQ_TPU_STATS JSONL ledgers, plus
+    the grafttrace tier — `trace` reassembles the cross-process span
+    forest of a rundir (router + N replicas, or coordinator + N
+    workers), prints the ranked overhead-bucket table and per-trace
+    critical paths, and exits non-zero on orphan spans or unterminated
+    traces (a truncated ledger set cannot pass); `top` polls a live
+    process's metrics; `check` on a DIRECTORY runs the same
+    cross-process validation, on a file the per-ledger schema +
+    closure invariants.
 
     --job (summarize) / --job-a/--job-b (diff) scope the view to one
     serve tenant's lines, so a job served from a shared ledger can be
     compared 1:1 against its standalone-run ledger."""
+    import os
+
     from bsseqconsensusreads_tpu.utils import ledger_tools
 
+    if args.op == "top":
+        return _poll_metrics(args)
+    if args.op == "trace":
+        from bsseqconsensusreads_tpu.utils import trace_tools
+
+        target = (
+            args.target[0] if len(args.target) == 1 else list(args.target)
+        )
+        report = trace_tools.assemble(target)
+        problems = trace_tools.check_traces(report)
+        print(trace_tools.format_report(report))
+        if problems:
+            for p in problems:
+                observe.stderr_line(f"observe trace: {p}")
+            return 1
+        return 0
     try:
         if args.op == "summarize":
             s = ledger_tools.summarize_ledger(
@@ -490,9 +542,21 @@ def cmd_observe(args) -> int:
             )
             print(ledger_tools.format_diff(a, b))
             return 0
-        problems = ledger_tools.check_ledger(
-            args.ledger, rel_tol=args.tolerance
-        )
+        if os.path.isdir(args.ledger):
+            # a rundir: cross-process trace validation (orphan spans,
+            # unterminated job/slice trees, trace-vs-counter
+            # reconciliation) — per-ledger schema checks stay the
+            # single-file form, since a shared fleet/elastic ledger
+            # interleaves several processes' manifests
+            from bsseqconsensusreads_tpu.utils import trace_tools
+
+            problems = trace_tools.check_traces(
+                trace_tools.assemble(args.ledger)
+            )
+        else:
+            problems = ledger_tools.check_ledger(
+                args.ledger, rel_tol=args.tolerance
+            )
     except ledger_tools.LedgerError as exc:
         observe.stderr_line(f"observe {args.op}: {exc}")
         return 2
@@ -515,6 +579,7 @@ def cmd_elastic(args) -> int:
     import os
 
     _arm_failpoints(args)
+    observe.install_flight_signal()  # SIGUSR1 → dump recent spans/events
     if args.op == "worker":
         from bsseqconsensusreads_tpu.elastic import worker as _worker
 
@@ -630,6 +695,7 @@ def cmd_serve(args) -> int:
         return 2
     _arm_failpoints(args)
     observe.open_ledger(component="serve")
+    observe.install_flight_signal()  # SIGUSR1 → dump recent spans/events
     engine = ServeEngine(
         params=_params(args),
         mode=args.mode,
@@ -687,6 +753,7 @@ def cmd_route(args) -> int:
         return 2
     _arm_failpoints(args)
     observe.open_ledger(component="route")
+    observe.install_flight_signal()  # SIGUSR1 → dump recent spans/events
     serve_args = [
         "--batch-families", str(args.batch_families),
         "--max-active", str(args.max_active),
@@ -1268,12 +1335,39 @@ def main(argv: list[str] | None = None) -> int:
     d.set_defaults(fn=cmd_observe)
     c = op.add_parser(
         "check",
-        help="schema + ledger-closure validation; non-zero exit on "
+        help="schema + ledger-closure validation (a directory runs the "
+        "cross-process trace checks instead); non-zero exit on "
         "violation",
     )
-    c.add_argument("ledger")
+    c.add_argument("ledger", help="ledger JSONL path, or a rundir")
     c.add_argument("--tolerance", type=float, default=0.15)
     c.set_defaults(fn=cmd_observe)
+    t = op.add_parser(
+        "trace",
+        help="grafttrace: reassemble the cross-process span forest of a "
+        "rundir's ledgers, print overhead buckets + critical paths; "
+        "non-zero exit on orphan/unterminated traces",
+    )
+    t.add_argument(
+        "target", nargs="+",
+        help="a rundir (all *.jsonl inside) or explicit ledger paths",
+    )
+    t.set_defaults(fn=cmd_observe)
+    tp = op.add_parser(
+        "top",
+        help="poll a live serve/router/coordinator's metrics op; one "
+        "JSON line per sample",
+    )
+    tp.add_argument(
+        "--address", required=True,
+        help="transport address (unix:/path or tcp:host:port)",
+    )
+    tp.add_argument("--interval", type=float, default=1.0)
+    tp.add_argument(
+        "--count", type=int, default=1,
+        help="samples to print (0 = until interrupted)",
+    )
+    tp.set_defaults(fn=cmd_observe)
 
     args = ap.parse_args(argv)
     from bsseqconsensusreads_tpu.utils import compilecache
@@ -1286,7 +1380,10 @@ def main(argv: list[str] | None = None) -> int:
         # refused checkpoint resume, ...): the diagnostic already
         # carries record #N / block @voffset — a traceback would bury
         # it and read as a crash, violating the fuzz contract's "clean
-        # typed error" leg
+        # typed error" leg. The flight recorder dumps the recent
+        # span/event ring first, so the ledger keeps the causal context
+        # of the refusal (a no-op when no ledger is armed).
+        observe.flight_dump(f"guard_error:{e.reason}")
         observe.stderr_line(
             f"bsseqconsensusreads_tpu: input error [{e.reason}]: {e}"
         )

@@ -58,6 +58,9 @@ from bsseqconsensusreads_tpu.utils import observe
 ENV_WORKER_ID = "BSSEQ_TPU_WORKER_ID"
 ENV_COORDINATOR_ADDR = "BSSEQ_TPU_COORDINATOR_ADDR"
 ENV_LEASE_S = "BSSEQ_TPU_ELASTIC_LEASE_S"
+#: wall-clock spawn instant, stamped by the supervisor into each worker's
+#: environment so the worker can book its own spawn→join overhead span
+ENV_SPAWNED_AT = "BSSEQ_TPU_SPAWNED_AT"
 
 #: Default lease duration. Workers renew at a third of this, so only a
 #: hung or dead worker lets a lease lapse.
@@ -167,12 +170,32 @@ def split_input(bam_path: str, rundir: str, n_slices: int) -> list[dict]:
         except OSError:
             pass  # damaged or missing slice file: rebuild the split
         else:
+            # resumed slices keep their original trace ids (their root
+            # spans live in the same rundir ledger); docs from before
+            # tracing get fresh ones so no slice ever runs untraced
+            for sl in doc["slices"]:
+                if not sl.get("trace"):
+                    sl["trace"] = observe.mint_trace(
+                        "slice", slice_name(sl["sid"])
+                    )
             observe.emit(
                 "elastic_split",
                 {"slices": len(doc["slices"]), "families": doc["families"],
                  "records": doc["records"], "resumed": True},
             )
             return doc["slices"]
+
+    # a rebuild over the same input reuses each slice's prior trace
+    # context when the rebuilt slice is byte-identical — the rebuilt
+    # file is the same unit of work, and its earlier root span already
+    # lives in this rundir's ledger
+    prior_traces: dict = {}
+    if doc and doc.get("input_fingerprint") == fp:
+        prior_traces = {
+            (sl["path"], sl["family_crc"], sl["input_crc"]): sl["trace"]
+            for sl in doc.get("slices", [])
+            if sl.get("trace")
+        }
 
     # pass 1: base-family ordinals in first-seen order (= the order the
     # single-process grouped stream meets them)
@@ -219,14 +242,25 @@ def split_input(bam_path: str, rundir: str, n_slices: int) -> list[dict]:
     slices = []
     for s in range(n):
         members = fam_ids[bounds[s]:bounds[s + 1]]
+        rel_path = os.path.join("slices", f"{slice_name(s)}.bam")
+        family_crc = (
+            zlib.crc32("\x00".join(members).encode()) & 0xFFFFFFFF
+        )
+        input_crc = _integrity.file_crc32(paths[s])
         slices.append({
             "sid": s,
-            "path": os.path.join("slices", f"{slice_name(s)}.bam"),
+            "path": rel_path,
             "records": counts[s],
             "families": len(members),
-            "family_crc": zlib.crc32("\x00".join(members).encode())
-            & 0xFFFFFFFF,
-            "input_crc": _integrity.file_crc32(paths[s]),
+            "family_crc": family_crc,
+            "input_crc": input_crc,
+            # the split is the slice's admission: its trace context is
+            # minted here, persisted in slices.json, and shipped inside
+            # every lease grant — one causal tree per slice across
+            # coordinator, every holder, and the merge; a byte-identical
+            # rebuild keeps the prior context
+            "trace": prior_traces.get((rel_path, family_crc, input_crc))
+            or observe.mint_trace("slice", slice_name(s)),
         })
     _save_json_atomic(doc_path, {
         "input_fingerprint": fp,
@@ -330,11 +364,15 @@ class SliceLedger:
                 "lease_id": lease_id,
                 "lease_s": self.lease_s,
             }
-        observe.emit(
-            "elastic_lease",
-            {"slice": slice_name(sid), "worker": worker,
-             "lease_id": lease_id},
-        )
+        # the slice's trace context ships inside the grant (the slice
+        # dict carries it); the lease line itself is stamped so the
+        # grant joins the slice's causal tree
+        with observe.bind_trace(grant["slice"].get("trace")):
+            observe.emit(
+                "elastic_lease",
+                {"slice": slice_name(sid), "worker": worker,
+                 "lease_id": lease_id},
+            )
         return grant
 
     def heartbeat(self, worker: str, lease_id: str) -> bool:
@@ -377,12 +415,15 @@ class SliceLedger:
         with self._lock:
             self._leases.pop(lease_id, None)
             self._done[sid] = manifest
-        observe.emit(
-            "elastic_slice_done",
-            {"slice": slice_name(sid),
-             "worker": worker or str(manifest.get("worker", "")),
-             "records": manifest.get("records_out")},
-        )
+        # the slice trace's terminal event: `observe check` requires
+        # every slice tree to reach one of these
+        with observe.bind_trace(sl.get("trace")):
+            observe.emit(
+                "elastic_slice_done",
+                {"slice": slice_name(sid),
+                 "worker": worker or str(manifest.get("worker", "")),
+                 "records": manifest.get("records_out")},
+            )
         return {"ok": True}
 
     # -- liveness --------------------------------------------------------
@@ -391,11 +432,15 @@ class SliceLedger:
         sid = lease["sid"]
         self._pending.appendleft(sid)
         self.requeues += 1
-        observe.emit(
-            "slice_requeued",
-            {"slice": slice_name(sid), "worker": lease["worker"],
-             "reason": reason, "batches_kept": self._batches_kept(sid)},
-        )
+        # the killed holder's trace continues, not dangles: this requeue
+        # line carries the SAME slice trace, and the next holder's spans
+        # join the same tree (chaos-drill trace-completeness gate)
+        with observe.bind_trace((self.slices.get(sid) or {}).get("trace")):
+            observe.emit(
+                "slice_requeued",
+                {"slice": slice_name(sid), "worker": lease["worker"],
+                 "reason": reason, "batches_kept": self._batches_kept(sid)},
+            )
 
     def _batches_kept(self, sid: int) -> int:
         """Batches the lost worker left durable in the slice's stage
@@ -471,6 +516,7 @@ class SliceLedger:
                 "leased": len(self._leases),
                 "requeues": self.requeues,
                 "workers_lost": self.workers_lost,
+                "workers": len(self.workers),
             }
 
     def manifests(self) -> dict[int, dict]:
@@ -546,6 +592,20 @@ class Coordinator(ProtocolServer):
             )
         if op == "status":
             return {"ok": True, **self.ledger.counts()}
+        if op == "metrics":
+            c = self.ledger.counts()
+            return {"ok": True, "metrics": {
+                "component": "coordinator",
+                "slices": c["slices"],
+                "slices_done": c["done"],
+                "lease_backlog": c["pending"],
+                "outstanding_leases": c["leased"],
+                "workers": c["workers"],
+                "counters": {
+                    "requeues": c["requeues"],
+                    "workers_lost": c["workers_lost"],
+                },
+            }}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 
@@ -591,9 +651,13 @@ def _run_inline(cfg: FrameworkConfig, ledger: SliceLedger) -> None:
             ledger.expire_scan()
             time.sleep(0.01)
             continue
-        manifest = _worker.process_slice(
-            cfg, ledger.rundir, grant["slice"], worker=wid
-        )
+        # same trace discipline as the subprocess worker: the slice's
+        # spans land on its causal tree even in inline mode
+        slice_trace = grant["slice"].get("trace")
+        with observe.bind_trace(slice_trace):
+            manifest = _worker.process_slice(
+                cfg, ledger.rundir, grant["slice"], worker=wid
+            )
         resp = ledger.commit(
             grant["lease_id"], grant["slice"]["sid"], manifest, worker=wid
         )
@@ -641,6 +705,9 @@ def _run_fleet(
             env = dict(os.environ)
             env[ENV_WORKER_ID] = wid
             env[ENV_COORDINATOR_ADDR] = addr
+            # the worker books its own spawn→join 'worker_spawn' span
+            # against this instant (same-host wall clock)
+            env[ENV_SPAWNED_AT] = repr(time.time())
             # failpoints arm per worker FIRST LIFE only (the chaos
             # drill's kill must not be inherited by the respawn — or by
             # every worker when the parent itself is under failpoints)
@@ -746,8 +813,11 @@ def run_elastic(
         )
     from bsseqconsensusreads_tpu.elastic import merge as _merge
 
-    target, report = _merge.finalize(cfg, bam_path, outdir, specs,
-                                     ledger.manifests())
+    # merge is a run-level overhead bucket: booked on the proc trace so
+    # `observe trace` can rank it against spawn/import/compile
+    with observe.span("merge", ctx=observe.proc_trace()):
+        target, report = _merge.finalize(cfg, bam_path, outdir, specs,
+                                         ledger.manifests())
     report["requeues"] = ledger.requeues
     report["workers_lost"] = ledger.workers_lost
     report["wall_s"] = round(time.monotonic() - t0, 3)

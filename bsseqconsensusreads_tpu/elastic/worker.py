@@ -46,6 +46,7 @@ from bsseqconsensusreads_tpu.utils import observe
 
 from bsseqconsensusreads_tpu.elastic.coordinator import (
     ENV_COORDINATOR_ADDR,
+    ENV_SPAWNED_AT,
     ENV_WORKER_ID,
     ElasticError,
     config_from_doc,
@@ -129,10 +130,20 @@ def process_slice(cfg: FrameworkConfig, rundir: str, sl: dict,
     )
     # deferred: run_pipeline pulls the jax stack in; workers that only
     # join/poll must stay cheap to import
+    import_t0 = time.time()
     from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
 
+    if observe.stats_sink() is not None:
+        # jax_import overhead bucket: near-zero after the first slice
+        # (sys.modules cache), so summing dur_s still reads as the
+        # one-time per-process import cost
+        observe.emit_span(
+            "jax_import", import_t0, time.time(),
+            ctx=observe.proc_trace(), worker=worker,
+        )
     t0 = time.monotonic()
-    target, _results, stats = run_pipeline(scfg, slice_bam, outdir=sdir)
+    with observe.span("slice_pipeline", slice=sname, worker=worker):
+        target, _results, stats = run_pipeline(scfg, slice_bam, outdir=sdir)
     wall_s = time.monotonic() - t0
     buckets, records_out = _bucket_manifest(
         target, resolve_buckets(cfg.sort_buckets)
@@ -193,9 +204,21 @@ def work_loop(address: str, worker_id: str | None = None,
     cfg = config_from_doc(joined["cfg"])
     rundir = joined["rundir"]
     lease_default = float(joined.get("lease_s") or 30.0)
+    spawned_env = os.environ.pop(ENV_SPAWNED_AT, None)
+    if spawned_env is not None and observe.stats_sink() is not None:
+        # the supervisor stamped wall-clock spawn time into our env;
+        # spawn → successful join is this process's worker_spawn bucket
+        try:
+            observe.emit_span(
+                "worker_spawn", float(spawned_env), time.time(),
+                ctx=observe.proc_trace(), worker=wid,
+            )
+        except ValueError:
+            pass  # unparseable stamp: skip the span, never the worker
     hb = WorkerHeartbeat(component="elastic")
     hb.start()
     processed = 0
+    wait_t0: float | None = None
     try:
         while True:
             hb.beat(phase="lease_poll")
@@ -205,8 +228,19 @@ def work_loop(address: str, worker_id: str | None = None,
             if grant.get("done"):
                 return processed
             if grant.get("wait") or "slice" not in grant:
+                if wait_t0 is None:
+                    wait_t0 = time.time()
                 time.sleep(poll_s)
                 continue
+            if wait_t0 is not None:
+                if observe.stats_sink() is not None:
+                    # lease_wait overhead bucket: idle span between the
+                    # last grant and this one (backlog starvation)
+                    observe.emit_span(
+                        "lease_wait", wait_t0, time.time(),
+                        ctx=observe.proc_trace(), worker=wid,
+                    )
+                wait_t0 = None
             sl = grant["slice"]
             lease_id = grant["lease_id"]
             lease_s = float(grant.get("lease_s") or lease_default)
@@ -219,33 +253,38 @@ def work_loop(address: str, worker_id: str | None = None,
                 name=f"lease-renew-{lease_id}", daemon=True,
             )
             renewer.start()
-            try:
-                manifest = process_slice(cfg, rundir, sl, worker=wid)
-            finally:
-                stop.set()
-                renewer.join(timeout=5.0)
-            _failpoints.fire("elastic_publish", slice=manifest["slice"],
-                             worker=wid)
-            resp = transport.request(
-                address,
-                {"op": "publish", "worker": wid, "lease_id": lease_id,
-                 "slice": sl["sid"], "manifest": manifest},
-                timeout=600.0,
-            )
-            if resp.get("ok"):
-                processed += 1
-                continue
-            if resp.get("reason") == "lease_expired":
-                # our lease lapsed mid-slice and the slice was requeued;
-                # the durable checkpoints keep the work — go get a new
-                # lease (possibly for this same slice)
-                observe.emit(
-                    "elastic_publish_refused",
-                    {"slice": manifest["slice"], "worker": wid,
-                     "reason": "lease_expired"},
+            # the slice's trace ctx rode in on the grant; binding it here
+            # puts process_slice's spans and the publish request (via the
+            # wire's `_trace`) on the slice's causal tree
+            slice_trace = sl.get("trace")
+            with observe.bind_trace(slice_trace):
+                try:
+                    manifest = process_slice(cfg, rundir, sl, worker=wid)
+                finally:
+                    stop.set()
+                    renewer.join(timeout=5.0)
+                _failpoints.fire("elastic_publish",
+                                 slice=manifest["slice"], worker=wid)
+                resp = transport.request(
+                    address,
+                    {"op": "publish", "worker": wid, "lease_id": lease_id,
+                     "slice": sl["sid"], "manifest": manifest},
+                    timeout=600.0,
                 )
-                continue
-            raise ElasticError(f"publish refused: {resp}")
+                if resp.get("ok"):
+                    processed += 1
+                    continue
+                if resp.get("reason") == "lease_expired":
+                    # our lease lapsed mid-slice and the slice was
+                    # requeued; the durable checkpoints keep the work —
+                    # go get a new lease (possibly for this same slice)
+                    observe.emit(
+                        "elastic_publish_refused",
+                        {"slice": manifest["slice"], "worker": wid,
+                         "reason": "lease_expired"},
+                    )
+                    continue
+                raise ElasticError(f"publish refused: {resp}")
     finally:
         hb.stop()
         observe.flush_sinks()

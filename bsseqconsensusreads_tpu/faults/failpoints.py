@@ -362,6 +362,9 @@ def _act(fp: FailPoint, site: str) -> None:
         time.sleep(fp.duration_s)
         return
     if fp.action == "exit":
+        # dump the flight-recorder ring first: the chaos drill reads the
+        # killed process's recent spans/events out of the shared ledger
+        observe.flight_dump(f"failpoint:{site}")
         observe.flush_sinks()  # the crash must not eat the evidence
         os._exit(fp.exit_code)
 

@@ -22,6 +22,7 @@ Alignment modes for the emitted consensus:
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
@@ -90,6 +91,31 @@ def _resolve_mesh(mesh):
 #: int16 transport dtypes (models.molecular.narrow_outputs) with margin.
 #: Families beyond it are skipped AND reported, as before.
 DEEP_TEMPLATE_CAP = 16_384
+
+
+@contextlib.contextmanager
+def _compile_probe(seen: set, key: tuple, stage: str):
+    """Book the FIRST dispatch of each kernel shape as a 'compile' span
+    on the proc trace: jit trace+compile runs synchronously inside that
+    first call, so its wall is the per-process compile cost `observe
+    trace` ranks against jax_import/worker_spawn. Later dispatches of
+    the same shape (and every dispatch when the ledger is unarmed) pay
+    one set lookup. A compile-cache-warm process shows near-zero spans
+    here — the compile_cache_hit/miss counters disambiguate load from
+    reload. `seen` races benignly under the overlap pool (worst case a
+    duplicate span for one shape)."""
+    if key in seen or observe.stats_sink() is None:
+        yield
+        return
+    seen.add(key)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        observe.emit_span(
+            "compile", t0, time.time(), ctx=observe.proc_trace(),
+            stage=stage, shape=list(key),
+        )
 
 
 def _resolve_transport(transport: str, mesh) -> str:
@@ -1420,6 +1446,8 @@ def call_molecular_batches(
     use_wire = wire_mode != "off"
     sharded_fn = None
     deep_state: dict = {}
+    #: kernel shapes already dispatched once — _compile_probe bookkeeping
+    compile_shapes: set = set()
     wire_rr = _WireRoundRobin(mesh) if wire_mc else None
     kernel_layout = _resolve_kernel_layout(layout)
     singleton_on = os.environ.get("BSSEQ_TPU_SINGLETON", "1") != "0"
@@ -1661,7 +1689,9 @@ def call_molecular_batches(
         the RECOVERY unit: a retry or a stall re-dispatch re-runs exactly
         this (dispatch + fetch), never a half-retired batch."""
         phase = "host_vote" if is_singleton_batch(batch) else "kernel"
-        with stats.metrics.timed(phase):
+        with _compile_probe(
+            compile_shapes, (phase, *batch.bases.shape), stage_label
+        ), stats.metrics.timed(phase):
             wire, pf = dispatch_kernel(batch, bi)
         return fetch_out(wire, pf, batch, bi)
 
@@ -1989,7 +2019,9 @@ def call_molecular_batches(
                 continue
             phase = "host_vote" if is_singleton_batch(batch) else "kernel"
             try:
-                with stats.metrics.timed(phase):
+                with _compile_probe(
+                    compile_shapes, (phase, *batch.bases.shape), stage_label
+                ), stats.metrics.timed(phase):
                     out_dev, trim = dispatch_kernel(batch, batch_index)
             except _faultretry.RETRYABLE as exc:
                 # dispatch itself failed: recover the whole unit now (the
@@ -2297,6 +2329,8 @@ def call_duplex_batches(
         # worker dispatches from queueing behind a genome-sized transfer)
         refstore.device_codes
     genome_per_dev: dict = {}
+    #: kernel shapes already dispatched once — _compile_probe bookkeeping
+    compile_shapes: set = set()
     # round-robin dispatch now runs on overlap workers (pool × wire_rr
     # composition): the per-device genome cache needs its own lock
     genome_lock = threading.Lock()
@@ -2597,7 +2631,9 @@ def call_duplex_batches(
         stage's twin): dispatch + blocking fetch + rawize off the main
         thread, hiding tunnel waits and retire compute under ingest/
         encode/emit of neighbouring batches. Also the recovery unit."""
-        with stats.metrics.timed("kernel"):
+        with _compile_probe(
+            compile_shapes, ("kernel", *batch.bases.shape), stage_label
+        ), stats.metrics.timed("kernel"):
             packed, pf = dispatch_kernel(batch, bi)
         return fetch_out(packed, pf, batch, sidecar, bi)
 
@@ -2740,7 +2776,10 @@ def call_duplex_batches(
                 )
                 continue
             try:
-                with stats.metrics.timed("kernel"):
+                with _compile_probe(
+                    compile_shapes, ("kernel", *batch.bases.shape),
+                    stage_label
+                ), stats.metrics.timed("kernel"):
                     packed, pf = dispatch_kernel(batch, batch_index)
             except _faultretry.RETRYABLE as exc:
                 out = recover_fetch(batch, sidecar, batch_index, exc)

@@ -141,6 +141,7 @@ class ReplicaSet:
         )
         replica.address = ""
         replica._addr_file = addr_file
+        replica._spawned_at = time.time()
         observe.emit(
             "fleet_replica_spawn",
             {"replica_id": replica.rid, "generation": replica.generation,
@@ -168,6 +169,19 @@ class ReplicaSet:
                     )
                     if resp.get("ok", False):
                         replica.address = addr
+                        spawned = getattr(replica, "_spawned_at", None)
+                        if spawned is not None and (
+                            observe.stats_sink() is not None
+                        ):
+                            # the worker_spawn overhead bucket: spawn →
+                            # first answered ping, booked on the proc
+                            # trace (a per-process cost, not one job's)
+                            observe.emit_span(
+                                "worker_spawn", spawned, time.time(),
+                                ctx=observe.proc_trace(),
+                                replica_id=replica.rid,
+                                generation=replica.generation,
+                            )
                         return
                 except (OSError, ConnectionError):
                     pass  # still booting; the deadline bounds the poll

@@ -137,6 +137,9 @@ class Job:
         self.consensus_out = 0
         #: signalled on done/failed — ServeEngine.wait() blocks on it
         self.done = threading.Event()
+        #: causal trace context {trace, span}: adopted from the wire
+        #: (router-minted, via the bound `_trace`) or minted at admission
+        self.trace: dict | None = None
         # -- scheduler-owned plumbing (set when the job goes RUNNING) --
         self.stats = None          # per-job StageStats
         self.q: queue.Queue | None = None  # bounded family queue
@@ -161,6 +164,8 @@ class Job:
             d["error"] = self.error
         if self.latency_s is not None:
             d["latency_s"] = round(self.latency_s, 3)
+        if self.trace is not None:
+            d["trace"] = self.trace["trace"]
         return d
 
 
@@ -194,20 +199,29 @@ class JobQueue:
             "config": observe.config_digest(spec.as_dict()),
         }
         job = Job(job_id, spec, fp)
+        # trace admission: adopt the submitter's context (a router-minted
+        # trace that rode the wire and was bound around dispatch) or mint
+        # a fresh job trace — either way the job carries ONE causal tree
+        # id for its whole life across processes
+        trace_ctx = observe.current_trace()
+        if trace_ctx is None:
+            trace_ctx = observe.mint_trace("job", job_id, job=job_id)
+        job.trace = trace_ctx
         with self._lock:
             if self._closed:
                 raise QueueClosed("serve engine is draining; job refused")
             self._jobs[job_id] = job
-        observe.emit(
-            "job_admitted",
-            {
-                "input": spec.input,
-                "output": spec.output,
-                "policy": _guard.resolve_policy(spec.policy),
-                "fingerprint": fp,
-            },
-            job=job_id,
-        )
+        with observe.bind_trace(trace_ctx):
+            observe.emit(
+                "job_admitted",
+                {
+                    "input": spec.input,
+                    "output": spec.output,
+                    "policy": _guard.resolve_policy(spec.policy),
+                    "fingerprint": fp,
+                },
+                job=job_id,
+            )
         while True:
             try:
                 self._pending.put(job, timeout=0.25)

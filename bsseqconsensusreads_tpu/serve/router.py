@@ -64,6 +64,9 @@ class RoutedJob:
         self.last: dict = {}
         self.requeues = 0
         self.submitted_s = time.monotonic()
+        #: causal trace context minted at router admission; requeues and
+        #: the replica-side job adopt it, so one tree spans every attempt
+        self.trace: dict | None = None
 
     def snapshot(self) -> dict:
         out = dict(self.last)
@@ -77,6 +80,8 @@ class RoutedJob:
                 "requeues": self.requeues,
             }
         )
+        if self.trace is not None:
+            out["trace"] = self.trace["trace"]
         return out
 
 
@@ -179,6 +184,12 @@ class Router:
             self._seq += 1
             job = RoutedJob(f"f{self._seq:04d}", dict(spec), digest)
             self._jobs[job.rid] = job
+        # router admission is THE mint point for a fleet job's trace:
+        # the context rides every forward (original and requeued) so the
+        # replica-side job joins the same causal tree
+        job.trace = observe.current_trace() or observe.mint_trace(
+            "job", job.rid
+        )
         resp = self._route(job, exclude=None)
         if not resp.get("ok"):
             with self._lock:
@@ -226,15 +237,16 @@ class Router:
                         self.counters["affinity_hits"] += 1
                     if self.affinity_enabled:
                         self._affinity[job.digest] = replica.rid
-                observe.emit(
-                    "fleet_route",
-                    {
-                        "rjob": job.rid,
-                        "replica_id": replica.rid,
-                        "remote_id": job.remote_id,
-                        "affinity": was_affinity,
-                    },
-                )
+                with observe.bind_trace(job.trace):
+                    observe.emit(
+                        "fleet_route",
+                        {
+                            "rjob": job.rid,
+                            "replica_id": replica.rid,
+                            "remote_id": job.remote_id,
+                            "affinity": was_affinity,
+                        },
+                    )
                 return resp
             last_error = str(resp.get("error"))
             tried.add(replica.rid)
@@ -246,21 +258,25 @@ class Router:
         transient I/O error exercises exactly the retry the grammar
         promises (chaos: fleet_router_transient_io)."""
         last: Exception | None = None
-        for _ in range(self.forward_retries):
-            try:
-                _failpoints.fire("fleet_route", stage="fleet", job=job.rid)
-                return _transport.request(
-                    replica.address,
-                    {"op": "submit", "spec": job.spec},
-                    timeout=self.forward_timeout,
-                )
-            except _transport.TransportError as exc:
-                return {"ok": False, "error": f"refused: {exc}"}
-            except (OSError, ConnectionError) as exc:
-                last = exc
-                if not replica.alive():
-                    break
-                time.sleep(0.05)
+        with observe.bind_trace(job.trace) as trace_ctx:
+            for _ in range(self.forward_retries):
+                try:
+                    _failpoints.fire(
+                        "fleet_route", stage="fleet", job=job.rid
+                    )
+                    # trace_ctx bound above rides the wire as `_trace`
+                    return _transport.request(
+                        replica.address,
+                        {"op": "submit", "spec": job.spec},
+                        timeout=self.forward_timeout,
+                    )
+                except _transport.TransportError as exc:
+                    return {"ok": False, "error": f"refused: {exc}"}
+                except (OSError, ConnectionError) as exc:
+                    last = exc
+                    if not replica.alive():
+                        break
+                    time.sleep(0.05)
         return {"ok": False, "error": f"forward to {replica.rid}: {last}"}
 
     # -- tenant-facing ops ----------------------------------------------
@@ -368,6 +384,35 @@ class Router:
             "replicas": per_replica,
         }
 
+    def metrics_dict(self) -> dict:
+        """Live gauges/counters for the `metrics` protocol op: placement
+        state the router already owns — no replica round-trips, so a
+        poller can hit this at high frequency without perturbing the
+        fleet."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+            counters = dict(self.counters)
+            affinity_size = len(self._affinity)
+            inflight = {
+                r.rid: self._outstanding(r.rid)
+                for r in self.fleet.replicas
+            }
+        states: dict[str, int] = {}
+        for j in jobs:
+            st = j.state if j.state in _TERMINAL else "open"
+            states[st] = states.get(st, 0) + 1
+        return {
+            "component": "router",
+            "jobs_total": len(jobs),
+            "jobs_open": states.get("open", 0),
+            "jobs_by_state": states,
+            "per_replica_inflight": inflight,
+            "replicas_alive": len(self.fleet.alive()),
+            "replicas_total": len(self.fleet.replicas),
+            "affinity_entries": affinity_size,
+            "counters": counters,
+        }
+
     def drain(self, timeout: float | None = None) -> bool:
         """Wait until every routed job is terminal (requeues included),
         then drain the replicas themselves."""
@@ -421,15 +466,19 @@ class Router:
                 self.counters["jobs_requeued"] += 1
                 from_replica = replica.rid
             resp = self._route(job, exclude=replica.rid)
-            observe.emit(
-                "fleet_requeue",
-                {
-                    "rjob": job.rid,
-                    "from_replica": from_replica,
-                    "to_replica": job.replica_id,
-                    "ok": bool(resp.get("ok")),
-                },
-            )
+            # same trace id across attempts: the killed attempt's trace
+            # ends in THIS requeue line, and the survivor's spans are
+            # children of the same tree — `observe check` requires it
+            with observe.bind_trace(job.trace):
+                observe.emit(
+                    "fleet_requeue",
+                    {
+                        "rjob": job.rid,
+                        "from_replica": from_replica,
+                        "to_replica": job.replica_id,
+                        "ok": bool(resp.get("ok")),
+                    },
+                )
             if not resp.get("ok"):
                 with self._lock:
                     job.state = "failed"
@@ -490,6 +539,8 @@ class RouterServer(ProtocolServer):
             return {"ok": st.get("state") in _TERMINAL, "job": st}
         if op in ("stats", "fleet"):
             return {"ok": True, "stats": self.router.fleet_stats()}
+        if op == "metrics":
+            return {"ok": True, "metrics": self.router.metrics_dict()}
         if op == "drain":
             self._drain_requested.set()
             timeout = req.get("timeout")

@@ -199,7 +199,9 @@ class Scheduler:
         """Reader thread: guarded ingest → tagged families → the job's
         bounded queue. Any failure is THIS tenant's failure: the error
         is recorded on the job and the engine never sees an exception,
-        only an exhausted queue."""
+        only an exhausted queue. The job's trace context is bound for
+        the ingest so every line — and the 'ingest' span covering the
+        guarded read — lands in the tenant's causal tree."""
         from bsseqconsensusreads_tpu.pipeline.stages import (
             molecular_ingest_stream,
             open_guarded_reader,
@@ -209,36 +211,38 @@ class Scheduler:
         reader = None
         err = None
         try:
-            guard = _guard.Guard(
-                policy=job.spec.policy, stats=job.stats, job=job.id
-            )
-            reader = open_guarded_reader(job.spec.input, guard)
-            job.header = reader.header
-            grouping = job.spec.grouping or self.grouping
-            records = molecular_ingest_stream(
-                job.spec.input, reader, job.stats,
-                ingest_choice=job.spec.ingest, grouping=grouping,
-                indel_policy=self.indel_policy, guard=guard,
-            )
-            groups = _guard.guard_groups(
-                _calling.stream_mi_groups(
-                    records, grouping=grouping, stats=job.stats
-                ),
-                guard,
-            )
-            seq = 0
-            for fam in groups:
-                if isinstance(fam, tuple):
-                    mi, recs = fam
-                else:  # native FamilyRun: materialize the Python shape
-                    mi, recs = fam.mi, list(fam.records)
-                seq += 1
-                _failpoints.fire(
-                    "serve_ingest", stage="serve", job=job.id, batch=seq
+            with observe.bind_trace(job.trace), \
+                    observe.span("ingest", job=job.id):
+                guard = _guard.Guard(
+                    policy=job.spec.policy, stats=job.stats, job=job.id
                 )
-                tag = JobMi(mi)
-                tag.job = job.id
-                self._offer(job, (tag, recs))
+                reader = open_guarded_reader(job.spec.input, guard)
+                job.header = reader.header
+                grouping = job.spec.grouping or self.grouping
+                records = molecular_ingest_stream(
+                    job.spec.input, reader, job.stats,
+                    ingest_choice=job.spec.ingest, grouping=grouping,
+                    indel_policy=self.indel_policy, guard=guard,
+                )
+                groups = _guard.guard_groups(
+                    _calling.stream_mi_groups(
+                        records, grouping=grouping, stats=job.stats
+                    ),
+                    guard,
+                )
+                seq = 0
+                for fam in groups:
+                    if isinstance(fam, tuple):
+                        mi, recs = fam
+                    else:  # native FamilyRun: materialize the Python shape
+                        mi, recs = fam.mi, list(fam.records)
+                    seq += 1
+                    _failpoints.fire(
+                        "serve_ingest", stage="serve", job=job.id, batch=seq
+                    )
+                    tag = JobMi(mi)
+                    tag.job = job.id
+                    self._offer(job, (tag, recs))
         except _Shutdown:
             err = "engine shutdown"
         except BaseException as exc:  # tenant-scoped: never escapes
@@ -254,7 +258,8 @@ class Scheduler:
                 with self._lock:
                     if job.error is None:
                         job.error = err
-                observe.emit("job_failed", {"error": err}, job=job.id)
+                with observe.bind_trace(job.trace):
+                    observe.emit("job_failed", {"error": err}, job=job.id)
             job._eos = True
             self._wake.set()
 
@@ -392,6 +397,18 @@ class Scheduler:
             self.stats.metrics.count("batches_shared_jobs")
         if recs:
             self.stats.metrics.count("serve_batches")
+            if observe.stats_sink() is not None:
+                # link the shared device chunk into the span forest: a
+                # point span under the process overhead trace naming the
+                # tenants whose families rode it (the armed-sink guard
+                # keeps the untraced hot path at one branch)
+                now = time.time()
+                observe.emit_span(
+                    "chunk_retire", now, now, ctx=observe.proc_trace(),
+                    batch=bi, jobs=sorted(
+                        j for j in per_job if j is not None
+                    ),
+                )
         self._retired = bi + 1
 
     def _write(self, job: _jobs.Job, recs: list) -> None:
@@ -447,7 +464,8 @@ class Scheduler:
         except BaseException as exc:
             with self._lock:
                 job.error = f"{type(exc).__name__}: {exc}"
-            observe.emit("job_failed", {"error": job.error}, job=job.id)
+            with observe.bind_trace(job.trace):
+                observe.emit("job_failed", {"error": job.error}, job=job.id)
             self._fail_job(job)
             return
         self._running.remove(job)
@@ -455,17 +473,18 @@ class Scheduler:
             job.state = _jobs.DONE
             job.finished_s = time.monotonic()
             job.latency_s = job.finished_s - job.submitted_s
-        self._emit_job_stats(job)
-        observe.emit(
-            "job_complete",
-            {
-                "output": job.spec.output,
-                "families": job.families,
-                "consensus_out": job.consensus_out,
-                "latency_s": round(job.latency_s, 3),
-            },
-            job=job.id,
-        )
+        with observe.bind_trace(job.trace):
+            self._emit_job_stats(job)
+            observe.emit(
+                "job_complete",
+                {
+                    "output": job.spec.output,
+                    "families": job.families,
+                    "consensus_out": job.consensus_out,
+                    "latency_s": round(job.latency_s, 3),
+                },
+                job=job.id,
+            )
         job.done.set()
 
     def _fail_job(self, job: _jobs.Job) -> None:
@@ -488,7 +507,8 @@ class Scheduler:
             job.finished_s = time.monotonic()
             job.latency_s = job.finished_s - job.submitted_s
         self.stats.metrics.count("jobs_failed")
-        self._emit_job_stats(job)
+        with observe.bind_trace(job.trace):
+            self._emit_job_stats(job)
         job.done.set()
 
     def _emit_job_stats(self, job: _jobs.Job) -> None:
@@ -539,7 +559,8 @@ class Scheduler:
             with self._lock:
                 if job.error is None:
                     job.error = err
-            observe.emit("job_failed", {"error": job.error}, job=job.id)
+            with observe.bind_trace(job.trace):
+                observe.emit("job_failed", {"error": job.error}, job=job.id)
             job.exhausted = True
             if job.state == _jobs.QUEUED:
                 with self._lock:

@@ -12,8 +12,15 @@ the serve protocol for `cli submit` / `cli serve-ctl`:
     {"op": "wait", "job": "j0001",
      "timeout": 600}                        → {"ok": true, "job": {...}}
     {"op": "stats"}                         → {"ok": true, "stats": {...}}
+    {"op": "metrics"}                       → {"ok": true, "metrics": {...}}
+                                              (live gauges/counters — the
+                                              `observe top` poll surface)
     {"op": "drain", "timeout": 600}         → {"ok": true, "drained": b}
                                               (server exits afterwards)
+
+Requests may carry a reserved ``_trace`` field (a {trace, span} context
+injected by transport.request): the server binds it around dispatch so
+every ledger line the op emits joins the sender's causal tree.
 
 How the message crosses the wire is serve/transport.py's business: a
 server listens on one or more addresses — ``unix:<path>`` (newline
@@ -92,6 +99,7 @@ class ServeEngine:
             idle_wait_s=idle_wait_s,
         )
         self._started = False
+        self._started_monotonic: float | None = None
         self._start_lock = threading.Lock()
 
     # -- lifecycle -------------------------------------------------------
@@ -100,6 +108,7 @@ class ServeEngine:
         with self._start_lock:
             if not self._started:
                 self._started = True
+                self._started_monotonic = time.monotonic()
                 self.scheduler.start()
         return self
 
@@ -183,6 +192,42 @@ class ServeEngine:
             "counters": self.scheduler.counters(),
             "engine_alive": self.scheduler.alive,
             "engine_error": self.scheduler.engine_error,
+        }
+
+    def metrics_dict(self) -> dict:
+        """The live-metrics gauges/counters (protocol op `metrics`) — the
+        sensor surface the future autoscaling scheduler polls. Gauges are
+        instantaneous (queue depth, running jobs); counters are monotonic
+        (the scheduler's Metrics counters, retries/degrades included);
+        rates are derived against engine uptime so a poller needs no
+        state."""
+        jobs = self.queue.jobs()
+        states: dict[str, int] = {}
+        for j in jobs:
+            states[j.state] = states.get(j.state, 0) + 1
+        counters = self.scheduler.counters()
+        with self.scheduler.stats.metrics._lock:
+            secs = dict(self.scheduler.stats.metrics.seconds)
+        device_s = sum(
+            v for k, v in secs.items() if k in observe.DEVICE_PHASES
+        )
+        uptime = (
+            time.monotonic() - self._started_monotonic
+            if self._started_monotonic is not None else 0.0
+        )
+        return {
+            "component": "serve",
+            "uptime_s": round(uptime, 3),
+            "queue_depth": self.queue.pending_count(),
+            "jobs_total": len(jobs),
+            "jobs_by_state": states,
+            "engine_alive": self.scheduler.alive,
+            "chip_busy": round(device_s / uptime, 4) if uptime else 0.0,
+            "batches_shared_jobs_rate": (
+                round(counters.get("batches_shared_jobs", 0) / uptime, 4)
+                if uptime else 0.0
+            ),
+            "counters": counters,
         }
 
 
@@ -323,8 +368,13 @@ class ProtocolServer:
                 return
             if req is None:
                 return
+            # trace carriage: the client's causal context (if any) rides
+            # the reserved `_trace` key — bind it so every ledger line the
+            # dispatch emits lands in the sender's trace tree
+            trace_ctx = req.pop("_trace", None)
             try:
-                resp = self._dispatch(req)
+                with observe.bind_trace(trace_ctx):
+                    resp = self._dispatch(req)
             except Exception as exc:  # protocol errors answer, not crash
                 resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
             conn.settimeout(10.0)
@@ -395,6 +445,8 @@ class ServeServer(ProtocolServer):
             return {"ok": st["state"] in (_jobs.DONE, _jobs.FAILED), "job": st}
         if op == "stats":
             return {"ok": True, "stats": self.engine.stats_dict()}
+        if op == "metrics":
+            return {"ok": True, "metrics": self.engine.metrics_dict()}
         if op == "drain":
             self._drain_requested.set()
             timeout = req.get("timeout")

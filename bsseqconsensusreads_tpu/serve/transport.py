@@ -36,8 +36,10 @@ import json
 import os
 import socket
 import struct
+import time
 
 from bsseqconsensusreads_tpu.faults.guard import GuardError
+from bsseqconsensusreads_tpu.utils import observe
 
 #: Hard ceiling on one protocol message (either direction, both
 #: transports). Large enough for any stats payload; small enough that a
@@ -303,7 +305,17 @@ def send_message(conn: socket.socket, kind: str, obj: dict) -> None:
 def request(address: str, payload: dict, timeout: float = 600.0) -> dict:
     """One client request/response against a serve or router process.
     Raises TransportError on wire refusals, ConnectionError/OSError on
-    plain socket failures."""
+    plain socket failures.
+
+    Trace carriage: when the calling thread has a bound trace context
+    (observe.bind_trace), it rides as the reserved `_trace` key of the
+    request object — identical on both framings, since each is one JSON
+    object per message — and the round-trip is booked as a 'transport'
+    span in that trace. The payload the caller passed is never mutated."""
+    trace_ctx = observe.current_trace()
+    if trace_ctx is not None and "_trace" not in payload:
+        payload = dict(payload, _trace=trace_ctx)
+    t0 = time.time()
     sock, kind = connect(address, timeout=timeout)
     try:
         send_message(sock, kind, payload)
@@ -313,6 +325,11 @@ def request(address: str, payload: dict, timeout: float = 600.0) -> dict:
             sock.close()
         except OSError:
             pass
+        if trace_ctx is not None:
+            observe.emit_span(
+                "transport", t0, time.time(), ctx=trace_ctx,
+                op=str(payload.get("op", "")),
+            )
     if resp is None:
         raise ConnectionError(f"no response from {address}")
     return resp

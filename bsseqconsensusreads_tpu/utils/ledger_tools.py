@@ -72,6 +72,11 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "elastic_ledger_resumed": ("done", "pending"),
     "elastic_merged": ("records", "slices", "ok"),
     "elastic_run_complete": ("slices", "records", "requeues", "ok"),
+    # grafttrace (observability): completed causal spans (root spans
+    # carry no 'parent' key; trace/span ids also stamp ordinary events)
+    # and the crash-path flight-recorder dump
+    "span": ("name", "trace", "span", "t0", "t1", "dur_s"),
+    "flight_record": ("reason", "count", "events"),
 }
 
 #: Default closure tolerance: relative share of the wall allowed to go

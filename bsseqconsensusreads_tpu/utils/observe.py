@@ -32,13 +32,31 @@ Activation is environment-driven so the CLI and library paths share it:
   BSSEQ_TPU_TRACE=/path/dir    wrap stages in jax.profiler.trace(dir)
                                (view with tensorboard / xprof)
 
-`python -m bsseqconsensusreads_tpu observe summarize|diff|check` consumes
-the ledgers (utils.ledger_tools).
+grafttrace adds two more planes on the same sinks:
+
+* **causal trace contexts**: `mint_trace` creates {trace, span} at an
+  admission point (router submit, serve admit, elastic split/lease);
+  `bind_trace` installs it thread-locally so every `emit` in the dynamic
+  extent is stamped with the trace/span ids; `span(name)` times a child
+  span and emits one completed 'span' line {name, trace, span, parent,
+  t0, t1, dur_s}. Contexts cross processes as the reserved `_trace` key
+  of a framed-transport request (serve.transport.request injects it,
+  serve.server binds it around dispatch), so `observe trace` can
+  reassemble one causal tree per job/slice across router, replicas,
+  coordinator, and workers.
+* a **flight recorder**: a bounded ring of the most recent ledger
+  records per process (BSSEQ_TPU_FLIGHT_RING, default 256), dumped as
+  one 'flight_record' line on SIGUSR1, on GuardError exits, and on
+  chaos-drill kills — post-mortem evidence beyond the last flushed line.
+
+`python -m bsseqconsensusreads_tpu observe summarize|diff|check|trace|top`
+consumes the ledgers (utils.ledger_tools, utils.trace_tools).
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
 import hashlib
 import json
@@ -280,6 +298,15 @@ def emit(
         record["replica"] = replica
     if worker is not None:
         record["worker"] = worker
+    ctx = getattr(_TRACE_TLS, "ctx", None)
+    if ctx is not None and "trace" not in record:
+        # stamp the bound causal context; explicit payload keys win so
+        # 'span' lines (which carry their own ids) pass through untouched
+        record["trace"] = ctx["trace"]
+        record.setdefault("span", ctx["span"])
+    if event != "flight_record":
+        with _FLIGHT_LOCK:
+            _flight_ring().append(record)
     line = json.dumps(record)
     if sink is not None:
         _writer(sink).write_line(line)
@@ -287,6 +314,206 @@ def emit(
         if mirror is not None:
             os.makedirs(os.path.dirname(mirror), exist_ok=True)
             _writer(mirror).write_line(line)
+
+
+# ---------------------------------------------------------------------------
+# grafttrace: cross-process causal contexts and completed-span emission.
+#
+# A trace context is a two-key dict {trace, span}: `trace` is the causal
+# tree id ("<kind>-<key>-<6 hex>", kind in {job, slice, proc}), `span`
+# the CURRENT node in that tree. Contexts are minted once per job/slice
+# at admission, bound thread-locally for the dynamic extent of work on
+# that job/slice, and shipped across processes as the `_trace` field of
+# a framed-transport request. Span durations use wall-clock time.time()
+# (not monotonic) because cross-process monotonic clocks do not compare;
+# the analysis layer (utils.trace_tools) orders and subtracts them.
+
+_TRACE_TLS = threading.local()
+_SPAN_LOCK = threading.Lock()
+_SPAN_SEQ = [0]
+_FLIGHT_LOCK = threading.Lock()
+_FLIGHT: collections.deque | None = None
+_PROC_TRACE: dict | None = None
+
+#: trace-id kinds whose trees must reach a terminal event (job retired /
+#: slice merged) — `observe check` treats other kinds (proc overhead
+#: roots) as terminal-exempt.
+TRACE_TERMINAL_KINDS = frozenset({"job", "slice"})
+
+
+def _next_span_id() -> str:
+    """Process-unique span id: '<pid hex>.<seq hex>' — two processes can
+    never collide, and within a process the locked sequence is total."""
+    with _SPAN_LOCK:
+        _SPAN_SEQ[0] += 1
+        n = _SPAN_SEQ[0]
+    return f"{os.getpid():x}.{n:x}"
+
+
+def mint_trace(kind: str, key: str, job: str | None = None, **fields) -> dict:
+    """Mint a new trace context at an admission point and emit its root
+    span (zero duration, no parent) so every later child resolves. Returns
+    the context dict; the caller persists/ships it (`_trace` on the wire,
+    a field in slices.json, an attribute on the job object)."""
+    ctx = {
+        "trace": f"{kind}-{key}-{os.urandom(3).hex()}",
+        "span": _next_span_id(),
+    }
+    now = round(time.time(), 3)
+    emit(
+        "span",
+        {
+            "name": f"{kind}_admit", "trace": ctx["trace"],
+            "span": ctx["span"], "t0": now, "t1": now, "dur_s": 0.0,
+            **fields,
+        },
+        job=job,
+    )
+    return ctx
+
+
+def current_trace() -> dict | None:
+    """The thread's bound trace context (a copy), or None."""
+    ctx = getattr(_TRACE_TLS, "ctx", None)
+    return dict(ctx) if ctx is not None else None
+
+
+def trace_kind(trace_id: str) -> str:
+    """The kind segment of a trace id ('job-j0001-a1b2c3' -> 'job')."""
+    return str(trace_id).split("-", 1)[0]
+
+
+@contextlib.contextmanager
+def bind_trace(ctx: dict | None):
+    """Install `ctx` as the thread's trace context for the block. A falsy
+    or malformed ctx (no 'trace'/'span') binds nothing and yields None —
+    callers at trust boundaries (server dispatch) pass whatever arrived.
+    The previous binding is restored on exit."""
+    if not isinstance(ctx, dict) or "trace" not in ctx or "span" not in ctx:
+        yield None
+        return
+    bound = {"trace": str(ctx["trace"]), "span": str(ctx["span"])}
+    prev = getattr(_TRACE_TLS, "ctx", None)
+    _TRACE_TLS.ctx = bound
+    try:
+        yield bound
+    finally:
+        _TRACE_TLS.ctx = prev
+
+
+@contextlib.contextmanager
+def span(
+    name: str, ctx: dict | None = None, job: str | None = None, **fields
+):
+    """Time a child span of `ctx` (default: the bound context). Binds the
+    child for the body — nested spans and emits inside parent correctly —
+    and emits ONE completed 'span' line on exit. With no context in scope
+    this is a no-op yielding None: unarmed/untraced paths stay one branch."""
+    parent = ctx if ctx is not None else getattr(_TRACE_TLS, "ctx", None)
+    if not isinstance(parent, dict) or "trace" not in parent:
+        yield None
+        return
+    child = {"trace": parent["trace"], "span": _next_span_id()}
+    t0 = time.time()
+    prev = getattr(_TRACE_TLS, "ctx", None)
+    _TRACE_TLS.ctx = child
+    try:
+        yield child
+    finally:
+        _TRACE_TLS.ctx = prev
+        t1 = time.time()
+        emit(
+            "span",
+            {
+                "name": name, "trace": child["trace"], "span": child["span"],
+                "parent": parent["span"], "t0": round(t0, 3),
+                "t1": round(t1, 3), "dur_s": round(t1 - t0, 6), **fields,
+            },
+            job=job,
+        )
+
+
+def emit_span(
+    name: str, t0: float, t1: float, ctx: dict | None = None,
+    job: str | None = None, **fields,
+) -> str | None:
+    """Emit a completed span for an EXTERNALLY measured wall-clock window
+    (e.g. replica spawn→ready, measured around a subprocess). Returns the
+    span id, or None when no context is in scope."""
+    parent = ctx if ctx is not None else getattr(_TRACE_TLS, "ctx", None)
+    if not isinstance(parent, dict) or "trace" not in parent:
+        return None
+    sid = _next_span_id()
+    emit(
+        "span",
+        {
+            "name": name, "trace": parent["trace"], "span": sid,
+            "parent": parent["span"], "t0": round(t0, 3), "t1": round(t1, 3),
+            "dur_s": round(t1 - t0, 6), **fields,
+        },
+        job=job,
+    )
+    return sid
+
+
+def proc_trace() -> dict:
+    """The lazily minted per-process overhead trace ('proc-pid<N>-…'):
+    the parent for spans not owned by any one job/slice — worker spawn,
+    jax import, merge. Proc trees are exempt from the terminal-state
+    check but feed the overhead bucket table like any other span."""
+    global _PROC_TRACE
+    with _SPAN_LOCK:
+        ctx = _PROC_TRACE
+    if ctx is None:
+        ctx = mint_trace("proc", f"pid{os.getpid()}")
+        with _SPAN_LOCK:
+            if _PROC_TRACE is None:
+                _PROC_TRACE = ctx
+            else:
+                ctx = _PROC_TRACE
+    return dict(ctx)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: the last N ledger records, dumped on demand/crash.
+
+
+def _flight_ring() -> collections.deque:
+    global _FLIGHT
+    if _FLIGHT is None:
+        try:
+            cap = int(os.environ.get("BSSEQ_TPU_FLIGHT_RING", "256"))
+        except ValueError:
+            cap = 256
+        _FLIGHT = collections.deque(maxlen=max(cap, 1))
+    return _FLIGHT
+
+
+def flight_dump(reason: str) -> int:
+    """Dump the ring as ONE 'flight_record' ledger line {reason, count,
+    events} and flush. Called from SIGUSR1 handlers, the CLI GuardError
+    path, and failpoint kill actions; safe (a no-op count of 0) when the
+    ring is empty or no sink is armed. Returns the event count dumped."""
+    with _FLIGHT_LOCK:
+        recent = list(_flight_ring())
+    emit("flight_record", {"reason": reason, "count": len(recent),
+                           "events": recent})
+    flush_sinks()
+    return len(recent)
+
+
+def install_flight_signal() -> None:
+    """Install the SIGUSR1 → flight_dump handler (long-lived serve /
+    router / worker processes). Best-effort: non-main-thread or platform
+    refusal leaves the process untouched."""
+    try:
+        import signal
+
+        signal.signal(
+            signal.SIGUSR1, lambda _sig, _frm: flight_dump("sigusr1")
+        )
+    except (ValueError, OSError, AttributeError):
+        pass
 
 
 # ---------------------------------------------------------------------------

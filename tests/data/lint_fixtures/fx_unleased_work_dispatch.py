@@ -10,7 +10,8 @@ from bsseqconsensusreads_tpu.serve import transport
 def dispatch_all(address, slices):
     results = []
     for sl in slices:
-        resp = transport.request(address, {"op": "assign", "slice": sl})  # seeded: unleased-work-dispatch
+        slice_trace = sl.get("trace")  # traced, but STILL unleased
+        resp = transport.request(address, {"op": "assign", "slice": sl, "trace": slice_trace})  # seeded: unleased-work-dispatch
         results.append(resp)
     return results
 
@@ -20,10 +21,11 @@ def dispatch_leased(address, slices, ledger):
     for sl in slices:
         lease_id = ledger.lease(sl)
         lease_expires = ledger.expiry_of(lease_id)
+        slice_trace = sl.get("trace")
         resp = transport.request(
             address,
             {"op": "assign", "slice": sl, "lease_id": lease_id,
-             "until": lease_expires},
+             "until": lease_expires, "trace": slice_trace},
         )
         results.append(resp)
     return results

@@ -44,6 +44,7 @@ FIXTURES = {
     "unframed-socket-read": "fx_unframed_socket_read.py",
     "serial-deflate": "fx_serial_deflate.py",
     "unleased-work-dispatch": "fx_unleased_work_dispatch.py",
+    "untraced-transport-send": "fx_untraced_transport_send.py",
 }
 
 

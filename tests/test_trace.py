@@ -1,0 +1,775 @@
+"""grafttrace tests: cross-process causal tracing end to end.
+
+* mint/propagate/stamp — trace contexts minted at admission, bound
+  thread-locally, stamped onto ordinary ledger lines, and emitted as
+  completed parent-linked spans; unarmed/untraced paths stay one branch;
+* ledger round-trips — a real inline router + 2-replica serve run and a
+  real coordinator + worker run over tcp each leave a ledger from which
+  `trace_tools.assemble` rebuilds COMPLETE causal trees: zero orphan
+  spans, every job/slice trace terminal, counters reconciled;
+* critical path — arithmetic on a hand-built span forest: root→leaf
+  walk, bucket ranking, orphan detection, requeue annotation;
+* flight recorder — bounded ring, SIGUSR1 dump, dump-on-demand;
+* metrics plane — the `metrics` protocol op on serve and coordinator
+  servers, `cli observe top`, and the transport's typed refusals for
+  oversized/garbage metrics traffic;
+* byte identity — arming the tracing plane changes no output bytes;
+* truncation smoke — `cli observe trace` exits non-zero when a ledger
+  of the set is missing (the tier-1 gate bench.py's trace leg rides).
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu import cli
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.elastic import (
+    Coordinator,
+    SliceLedger,
+    run_elastic,
+    split_input,
+    worker as worker_mod,
+)
+from bsseqconsensusreads_tpu.elastic.coordinator import (
+    ENV_COORDINATOR_ADDR,
+    ENV_WORKER_ID,
+)
+from bsseqconsensusreads_tpu.elastic.coordinator import config_doc
+from bsseqconsensusreads_tpu.io.bam import BamWriter
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.serve.router import Router
+from bsseqconsensusreads_tpu.serve.server import ServeEngine, ServeServer
+from bsseqconsensusreads_tpu.utils import observe, trace_tools
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observe():
+    """Sinks, the flight ring, and the lazy proc trace are process
+    globals; reset between tests so each starts unarmed and empty."""
+    yield
+    observe.close_sinks()
+    observe._FLIGHT = None
+    observe._PROC_TRACE = None
+
+
+def _lines(path):
+    return [json.loads(s) for s in open(path).read().splitlines()]
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# mint / propagate / stamp
+
+
+class TestMintAndStamp:
+    def test_mint_emits_zero_duration_root_span(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        ctx = observe.mint_trace("job", "j0001")
+        assert set(ctx) == {"trace", "span"}
+        assert ctx["trace"].startswith("job-j0001-")
+        (d,) = _lines(sink)
+        assert d["event"] == "span" and d["name"] == "job_admit"
+        assert d["trace"] == ctx["trace"] and d["span"] == ctx["span"]
+        assert "parent" not in d  # a root resolves every later child
+        assert d["t0"] == d["t1"] and d["dur_s"] == 0.0
+
+    def test_trace_kind(self):
+        assert observe.trace_kind("job-j0001-a1b2c3") == "job"
+        assert observe.trace_kind("slice-s0002-ffffff") == "slice"
+        assert observe.trace_kind("proc-pid77-0") == "proc"
+
+    def test_bind_trace_stamps_ordinary_events(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        ctx = observe.mint_trace("job", "j0002")
+        with observe.bind_trace(ctx):
+            observe.emit("inside", {"k": 1})
+            assert observe.current_trace() == ctx
+        observe.emit("outside", {"k": 2})
+        assert observe.current_trace() is None
+        by_event = {d["event"]: d for d in _lines(sink) if d["event"] != "span"}
+        assert by_event["inside"]["trace"] == ctx["trace"]
+        assert by_event["inside"]["span"] == ctx["span"]
+        assert "trace" not in by_event["outside"]
+
+    def test_bind_trace_malformed_yields_none(self):
+        for bogus in (None, "job-x-1", {}, {"trace": "t"}, {"span": "s"}, 7):
+            with observe.bind_trace(bogus) as bound:
+                assert bound is None
+            assert observe.current_trace() is None
+
+    def test_bind_trace_restores_previous_binding(self):
+        outer = {"trace": "job-a-000000", "span": "1.1"}
+        inner = {"trace": "slice-b-000000", "span": "1.2"}
+        with observe.bind_trace(outer):
+            with observe.bind_trace(inner):
+                assert observe.current_trace()["trace"] == inner["trace"]
+            assert observe.current_trace()["trace"] == outer["trace"]
+
+    def test_nested_spans_chain_parents(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        ctx = observe.mint_trace("slice", "s0000")
+        with observe.bind_trace(ctx):
+            with observe.span("outer") as outer:
+                with observe.span("inner") as inner:
+                    assert inner["trace"] == ctx["trace"]
+        spans = {d["name"]: d for d in _lines(sink)}
+        assert spans["inner"]["parent"] == outer["span"]
+        assert spans["outer"]["parent"] == ctx["span"]
+        # the file round-trips into a whole single-trace forest
+        report = trace_tools.assemble(sink)
+        assert report.by_kind() == {"slice": 1}
+        assert report.orphans == []
+
+    def test_span_without_context_is_noop(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        with observe.span("nothing") as s:
+            assert s is None
+        assert not os.path.exists(sink)  # nothing was ever emitted
+
+    def test_emit_span_external_window(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        ctx = observe.mint_trace("proc", "pidX")
+        sid = observe.emit_span("worker_spawn", 10.0, 12.5, ctx=ctx, rid="r0")
+        assert isinstance(sid, str)
+        d = _lines(sink)[-1]
+        assert d["name"] == "worker_spawn" and d["parent"] == ctx["span"]
+        assert d["dur_s"] == pytest.approx(2.5)
+        assert d["rid"] == "r0"
+        assert observe.emit_span("x", 0.0, 1.0) is None  # no ctx in scope
+
+    def test_span_ids_unique_and_process_scoped(self):
+        ids = {observe._next_span_id() for _ in range(100)}
+        assert len(ids) == 100
+        assert all(i.startswith(f"{os.getpid():x}.") for i in ids)
+
+    def test_unarmed_emit_is_one_branch_and_rings_nothing(self, monkeypatch):
+        monkeypatch.delenv("BSSEQ_TPU_STATS", raising=False)
+        observe._FLIGHT = None
+        observe.emit("tick", {"i": 1})
+        # the early return fired before record build OR ring append
+        assert observe._FLIGHT is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_keeps_latest(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv("BSSEQ_TPU_FLIGHT_RING", "8")
+        observe._FLIGHT = None  # cap is read at first ring build
+        for i in range(50):
+            observe.emit("tick", {"i": i})
+        assert observe.flight_dump("test") == 8
+        d = _lines(sink)[-1]
+        assert d["event"] == "flight_record" and d["reason"] == "test"
+        assert d["count"] == 8
+        assert [e["i"] for e in d["events"]] == list(range(42, 50))
+
+    def test_dump_excludes_prior_dumps_from_ring(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        observe._FLIGHT = None
+        observe.emit("tick", {"i": 0})
+        assert observe.flight_dump("first") == 1
+        # the flight_record line itself never re-enters the ring
+        assert observe.flight_dump("second") == 1
+        events = [d["event"] for d in _lines(sink)]
+        assert events.count("flight_record") == 2
+
+    def test_empty_ring_dump_is_zero(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BSSEQ_TPU_STATS", str(tmp_path / "l.jsonl"))
+        observe._FLIGHT = None
+        assert observe.flight_dump("empty") == 0
+
+    def test_sigusr1_dumps_ring(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        observe._FLIGHT = None
+        prev = signal.getsignal(signal.SIGUSR1)
+        try:
+            observe.install_flight_signal()
+            observe.emit("alive", {"n": 1})
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.monotonic() + 5.0
+            dumped = []
+            while time.monotonic() < deadline and not dumped:
+                dumped = [
+                    d for d in _lines(sink)
+                    if d["event"] == "flight_record"
+                ]
+                time.sleep(0.01)
+        finally:
+            signal.signal(signal.SIGUSR1, prev)
+        assert dumped and dumped[0]["reason"] == "sigusr1"
+        assert dumped[0]["count"] == 1
+        assert dumped[0]["events"][0]["event"] == "alive"
+
+
+# ---------------------------------------------------------------------------
+# critical-path arithmetic on a hand-built forest
+
+
+def _span_line(trace, sid, name, t0, t1, parent=None, **extra):
+    d = {
+        "ts": t0, "event": "span", "name": name, "trace": trace,
+        "span": sid, "t0": t0, "t1": t1, "dur_s": round(t1 - t0, 6),
+    }
+    if parent is not None:
+        d["parent"] = parent
+    d.update(extra)
+    return d
+
+
+def _write_ledger(path, lines):
+    with open(path, "w") as fh:
+        for d in lines:
+            fh.write(json.dumps(d) + "\n")
+
+
+class TestHandBuiltForest:
+    TID = "job-j0001-abcdef"
+
+    def forest(self):
+        """root(0s) -> ingest(100..104) -> retire(101..103.5)
+                    -> transport(100.5..102)
+        latest-finishing span is `ingest` (a non-leaf beats its child):
+        the critical path is the chain root > ingest."""
+        return [
+            _span_line(self.TID, "1.1", "job_admit", 100.0, 100.0),
+            _span_line(self.TID, "1.2", "ingest", 100.0, 104.0,
+                       parent="1.1"),
+            _span_line(self.TID, "1.3", "transport", 100.5, 102.0,
+                       parent="1.1"),
+            _span_line(self.TID, "1.4", "chunk_retire", 101.0, 103.5,
+                       parent="1.2"),
+            {"ts": 104.0, "event": "job_complete", "trace": self.TID,
+             "span": "1.1", "job": "j0001"},
+        ]
+
+    def test_critical_path_walks_to_root(self, tmp_path):
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, self.forest())
+        report = trace_tools.assemble(path)
+        trace = report.traces[self.TID]
+        assert [s.name for s in trace.critical_path()] == [
+            "job_admit", "ingest"
+        ]
+        assert trace.terminal() and not trace.requeued()
+        assert trace.t0 == 100.0 and trace.t1 == 104.0
+        assert trace_tools.check_traces(report) == []
+
+    def test_buckets_ranked_by_total_duration(self, tmp_path):
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, self.forest())
+        report = trace_tools.assemble(path)
+        buckets = report.buckets()
+        assert [b[0] for b in buckets] == [
+            "ingest", "chunk_retire", "transport", "job_admit"
+        ]
+        assert buckets[0][2] == pytest.approx(4.0)
+        assert buckets[-1] == ("job_admit", 1, 0.0)
+
+    def test_orphan_detection_and_exit_code(self, tmp_path, capsys):
+        lines = self.forest()
+        lines.append(
+            _span_line(self.TID, "1.9", "lost_child", 102.0, 103.0,
+                       parent="9.9")
+        )
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        report = trace_tools.assemble(path)
+        assert report.orphans == [(self.TID, "1.9", "9.9", "lost_child")]
+        problems = trace_tools.check_traces(report)
+        assert any("orphan span 1.9" in p for p in problems)
+        assert cli.main(["observe", "trace", path]) == 1
+        assert "orphan" in capsys.readouterr().err
+
+    def test_nonterminal_requeued_trace_is_annotated(self, tmp_path):
+        tid = "slice-s0001-ffffff"
+        lines = [
+            _span_line(tid, "2.1", "slice_admit", 10.0, 10.0),
+            _span_line(tid, "2.2", "slice_pipeline", 10.0, 11.0,
+                       parent="2.1"),
+            {"ts": 11.5, "event": "slice_requeued", "trace": tid,
+             "span": "2.1", "sid": 1},
+        ]
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        report = trace_tools.assemble(path)
+        assert report.traces[tid].requeued()
+        (problem,) = trace_tools.check_traces(report)
+        assert "never reached a terminal state" in problem
+        assert "(requeued, then lost)" in problem
+
+    def test_proc_traces_are_terminal_exempt(self, tmp_path):
+        tid = "proc-pid123-0"
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, [
+            _span_line(tid, "3.1", "proc_admit", 5.0, 5.0),
+            _span_line(tid, "3.2", "jax_import", 5.0, 9.0, parent="3.1"),
+        ])
+        report = trace_tools.assemble(path)
+        assert trace_tools.check_traces(report) == []
+
+    def test_longest_trace_wins_critical_path(self, tmp_path):
+        other = "job-j0002-123456"
+        lines = self.forest() + [
+            _span_line(other, "4.1", "job_admit", 200.0, 200.0),
+            _span_line(other, "4.2", "ingest", 200.0, 210.0, parent="4.1"),
+            {"ts": 210.0, "event": "job_complete", "trace": other,
+             "span": "4.1", "job": "j0002"},
+        ]
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        report = trace_tools.assemble(path)
+        assert report.longest().tid == other  # 10s wall beats 4s
+
+    def test_reconcile_flags_unadmitted_job_trace(self, tmp_path):
+        lines = self.forest()
+        # admitted under its trace...
+        lines.append({"ts": 100.0, "event": "job_admitted",
+                      "trace": self.TID, "span": "1.1", "job": "j0001"})
+        # ...plus a routed trace that never reached any replica
+        ghost = "job-f0009-dddddd"
+        lines.append(_span_line(ghost, "5.1", "job_admit", 300.0, 300.0))
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        problems = trace_tools.check_traces(trace_tools.assemble(path))
+        assert any("no admission event" in p and ghost in p
+                   for p in problems)
+        assert any(ghost in p and "terminal" in p for p in problems)
+
+    def test_reconcile_flags_untraced_admission(self, tmp_path):
+        lines = self.forest()
+        lines.append({"ts": 100.0, "event": "job_admitted", "job": "jX"})
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        problems = trace_tools.check_traces(trace_tools.assemble(path))
+        assert any("carry no trace id" in p for p in problems)
+
+    def test_reconcile_flags_untraced_route(self, tmp_path):
+        lines = self.forest()
+        lines.append({"ts": 100.0, "event": "fleet_route", "job": "f0001",
+                      "replica": "r0"})
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        problems = trace_tools.check_traces(trace_tools.assemble(path))
+        assert any("fleet_route" in p and "no trace id" in p
+                   for p in problems)
+
+    def test_requeued_reroute_same_trace_reconciles(self, tmp_path):
+        """A killed replica's job is RE-routed under the same trace:
+        two stamped fleet_route events, one requeue, one terminal —
+        placements outnumber traces and that is fine."""
+        lines = self.forest()
+        for ts in (100.0, 102.0):
+            lines.append({"ts": ts, "event": "fleet_route",
+                          "trace": self.TID, "span": "1.1",
+                          "job": "f0001"})
+        lines.append({"ts": 101.5, "event": "fleet_requeue",
+                      "trace": self.TID, "span": "1.1", "job": "f0001"})
+        lines.append({"ts": 100.3, "event": "job_admitted",
+                      "trace": self.TID, "span": "1.1", "job": "j0001"})
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        report = trace_tools.assemble(path)
+        assert trace_tools.check_traces(report) == []
+        assert report.traces[self.TID].requeued()
+
+    def test_reconcile_flags_split_vs_slice_traces(self, tmp_path):
+        tid = "slice-s0000-aaaaaa"
+        lines = [
+            _span_line(tid, "6.1", "slice_admit", 1.0, 1.0),
+            {"ts": 1.0, "event": "elastic_split", "slices": 3,
+             "records": 10, "trace": tid, "span": "6.1"},
+            {"ts": 2.0, "event": "elastic_slice_done", "trace": tid,
+             "span": "6.1", "sid": 0},
+        ]
+        path = str(tmp_path / "l.jsonl")
+        _write_ledger(path, lines)
+        problems = trace_tools.check_traces(trace_tools.assemble(path))
+        assert any("split produced 3 slices but 1 slice traces" in p
+                   for p in problems)
+
+    def test_truncated_ledger_set_fails_whole_set_passes(
+        self, tmp_path, capsys
+    ):
+        """The tier-1 truncation smoke: drop one ledger of a two-file
+        set whose root spans live in the dropped file — `observe trace`
+        must exit non-zero on the orphaned remainder."""
+        rundir = str(tmp_path / "run")
+        os.makedirs(rundir)
+        _write_ledger(os.path.join(rundir, "router.jsonl"), [
+            _span_line(self.TID, "1.1", "job_admit", 100.0, 100.0),
+            _span_line(self.TID, "1.5", "transport", 100.0, 100.2,
+                       parent="1.1", op="submit"),
+        ])
+        _write_ledger(os.path.join(rundir, "replica.jsonl"), [
+            _span_line(self.TID, "7.1", "ingest", 100.2, 103.0,
+                       parent="1.1"),
+            {"ts": 103.0, "event": "job_complete", "trace": self.TID,
+             "span": "7.1", "job": "j0001"},
+        ])
+        assert cli.main(["observe", "trace", rundir]) == 0
+        out = capsys.readouterr().out
+        assert "orphans: 0" in out and "overhead buckets" in out
+        os.unlink(os.path.join(rundir, "router.jsonl"))
+        assert cli.main(["observe", "trace", rundir]) == 1
+        err = capsys.readouterr().err
+        assert "orphan" in err
+        # `observe check` on the directory fails the same way
+        assert cli.main(["observe", "check", rundir]) == 1
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# live metrics plane: protocol op + `observe top` + typed refusals
+
+
+class _Replica:
+    """Fleet-protocol shim pointing at a real in-thread ServeServer."""
+
+    def __init__(self, rid, address):
+        self.rid = rid
+        self.address = address
+        self.proc = None
+        self.generation = 0
+
+    @property
+    def supervised(self) -> bool:
+        return False
+
+    def alive(self) -> bool:
+        return True
+
+
+class _Fleet:
+    def __init__(self, replicas):
+        self.replicas = list(replicas)
+
+    def alive(self):
+        return list(self.replicas)
+
+    def lookup(self, rid):
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        return None
+
+    def restart(self, replica):
+        pass
+
+
+def _start_server(server):
+    # graftlint: owned-thread -- test accept loop, drained in teardown
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    deadline = time.monotonic() + 10.0
+    while not server.bound and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert server.bound
+    return thread
+
+
+class TestMetricsPlane:
+    @pytest.fixture()
+    def served_engine(self):
+        eng = ServeEngine(batch_families=4, stride=2)
+        eng.start()
+        server = ServeServer(eng, addresses=["tcp:127.0.0.1:0"])
+        thread = _start_server(server)
+        yield server.bound[0], eng
+        server.request_drain()
+        thread.join(timeout=10.0)
+        eng.stop(timeout=30)
+
+    def test_serve_metrics_op(self, served_engine):
+        addr, _eng = served_engine
+        resp = transport.request(addr, {"op": "metrics"}, timeout=10.0)
+        assert resp["ok"]
+        m = resp["metrics"]
+        assert m["component"] == "serve"
+        assert m["queue_depth"] == 0 and m["engine_alive"] is True
+        for key in ("uptime_s", "jobs_by_state", "chip_busy",
+                    "batches_shared_jobs_rate", "counters"):
+            assert key in m, key
+
+    def test_coordinator_metrics_op(self, tmp_path):
+        rundir = str(tmp_path / "run")
+        os.makedirs(os.path.join(rundir, "slices"), exist_ok=True)
+        specs = [{"sid": 0, "path": "slices/s0.bam", "records": 1,
+                  "families": 1, "family_crc": 7, "input_crc": 0}]
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, {"doc": True}, addresses=["tcp:127.0.0.1:0"]
+        )
+        thread = _start_server(server)
+        try:
+            ledger.join("w0")
+            ledger.lease("w0")
+            resp = transport.request(
+                server.bound[0], {"op": "metrics"}, timeout=10.0
+            )
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)
+        assert resp["ok"]
+        m = resp["metrics"]
+        assert m["component"] == "coordinator"
+        assert m["slices"] == 1 and m["outstanding_leases"] == 1
+        assert m["lease_backlog"] == 0 and m["workers"] == 1
+        assert m["counters"] == {"requeues": 0, "workers_lost": 0}
+
+    def test_observe_top_polls_json_lines(self, served_engine, capsys):
+        addr, _eng = served_engine
+        rc = cli.main([
+            "observe", "top", "--address", addr,
+            "--count", "2", "--interval", "0.01",
+        ])
+        assert rc == 0
+        lines = capsys.readouterr().out.splitlines()
+        assert len(lines) == 2
+        for line in lines:
+            sample = json.loads(line)
+            assert sample["component"] == "serve"
+            assert "queue_depth" in sample
+
+    def test_observe_top_dead_address_exits_nonzero(self, capsys):
+        rc = cli.main([
+            "observe", "top", "--address", "tcp:127.0.0.1:1", "--count", "1",
+        ])
+        assert rc == 1
+        capsys.readouterr()
+
+    def test_oversized_metrics_request_typed_refusal(self, served_engine):
+        addr, _eng = served_engine
+        huge = {"op": "metrics", "pad": "x" * (transport.MAX_FRAME + 1)}
+        with pytest.raises(transport.TransportError):
+            transport.request(addr, huge, timeout=10.0)
+
+    def test_garbage_metrics_frame_answered_with_guard(self, served_engine):
+        addr, _eng = served_engine
+        sock, kind = transport.connect(addr, timeout=5.0)
+        try:
+            body = b"metrics please"
+            sock.sendall(struct.pack("!I", len(body)) + body)
+            resp = transport.recv_message(sock, kind)
+        finally:
+            sock.close()
+        assert resp["ok"] is False and resp["guard"] == "bad_json"
+
+
+# ---------------------------------------------------------------------------
+# ledger round-trips: real runs, whole causal trees
+
+
+GENOME = "".join(
+    "ACGT"[i] for i in np.random.default_rng(7).integers(0, 4, size=2000)
+)
+
+
+def _grouped_bam(path, seed, n_families=4):
+    header, records = make_grouped_bam_records(
+        np.random.default_rng(seed), f"chr{seed % 97}", GENOME,
+        n_families=n_families, reads_per_strand=(2, 2), read_len=40,
+    )
+    with BamWriter(path, header) as w:
+        w.write_all(records)
+
+
+@pytest.fixture(scope="module")
+def elastic_input(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("trace_elastic")
+    rng = np.random.default_rng(906)
+    name, genome = random_genome(rng, 4000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=6, error_rate=0.01
+    )
+    bam = str(tmp / "in.bam")
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    cfg = FrameworkConfig(
+        genome_dir=os.path.dirname(fasta),
+        genome_fasta_file_name=os.path.basename(fasta),
+        aligner="self",
+    )
+    return {"bam": bam, "cfg": cfg, "tmp": tmp}
+
+
+class TestLedgerRoundTrips:
+    def test_serve_router_two_replicas_zero_orphans(
+        self, tmp_path, monkeypatch
+    ):
+        """Inline router + 2 real replicas over tcp: both job traces are
+        minted at the router, ride the `_trace` wire field into replica
+        admission, and close with replica-side job_complete — one whole
+        tree per job, zero orphans, counters reconciled."""
+        sink = str(tmp_path / "fleet.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        engines, servers, threads = [], [], []
+        for _ in range(2):
+            eng = ServeEngine(batch_families=4, stride=2)
+            eng.start()
+            srv = ServeServer(eng, addresses=["tcp:127.0.0.1:0"])
+            threads.append(_start_server(srv))
+            engines.append(eng)
+            servers.append(srv)
+        fleet = _Fleet([
+            _Replica(f"r{i}", srv.bound[0])
+            for i, srv in enumerate(servers)
+        ])
+        router = Router(replicas=fleet)  # no launch(): no monitor thread
+        try:
+            for k in range(2):
+                inp = str(tmp_path / f"in{k}.bam")
+                _grouped_bam(inp, seed=300 + k)
+                resp = router.submit({
+                    "input": inp, "output": str(tmp_path / f"out{k}.bam"),
+                })
+                assert resp["ok"], resp
+            placed = {j.replica_id for j in router._jobs.values()}
+            assert placed == {"r0", "r1"}  # least-outstanding spread
+            for eng in engines:
+                for job in eng.queue.jobs():
+                    st = eng.wait(job.id, timeout=120)
+                    assert st["state"] == "done", st
+        finally:
+            for srv, thread in zip(servers, threads):
+                srv.request_drain()
+                thread.join(timeout=10.0)
+            for eng in engines:
+                eng.stop(timeout=30)
+        observe.close_sinks()
+        report = trace_tools.assemble(sink)
+        assert trace_tools.check_traces(report) == []
+        assert report.by_kind().get("job") == 2
+        assert report.orphans == []
+        for trace in report.traces.values():
+            if trace.kind != "job":
+                continue
+            assert trace.terminal()
+            names = {s.name for s in trace.spans.values()}
+            assert "job_admit" in names  # the router-side mint
+            assert "transport" in names  # the forward leg, same tree
+            events = {e.get("event") for e in trace.events}
+            assert "fleet_route" in events and "job_admitted" in events
+            assert "job_complete" in events
+        # the CLI agrees end to end
+        assert cli.main(["observe", "trace", sink]) == 0
+
+    def test_coordinator_worker_over_tcp_zero_orphans(
+        self, elastic_input, tmp_path, monkeypatch
+    ):
+        """Real coordinator + work_loop over tcp: slice traces minted at
+        split, shipped inside lease grants, closed by the coordinator's
+        commit — every slice one whole tree across both endpoints."""
+        sink = str(tmp_path / "elastic.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv(ENV_WORKER_ID, "wt0")
+        monkeypatch.setenv(ENV_COORDINATOR_ADDR, "")
+        rundir = str(tmp_path / "run")
+        os.makedirs(rundir, exist_ok=True)
+        cfg = elastic_input["cfg"]
+        specs = split_input(elastic_input["bam"], rundir, 2)
+        assert all("trace" in sl for sl in specs)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, config_doc(cfg), addresses=["tcp:127.0.0.1:0"]
+        )
+        thread = _start_server(server)
+        try:
+            processed = worker_mod.work_loop(
+                server.bound[0], worker_id="wt0"
+            )
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)
+        assert processed == 2
+        observe.close_sinks()
+        report = trace_tools.assemble(sink)
+        assert trace_tools.check_traces(report) == []
+        assert report.by_kind().get("slice") == 2
+        for trace in report.traces.values():
+            if trace.kind != "slice":
+                continue
+            assert trace.terminal()
+            names = {s.name for s in trace.spans.values()}
+            assert "slice_pipeline" in names
+            events = {e.get("event") for e in trace.events}
+            assert "elastic_slice_done" in events
+        assert cli.main(["observe", "trace", sink]) == 0
+
+    def test_elastic_inline_run_round_trips(
+        self, elastic_input, tmp_path, monkeypatch
+    ):
+        """The merged artifact path: run_elastic inline over 3 slices
+        leaves a ledger whose forest `observe check` passes whole, and
+        trace_summary carries the bucket table for HEAD artifacts."""
+        sink = str(tmp_path / "inline.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        outdir = str(tmp_path / "out")
+        _target, rep = run_elastic(
+            elastic_input["cfg"], elastic_input["bam"], outdir,
+            inline=True, slices=3,
+        )
+        assert rep["ok"]
+        observe.close_sinks()
+        report = trace_tools.assemble(sink)
+        assert trace_tools.check_traces(report) == []
+        assert report.by_kind().get("slice") == 3
+        summary = trace_tools.trace_summary(sink)
+        assert summary["ok"] and summary["orphans"] == 0
+        assert summary["traces"]["slice"] == 3
+        assert "slice_pipeline" in summary["buckets"]
+        assert "merge" in summary["buckets"]
+        assert summary["critical_path"]["spans"]
+
+    def test_tracing_changes_no_output_bytes(self, tmp_path, monkeypatch):
+        """Byte-identity pin: the same input through `cli molecular`
+        with the ledger armed and unarmed produces identical BAMs."""
+        inp = str(tmp_path / "in.bam")
+        _grouped_bam(inp, seed=42)
+        quiet = str(tmp_path / "quiet.bam")
+        traced = str(tmp_path / "traced.bam")
+        monkeypatch.delenv("BSSEQ_TPU_STATS", raising=False)
+        assert cli.main([
+            "molecular", "-i", inp, "-o", quiet,
+            "--batching", "sequential",
+        ]) == 0
+        sink = str(tmp_path / "l.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        assert cli.main([
+            "molecular", "-i", inp, "-o", traced,
+            "--batching", "sequential",
+        ]) == 0
+        observe.close_sinks()
+        assert _sha(traced) == _sha(quiet)
+        assert os.path.exists(sink)  # the traced run really was armed

@@ -206,6 +206,29 @@ def _ledger_counts(path: str) -> dict:
     return counts
 
 
+def _trace_check(target, expect_requeued: bool = False) -> dict:
+    """Cross-process causal-trace gate (grafttrace): reassemble the span
+    forest from the scenario's ledger(s) and require it WHOLE — zero
+    orphan spans, every job/slice trace terminal, counters reconciled.
+    For kill scenarios the victim's trace must additionally carry a
+    requeue event before its terminal: the kill was resolved back onto
+    the queue, not left dangling."""
+    from bsseqconsensusreads_tpu.utils import trace_tools
+
+    report = trace_tools.assemble(target)
+    problems = trace_tools.check_traces(report)
+    requeued = sum(1 for t in report.traces.values() if t.requeued())
+    return {
+        "traces": report.by_kind(),
+        "spans": report.span_count(),
+        "orphans": len(report.orphans),
+        "requeued_traces": requeued,
+        "problems": problems[:8],
+        "ok": not problems
+        and (requeued >= 1 if expect_requeued else True),
+    }
+
+
 def _child_payload(cp) -> dict:
     for line in reversed(cp.stdout.strip().splitlines()):
         if line.startswith("{"):
@@ -809,6 +832,7 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 entry["corrupt_identical_to_quarantine_run"] = (
                     open(corrupt_out, "rb").read() == q_ref
                 )
+                entry["trace"] = _trace_check(ledger)
                 entry["ok"] = (
                     sc["job"]["state"] == "done"
                     and sq["job"]["state"] == "done"
@@ -816,6 +840,7 @@ def run_drill(quick: bool, out_path: str) -> dict:
                     and entry["corrupt_identical_to_quarantine_run"]
                     and entry["quarantined"] >= 1
                     and entry["clean_latency_s"] < 120
+                    and entry["trace"]["ok"]
                     and rc == 0
                 )
         finally:
@@ -939,12 +964,17 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 entry["identical"] = [
                     open(o, "rb").read() == clean_ref for o in outs
                 ]
+                # a SIGKILLed replica's stranded jobs must leave traces
+                # that carry a fleet_requeue and STILL terminate on the
+                # survivor — the forest stays whole across the kill
+                entry["trace"] = _trace_check(ledger, expect_requeued=True)
                 entry["ok"] = (
                     all(s == "done" for s in states)
                     and all(entry["identical"])
                     and counters.get("jobs_requeued", 0) >= 1
                     and counters.get("replica_restarts", 0) >= 1
                     and entry["max_wait_s"] < 120.0
+                    and entry["trace"]["ok"]
                     and rc == 0
                 )
         finally:
@@ -994,11 +1024,13 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 entry["identical"] = [
                     open(o, "rb").read() == clean_ref for o in outs
                 ]
+                entry["trace"] = _trace_check(ledger)
                 entry["ok"] = (
                     all(s == "done" for s in states)
                     and all(entry["identical"])
                     and entry["faults_fired"] >= 1
                     and counters.get("jobs_requeued", 0) == 0
+                    and entry["trace"]["ok"]
                     and rc == 0
                 )
         finally:
@@ -1036,12 +1068,16 @@ def run_drill(quick: bool, out_path: str) -> dict:
             entry["requeues"] = report.get("requeues", 0)
             entry["counters_reconciled"] = report.get("ok", False)
             entry["checks"] = report.get("checks", {})
+            # the killed worker's slice trace must carry slice_requeued
+            # and still reach elastic_slice_done on the retaker
+            entry["trace"] = _trace_check(ledger, expect_requeued=True)
             entry["ok"] = (
                 entry["byte_identical"]
                 and entry["counters_reconciled"]
                 and entry["slice_requeued"] >= 1
                 and entry["worker_lost"] >= 1
                 and entry["worker_spawns"] >= 3  # w0, w1, w0 respawn
+                and entry["trace"]["ok"]
             )
         entry["seconds"] = round(time.monotonic() - t0, 1)
 

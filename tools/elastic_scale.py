@@ -12,7 +12,12 @@ the single-process pipeline, and writes ELASTIC_HEAD.json:
 * per-worker chip_busy from the worker-scoped ledger sub-streams
   (`observe summarize --worker wN` surface);
 * a requeue drill: worker w0 hard-killed mid-slice, slice requeued,
-  bytes still identical — loss recovery measured, not assumed.
+  bytes still identical — loss recovery measured, not assumed;
+* per-run grafttrace digests (utils.trace_tools.trace_summary): the
+  ranked overhead-bucket table + run critical path reassembled from the
+  run's ledger, and the cross-process trace checks (zero orphans, every
+  slice trace terminal) as an admissibility gate — a fleet wall-clock
+  number ships WITH the table that attributes its overhead.
 
 `--quick` shrinks the input for the bench.py ride-along; the run
 matrix is the same.
@@ -203,9 +208,14 @@ def run_bench(quick: bool, out_path: str) -> dict:
     with tempfile.TemporaryDirectory(prefix="bsseq_elastic_") as wd:
         bam = _build_input(wd, n_families, genome_len)
         cfgfile = _cfg_file(wd)
+        from bsseqconsensusreads_tpu.utils import trace_tools
+
         single = _single_process(
             wd, bam, os.path.join(wd, "out_single"),
             os.path.join(wd, "single.jsonl"),
+        )
+        single["trace"] = trace_tools.trace_summary(
+            os.path.join(wd, "single.jsonl")
         )
         doc["single_process"] = single
 
@@ -222,7 +232,15 @@ def run_bench(quick: bool, out_path: str) -> dict:
                 if entry["wall_s"] else None
             )
             entry["per_worker"] = _worker_busy(ledger, workers)
-            ok = ok and entry["byte_identical"] and entry["counters_reconciled"]
+            # the attribution for this fleet size's wall clock: ranked
+            # overhead buckets + critical path, and the whole-forest
+            # check — the speedup number is inadmissible without it
+            entry["trace"] = trace_tools.trace_summary(ledger)
+            ok = (
+                ok and entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["trace"]["ok"]
+            )
             fleets[f"workers_{workers}"] = entry
         doc["fleet"] = fleets
 
@@ -233,11 +251,14 @@ def run_bench(quick: bool, out_path: str) -> dict:
             worker_failpoints="w0:elastic_slice=exit:9@hit=2",
         )
         drill["byte_identical"] = drill["sha256"] == single["sha256"]
+        # even the killed worker's slice trace must re-terminate whole
+        drill["trace"] = trace_tools.trace_summary(ledger)
         drill["ok"] = (
             drill["byte_identical"]
             and drill["counters_reconciled"]
             and drill["requeues"] >= 1
             and drill["workers_lost"] >= 1
+            and drill["trace"]["ok"]
         )
         ok = ok and drill["ok"]
         doc["requeue_drill"] = drill

@@ -31,6 +31,11 @@ edge runs over the TCP transport (router front + router→replica).
 
 Writes FLEET_HEAD.json (committed denominator; bench.py's
 BSSEQ_BENCH_FLEET leg runs the --fleet --quick form).
+
+Both artifacts embed a grafttrace digest (`trace`): the ranked
+overhead-bucket table and run critical path reassembled from the run's
+ledger via utils.trace_tools, gated on the cross-process trace checks —
+a throughput/latency number ships with its attribution attached.
 """
 
 import argparse
@@ -297,6 +302,12 @@ def run_load(n_jobs: int, n_families: int, rate: float, seed: int,
         counters = _ledger_counters(ledger)
         all_ok = bool(jobs) and all(j.get("ok") for j in jobs)
         shared = counters.get("batches_shared_jobs", 0)
+        # grafttrace digest: ranked overhead buckets + critical path
+        # reassembled from the server's ledger, plus the whole-forest
+        # check (zero orphans, every job trace terminal) as a gate
+        from bsseqconsensusreads_tpu.utils import trace_tools
+
+        trace = trace_tools.trace_summary(ledger)
         head = {
             "suite": "serve_loadgen",
             "config": {
@@ -315,7 +326,8 @@ def run_load(n_jobs: int, n_families: int, rate: float, seed: int,
             "counters": counters,
             "server_exit_code": rc,
             "jobs_detail": jobs,
-            "ok": all_ok and rc == 0 and shared > 0,
+            "trace": trace,
+            "ok": all_ok and rc == 0 and shared > 0 and trace["ok"],
         }
         with open(out_path, "w") as fh:
             json.dump(head, fh, indent=2, sort_keys=True)
@@ -413,6 +425,13 @@ def run_fleet_load(replicas: int, tenants: int, distinct: int,
         reconciled = (
             sum(admissions.values()) == counters.get("jobs_routed", -1)
         )
+        # the shared fleet ledger (router + every replica) must
+        # reassemble into whole causal trees: each tenant's trace minted
+        # at the router, admitted replica-side, terminated at retire —
+        # and the bucket table attributes the fleet's overhead
+        from bsseqconsensusreads_tpu.utils import trace_tools
+
+        trace = trace_tools.trace_summary(ledger)
         head = {
             "suite": "fleet_loadgen",
             "config": {
@@ -440,8 +459,10 @@ def run_fleet_load(replicas: int, tenants: int, distinct: int,
             # 200 identical job_detail dicts say nothing a failure list
             # doesn't; keep the artifact reviewable
             "failed_jobs": [j for j in jobs if not j.get("ok")],
+            "trace": trace,
             "ok": (
                 all_ok and rc == 0 and affinity_hits > 0 and reconciled
+                and trace["ok"]
             ),
         }
         with open(out_path, "w") as fh:
