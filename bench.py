@@ -1422,6 +1422,36 @@ def _run_elastic_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_contracts_quick() -> dict | None:
+    """graftcontract quick leg: `cli lint --contracts --json` over the
+    package, embedding the drift/waiver verdict in the artifact so a
+    HEAD bench from a drifted tree is self-incriminating.
+    BSSEQ_BENCH_CONTRACTS=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_CONTRACTS", "1") == "0":
+        return None
+    try:
+        cp = subprocess.run(
+            [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+             "lint", "--contracts", "--json"],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_CONTRACTS_TIMEOUT", 300),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = json.loads(cp.stdout.strip().splitlines()[-1])
+        if "error" in data:
+            return {"ok": False, "rc": cp.returncode,
+                    "error": data["error"][:200]}
+        return {
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "rc": cp.returncode,
+            "drift": len(data.get("drift", [])),
+            "waived": len(data.get("waived", [])),
+            "checked": data.get("checked", {}),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def main() -> None:
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         if sys.argv[2] == "probe":
@@ -1661,6 +1691,18 @@ def main() -> None:
                 "ok": trace.get("ok"),
                 "orphans": trace.get("orphans"),
                 "truncated_rc": trace.get("truncated_rc"),
+            },
+            sink=ledger_sink,
+        )
+    contracts = _run_contracts_quick()
+    if contracts is not None:
+        out["contracts"] = contracts
+        observe.emit(
+            "bench_contracts",
+            {
+                "ok": contracts.get("ok"),
+                "drift": contracts.get("drift"),
+                "waived": contracts.get("waived"),
             },
             sink=ledger_sink,
         )

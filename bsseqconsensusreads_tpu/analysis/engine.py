@@ -7,12 +7,23 @@ under tests/data/lint_fixtures/) — so all cross-file context (call
 graph, hot-path/jit/worker reachability) is rebuilt from exactly the
 files being linted, never from imports.
 
-Naming is basename-level on purpose: `events()` calling
-`dispatch_fetch` resolves to pipeline.calling's nested def without a
-type system. That makes reachability generous (a shared basename links
-both definitions), which is the right bias for a linter gating a hot
-path — a missed edge hides a stall, a spurious edge costs at most one
-reviewed suppression.
+Two naming layers coexist:
+
+* Reachability stays basename-level on purpose: `events()` calling
+  `dispatch_fetch` resolves to pipeline.calling's nested def without a
+  type system. That makes reachability generous (a shared basename
+  links both definitions), which is the right bias for a linter gating
+  a hot path — a missed edge hides a stall, a spurious edge costs at
+  most one reviewed suppression.
+* Extraction facts (the graftcontract pass in analysis.contracts)
+  need the opposite bias: `observe.emit(...)` must attribute to
+  utils.observe.emit and nowhere else, or a same-named helper would
+  pollute the ledger-event census. For that, every SourceFile carries
+  a module name derived from its display path plus import/alias maps
+  (`import x as y`, `from m import n`, relative imports resolved
+  against the module), and PackageIndex exposes a qualified function
+  table and `resolve_call`, which returns the dotted target of a call
+  when the aliases pin it down and None when they don't.
 """
 
 from __future__ import annotations
@@ -86,8 +97,23 @@ class Rule:
     check: Callable[["SourceFile", "PackageIndex"], Iterator[Finding]]
 
 
+def module_name(display: str) -> str:
+    """Dotted module name derived from a display path:
+    `bsseqconsensusreads_tpu/utils/observe.py` -> the obvious dotted
+    form, `pkg/__init__.py` -> `pkg`. Paths outside any package still
+    get a stable dotted name (fixtures resolve against themselves)."""
+    p = display.replace(os.sep, "/").lstrip("./")
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [seg for seg in p.split("/") if seg]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
 class SourceFile:
-    """One parsed file: AST with parent links + suppression tables."""
+    """One parsed file: AST with parent links, suppression tables, and
+    the import/alias maps qualified-name resolution reads."""
 
     def __init__(self, path: str, display: str, source: str,
                  known_rules: Iterable[str]):
@@ -102,12 +128,90 @@ class SourceFile:
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
+        self.module = module_name(display)
+        #: local name -> dotted module (`import x.y as z` => z: x.y)
+        self.import_aliases: dict[str, str] = {}
+        #: local name -> (dotted module, original name) for
+        #: `from m import n as k` => k: (m, n); relative imports are
+        #: resolved against self.module
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        #: top-level def/class names defined in this module
+        self.toplevel_defs: set[str] = set()
+        self._scan_imports()
         self.line_suppress: dict[int, set[str]] = {}
         self.file_suppress: set[str] = set()
         #: lines whose Thread(...) call is a declared single-owner
         #: thread (`# graftlint: owned-thread`) — not a worker root
         self.owned_thread_lines: set[int] = set()
         self._scan_suppressions(set(known_rules))
+
+    # -- imports / qualified names ---------------------------------------
+
+    def _resolve_relative(self, level: int, mod: str | None) -> str | None:
+        """Anchor a `from ...x import y` against self.module. level=1 is
+        the containing package; each extra level climbs one more."""
+        parts = self.module.split(".")
+        # self.module names the file itself unless it is an __init__
+        # (module_name already stripped that), so the containing
+        # package is everything but the last component
+        base = parts[:-1] if parts else []
+        climb = level - 1
+        if climb > len(base):
+            return None
+        anchor = base[: len(base) - climb]
+        if mod:
+            anchor = anchor + mod.split(".")
+        return ".".join(anchor) if anchor else None
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    self.import_aliases[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    mod = self._resolve_relative(node.level, node.module)
+                else:
+                    mod = node.module
+                if mod is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.from_imports[local] = (mod, alias.name)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self.toplevel_defs.add(node.name)
+
+    def resolve_name(self, name: str) -> str | None:
+        """Dotted target a bare name binds to in this module, when the
+        import maps pin it down: a from-import resolves to module.orig,
+        an `import x as y` alias to x, a top-level def to
+        self.module.name. Unknown names resolve to None."""
+        if name in self.from_imports:
+            mod, orig = self.from_imports[name]
+            return f"{mod}.{orig}"
+        if name in self.import_aliases:
+            return self.import_aliases[name]
+        if name in self.toplevel_defs:
+            return f"{self.module}.{name}"
+        return None
+
+    def resolve_expr(self, expr: ast.AST) -> str | None:
+        """Dotted name for a Name/Attribute chain (`observe.emit`,
+        `pkg.utils.observe.emit`), resolving the root through the
+        import maps. None for anything else (calls, subscripts)."""
+        if isinstance(expr, ast.Name):
+            return self.resolve_name(expr.id)
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve_expr(expr.value)
+            if base is not None:
+                return f"{base}.{expr.attr}"
+        return None
 
     # -- suppressions ----------------------------------------------------
 
@@ -324,6 +428,11 @@ class PackageIndex:
         self.files = files
         self.functions: dict[str, list[FuncInfo]] = {}
         self._info_by_node: dict[ast.AST, FuncInfo] = {}
+        #: dotted module name -> SourceFile (last one wins on collision)
+        self.modules: dict[str, SourceFile] = {sf.module: sf for sf in files}
+        #: fully-qualified dotted name -> FuncInfo for *top-level* defs
+        #: (the targets import aliases can actually name)
+        self.functions_qual: dict[str, FuncInfo] = {}
         for sf in files:
             self._index_file(sf)
         self.hot_reachable = self._reach(self._hot_roots())
@@ -372,6 +481,16 @@ class PackageIndex:
                     fi.calls.add(sub.id)  # functions passed as values
             self.functions.setdefault(node.name, []).append(fi)
             self._info_by_node[node] = fi
+            if node in sf.tree.body or (
+                isinstance(sf.parents.get(node), ast.ClassDef)
+                and sf.parents[sf.parents[node]] is sf.tree
+            ):
+                dotted = (
+                    f"{sf.module}.{sf.parents[node].name}.{node.name}"
+                    if isinstance(sf.parents.get(node), ast.ClassDef)
+                    else f"{sf.module}.{node.name}"
+                )
+                self.functions_qual.setdefault(dotted, fi)
 
     @staticmethod
     def _own_nodes(func: ast.AST) -> Iterator[ast.AST]:
@@ -386,6 +505,23 @@ class PackageIndex:
 
     def info(self, node: ast.AST) -> FuncInfo | None:
         return self._info_by_node.get(node)
+
+    def resolve_call(self, sf: SourceFile, call: ast.Call) -> str | None:
+        """Fully-qualified dotted name of a call target, when the
+        module's import aliases pin it down: `observe.emit(...)` under
+        `from ..utils import observe` resolves to
+        `<pkg>.utils.observe.emit`; a bare `emit(...)` under
+        `from .observe import emit` resolves the same way; a local
+        top-level def resolves to `<module>.<name>`. Returns None when
+        the target is dynamic (methods on instances, subscripts,
+        shadowed names) — callers fall back to basename heuristics."""
+        return sf.resolve_expr(call.func)
+
+    def resolves_to(self, sf: SourceFile, call: ast.Call,
+                    *dotted: str) -> bool:
+        """True when resolve_call lands exactly on one of `dotted`."""
+        target = self.resolve_call(sf, call)
+        return target is not None and target in dotted
 
     def _factory_basenames(self) -> frozenset[str]:
         """Basenames of functions that return a jitted callable —
@@ -507,6 +643,7 @@ class PackageIndex:
 
 def all_rules() -> dict[str, Rule]:
     from bsseqconsensusreads_tpu.analysis import (
+        rules_contract,
         rules_deflate,
         rules_elastic,
         rules_emit,
@@ -527,7 +664,7 @@ def all_rules() -> dict[str, Rule]:
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
                 rules_pack, rules_methyl, rules_transport, rules_deflate,
-                rules_elastic, rules_trace):
+                rules_elastic, rules_trace, rules_contract):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

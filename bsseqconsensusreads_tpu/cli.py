@@ -641,6 +641,27 @@ def cmd_lint(args) -> int:
     rules = None
     if args.rules:
         rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    if args.contracts:
+        from bsseqconsensusreads_tpu.analysis import contracts
+
+        try:
+            report = contracts.verify_package(args.paths or None)
+        except analysis.LintError as exc:
+            if args.json:
+                print(json.dumps({"error": str(exc)}))
+            else:
+                observe.stderr_line(f"lint: {exc}")
+            return 2
+        if args.json:
+            print(json.dumps(report.as_dict()))
+        else:
+            for d in report.drifts:
+                print(d.format())
+            print(
+                f"{len(report.drifts)} drift(s), "
+                f"{len(report.waived)} waived"
+            )
+        return 0 if report.ok else 1
     registry = analysis.all_rules()
     if args.list_rules:
         if args.json:
@@ -1285,6 +1306,12 @@ def main(argv: list[str] | None = None) -> int:
         "--include-suppressed", action="store_true",
         help="report findings even where a graftlint disable comment "
         "covers them (audit mode)",
+    )
+    p.add_argument(
+        "--contracts", action="store_true",
+        help="run the whole-program graftcontract drift pass instead of "
+        "the per-file rules (registry vs extracted uses of env vars, "
+        "failpoints, ledger events, counters, protocol ops, CLI surface)",
     )
     p.set_defaults(fn=cmd_lint)
 

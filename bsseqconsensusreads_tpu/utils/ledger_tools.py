@@ -35,7 +35,47 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "merge_pass": ("pass", "runs"),
     "overlap_pool_disabled": ("reason",),
     "overlap_pool_enabled": ("workers",),
+    "overlap_pool_composed": ("stage", "workers", "devices"),
+    "host_pool_enabled": ("stage", "workers"),
+    "host_pool_disabled": ("stage", "reason"),
     "worker_heartbeat": ("process_index", "seq", "phase"),
+    # batch recovery (faults/retry + pipeline/calling): retries, degrades
+    # and the stall watchdog — chaos drills count these
+    "batch_retry": ("stage", "batch", "attempt"),
+    "batch_recovered": ("stage", "batch", "attempts"),
+    "batch_degraded": ("stage", "batch", "attempts", "error"),
+    "batch_stall_redispatch": ("stage", "batch", "timeout_s"),
+    "interstage_fallback": ("reason",),
+    "failpoint_fired": ("site", "action"),
+    # sort/checkpoint durability (pipeline/bucketemit + pipeline/checkpoint)
+    "bucket_plan": ("buckets", "records_per_spill"),
+    "bucket_spill": ("bucket", "records", "run", "seconds"),
+    "bucket_replayed": ("buckets", "target"),
+    "bucket_manifest_resumed": ("replayed", "target"),
+    "bucket_manifest_discarded": ("reason", "target"),
+    "checkpoint_input_changed": (
+        "target", "run_input", "manifest_input", "batches_at_stake",
+    ),
+    "checkpoint_discarded": (
+        "target", "reason", "dropped_batches", "dropped_shards",
+    ),
+    "shard_quarantined": (
+        "target", "shard", "error", "dropped_batches", "dropped_shards",
+    ),
+    # methyl tally durability (methyl/tally)
+    "methyl_spill": ("run", "sites", "upto"),
+    "methyl_resume": ("watermark", "runs_kept", "runs_dropped"),
+    "methyl_finalize": (),
+    # input guard + stream resilience (faults/guard, io/bam, io/bgzf)
+    "record_quarantined": ("input", "reason", "record_index"),
+    "record_repaired": ("input", "qname", "reason", "record_index"),
+    "family_quarantined": ("input", "mi", "reason", "records"),
+    "guard_events_truncated": ("input", "dropped"),
+    "stream_gap": ("input", "gap_start", "resumed_at", "skipped_bytes"),
+    "stream_truncated": ("input", "error"),
+    "frame_resync": ("input", "voffset", "discarded_bytes"),
+    "frame_lost": ("input", "error"),
+    "integrity_mismatch": ("what", "path"),
     # graftserve (serve/): per-tenant lines carry a 'job' field and are
     # mirrored to BSSEQ_TPU_STATS_JOBS sub-sinks
     "job_admitted": ("input", "output", "fingerprint"),
@@ -50,6 +90,7 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     # router's own lines reconcile placement with per-replica counts
     "fleet_replica_spawn": ("replica_id", "generation"),
     "fleet_replica_down": ("replica_id",),
+    "fleet_restart_failed": ("replica_id", "error"),
     "fleet_route": ("rjob", "replica_id"),
     "fleet_requeue": ("rjob", "from_replica", "to_replica"),
     "fleet_counters": (
@@ -285,9 +326,10 @@ def summarize_ledger(
             s.rules.append(d)
         elif ev == "pipeline_complete":
             s.pipeline = d
-        elif ev == "overlap_pool_disabled":
+        elif ev in ("overlap_pool_disabled", "host_pool_disabled"):
+            pool = "overlap" if ev == "overlap_pool_disabled" else "host"
             s.notes.append(
-                f"overlap pool disabled ({d.get('stage', '?')}): "
+                f"{pool} pool disabled ({d.get('stage', '?')}): "
                 f"{d.get('reason', '?')}"
             )
     if job is not None and not s.events:

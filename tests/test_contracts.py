@@ -1,0 +1,301 @@
+"""graftcontract: registry round-trip, seeded drift, waivers, tier-1 gate.
+
+The whole-program pass (`analysis/contracts.py`) cross-references the
+declared-surface registry against every extracted use in the package
+AST. These tests pin three things:
+
+* the registry itself is well-formed and agrees with its in-code
+  mirrors (ledger_tools.EVENT_SCHEMA, faults.failpoints.SITES);
+* each drift class actually fires — a scratch copy of the package with
+  one seeded mutation (renamed event emit, undeclared env read,
+  unknown protocol op, undeclared CLI flag) goes from clean to dirty,
+  and an in-process registry mutation (deleted entry, orphan entry)
+  does the same, so the gate catches drift at introduction in either
+  direction;
+* waiver semantics — mandatory why, stale-waiver hard error — and the
+  tier-1 gate shelling `cli lint --contracts --json` over the package.
+
+Scratch copies verify without README/fixture siblings, so doc and
+fixture-wiring checks stay out of the mutation tests' way.
+"""
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from bsseqconsensusreads_tpu.analysis import contracts
+from bsseqconsensusreads_tpu.analysis.engine import LintError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = contracts.package_root()
+
+
+def _verify_scratch(tmp_path, mutate=None, registry=None):
+    """Copy the package into tmp_path, optionally mutate one file via
+    `mutate(scratch_pkg_dir)`, and run the whole-program pass on it."""
+    scratch = str(tmp_path / "bsseqconsensusreads_tpu")
+    shutil.copytree(
+        PKG_DIR, scratch,
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so"),
+    )
+    if mutate is not None:
+        mutate(scratch)
+    return contracts.verify_package([scratch], registry=registry)
+
+
+def _rewrite(pkg, rel, old, new):
+    path = os.path.join(pkg, rel)
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    assert old in text, f"mutation anchor missing from {rel}: {old!r}"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(text.replace(old, new))
+
+
+# ---------------------------------------------------------------------------
+# registry round-trip
+
+
+def test_registry_wellformed():
+    reg = contracts.REGISTRY
+    env_re = re.compile(r"^BSSEQ_TPU_[A-Z0-9_]+$")
+    names = [v.name for v in reg.env_vars]
+    assert len(names) == len(set(names))
+    for v in reg.env_vars:
+        assert env_re.match(v.name), v.name
+        assert v.kind and v.owner and v.doc
+    ev_names = [e.name for e in reg.events]
+    assert len(ev_names) == len(set(ev_names))
+    for e in reg.events:
+        assert isinstance(e.fields, tuple)
+        assert all(isinstance(f, str) for f in e.fields)
+    for op in reg.ops:
+        assert set(op.planes) <= {"serve", "router", "coordinator"}, op
+        assert op.doc
+    for w in reg.waivers:
+        assert w.why.strip(), w.surface
+
+
+def test_registry_mirrors_event_schema():
+    # field tuples must agree verbatim — this is the emitter/consumer
+    # contract the pass exists to hold
+    from bsseqconsensusreads_tpu.utils.ledger_tools import EVENT_SCHEMA
+
+    assert contracts.REGISTRY.event_fields() == {
+        k: tuple(v) for k, v in EVENT_SCHEMA.items()
+    }
+
+
+def test_registry_mirrors_failpoint_sites():
+    from bsseqconsensusreads_tpu.faults.failpoints import SITES
+
+    assert contracts.REGISTRY.failpoint_sites == frozenset(SITES)
+
+
+def test_report_roundtrips_through_json():
+    report = contracts.verify_package()
+    d = json.loads(json.dumps(report.as_dict()))
+    assert d["ok"] is True
+    assert d["drift"] == []
+    assert d["checked"]["rules"] == len(contracts.REGISTRY.rules)
+    assert any(w["surface"] == "op:fleet" and w["why"] for w in d["waived"])
+
+
+def test_env_table_covers_registry():
+    table = contracts.render_env_table()
+    for v in contracts.REGISTRY.env_vars:
+        assert f"`{v.name}`" in table
+
+
+# ---------------------------------------------------------------------------
+# seeded drift: scratch-copy package mutations
+
+
+def test_scratch_copy_is_clean(tmp_path):
+    report = _verify_scratch(tmp_path)
+    assert report.ok, [d.format() for d in report.drifts]
+
+
+def test_renamed_event_emit_drifts(tmp_path):
+    report = _verify_scratch(tmp_path, mutate=lambda pkg: _rewrite(
+        pkg, os.path.join("pipeline", "bucketemit.py"),
+        '"bucket_plan",', '"bucket_plan_v2",',
+    ))
+    assert not report.ok
+    kinds = {(d.kind, d.surface) for d in report.drifts}
+    # new name is undeclared; old name is now declared-but-never-emitted
+    assert ("undeclared", "event:bucket_plan_v2") in kinds
+    assert ("unemitted", "event:bucket_plan") in kinds
+
+
+def test_undeclared_env_read_drifts(tmp_path):
+    report = _verify_scratch(tmp_path, mutate=lambda pkg: _rewrite(
+        pkg, "config.py", "import os",
+        'import os\n_GHOST = os.environ.get("BSSEQ_TPU_GHOST_KNOB")',
+    ))
+    assert not report.ok
+    assert ("undeclared", "env:BSSEQ_TPU_GHOST_KNOB") in {
+        (d.kind, d.surface) for d in report.drifts
+    }
+
+
+def test_unknown_protocol_op_drifts(tmp_path):
+    report = _verify_scratch(tmp_path, mutate=lambda pkg: _rewrite(
+        pkg, "config.py", "import os",
+        'import os\n_GHOST_REQ = {"op": "frobnicate"}',
+    ))
+    assert not report.ok
+    assert ("undeclared", "op:frobnicate") in {
+        (d.kind, d.surface) for d in report.drifts
+    }
+
+
+def test_undeclared_cli_flag_drifts(tmp_path):
+    report = _verify_scratch(tmp_path, mutate=lambda pkg: _rewrite(
+        pkg, "cli.py", '"--list-rules", action="store_true"',
+        '"--ghost-flag", action="store_true")\n'
+        '    p.add_argument("--list-rules", action="store_true"',
+    ))
+    assert not report.ok
+    assert ("undeclared", "cli:--ghost-flag") in {
+        (d.kind, d.surface) for d in report.drifts
+    }
+
+
+def test_undeclared_fire_site_drifts(tmp_path):
+    report = _verify_scratch(tmp_path, mutate=lambda pkg: _rewrite(
+        pkg, os.path.join("pipeline", "bucketemit.py"),
+        '_failpoints.fire("bucket_spill", bucket=bucket, run=run_index)',
+        '_failpoints.fire("ghost_site", bucket=bucket, run=run_index)',
+    ))
+    assert not report.ok
+    assert ("undeclared", "failpoint:ghost_site") in {
+        (d.kind, d.surface) for d in report.drifts
+    }
+
+
+# ---------------------------------------------------------------------------
+# seeded drift: registry mutations over the real package
+
+
+def test_deleted_event_entry_drifts():
+    reg = contracts.REGISTRY
+    pruned = dataclasses.replace(
+        reg, events=tuple(e for e in reg.events if e.name != "spill"),
+    )
+    report = contracts.verify_package(registry=pruned)
+    assert not report.ok
+    kinds = {(d.kind, d.surface) for d in report.drifts}
+    assert ("undeclared", "event:spill") in kinds
+    assert ("mismatch", "event:spill") in kinds  # EVENT_SCHEMA still has it
+
+
+def test_deleted_env_entry_drifts():
+    reg = contracts.REGISTRY
+    pruned = dataclasses.replace(
+        reg,
+        env_vars=tuple(v for v in reg.env_vars
+                       if v.name != "BSSEQ_TPU_STATS"),
+    )
+    report = contracts.verify_package(registry=pruned)
+    assert not report.ok
+    assert ("undeclared", "env:BSSEQ_TPU_STATS") in {
+        (d.kind, d.surface) for d in report.drifts
+    }
+
+
+def test_orphan_event_entry_drifts():
+    reg = contracts.REGISTRY
+    padded = dataclasses.replace(
+        reg,
+        events=reg.events + (
+            contracts.LedgerEvent("ghost_event", ("what",), "nowhere"),
+        ),
+    )
+    report = contracts.verify_package(registry=padded)
+    assert not report.ok
+    kinds = {(d.kind, d.surface) for d in report.drifts}
+    assert ("unemitted", "event:ghost_event") in kinds
+    assert ("unconsumed", "event:ghost_event") in kinds
+
+
+def test_missing_fixture_is_unwired():
+    reg = contracts.REGISTRY
+    padded = dataclasses.replace(
+        reg, rules=reg.rules | {"ghost-rule"},
+    )
+    report = contracts.verify_package(registry=padded)
+    assert not report.ok
+    kinds = {(d.kind, d.surface) for d in report.drifts}
+    # half-landed rule: no Rule() definition, no seeded fixture, no docs
+    assert ("unwired", "rule:ghost-rule") in kinds
+    assert ("unused", "rule:ghost-rule") in kinds
+
+
+# ---------------------------------------------------------------------------
+# waiver semantics
+
+
+def test_waiver_without_why_is_hard_error():
+    reg = contracts.REGISTRY
+    bad = dataclasses.replace(
+        reg, waivers=reg.waivers + (
+            contracts.Waiver("unused", "op:fleet2", "  "),
+        ),
+    )
+    with pytest.raises(LintError, match="no why"):
+        contracts.verify_package(registry=bad)
+
+
+def test_stale_waiver_is_hard_error():
+    reg = contracts.REGISTRY
+    stale = dataclasses.replace(
+        reg, waivers=reg.waivers + (
+            contracts.Waiver("unused", "env:BSSEQ_TPU_NOT_A_DRIFT",
+                             "excuses nothing"),
+        ),
+    )
+    with pytest.raises(LintError, match="stale contract waiver"):
+        contracts.verify_package(registry=stale)
+
+
+def test_waiver_suppresses_matching_drift():
+    reg = contracts.REGISTRY
+    pruned = dataclasses.replace(
+        reg,
+        events=tuple(e for e in reg.events if e.name != "spill"),
+        waivers=reg.waivers + (
+            contracts.Waiver("undeclared", "event:spill", "test waiver"),
+            contracts.Waiver("mismatch", "event:spill", "test waiver"),
+        ),
+    )
+    report = contracts.verify_package(registry=pruned)
+    assert report.ok, [d.format() for d in report.drifts]
+    assert sum(n for _, n in report.waived) >= 3  # op:fleet + the two
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: self-application through the CLI
+
+
+def test_cli_contracts_gate():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+         "lint", "--contracts", "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["ok"] is True
+    assert out["drift"] == []
+    for w in out["waived"]:
+        assert w["why"].strip()
+        assert w["matched"] >= 1

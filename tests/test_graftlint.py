@@ -45,6 +45,7 @@ FIXTURES = {
     "serial-deflate": "fx_serial_deflate.py",
     "unleased-work-dispatch": "fx_unleased_work_dispatch.py",
     "untraced-transport-send": "fx_untraced_transport_send.py",
+    "contract-drift": "fx_contract_drift.py",
 }
 
 

@@ -126,8 +126,42 @@ def _guard_counts(payload: dict) -> dict:
     return out
 
 
+def _registry_check(schedule: str = "", events: tuple = (),
+                    counters: tuple = ()) -> None:
+    """Refuse to arm a scenario whose failpoint sites or expected
+    ledger/counter names the graftcontract registry does not declare —
+    a drill asserting on a misspelled name passes vacuously (the fault
+    never fires, the count stays 0 against a floor of 0), which is
+    exactly the silent rot `cli lint --contracts` exists to stop."""
+    from bsseqconsensusreads_tpu.analysis import contracts
+
+    reg = contracts.REGISTRY
+    for term in filter(None, (t.strip() for t in schedule.split(";"))):
+        site = term.split("=", 1)[0]
+        if site not in reg.failpoint_sites:
+            raise SystemExit(
+                f"chaos_drill: schedule {term!r} names failpoint site "
+                f"{site!r}, which the graftcontract registry does not "
+                f"declare"
+            )
+    declared_events = reg.event_names()
+    for ev in events:
+        if ev not in declared_events:
+            raise SystemExit(
+                f"chaos_drill: expectation names ledger event {ev!r}, "
+                f"which the graftcontract registry does not declare"
+            )
+    for c in counters:
+        if c not in reg.counters:
+            raise SystemExit(
+                f"chaos_drill: expectation names counter {c!r}, which "
+                f"the graftcontract registry does not declare"
+            )
+
+
 def _run_child(wd: str, bam: str, outdir: str, ledger: str,
                failpoints: str = "", env_extra: dict | None = None):
+    _registry_check(schedule=failpoints)
     env = dict(
         os.environ,
         PYTHONPATH=REPO,
@@ -153,6 +187,8 @@ def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
     """One `cli elastic run` over the drill input with the drill's
     pipeline geometry (same cfg the _child runs use, so the merged
     output must equal the fault-free reference bytes)."""
+    _registry_check(schedule=worker_failpoints)
+    _registry_check(schedule=coordinator_failpoints)
     cfgfile = os.path.join(wd, "elastic_cfg.yaml")
     if not os.path.exists(cfgfile):
         with open(cfgfile, "w") as fh:
@@ -477,6 +513,16 @@ def run_drill(quick: bool, out_path: str) -> dict:
     import tempfile
 
     n_families, genome_len = (60, 20_000) if quick else (150, 40_000)
+    # resolve every scenario's names against the contract registry
+    # before building any input or arming anything
+    for sc in SCENARIOS:
+        _registry_check(
+            schedule=sc["failpoints"],
+            events=tuple(k for src, k, _ in sc["expect"]
+                         if src == "ledger"),
+            counters=tuple(k for src, k, _ in sc["expect"]
+                           if src.startswith("stage:")),
+        )
     results: dict[str, dict] = {}
     with tempfile.TemporaryDirectory(prefix="bsseq_chaos_") as wd:
         bam = _build_input(wd, n_families, genome_len)

@@ -78,7 +78,7 @@ def _child(workdir: str, n_families: int, raw_umis: bool = False,
         # compiles across batch-shape variants, runs, and retries.
         try:
             cache_dir = os.environ.get(
-                "BSSEQ_TPU_COMPILE_CACHE", "/tmp/bsseq_jax_cache"
+                "BSSEQ_TPU_COMPILE_CACHE_DIR", "/tmp/bsseq_jax_cache"
             )
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
