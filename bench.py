@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -1422,6 +1423,42 @@ def _run_elastic_quick() -> dict | None:
         return {"path": out_path, "ok": False, "error": str(exc)[:200]}
 
 
+def _run_netchaos_quick() -> dict | None:
+    """graftnet quick leg: the wire-fault refusal matrix, fencing
+    matrix, and ship byte-identity checks (tests/test_netchaos.py) run
+    as one in-process probe, embedding pass/fail counts so a HEAD bench
+    records whether injected partitions, dup frames, corrupt frames,
+    and stale-epoch publishes are all still refused typed. Best-effort
+    and cpu-pinned like the chaos drill. BSSEQ_BENCH_NETCHAOS=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_NETCHAOS", "1") == "0":
+        return None
+    suite = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests",
+        "test_netchaos.py",
+    )
+    try:
+        cp = subprocess.run(
+            [sys.executable, "-m", "pytest", suite, "-q",
+             "-p", "no:cacheprovider"],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_NETCHAOS_TIMEOUT", 600),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        tail = cp.stdout.strip().splitlines()[-1] if cp.stdout.strip() else ""
+        counts = {
+            verdict: int(n)
+            for n, verdict in re.findall(r"(\d+) (passed|failed|error)", tail)
+        }
+        return {
+            "ok": cp.returncode == 0 and counts.get("passed", 0) > 0,
+            "rc": cp.returncode,
+            "passed": counts.get("passed", 0),
+            "failed": counts.get("failed", 0) + counts.get("error", 0),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def _run_contracts_quick() -> dict | None:
     """graftcontract quick leg: `cli lint --contracts --json` over the
     package, embedding the drift/waiver verdict in the artifact so a
@@ -1680,6 +1717,15 @@ def main() -> None:
         observe.emit(
             "bench_elastic_scale",
             {"ok": elastic.get("ok"), "path": elastic.get("path")},
+            sink=ledger_sink,
+        )
+    netchaos = _run_netchaos_quick()
+    if netchaos is not None:
+        out["netchaos"] = netchaos
+        observe.emit(
+            "bench_netchaos",
+            {"ok": netchaos.get("ok"), "passed": netchaos.get("passed"),
+             "failed": netchaos.get("failed")},
             sink=ledger_sink,
         )
     trace = _run_trace_quick()

@@ -64,12 +64,19 @@ PKG = "bsseqconsensusreads_tpu"
 #: what path prefix the lint invocation's cwd put on the module names
 OBSERVE_EMIT = f"{PKG}.utils.observe.emit"
 FAILPOINT_FIRE = f"{PKG}.faults.failpoints.fire"
+FAILPOINT_EVAL = f"{PKG}.faults.failpoints.evaluate"
+NETCHAOS_PLAN = f"{PKG}.faults.netchaos.plan"
 
 ENV_RE = re.compile(r"^BSSEQ_TPU_[A-Z0-9_]+$")
 #: one `site=action[...]` term of a failpoint schedule, with an
-#: optional `worker:` routing prefix (faults.failpoints grammar)
+#: optional `worker:` routing prefix (faults.failpoints grammar);
+#: the second alternation group is the net-fault vocabulary, legal at
+#: net_* sites only (parse_schedule enforces the site gating — here we
+#: only need to RECOGNIZE the literal as a schedule)
 SCHEDULE_TERM_RE = re.compile(
-    r"^(?:[A-Za-z0-9_.-]+:)?([a-z_]+)=(?:raise|io_error|stall|exit)\b"
+    r"^(?:[A-Za-z0-9_.-]+:)?([a-z_]+)="
+    r"(?:raise|io_error|stall|exit"
+    r"|delay|drop|dup|corrupt|half_open|partition)\b"
 )
 
 #: basenames whose literal first argument is a ledger event name: the
@@ -389,9 +396,15 @@ class Extraction:
                 if recv == "counters" or recv.endswith(".counters"):
                     _record(self.counter_reads, lit, sf, node)
 
-            if base == "fire":
+            if base in ("fire", "evaluate", "plan"):
+                # evaluate() is the non-raising fire (netchaos folds its
+                # results into a WirePlan); plan() is netchaos's own
+                # front door — all three are failpoint-site USES
                 target = index.resolve_call(sf, node)
-                if target is None or _target_is(target, FAILPOINT_FIRE):
+                if (target is None
+                        or _target_is(target, FAILPOINT_FIRE)
+                        or _target_is(target, FAILPOINT_EVAL)
+                        or _target_is(target, NETCHAOS_PLAN)):
                     site = lit
                     if site is None and node.args and isinstance(
                         node.args[0], ast.Name
@@ -728,6 +741,10 @@ ENV_VARS: tuple[EnvVar, ...] = (
            "slice lease duration before the coordinator requeues"),
     EnvVar("BSSEQ_TPU_SPAWNED_AT", "float", "unset", "elastic.coordinator",
            "spawn timestamp handed to respawned workers (internal)"),
+    EnvVar("BSSEQ_TPU_ELASTIC_CHUNK_B", "int", "1048576",
+           "elastic.coordinator",
+           "ship-mode transfer chunk size in bytes (clamped to 4 MiB "
+           "so a chunk always fits one frame)"),
 )
 
 FAILPOINT_SITES: frozenset[str] = frozenset({
@@ -742,6 +759,7 @@ FAILPOINT_SITES: frozenset[str] = frozenset({
     "fleet_route", "fleet_replica_exit",
     "elastic_slice", "elastic_publish", "elastic_manifest_commit",
     "elastic_merge",
+    "net_send", "net_recv", "net_accept",
 })
 
 EVENTS: tuple[LedgerEvent, ...] = (
@@ -873,6 +891,12 @@ EVENTS: tuple[LedgerEvent, ...] = (
     LedgerEvent("elastic_run_complete",
                 ("slices", "records", "requeues", "ok"),
                 "elastic.coordinator"),
+    # graftnet (fencing + shared-nothing shipping)
+    LedgerEvent("publish_fenced", ("slice", "worker", "epoch", "current"),
+                "elastic.coordinator"),
+    LedgerEvent("frame_dup_ignored", ("rid", "op"), "serve.server"),
+    LedgerEvent("slice_chunk_resent", ("slice", "offset", "attempt"),
+                "elastic.worker"),
 )
 
 #: counters read across a layer boundary (StageStats surface fields,
@@ -907,6 +931,12 @@ OPS: tuple[ProtocolOp, ...] = (
     ProtocolOp("heartbeat", ("coordinator",), "worker lease keep-alive"),
     ProtocolOp("publish", ("coordinator",),
                "worker publishes a finished slice"),
+    ProtocolOp("slice_fetch", ("coordinator",),
+               "ship mode: one CRC'd chunk of a slice input (stateless, "
+               "resumable at any offset)"),
+    ProtocolOp("slice_push", ("coordinator",),
+               "ship mode: one CRC'd chunk of a slice output (fenced, "
+               "sequential stream with resync replies)"),
 )
 
 REFUSAL_REASONS: frozenset[str] = frozenset({
@@ -946,7 +976,8 @@ CLI_FLAGS: frozenset[str] = frozenset({
     "--policy", "--pos0", "--raw-tag", "--ready-file", "--reference",
     "--replica", "--replica-address", "--replica-failpoints",
     "--replica-host", "--replicas", "--require-single-strand-agreement",
-    "--rules", "--rundir", "--single-strand", "--slices", "--socket",
+    "--rules", "--rundir", "--ship", "--single-strand", "--slices",
+    "--socket",
     "--sort-buckets", "--sort-engine", "--strategy",
     "--stream-interstage", "--stride", "--timeout", "--tolerance",
     "--transport", "--unmapped", "--vote-kernel", "--wait", "--warmup",
@@ -961,7 +992,7 @@ RULES: frozenset[str] = frozenset({
     "padded-envelope-dispatch", "unbounded-retry",
     "blocking-scheduler-loop", "thread-unsafe-mutation",
     "swallowed-exception", "untraced-transport-send",
-    "unframed-socket-read", "contract-drift",
+    "unframed-socket-read", "contract-drift", "unfenced-commit",
 })
 
 WAIVERS: tuple[Waiver, ...] = (

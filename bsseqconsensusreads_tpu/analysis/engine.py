@@ -647,6 +647,7 @@ def all_rules() -> dict[str, Rule]:
         rules_deflate,
         rules_elastic,
         rules_emit,
+        rules_fence,
         rules_hostphase,
         rules_input,
         rules_io,
@@ -664,7 +665,7 @@ def all_rules() -> dict[str, Rule]:
     for mod in (rules_jax, rules_thread, rules_io, rules_retry,
                 rules_hostphase, rules_input, rules_emit, rules_serve,
                 rules_pack, rules_methyl, rules_transport, rules_deflate,
-                rules_elastic, rules_trace, rules_contract):
+                rules_elastic, rules_fence, rules_trace, rules_contract):
         for rule in mod.RULES:
             rules[rule.name] = rule
     return rules

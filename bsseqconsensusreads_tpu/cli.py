@@ -617,6 +617,7 @@ def cmd_elastic(args) -> int:
             address=args.address, inline=args.inline,
             worker_failpoints=worker_failpoints,
             max_restarts=args.max_restarts, timeout_s=args.timeout,
+            ship=args.ship,
         )
     except elastic.ElasticError as exc:
         observe.stderr_line(f"elastic: {exc}")
@@ -1250,6 +1251,13 @@ def main(argv: list[str] | None = None) -> int:
         "--inline", action="store_true",
         help="process every slice sequentially in this process (no "
         "subprocesses/sockets; same bytes — the debug/test mode)",
+    )
+    r.add_argument(
+        "--ship", action="store_true",
+        help="shared-nothing mode: workers fetch slice inputs and ship "
+        "outputs over the wire as CRC-verified resumable chunks "
+        "(chunk size BSSEQ_TPU_ELASTIC_CHUNK_B) instead of touching "
+        "the shared rundir; same bytes as the shared-FS run",
     )
     r.add_argument(
         "--worker-failpoints", action="append", default=[],

@@ -6,8 +6,9 @@ byte-identical to the single-process pipeline. See coordinator.py for
 the ledger/durability design and merge.py for the determinism proof.
 """
 
-from bsseqconsensusreads_tpu.elastic import merge
+from bsseqconsensusreads_tpu.elastic import fencing, merge
 from bsseqconsensusreads_tpu.elastic.coordinator import (
+    ENV_CHUNK_B,
     DEFAULT_LEASE_S,
     ENV_COORDINATOR_ADDR,
     ENV_LEASE_S,
@@ -23,6 +24,7 @@ from bsseqconsensusreads_tpu.elastic.coordinator import (
     slice_name,
     split_input,
 )
+from bsseqconsensusreads_tpu.elastic.fencing import EpochBook, FencedError
 from bsseqconsensusreads_tpu.elastic.worker import (
     process_slice,
     slice_config,
@@ -31,11 +33,15 @@ from bsseqconsensusreads_tpu.elastic.worker import (
 
 __all__ = [
     "DEFAULT_LEASE_S",
+    "ENV_CHUNK_B",
     "ENV_COORDINATOR_ADDR",
     "ENV_LEASE_S",
     "ENV_WORKER_ID",
     "Coordinator",
     "ElasticError",
+    "EpochBook",
+    "FencedError",
+    "fencing",
     "SliceLedger",
     "base_mi",
     "config_doc",

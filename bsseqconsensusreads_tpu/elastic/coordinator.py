@@ -37,6 +37,7 @@ the counters reconcile.
 
 from __future__ import annotations
 
+import base64
 import bisect
 import dataclasses
 import json
@@ -49,6 +50,7 @@ import zlib
 from collections import deque
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.elastic import fencing as _fencing
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.faults import integrity as _integrity
 from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
@@ -61,10 +63,26 @@ ENV_LEASE_S = "BSSEQ_TPU_ELASTIC_LEASE_S"
 #: wall-clock spawn instant, stamped by the supervisor into each worker's
 #: environment so the worker can book its own spawn→join overhead span
 ENV_SPAWNED_AT = "BSSEQ_TPU_SPAWNED_AT"
+#: ship-mode wire chunk size (raw bytes per slice_fetch/slice_push
+#: frame; the base64 envelope must stay under transport.MAX_FRAME)
+ENV_CHUNK_B = "BSSEQ_TPU_ELASTIC_CHUNK_B"
 
 #: Default lease duration. Workers renew at a third of this, so only a
 #: hung or dead worker lets a lease lapse.
 DEFAULT_LEASE_S = 30.0
+
+DEFAULT_CHUNK_B = 1 << 20
+
+
+def chunk_bytes(default: int = DEFAULT_CHUNK_B) -> int:
+    """Raw bytes per slice-shipping chunk. Clamped so the base64
+    envelope (4/3 inflation + JSON overhead) stays under MAX_FRAME;
+    tests shrink it to force multi-chunk transfers on tiny slices."""
+    try:
+        n = int(os.environ.get(ENV_CHUNK_B, default))
+    except ValueError:
+        n = default
+    return max(1, min(n, 4 * 1024 * 1024))
 
 SLICES_DOC = "slices.json"
 CFG_DOC = "cfg.json"
@@ -296,6 +314,11 @@ class SliceLedger:
         self._leases: dict[str, dict] = {}
         self._done: dict[int, dict] = {}
         self._seq = 0
+        #: fence epochs: one minted (and persisted) per lease grant, so
+        #: a slice's CURRENT holder always outranks every prior holder —
+        #: and a restarted coordinator resumes above all of them
+        self.book = _fencing.EpochBook(rundir)
+        self._slice_epoch: dict[int, int] = {}
         self.requeues = 0
         self.workers_lost = 0
         self.workers: set[str] = set()
@@ -354,15 +377,22 @@ class SliceLedger:
             sid = self._pending.popleft()
             self._seq += 1
             lease_id = f"{slice_name(sid)}.{self._seq}"
+            # the fence epoch is durable BEFORE the grant leaves: a
+            # restarted coordinator can never re-mint an epoch some
+            # zombie already holds
+            epoch = self.book.mint()
+            self._slice_epoch[sid] = epoch
             self._leases[lease_id] = {
                 "sid": sid,
                 "worker": worker,
+                "epoch": epoch,
                 "expires": time.monotonic() + self.lease_s,
             }
             grant = {
                 "slice": dict(self.slices[sid]),
                 "lease_id": lease_id,
                 "lease_s": self.lease_s,
+                "fence_epoch": epoch,
             }
         # the slice's trace context ships inside the grant (the slice
         # dict carries it); the lease line itself is stamped so the
@@ -371,9 +401,14 @@ class SliceLedger:
             observe.emit(
                 "elastic_lease",
                 {"slice": slice_name(sid), "worker": worker,
-                 "lease_id": lease_id},
+                 "lease_id": lease_id, "epoch": epoch},
             )
         return grant
+
+    def slice_epoch(self, sid: int) -> int | None:
+        """The epoch of the slice's CURRENT (latest) grant."""
+        with self._lock:
+            return self._slice_epoch.get(sid)
 
     def heartbeat(self, worker: str, lease_id: str) -> bool:
         with self._lock:
@@ -384,20 +419,38 @@ class SliceLedger:
             return True
 
     def commit(self, lease_id: str, sid: int, manifest: dict,
-               worker: str = "") -> dict:
-        """Publish: validate the lease and fingerprint, verify the
-        output bytes, then commit the manifest atomically. A publish
-        under a lapsed lease is refused (its slice was requeued; the
-        durable checkpoint keeps the work) unless the requeued twin
-        already committed identical output."""
+               worker: str = "", epoch: int | None = None) -> dict:
+        """Publish: validate the fence epoch, the lease, and the
+        fingerprint, verify the output bytes, then commit the manifest
+        atomically. A publish carrying an epoch below the slice's
+        current grant is a ZOMBIE — refused with `publish_fenced` even
+        when its bytes happen to match (precedence over the duplicate
+        path: a superseded holder gets a typed refusal, not an "ok"
+        that invites it to keep writing). A publish under a merely
+        lapsed lease is refused (its slice was requeued; the durable
+        checkpoint keeps the work) unless the requeued twin already
+        committed identical output."""
+        fenced_current: int | None = None
         with self._lock:
-            lease = self._leases.get(lease_id)
-            if lease is None or lease["sid"] != sid:
-                done = self._done.get(sid)
-                if done is not None and done.get("crc") == manifest.get("crc"):
-                    return {"ok": True, "duplicate": True}
-                return {"ok": False, "reason": "lease_expired"}
-            sl = self.slices.get(sid)
+            current = self._slice_epoch.get(sid)
+            if (epoch is not None and current is not None
+                    and int(epoch) < current):
+                fenced_current = current
+            else:
+                lease = self._leases.get(lease_id)
+                if lease is None or lease["sid"] != sid:
+                    done = self._done.get(sid)
+                    if (done is not None
+                            and done.get("crc") == manifest.get("crc")):
+                        return {"ok": True, "duplicate": True}
+                    return {"ok": False, "reason": "lease_expired"}
+                sl = self.slices.get(sid)
+        if fenced_current is not None:
+            _fencing.emit_publish_fenced(
+                slice_name(sid), worker, int(epoch), fenced_current,
+                trace=(self.slices.get(sid) or {}).get("trace"),
+            )
+            return {"ok": False, "reason": "fenced", "epoch": fenced_current}
         if sl is None:
             return {"ok": False, "reason": "unknown_slice"}
         if manifest.get("family_crc") != sl["family_crc"]:
@@ -533,10 +586,21 @@ class Coordinator(ProtocolServer):
     (typed TransportError refusals, TLS via the serve env vars)."""
 
     def __init__(self, ledger: SliceLedger, cfg_doc: dict, *,
-                 addresses, ready_file: str | None = None):
+                 addresses, ready_file: str | None = None,
+                 ship: bool = False):
         super().__init__(addresses=addresses, ready_file=ready_file)
         self.ledger = ledger
         self.cfg_doc = cfg_doc
+        #: shared-nothing mode: workers fetch slice input and push
+        #: output over the wire instead of touching the rundir — the
+        #: flag rides the elastic_join reply, so `--join` workers on
+        #: another host need no local configuration
+        self.ship = ship
+        #: in-flight pushed-output streams: sid -> {epoch, name,
+        #: received}; a higher-epoch holder restarts the stream, a
+        #: mismatched offset answers a resync instead of corrupting it
+        self._push: dict[int, dict] = {}
+        self._push_lock = threading.Lock()
         self._monitor_stop = threading.Event()
         self._monitor_thread: threading.Thread | None = None
 
@@ -573,6 +637,7 @@ class Coordinator(ProtocolServer):
                 "cfg": self.cfg_doc,
                 "slices": len(self.ledger.slices),
                 "lease_s": self.ledger.lease_s,
+                "ship": self.ship,
             }
         if op == "lease":
             return {"ok": True, **self.ledger.lease(str(req.get("worker") or ""))}
@@ -584,12 +649,18 @@ class Coordinator(ProtocolServer):
                 return {"ok": False, "reason": "lease_expired"}
             return {"ok": True, "lease_s": self.ledger.lease_s}
         if op == "publish":
+            epoch = req.get("epoch")
             return self.ledger.commit(
                 str(req.get("lease_id") or ""),
                 int(req.get("slice", -1)),
                 req.get("manifest") or {},
                 worker=str(req.get("worker") or ""),
+                epoch=int(epoch) if epoch is not None else None,
             )
+        if op == "slice_fetch":
+            return self._slice_fetch(req)
+        if op == "slice_push":
+            return self._slice_push(req)
         if op == "status":
             return {"ok": True, **self.ledger.counts()}
         if op == "metrics":
@@ -607,6 +678,91 @@ class Coordinator(ProtocolServer):
                 },
             }}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- shared-nothing slice shipping -----------------------------------
+
+    def _slice_fetch(self, req: dict) -> dict:
+        """One bounded chunk of a slice input BAM, CRC'd per chunk. The
+        op is stateless and read-only: resume after a dropped connection
+        is the client re-asking for the same offset. Replies opt out of
+        the rid reply cache (`_nocache`) — re-fetching is safe and the
+        cache must stay small."""
+        sid = int(req.get("slice", -1))
+        sl = self.ledger.slices.get(sid)
+        if sl is None:
+            return {"ok": False, "error": f"unknown slice {sid}"}
+        offset = max(0, int(req.get("offset", 0)))
+        path = os.path.join(self.ledger.rundir, sl["path"])
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                data = fh.read(chunk_bytes())
+        except OSError as exc:
+            return {"ok": False, "error": f"slice_fetch: {exc}"}
+        return {
+            "ok": True,
+            "offset": offset,
+            "size": size,
+            "eof": offset + len(data) >= size,
+            "crc": zlib.crc32(data) & 0xFFFFFFFF,
+            "data": base64.b64encode(data).decode("ascii"),
+            "_nocache": True,
+        }
+
+    def _slice_push(self, req: dict) -> dict:
+        """One bounded chunk of a slice OUTPUT, shipped back by the
+        holder. Fenced like publish: a chunk carrying a stale epoch is
+        refused (`publish_fenced`) so a zombie can never race the
+        requeued holder's stream. The stream is strictly sequential —
+        a chunk at the wrong offset answers the authoritative
+        `received` byte count (resync) instead of writing, which makes
+        duplicate and retried chunks idempotent at chunk granularity."""
+        sid = int(req.get("slice", -1))
+        worker = str(req.get("worker") or "")
+        sl = self.ledger.slices.get(sid)
+        if sl is None:
+            return {"ok": False, "error": f"unknown slice {sid}"}
+        epoch = req.get("epoch")
+        current = self.ledger.slice_epoch(sid)
+        if epoch is not None and current is not None and int(epoch) < current:
+            _fencing.emit_publish_fenced(
+                slice_name(sid), worker, int(epoch), current,
+                trace=sl.get("trace"),
+            )
+            return {"ok": False, "reason": "fenced", "epoch": current}
+        name = os.path.basename(str(req.get("name") or ""))
+        if not name:
+            return {"ok": False, "error": "slice_push without a name"}
+        try:
+            data = base64.b64decode(str(req.get("data") or ""))
+        except ValueError:
+            return {"ok": False, "reason": "chunk_integrity"}
+        if (zlib.crc32(data) & 0xFFFFFFFF) != int(req.get("crc", -1)):
+            return {"ok": False, "reason": "chunk_integrity"}
+        offset = int(req.get("offset", 0))
+        sdir = self.ledger._slice_dir(sid)
+        os.makedirs(sdir, exist_ok=True)
+        part = os.path.join(sdir, f".push.{name}")
+        with self._push_lock:
+            st = self._push.get(sid)
+            if st is None or st.get("epoch") != epoch or st.get("name") != name:
+                # a new holder (or a new attempt) restarts the stream
+                st = {"epoch": epoch, "name": name, "received": 0}
+                self._push[sid] = st
+                with open(part, "wb"):
+                    pass
+            if offset != st["received"]:
+                return {"ok": True, "received": st["received"],
+                        "resync": True}
+            with open(part, "ab") as fh:
+                fh.write(data)
+            st["received"] += len(data)
+            received = st["received"]
+            if req.get("eof"):
+                os.replace(part, os.path.join(sdir, name))
+                self._push.pop(sid, None)
+        return {"ok": True, "received": received}
 
 
 # ----------------------------------------------------------------- run front
@@ -659,7 +815,8 @@ def _run_inline(cfg: FrameworkConfig, ledger: SliceLedger) -> None:
                 cfg, ledger.rundir, grant["slice"], worker=wid
             )
         resp = ledger.commit(
-            grant["lease_id"], grant["slice"]["sid"], manifest, worker=wid
+            grant["lease_id"], grant["slice"]["sid"], manifest, worker=wid,
+            epoch=grant.get("fence_epoch"),
         )
         if not resp.get("ok"):
             # lapsed lease: the slice went back to pending and the next
@@ -678,11 +835,12 @@ def _run_fleet(
     worker_failpoints: dict,
     max_restarts: int,
     timeout_s: float,
+    ship: bool = False,
 ) -> None:
     """Coordinator in-process + N worker subprocesses (the fleet spawn
     idiom: identity env var, one-shot first-life failpoint override,
     respawn budget)."""
-    server = Coordinator(ledger, cfg_doc_, addresses=[address])
+    server = Coordinator(ledger, cfg_doc_, addresses=[address], ship=ship)
     server.start_monitor()
     # graftlint: owned-thread -- the accept loop owns the socket; this
     # thread exists so the supervisor below can poll worker processes
@@ -787,6 +945,7 @@ def run_elastic(
     max_restarts: int = 2,
     lease_s: float | None = None,
     timeout_s: float = 3600.0,
+    ship: bool = False,
 ) -> tuple[str, dict]:
     """One elastic run end to end: split → lease/execute → merge →
     reconcile. Returns (final target path, reconciliation report).
@@ -803,6 +962,11 @@ def run_elastic(
     _save_json_atomic(os.path.join(rundir, CFG_DOC), doc)
     ledger = SliceLedger(rundir, specs, lease_s=lease_s)
     if inline or workers < 1:
+        if ship:
+            raise ElasticError(
+                "--ship needs a worker fleet: shared-nothing shipping "
+                "is meaningless inside one process (drop --inline)"
+            )
         _run_inline(cfg, ledger)
     else:
         _run_fleet(
@@ -810,6 +974,7 @@ def run_elastic(
             workers=workers, address=address,
             worker_failpoints=worker_failpoints or {},
             max_restarts=max_restarts, timeout_s=timeout_s,
+            ship=ship,
         )
     from bsseqconsensusreads_tpu.elastic import merge as _merge
 

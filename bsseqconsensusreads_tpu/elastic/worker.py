@@ -25,11 +25,15 @@ the run may call itself ok.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
 import os
+import random
 import shutil
+import tempfile
 import threading
 import time
+import zlib
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
@@ -44,14 +48,25 @@ from bsseqconsensusreads_tpu.pipeline.bucketemit import (
 from bsseqconsensusreads_tpu.serve import transport
 from bsseqconsensusreads_tpu.utils import observe
 
+from bsseqconsensusreads_tpu.elastic import fencing as _fencing
 from bsseqconsensusreads_tpu.elastic.coordinator import (
     ENV_COORDINATOR_ADDR,
     ENV_SPAWNED_AT,
     ENV_WORKER_ID,
     ElasticError,
+    chunk_bytes,
     config_from_doc,
     slice_name,
 )
+
+#: bounded per-chunk retries for the ship-mode transfers (each chunk is
+#: one request on its own connection — a dropped connection costs one
+#: chunk, not the stream)
+CHUNK_RETRIES = 5
+
+# where ship mode lands the fetched slice input inside the private work
+# dir; _reset_stale_finals must never mistake it for a stage final
+SHIP_INPUT = "input.bam"
 
 
 def slice_config(cfg: FrameworkConfig) -> FrameworkConfig:
@@ -98,10 +113,14 @@ def _reset_stale_finals(sdir: str, sname: str, worker: str) -> None:
     stale = sorted(
         f for f in os.listdir(sdir)
         if f.endswith(".bam") and ".ckpt" not in f and ".part" not in f
+        and f != SHIP_INPUT
     )
     if not stale:
         return
     for f in os.listdir(sdir):
+        if f == SHIP_INPUT:
+            # ship-mode fetched input: raw bytes, never a stage final
+            continue
         path = os.path.join(sdir, f)
         if os.path.isdir(path):
             shutil.rmtree(path)
@@ -114,17 +133,20 @@ def _reset_stale_finals(sdir: str, sname: str, worker: str) -> None:
 
 
 def process_slice(cfg: FrameworkConfig, rundir: str, sl: dict,
-                  worker: str = "") -> dict:
+                  worker: str = "", workdir: str | None = None,
+                  input_path: str | None = None) -> dict:
     """Run the standard pipeline chain over one leased slice; returns
     the publishable manifest. Work dir is keyed by SLICE id so a
-    requeued slice resumes its own checkpoints."""
+    requeued slice resumes its own checkpoints — unless ship mode hands
+    in a private `workdir` (and a locally fetched `input_path`), in
+    which case nothing here touches the shared rundir at all."""
     sname = slice_name(sl["sid"])
     _failpoints.fire("elastic_slice", slice=sname, worker=worker)
-    sdir = os.path.join(rundir, "slices", sname)
+    sdir = workdir or os.path.join(rundir, "slices", sname)
     os.makedirs(sdir, exist_ok=True)
     _reset_stale_finals(sdir, sname, worker)
     scfg = dataclasses.replace(slice_config(cfg), tmp=sdir)
-    slice_bam = os.path.join(rundir, sl["path"])
+    slice_bam = input_path or os.path.join(rundir, sl["path"])
     _integrity.verify_file_crc32(
         slice_bam, sl["input_crc"], what=f"slice input {sname}"
     )
@@ -170,23 +192,176 @@ def process_slice(cfg: FrameworkConfig, rundir: str, sl: dict,
 
 def _renew_lease(address: str, worker: str, lease_id: str, lease_s: float,
                  stop: threading.Event, hb: WorkerHeartbeat) -> None:
-    """Renewal pump for one held lease: a third of the lease period, so
-    only a hung or dead process lets the lease lapse. A refused renewal
-    means the coordinator already requeued us — stop renewing and let
-    the publish refusal surface it."""
-    interval = max(0.05, lease_s / 3.0)
-    while not stop.wait(interval):
+    """Renewal pump for one held lease, with deadline accounting that
+    closes the delayed-heartbeat race: renewal extends the LOCAL
+    deadline from the instant the frame was SENT, never from the reply
+    — wire delay counts against this worker, so a heartbeat that lands
+    coordinator-side after expiry can never leave the worker believing
+    it still holds the lease. The cadence is a jittered third of the
+    lease (±20%, seeded by the lease id) so a fleet's renewals never
+    synchronize, and each request times out well inside the remaining
+    lease instead of blocking past it.
+
+    Losing the lease — a `lease_expired` renewal reply, or the local
+    deadline passing with no successful renewal (partition) — revokes
+    the adopted fence epoch: the compute thread aborts at its next
+    durable write (FencedError) instead of racing the requeued holder."""
+    rng = random.Random(lease_id)
+    deadline = time.monotonic() + lease_s
+    while True:
+        interval = max(0.05, lease_s / 3.0 * (0.8 + 0.4 * rng.random()))
+        if stop.wait(interval):
+            return
         hb.beat(phase="lease_renew", lease_id=lease_id)
+        t_send = time.monotonic()
+        if t_send >= deadline:
+            # nothing renewed inside the whole lease window: presume
+            # requeued — self-fence without waiting to hear it refused
+            _fencing.revoke(f"lease {lease_id} deadline passed unrenewed",
+                            lease_id=lease_id)
+            return
         try:
             resp = transport.request(
                 address,
                 {"op": "heartbeat", "worker": worker, "lease_id": lease_id},
-                timeout=max(5.0, lease_s),
+                timeout=max(1.0, min(lease_s / 2.0, deadline - t_send)),
             )
+        # graftlint: disable=unbounded-retry -- bounded by the local lease
+        # deadline: the next tick self-fences and returns once it passes
         except (OSError, transport.TransportError):
-            continue  # transient: the next tick retries; expiry is the floor
-        if not resp.get("ok"):
-            return
+            continue  # transient: retry, but the local deadline still runs
+        if resp.get("ok"):
+            deadline = t_send + lease_s
+            continue
+        # the coordinator says the lease is gone: immediate local abort
+        _fencing.revoke(f"lease {lease_id} expired at the coordinator",
+                        lease_id=lease_id)
+        return
+
+
+# ------------------------------------------------- shared-nothing shipping
+
+
+def _fetch_slice(address: str, sl: dict, dest: str, worker: str = "") -> str:
+    """Pull one slice input BAM over the wire as CRC-verified bounded
+    chunks (`slice_fetch`). The op is stateless coordinator-side, so
+    resume after any failure is simply re-asking for the same offset —
+    each retry ledgers `slice_chunk_resent`. The assembled file lands
+    via tmp+rename and process_slice re-verifies the whole-file CRC
+    against the split manifest, so a torn fetch can never be computed."""
+    sname = slice_name(sl["sid"])
+    tmp = dest + ".part"
+    offset = 0
+    with open(tmp, "wb") as out:
+        while True:
+            attempt = 0
+            while True:
+                data = None
+                try:
+                    # graftlint: disable=unleased-work-dispatch,untraced-transport-send -- read-only
+                    # chunk pull under the CALLER's lease (work_loop holds
+                    # lease_id + the renewal pump) and the caller's bound
+                    # slice trace (request ships `_trace` from the ambient
+                    # context); nothing here dispatches work
+                    resp = transport.request(
+                        address,
+                        {"op": "slice_fetch", "slice": sl["sid"],
+                         "offset": offset, "worker": worker},
+                        timeout=120.0,
+                    )
+                # graftlint: disable=unbounded-retry -- bounded: attempt
+                # caps at CHUNK_RETRIES (raise) with linear backoff
+                except (OSError, transport.TransportError):
+                    resp = None
+                if resp is not None and resp.get("ok"):
+                    got = base64.b64decode(str(resp.get("data") or ""))
+                    if (zlib.crc32(got) & 0xFFFFFFFF) == int(
+                            resp.get("crc", -1)):
+                        data = got
+                if data is not None:
+                    break
+                attempt += 1
+                if attempt >= CHUNK_RETRIES:
+                    raise ElasticError(
+                        f"slice_fetch for {sname} failed at offset {offset} "
+                        f"after {CHUNK_RETRIES} attempts"
+                    )
+                observe.emit(
+                    "slice_chunk_resent",
+                    {"slice": sname, "offset": offset, "attempt": attempt},
+                )
+                time.sleep(0.05 * attempt)
+            out.write(data)
+            offset += len(data)
+            if resp.get("eof"):
+                break
+    os.replace(tmp, dest)
+    return dest
+
+
+def _push_output(address: str, sid: int, lease_id: str, epoch,
+                 target: str, worker: str = "") -> None:
+    """Ship one slice output back as a strictly sequential chunk stream
+    (`slice_push`). The coordinator answers its authoritative received
+    byte count on any offset mismatch (resync), which makes retried and
+    duplicated chunks idempotent at chunk granularity; a `fenced` reply
+    means a newer holder owns the slice — raise FencedError so the loop
+    aborts locally instead of racing it."""
+    sname = slice_name(sid)
+    name = os.path.basename(target)
+    size = os.path.getsize(target)
+    chunk = chunk_bytes()
+    offset = 0
+    with open(target, "rb") as fh:
+        while True:
+            fh.seek(offset)
+            data = fh.read(chunk)
+            eof = offset + len(data) >= size
+            payload = {
+                "op": "slice_push", "slice": sid, "lease_id": lease_id,
+                "epoch": epoch, "name": name, "offset": offset,
+                "data": base64.b64encode(data).decode("ascii"),
+                "crc": zlib.crc32(data) & 0xFFFFFFFF, "eof": eof,
+                "worker": worker,
+            }
+            attempt = 0
+            while True:
+                try:
+                    resp = transport.request(address, payload, timeout=120.0)
+                # graftlint: disable=unbounded-retry -- bounded: attempt
+                # caps at CHUNK_RETRIES (raise) with linear backoff
+                except (OSError, transport.TransportError):
+                    resp = None
+                if resp is not None and resp.get("reason") != "chunk_integrity":
+                    break
+                attempt += 1
+                if attempt >= CHUNK_RETRIES:
+                    raise ElasticError(
+                        f"slice_push for {sname} failed at offset {offset} "
+                        f"after {CHUNK_RETRIES} attempts"
+                    )
+                observe.emit(
+                    "slice_chunk_resent",
+                    {"slice": sname, "offset": offset, "attempt": attempt},
+                )
+                time.sleep(0.05 * attempt)
+            if resp.get("ok"):
+                if resp.get("resync"):
+                    # the coordinator already holds bytes we don't think
+                    # we sent (reply lost in flight): trust its count
+                    offset = int(resp.get("received", 0))
+                    continue
+                if eof:
+                    return
+                offset += len(data)
+                continue
+            if resp.get("reason") == "fenced":
+                raise _fencing.FencedError(
+                    f"slice_push for {sname} refused: epoch {epoch} is "
+                    f"stale (current {resp.get('epoch')})",
+                    epoch=epoch if epoch is None else int(epoch),
+                )
+            raise ElasticError(f"slice_push refused: {resp}")
 
 
 def work_loop(address: str, worker_id: str | None = None,
@@ -215,6 +390,12 @@ def work_loop(address: str, worker_id: str | None = None,
             )
         except ValueError:
             pass  # unparseable stamp: skip the span, never the worker
+    ship = bool(joined.get("ship"))
+    private_root: str | None = None
+    if ship:
+        # shared-nothing: every byte of slice input/output crosses the
+        # wire; this tmpdir is the worker's ONLY filesystem footprint
+        private_root = tempfile.mkdtemp(prefix=f"bsseq-ship-{wid}-")
     hb = WorkerHeartbeat(component="elastic")
     hb.start()
     processed = 0
@@ -243,7 +424,13 @@ def work_loop(address: str, worker_id: str | None = None,
                 wait_t0 = None
             sl = grant["slice"]
             lease_id = grant["lease_id"]
+            epoch = grant.get("fence_epoch")
             lease_s = float(grant.get("lease_s") or lease_default)
+            sname = slice_name(sl["sid"])
+            # adopt the grant's fence BEFORE any work: from here every
+            # durable write goes through the fence gate, and losing the
+            # lease turns into a local FencedError instead of a race
+            _fencing.adopt(epoch, lease_id)
             stop = threading.Event()
             # graftlint: owned-thread -- lease-renewal pump for the
             # slice this loop iteration is processing; joined below
@@ -259,32 +446,79 @@ def work_loop(address: str, worker_id: str | None = None,
             slice_trace = sl.get("trace")
             with observe.bind_trace(slice_trace):
                 try:
-                    manifest = process_slice(cfg, rundir, sl, worker=wid)
-                finally:
-                    stop.set()
-                    renewer.join(timeout=5.0)
-                _failpoints.fire("elastic_publish",
-                                 slice=manifest["slice"], worker=wid)
-                resp = transport.request(
-                    address,
-                    {"op": "publish", "worker": wid, "lease_id": lease_id,
-                     "slice": sl["sid"], "manifest": manifest},
-                    timeout=600.0,
-                )
+                    try:
+                        if ship:
+                            workdir = os.path.join(private_root, sname)
+                            os.makedirs(workdir, exist_ok=True)
+                            local_bam = _fetch_slice(
+                                address, sl,
+                                os.path.join(workdir, SHIP_INPUT),
+                                worker=wid,
+                            )
+                            manifest = process_slice(
+                                cfg, rundir, sl, worker=wid,
+                                workdir=workdir, input_path=local_bam,
+                            )
+                        else:
+                            manifest = process_slice(
+                                cfg, rundir, sl, worker=wid
+                            )
+                    finally:
+                        stop.set()
+                        renewer.join(timeout=5.0)
+                    if ship:
+                        _push_output(
+                            address, sl["sid"], lease_id, epoch,
+                            os.path.join(workdir, manifest["output"]),
+                            worker=wid,
+                        )
+                    # last local gate before publish: the renewer may
+                    # have revoked after the final durable write
+                    _fencing.check("publish")
+                    _failpoints.fire("elastic_publish",
+                                     slice=sname, worker=wid)
+                    resp = transport.request(
+                        address,
+                        {"op": "publish", "worker": wid,
+                         "lease_id": lease_id, "slice": sl["sid"],
+                         "manifest": manifest, "epoch": epoch},
+                        timeout=600.0,
+                    )
+                # graftlint: disable=unbounded-retry -- not a retry: the
+                # slice is ABANDONED (the requeued holder owns it) and the
+                # loop leases different work; the coordinator's `done`
+                # reply is the bound
+                except _fencing.FencedError as exc:
+                    # the lease is gone (renewal refused, deadline lapsed
+                    # behind a partition, or the coordinator fenced our
+                    # push): abort the slice locally — the requeued
+                    # holder owns it now — and lease fresh work
+                    observe.emit(
+                        "elastic_publish_refused",
+                        {"slice": sname, "worker": wid,
+                         "reason": "fence_revoked", "detail": str(exc)},
+                    )
+                    _fencing.release()
+                    continue
                 if resp.get("ok"):
+                    _fencing.release()
                     processed += 1
                     continue
-                if resp.get("reason") == "lease_expired":
+                if resp.get("reason") in ("lease_expired", "fenced"):
                     # our lease lapsed mid-slice and the slice was
                     # requeued; the durable checkpoints keep the work —
                     # go get a new lease (possibly for this same slice)
                     observe.emit(
                         "elastic_publish_refused",
-                        {"slice": manifest["slice"], "worker": wid,
-                         "reason": "lease_expired"},
+                        {"slice": sname, "worker": wid,
+                         "reason": str(resp.get("reason"))},
                     )
+                    _fencing.release()
                     continue
                 raise ElasticError(f"publish refused: {resp}")
     finally:
+        _fencing.release()
         hb.stop()
         observe.flush_sinks()
+        if private_root is not None:
+            shutil.rmtree(private_root, ignore_errors=True)

@@ -15,6 +15,19 @@ Actions
     exit[:code]         os._exit(code) (default 9) — a hard crash, no
                         cleanup, for kill-at-batch-N drills
 
+Network actions (net_send / net_recv / net_accept sites ONLY — they do
+not raise; the transport shim in faults/netchaos.py interprets them
+via `evaluate()`):
+    delay[:<dur>s]      sleep before the wire op (default 0.2s)
+    drop                close the connection without sending the frame
+    dup                 deliver the same frame twice (a second identical
+                        request on a fresh connection)
+    corrupt             flip payload bytes after the length header — the
+                        peer's framing must refuse, never parse, it
+    half_open           accept, then stall and close without answering
+    partition           refuse the connection outright (pairs with
+                        @peer= for one-sided partitions)
+
 Arguments (colon-separated `k=v` after the action)
     p=<float>           fire probability per eligible hit (default 1.0)
     seed=<int>          seed of the failpoint's own RNG — a p< 1
@@ -29,6 +42,10 @@ Predicates (each `@k=v` must match the fire() call's context)
     @job=<id>           only when the site reports that serve job id
                         (the serve_* sites — targets ONE tenant)
     @hit=<int>          only on the Nth predicate-matching hit
+    @peer=<substr>      only when the site's peer address CONTAINS the
+                        value (ports are dynamic, so exact match is
+                        useless — `@peer=127.0.0.1` or a socket path
+                        fragment)
 
 Examples (the grammar of ISSUE 3):
     wire_transfer-style transient:  dispatch_kernel=raise:RuntimeError@batch=7
@@ -103,10 +120,28 @@ SITES = frozenset(
         "elastic_publish",
         "elastic_manifest_commit",
         "elastic_merge",
+        # serve.transport / faults.netchaos — the wire itself: the send
+        # edge (client or server answering), the recv edge, and the
+        # server accept loop. These sites take the network actions
+        # (delay/drop/dup/corrupt/half_open/partition) and are
+        # interpreted by the transport shim via evaluate(), not fire().
+        "net_send",
+        "net_recv",
+        "net_accept",
     }
 )
 
+#: Sites whose faults live on the wire — the only sites that accept the
+#: network actions below.
+NET_SITES = frozenset({"net_send", "net_recv", "net_accept"})
+
 _ACTIONS = frozenset({"raise", "io_error", "stall", "exit"})
+
+#: Actions interpreted by the transport shim (faults/netchaos.py)
+#: rather than raised by _act(); valid only at NET_SITES.
+NET_ACTIONS = frozenset(
+    {"delay", "drop", "dup", "corrupt", "half_open", "partition"}
+)
 
 #: Exceptions an injected `raise` may name — a restricted table, not a
 #: builtins lookup, so a schedule cannot conjure arbitrary types.
@@ -142,6 +177,7 @@ class FailPoint:
     stage: str | None = None
     job: str | None = None
     hit: int | None = None
+    peer: str | None = None
     spec: str = ""
     _hits: int = 0
     _fires: int = 0
@@ -156,6 +192,8 @@ class FailPoint:
         if self.stage is not None and ctx.get("stage") != self.stage:
             return False
         if self.job is not None and ctx.get("job") != self.job:
+            return False
+        if self.peer is not None and self.peer not in str(ctx.get("peer", "")):
             return False
         return True
 
@@ -226,12 +264,19 @@ def parse_schedule(spec: str) -> list[FailPoint]:
             )
         parts = action_part.split(":")
         action = parts[0].strip()
-        if action not in _ACTIONS:
+        if action not in _ACTIONS and action not in NET_ACTIONS:
             raise FailpointError(
                 f"unknown failpoint action {action!r} in {term!r} "
-                f"(want {'|'.join(sorted(_ACTIONS))})"
+                f"(want {'|'.join(sorted(_ACTIONS | NET_ACTIONS))})"
+            )
+        if action in NET_ACTIONS and site not in NET_SITES:
+            raise FailpointError(
+                f"network action {action!r} is only valid at net_* sites "
+                f"({term!r})"
             )
         fp = FailPoint(site=site, action=action, spec=term)
+        if action == "delay":
+            fp.duration_s = 0.2
         for arg in parts[1:]:
             arg = arg.strip()
             if not arg:
@@ -257,7 +302,7 @@ def parse_schedule(spec: str) -> list[FailPoint]:
                         f"{', '.join(sorted(_EXCEPTIONS))})"
                     )
                 fp.exc_name = arg
-            elif action == "stall":
+            elif action in ("stall", "delay", "half_open"):
                 fp.duration_s = _parse_duration(arg, term)
             elif action == "exit":
                 fp.exit_code = _parse_int("exit code", arg, term)
@@ -278,10 +323,12 @@ def parse_schedule(spec: str) -> list[FailPoint]:
                 fp.job = v
             elif k == "hit":
                 fp.hit = _parse_int("hit", v, term)
+            elif k == "peer":
+                fp.peer = v
             else:
                 raise FailpointError(
                     f"unknown predicate {k!r} in {term!r} "
-                    "(want batch|stage|job|hit)"
+                    "(want batch|stage|job|hit|peer)"
                 )
         fp.__post_init__()  # re-seed after arg parse set .seed
         points.append(fp)
@@ -323,12 +370,15 @@ def fired_total() -> int:
         return sum(_FIRED.values())
 
 
-def fire(site: str, **ctx) -> None:
-    """Evaluate the armed schedule at one site. No-op (one branch) when
-    unarmed. A firing failpoint is ledgered and counted BEFORE its
-    action runs, so even an `exit` crash leaves evidence."""
+def evaluate(site: str, **ctx) -> list[FailPoint]:
+    """Evaluate the armed schedule at one site WITHOUT acting: every
+    matching failpoint is counted and ledgered ('failpoint_fired', with
+    trace context via the ambient ledger binding), then returned for
+    the caller to interpret. This is the shim API for the network
+    actions, whose behaviours (drop/dup/corrupt/...) only the transport
+    layer can enact. Returns [] when unarmed."""
     if not ARMED:
-        return
+        return []
     to_run: list[FailPoint] = []
     with _LOCK:
         for fp in _SCHEDULE:
@@ -344,10 +394,20 @@ def fire(site: str, **ctx) -> None:
                 "spec": fp.spec,
                 **{
                     k: v for k, v in ctx.items()
-                    if k in ("batch", "stage", "job")
+                    if k in ("batch", "stage", "job", "peer")
                 },
             },
         )
+    return to_run
+
+
+def fire(site: str, **ctx) -> None:
+    """Evaluate the armed schedule at one site. No-op (one branch) when
+    unarmed. A firing failpoint is ledgered and counted BEFORE its
+    action runs, so even an `exit` crash leaves evidence."""
+    if not ARMED:
+        return
+    for fp in evaluate(site, **ctx):
         _act(fp, site)
 
 

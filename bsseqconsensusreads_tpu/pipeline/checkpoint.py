@@ -53,6 +53,23 @@ from bsseqconsensusreads_tpu.io.bam import (
 )
 from bsseqconsensusreads_tpu.utils import observe
 
+#: Durable-write gate, installed by elastic.fencing.adopt() in workers
+#: holding a fenced lease: called (with the seam name) before every
+#: checkpoint shard write, manifest rename, and stage finalize, and
+#: raises FencedError once the holder's fence epoch is revoked. None —
+#: one branch per durable write — everywhere else.
+_WRITE_GATE = None
+
+
+def install_write_gate(gate) -> None:
+    global _WRITE_GATE
+    _WRITE_GATE = gate
+
+
+def _gate(what: str) -> None:
+    if _WRITE_GATE is not None:
+        _WRITE_GATE(what)
+
 
 @dataclasses.dataclass
 class _Manifest:
@@ -98,6 +115,7 @@ class _Manifest:
         )
 
     def save(self, path: str) -> None:
+        _gate("ckpt_manifest_rename")
         _failpoints.fire("ckpt_manifest_rename")
         tmp = path + ".tmp"
         with open(tmp, "w") as fh:
@@ -294,6 +312,7 @@ class BatchCheckpoint:
         """One shard write attempt — the retry unit for transient I/O
         errors (the batch items are still in memory, so a failed attempt
         rewrites the whole shard)."""
+        _gate("ckpt_shard_write")
         _failpoints.fire("ckpt_shard_write", shard=os.path.basename(path))
         # shards are scratch (re-read once at finalize, then deleted):
         # always deflate fast, like the external-sort spills
@@ -356,6 +375,7 @@ class BatchCheckpoint:
         for a completed rule — the manifest survives and the rerun
         re-finalizes from the durable shards.
         """
+        _gate("ckpt_finalize")
         _failpoints.fire("ckpt_finalize", target=self.target)
         n = 0
         tmp = self.target + ".finalize.tmp"

@@ -46,7 +46,9 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 
+from bsseqconsensusreads_tpu.faults import netchaos
 from bsseqconsensusreads_tpu.serve import jobs as _jobs
 from bsseqconsensusreads_tpu.serve import scheduler as _scheduler
 from bsseqconsensusreads_tpu.serve import transport as _transport
@@ -270,6 +272,18 @@ class ProtocolServer:
         self._inflight_lock = threading.Lock()
         self._idle = threading.Event()
         self._idle.set()
+        #: duplicate-delivery protection: `_rid` nonce -> the reply the
+        #: first delivery earned. A re-delivered frame (netchaos `dup`,
+        #: or any at-least-once retry that reuses its rid) answers from
+        #: here with NO second dispatch — `frame_dup_ignored` — so
+        #: lease/publish/heartbeat never double a state transition.
+        self._rid_cache: OrderedDict[str, dict] = OrderedDict()
+        self._rid_lock = threading.Lock()
+
+    #: bounded reply cache — old rids age out; a duplicate arriving
+    #: later than 1024 requests re-dispatches (the ledger-level
+    #: duplicate-commit path still holds for publish)
+    RID_CACHE_SIZE = 1024
 
     def request_drain(self) -> None:
         """Signal-handler safe: ask the accept loops to drain and exit."""
@@ -328,31 +342,47 @@ class ProtocolServer:
     def _accept_loop(self, sock: socket.socket, kind: str) -> None:
         while not self._drain_requested.is_set():
             try:
-                conn, _ = sock.accept()
+                conn, addr = sock.accept()
             except socket.timeout:
                 continue
             except OSError:
                 break
+            peer = f"{addr[0]}:{addr[1]}" if isinstance(addr, tuple) else ""
             # graftlint: owned-thread -- one connection = one
             # request; the handler owns conn and only calls the
             # lock-guarded engine API
             threading.Thread(
-                target=self._handle, args=(conn, kind),
+                target=self._handle, args=(conn, kind, peer),
                 name="serve-conn", daemon=True,
             ).start()
 
     # -- one connection = one request ------------------------------------
 
-    def _handle(self, conn: socket.socket, kind: str) -> None:
+    def _handle(self, conn: socket.socket, kind: str, peer: str = "") -> None:
         with self._inflight_lock:
             self._inflight += 1
             self._idle.clear()
         try:
+            afault = netchaos.plan("net_accept", peer=peer)
+            if afault.partition or afault.drop:
+                return  # injected: connection reset at accept
+            if afault.delay_s:
+                time.sleep(afault.delay_s)
+            if afault.half_open:
+                # accept, then stall: never read, never answer — the
+                # client's own timeout is its only way out
+                time.sleep(afault.half_open_s)
+                return
             conn.settimeout(10.0)
             try:
                 conn = _transport.server_wrap(conn, kind)
             except OSError:
                 return  # failed TLS handshake: refused client
+            rfault = netchaos.plan("net_recv", peer=peer)
+            if rfault.delay_s:
+                time.sleep(rfault.delay_s)
+            if rfault.drop:
+                return  # injected: the request frame never arrives
             try:
                 req = _transport.recv_message(conn, kind)
             except _transport.TransportError as exc:
@@ -372,13 +402,47 @@ class ProtocolServer:
             # the reserved `_trace` key — bind it so every ledger line the
             # dispatch emits lands in the sender's trace tree
             trace_ctx = req.pop("_trace", None)
-            try:
+            rid = req.pop("_rid", None)
+            cached = None
+            if rid is not None:
+                with self._rid_lock:
+                    cached = self._rid_cache.get(rid)
+            if cached is not None:
+                # duplicate delivery: same reply, no second dispatch —
+                # the idempotency contract for lease/publish/heartbeat
                 with observe.bind_trace(trace_ctx):
-                    resp = self._dispatch(req)
-            except Exception as exc:  # protocol errors answer, not crash
-                resp = {"ok": False, "error": f"{type(exc).__name__}: {exc}"}
+                    observe.emit(
+                        "frame_dup_ignored",
+                        {"rid": rid, "op": str(req.get("op", ""))},
+                    )
+                resp = cached
+            else:
+                try:
+                    with observe.bind_trace(trace_ctx):
+                        resp = self._dispatch(req)
+                except Exception as exc:  # protocol errors answer, not crash
+                    resp = {
+                        "ok": False,
+                        "error": f"{type(exc).__name__}: {exc}",
+                    }
+                # bulk replies (slice chunks) opt out via the reserved
+                # `_nocache` key: re-dispatching a read-only fetch is
+                # safe and keeps the cache memory-bounded
+                nocache = bool(resp.pop("_nocache", False)) if isinstance(
+                    resp, dict
+                ) else False
+                if rid is not None and not nocache:
+                    with self._rid_lock:
+                        self._rid_cache[rid] = resp
+                        while len(self._rid_cache) > self.RID_CACHE_SIZE:
+                            self._rid_cache.popitem(last=False)
+            sfault = netchaos.plan("net_send", peer=peer)
+            if sfault.delay_s:
+                time.sleep(sfault.delay_s)
+            if sfault.drop:
+                return  # injected: the answer never leaves the host
             conn.settimeout(10.0)
-            self._answer(conn, kind, resp)
+            self._answer(conn, kind, resp, corrupt=sfault.corrupt)
         except OSError:
             pass
         finally:
@@ -392,9 +456,11 @@ class ProtocolServer:
                     self._idle.set()
 
     @staticmethod
-    def _answer(conn: socket.socket, kind: str, resp: dict) -> None:
+    def _answer(
+        conn: socket.socket, kind: str, resp: dict, corrupt: bool = False
+    ) -> None:
         try:
-            _transport.send_message(conn, kind, resp)
+            _transport.send_message(conn, kind, resp, _corrupt=corrupt)
         except OSError:
             pass
 

@@ -38,6 +38,7 @@ import socket
 import struct
 import time
 
+from bsseqconsensusreads_tpu.faults import netchaos
 from bsseqconsensusreads_tpu.faults.guard import GuardError
 from bsseqconsensusreads_tpu.utils import observe
 
@@ -289,17 +290,32 @@ def recv_message(
     return _decode(line, max_bytes)
 
 
-def send_message(conn: socket.socket, kind: str, obj: dict) -> None:
+def send_message(
+    conn: socket.socket, kind: str, obj: dict, _corrupt: bool = False
+) -> None:
     data = json.dumps(obj).encode()
     if len(data) > MAX_FRAME:
         raise TransportError(
             f"refusing to send oversized message ({len(data)} bytes)",
             reason="oversized_frame",
         )
+    if _corrupt:
+        # netchaos `corrupt`: flip body bytes AFTER the length header —
+        # length stays truthful, so the peer buffers the frame and must
+        # refuse it at decode (bad_json), proving garbage never parses
+        data = netchaos.mangle(data)
     if kind == "tcp":
         conn.sendall(_LEN.pack(len(data)) + data)
     else:
         conn.sendall(data + b"\n")
+
+
+def mint_rid() -> str:
+    """A request id (nonce) for duplicate-delivery detection: stamped by
+    `request()` as the reserved `_rid` key, echoed nowhere, consumed by
+    the server's dedup cache. Random, not sequential — two processes
+    sharing a worker id must never collide."""
+    return os.urandom(8).hex()
 
 
 def request(address: str, payload: dict, timeout: float = 600.0) -> dict:
@@ -311,14 +327,47 @@ def request(address: str, payload: dict, timeout: float = 600.0) -> dict:
     (observe.bind_trace), it rides as the reserved `_trace` key of the
     request object — identical on both framings, since each is one JSON
     object per message — and the round-trip is booked as a 'transport'
-    span in that trace. The payload the caller passed is never mutated."""
+    span in that trace. The payload the caller passed is never mutated.
+
+    Duplicate-delivery protection: every request is stamped with a
+    reserved `_rid` nonce; servers answer a re-delivered rid from their
+    reply cache without re-running the op (`frame_dup_ignored`).
+
+    Wire faults (faults/netchaos.py, sites net_send/net_recv armed via
+    BSSEQ_TPU_FAILPOINTS): partition refuses the connection, delay
+    sleeps, drop closes without delivering, corrupt mangles the frame
+    body (the peer must refuse it), dup re-issues the identical frame —
+    same _rid, same _trace — on a fresh connection and discards the
+    second reply."""
     trace_ctx = observe.current_trace()
     if trace_ctx is not None and "_trace" not in payload:
         payload = dict(payload, _trace=trace_ctx)
+    if "_rid" not in payload:
+        payload = dict(payload, _rid=mint_rid())
+    fault = netchaos.plan("net_send", peer=address)
+    if fault.partition:
+        raise ConnectionError(
+            f"injected partition: refusing connection to {address}"
+        )
+    if fault.delay_s:
+        time.sleep(fault.delay_s)
     t0 = time.time()
     sock, kind = connect(address, timeout=timeout)
     try:
-        send_message(sock, kind, payload)
+        if fault.drop:
+            # connected, then the frame never arrives: the peer sees a
+            # clean EOF, this client a dead exchange
+            raise ConnectionError(
+                f"injected drop: frame to {address} not delivered"
+            )
+        send_message(sock, kind, payload, _corrupt=fault.corrupt)
+        rfault = netchaos.plan("net_recv", peer=address)
+        if rfault.delay_s:
+            time.sleep(rfault.delay_s)
+        if rfault.drop:
+            raise ConnectionError(
+                f"injected drop: reply from {address} discarded"
+            )
         resp = recv_message(sock, kind)
     finally:
         try:
@@ -332,4 +381,19 @@ def request(address: str, payload: dict, timeout: float = 600.0) -> dict:
             )
     if resp is None:
         raise ConnectionError(f"no response from {address}")
+    if fault.dup:
+        # second delivery of the SAME frame: fresh connection, identical
+        # payload (same _rid); the reply is discarded — the server's
+        # dedup cache must answer it without a second state transition
+        sock2, kind2 = connect(address, timeout=timeout)
+        try:
+            send_message(sock2, kind2, payload)
+            recv_message(sock2, kind2)
+        except (TransportError, OSError):
+            pass  # the duplicate best-efforts; the first reply stands
+        finally:
+            try:
+                sock2.close()
+            except OSError:
+                pass
     return resp

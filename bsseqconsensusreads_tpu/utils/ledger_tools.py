@@ -113,6 +113,10 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "elastic_ledger_resumed": ("done", "pending"),
     "elastic_merged": ("records", "slices", "ok"),
     "elastic_run_complete": ("slices", "records", "requeues", "ok"),
+    # graftnet: epoch fencing + shared-nothing slice shipping
+    "publish_fenced": ("slice", "worker", "epoch", "current"),
+    "frame_dup_ignored": ("rid", "op"),
+    "slice_chunk_resent": ("slice", "offset", "attempt"),
     # grafttrace (observability): completed causal spans (root spans
     # carry no 'parent' key; trace/span ids also stamp ordinary events)
     # and the crash-path flight-recorder dump

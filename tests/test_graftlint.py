@@ -43,6 +43,7 @@ FIXTURES = {
     "unfused-methyl-scan": "fx_unfused_methyl_scan.py",
     "unframed-socket-read": "fx_unframed_socket_read.py",
     "serial-deflate": "fx_serial_deflate.py",
+    "unfenced-commit": "fx_unfenced_commit.py",
     "unleased-work-dispatch": "fx_unleased_work_dispatch.py",
     "untraced-transport-send": "fx_untraced_transport_send.py",
     "contract-drift": "fx_contract_drift.py",

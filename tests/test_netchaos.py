@@ -1,0 +1,658 @@
+"""graftnet tests: wire faults, epoch fencing, shared-nothing shipping.
+
+* grammar — the net actions (delay/drop/dup/corrupt/half_open/
+  partition) parse, are gated to the net_* sites, take the @peer
+  predicate, and fold into a WirePlan; mangle() produces a frame the
+  guarded decoder must refuse (bad_json);
+* refusal matrix — each injected wire fault against a real tcp server:
+  partition refuses the connection, drop kills one delivery and the
+  retry heals, dup answers from the rid cache with NO second dispatch,
+  corrupt (either direction) is refused at decode, half_open is
+  bounded by the client's own timeout;
+* fencing — EpochBook mint/persist/restart continuity, per-lease epoch
+  mint, stale-epoch publish refused (`publish_fenced`) with duplicate
+  commits still tolerated, adopt/revoke/check with lease-scoped revoke
+  (the stale-renewer race), and the durable-write gate installed into
+  pipeline.checkpoint;
+* renewal race — the pump self-fences when its local deadline lapses
+  behind a partition and on a lease_expired reply, and a pump that
+  outlived its lease can never fence the next one;
+* ship byte-identity — work_loop in ship mode (inputs fetched, outputs
+  pushed as small CRC chunks over real tcp) merges to the
+  single-process SHA for 1- and 3-slice runs.
+
+Everything here is in-process (tier-1); the subprocess fleet versions
+of these faults live in tools/chaos_drill.py.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.elastic import (
+    Coordinator,
+    SliceLedger,
+    config_doc,
+    fencing,
+    merge as merge_mod,
+    slice_name,
+    split_input,
+    worker as worker_mod,
+)
+from bsseqconsensusreads_tpu.elastic.coordinator import (
+    ENV_CHUNK_B,
+    ENV_COORDINATOR_ADDR,
+    ENV_WORKER_ID,
+    chunk_bytes,
+)
+from bsseqconsensusreads_tpu.faults import failpoints, integrity, netchaos
+from bsseqconsensusreads_tpu.io.bam import BamWriter
+from bsseqconsensusreads_tpu.pipeline import checkpoint as ckpt_mod
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.serve.server import ProtocolServer
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Failpoints and the adopted fence are process-global: every test
+    leaves them as it found them (unarmed, unfenced, gate removed)."""
+    yield
+    failpoints.disarm()
+    fencing.release()
+    ckpt_mod.install_write_gate(None)
+
+
+def _events(path):
+    out = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def _sha(path):
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + WirePlan folding
+
+
+class TestGrammar:
+    def test_net_actions_parse(self):
+        pts = failpoints.parse_schedule(
+            "net_send=delay;net_recv=drop;net_send=dup;net_recv=corrupt;"
+            "net_accept=half_open:0.4s;net_send=partition"
+        )
+        actions = [p.action for p in pts]
+        assert actions == [
+            "delay", "drop", "dup", "corrupt", "half_open", "partition"
+        ]
+        assert pts[0].duration_s == 0.2  # delay default
+        assert pts[4].duration_s == 0.4
+
+    def test_delay_takes_duration(self):
+        (fp,) = failpoints.parse_schedule("net_send=delay:1.5s")
+        assert fp.duration_s == 1.5
+
+    def test_net_actions_gated_to_net_sites(self):
+        with pytest.raises(failpoints.FailpointError, match="net_"):
+            failpoints.parse_schedule("dispatch_kernel=drop")
+        with pytest.raises(failpoints.FailpointError, match="net_"):
+            failpoints.parse_schedule("elastic_publish=partition")
+
+    def test_process_actions_stay_legal_at_net_sites(self):
+        (fp,) = failpoints.parse_schedule("net_send=stall:0.1s")
+        assert fp.action == "stall"
+
+    def test_peer_predicate_is_substring(self):
+        (fp,) = failpoints.parse_schedule("net_send=partition@peer=10.0.0.9")
+        assert fp.peer == "10.0.0.9"
+        assert fp.matches({"peer": "tcp:10.0.0.9:8600"})
+        assert not fp.matches({"peer": "tcp:10.0.0.8:8600"})
+
+    def test_unknown_predicate_names_peer(self):
+        with pytest.raises(failpoints.FailpointError, match="peer"):
+            failpoints.parse_schedule("net_send=drop@host=x")
+
+    def test_plan_folds_fired_points(self):
+        failpoints.arm("net_send=delay:0.05s;net_send=dup;net_recv=drop")
+        p = netchaos.plan("net_send", peer="tcp:h:1")
+        assert p and p.delay_s == 0.05 and p.dup
+        assert not p.drop and not p.partition
+        r = netchaos.plan("net_recv", peer="tcp:h:1")
+        assert r.drop and not r.dup
+
+    def test_plan_quiet_when_unarmed(self):
+        failpoints.disarm()
+        assert not netchaos.plan("net_send", peer="anything")
+
+    def test_peer_gates_plan(self):
+        failpoints.arm("net_send=partition@peer=10.9.9.9")
+        assert not netchaos.plan("net_send", peer="tcp:127.0.0.1:1")
+        assert netchaos.plan("net_send", peer="tcp:10.9.9.9:1").partition
+
+    def test_mangle_is_refused_by_decoder(self):
+        body = json.dumps({"op": "ping"}).encode()
+        bad = netchaos.mangle(body)
+        assert bad != body and len(bad) == len(body)
+        with pytest.raises(transport.TransportError) as ei:
+            transport._decode(bad, transport.MAX_FRAME)
+        assert ei.value.reason == "bad_json"
+        assert netchaos.mangle(b"") == b""
+
+    def test_chunk_bytes_clamped(self, monkeypatch):
+        monkeypatch.delenv(ENV_CHUNK_B, raising=False)
+        assert chunk_bytes() == 1 << 20
+        monkeypatch.setenv(ENV_CHUNK_B, "512")
+        assert chunk_bytes() == 512
+        monkeypatch.setenv(ENV_CHUNK_B, str(64 << 20))
+        assert chunk_bytes() == 4 << 20  # one chunk must fit one frame
+        monkeypatch.setenv(ENV_CHUNK_B, "nonsense")
+        assert chunk_bytes() == 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# the refusal matrix over real sockets
+
+
+class _Echo(ProtocolServer):
+    """One-op server counting real dispatches — the idempotency meter."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.dispatches = 0
+
+    def _dispatch(self, req):
+        self.dispatches += 1
+        return {"ok": True, "echo": req.get("n")}
+
+    def _on_drain(self):
+        pass
+
+
+class TestWireFaults:
+    @pytest.fixture()
+    def echo(self):
+        srv = _Echo(addresses=["tcp:127.0.0.1:0"])
+        # graftlint: owned-thread -- test fixture accept loop, drained
+        # in teardown
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not srv.bound and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.bound
+        yield srv, srv.bound[0]
+        failpoints.disarm()
+        srv.request_drain()
+        t.join(timeout=10.0)
+
+    def test_partition_refuses_then_heals(self, echo):
+        srv, addr = echo
+        failpoints.arm("net_send=partition")
+        with pytest.raises(ConnectionError, match="injected partition"):
+            transport.request(addr, {"op": "e", "n": 1}, timeout=5.0)
+        assert srv.dispatches == 0
+        failpoints.disarm()
+        assert transport.request(addr, {"op": "e", "n": 2}, timeout=5.0)["ok"]
+
+    def test_drop_kills_one_delivery_retry_heals(self, echo):
+        srv, addr = echo
+        failpoints.arm("net_send=drop@hit=1")
+        with pytest.raises(ConnectionError, match="injected drop"):
+            transport.request(addr, {"op": "e", "n": 1}, timeout=5.0)
+        resp = transport.request(addr, {"op": "e", "n": 2}, timeout=5.0)
+        assert resp["ok"] and resp["echo"] == 2
+        assert srv.dispatches == 1
+
+    def test_dup_answered_from_rid_cache(self, echo, monkeypatch, tmp_path):
+        srv, addr = echo
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        failpoints.arm("net_send=dup@peer=tcp:")
+        resp = transport.request(addr, {"op": "e", "n": 7}, timeout=5.0)
+        assert resp["ok"] and resp["echo"] == 7
+        # the duplicate frame (same _rid) earned NO second dispatch
+        assert srv.dispatches == 1
+        dups = [e for e in _events(sink) if e.get("event") == "frame_dup_ignored"]
+        assert len(dups) == 1 and dups[0]["op"] == "e"
+
+    def test_corrupt_request_refused_as_bad_json(self, echo):
+        srv, addr = echo
+        # @peer=tcp: matches only the CLIENT edge (the server's peer is
+        # the bare accepted address) — the request frame is mangled, the
+        # server's framing refuses it without dispatching
+        failpoints.arm("net_send=corrupt@peer=tcp:")
+        resp = transport.request(addr, {"op": "e", "n": 1}, timeout=5.0)
+        assert not resp["ok"] and resp["guard"] == "bad_json"
+        assert srv.dispatches == 0
+
+    def test_corrupt_reply_refused_as_bad_json(self, echo):
+        srv, addr = echo
+        # hits count matching evaluations: 1 = client send, 2 = server
+        # answering — the REPLY is mangled, this client must refuse it
+        failpoints.arm("net_send=corrupt@hit=2")
+        with pytest.raises(transport.TransportError) as ei:
+            transport.request(addr, {"op": "e", "n": 1}, timeout=5.0)
+        assert ei.value.reason == "bad_json"
+        assert srv.dispatches == 1
+
+    def test_half_open_bounded_by_client_timeout(self, echo):
+        srv, addr = echo
+        failpoints.arm("net_accept=half_open:2s")
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            transport.request(addr, {"op": "e", "n": 1}, timeout=0.5)
+        assert time.monotonic() - t0 < 1.8  # the client's timeout, not 2s
+        assert srv.dispatches == 0
+
+    def test_accept_drop_is_no_response(self, echo):
+        srv, addr = echo
+        failpoints.arm("net_accept=drop@hit=1")
+        with pytest.raises(ConnectionError):
+            transport.request(addr, {"op": "e", "n": 1}, timeout=5.0)
+        assert srv.dispatches == 0
+        assert transport.request(addr, {"op": "e", "n": 2}, timeout=5.0)["ok"]
+
+    def test_delay_slows_but_delivers(self, echo):
+        srv, addr = echo
+        failpoints.arm("net_send=delay:0.3s@peer=tcp:")
+        t0 = time.monotonic()
+        resp = transport.request(addr, {"op": "e", "n": 3}, timeout=5.0)
+        assert resp["ok"] and time.monotonic() - t0 >= 0.3
+        assert srv.dispatches == 1
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing
+
+
+def _fake_rundir(tmp_path, n=2):
+    rundir = str(tmp_path / "run")
+    specs = []
+    for sid in range(n):
+        os.makedirs(os.path.join(rundir, "slices", slice_name(sid)),
+                    exist_ok=True)
+        specs.append({
+            "sid": sid,
+            "path": os.path.join("slices", f"{slice_name(sid)}.bam"),
+            "records": 5 + sid,
+            "families": 2,
+            "family_crc": 1000 + sid,
+            "input_crc": 0,
+        })
+    return rundir, specs
+
+
+def _out(rundir, sid, payload=b"consensus-bytes"):
+    path = os.path.join(rundir, "slices", slice_name(sid), "out.bam")
+    with open(path, "wb") as fh:
+        fh.write(payload)
+    return {
+        "slice": slice_name(sid),
+        "output": "out.bam",
+        "crc": integrity.file_crc32(path),
+        "family_crc": 1000 + sid,
+        "records_out": 2,
+    }
+
+
+class TestFencing:
+    def test_epoch_book_mints_and_persists(self, tmp_path):
+        book = fencing.EpochBook(str(tmp_path))
+        assert book.mint() == 1 and book.mint() == 2
+        with open(os.path.join(str(tmp_path), fencing.FENCE_DOC)) as fh:
+            assert json.load(fh) == {"epoch": 2}
+
+    def test_epoch_book_restart_continuity(self, tmp_path):
+        fencing.EpochBook(str(tmp_path)).mint()
+        reborn = fencing.EpochBook(str(tmp_path))
+        assert reborn.mint() == 2  # strictly above every granted epoch
+
+    def test_lease_mints_epoch_restart_stays_above(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        first = SliceLedger(rundir, specs, lease_s=30.0)
+        assert first.lease("wa")["fence_epoch"] == 1
+        # coordinator restart: fresh ledger over the same rundir
+        second = SliceLedger(rundir, specs, lease_s=30.0)
+        assert second.lease("wb")["fence_epoch"] == 2
+
+    def test_stale_epoch_publish_fenced(self, tmp_path, monkeypatch):
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=0.05)
+        zombie = ledger.lease("wz")
+        time.sleep(0.1)
+        assert ledger.expire_scan() == 1
+        retaker = ledger.lease("wr")
+        assert retaker["fence_epoch"] > zombie["fence_epoch"]
+        manifest = _out(rundir, 0)
+        resp = ledger.commit(
+            zombie["lease_id"], 0, manifest, worker="wz",
+            epoch=zombie["fence_epoch"],
+        )
+        assert resp == {
+            "ok": False, "reason": "fenced",
+            "epoch": retaker["fence_epoch"],
+        }
+        fenced = [e for e in _events(sink) if e.get("event") == "publish_fenced"]
+        assert len(fenced) == 1
+        assert fenced[0]["worker"] == "wz"
+        assert fenced[0]["current"] == retaker["fence_epoch"]
+        # the live holder's publish commits
+        assert ledger.commit(
+            retaker["lease_id"], 0, manifest, worker="wr",
+            epoch=retaker["fence_epoch"],
+        ) == {"ok": True}
+
+    def test_zombie_fenced_even_with_matching_bytes(self, tmp_path):
+        """Fencing outranks the duplicate-commit path: a superseded
+        holder gets the typed refusal even when its output is identical
+        — an "ok" would invite it to keep writing."""
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=0.05)
+        zombie = ledger.lease("wz")
+        time.sleep(0.1)
+        ledger.expire_scan()
+        retaker = ledger.lease("wr")
+        manifest = _out(rundir, 0)
+        assert ledger.commit(
+            retaker["lease_id"], 0, manifest, worker="wr",
+            epoch=retaker["fence_epoch"],
+        ) == {"ok": True}
+        resp = ledger.commit(
+            zombie["lease_id"], 0, manifest, worker="wz",
+            epoch=zombie["fence_epoch"],
+        )
+        assert resp["reason"] == "fenced"
+
+    def test_duplicate_commit_same_epoch_tolerated(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        grant = ledger.lease("wa")
+        manifest = _out(rundir, 0)
+        kw = dict(worker="wa", epoch=grant["fence_epoch"])
+        assert ledger.commit(grant["lease_id"], 0, manifest, **kw)["ok"]
+        dup = ledger.commit(grant["lease_id"], 0, manifest, **kw)
+        assert dup == {"ok": True, "duplicate": True}
+
+    def test_adopt_check_revoke_release(self):
+        fencing.adopt(3, "lease-a")
+        fencing.check("anything")  # live fence: no-op
+        fencing.revoke("stale pump", lease_id="lease-b")
+        assert not fencing.is_revoked()  # wrong lease: no-op
+        fencing.revoke("deadline passed", lease_id="lease-a")
+        assert fencing.is_revoked()
+        with pytest.raises(fencing.FencedError) as ei:
+            fencing.check("shard write")
+        assert ei.value.epoch == 3 and "deadline passed" in str(ei.value)
+        fencing.release()
+        fencing.check("anything")  # released: unfenced again
+
+    def test_revoke_without_adopt_is_noop(self):
+        fencing.release()
+        fencing.revoke("nothing adopted")
+        assert not fencing.is_revoked()
+
+    def test_adopt_installs_checkpoint_write_gate(self):
+        fencing.adopt(5, "lease-g")
+        fencing.revoke(lease_id="lease-g")
+        with pytest.raises(fencing.FencedError):
+            ckpt_mod._gate("ckpt shard write")
+
+
+# ---------------------------------------------------------------------------
+# the renewal-race regression
+
+
+class _BeatStub:
+    def beat(self, **kw):
+        pass
+
+
+class _LeaseRefuser(ProtocolServer):
+    def _dispatch(self, req):
+        return {"ok": False, "reason": "lease_expired"}
+
+    def _on_drain(self):
+        pass
+
+
+class TestRenewalRace:
+    def test_deadline_lapse_behind_partition_self_fences(self):
+        """No coordinator at all (connection refused every tick): the
+        pump must NOT spin forever on 'transient' errors — its local
+        deadline lapses and it revokes the fence it was renewing."""
+        fencing.adopt(4, "lease-dead")
+        stop = threading.Event()
+        t0 = time.monotonic()
+        worker_mod._renew_lease(
+            "tcp:127.0.0.1:9", "wx", "lease-dead", 0.6, stop, _BeatStub()
+        )
+        assert time.monotonic() - t0 < 10.0
+        assert fencing.is_revoked()
+        with pytest.raises(fencing.FencedError):
+            fencing.check("publish")
+
+    def test_lease_expired_reply_revokes_immediately(self):
+        srv = _LeaseRefuser(addresses=["tcp:127.0.0.1:0"])
+        # graftlint: owned-thread -- test fixture accept loop, drained
+        # below
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not srv.bound and time.monotonic() < deadline:
+            time.sleep(0.01)
+        try:
+            fencing.adopt(6, "lease-gone")
+            stop = threading.Event()
+            worker_mod._renew_lease(
+                srv.bound[0], "wx", "lease-gone", 0.9, stop, _BeatStub()
+            )
+            assert fencing.is_revoked()
+        finally:
+            srv.request_drain()
+            t.join(timeout=10.0)
+
+    def test_stop_wins_without_revoking(self):
+        fencing.adopt(7, "lease-live")
+        stop = threading.Event()
+        stop.set()  # joiner already asked: first wait returns instantly
+        worker_mod._renew_lease(
+            "tcp:127.0.0.1:9", "wx", "lease-live", 0.3, stop, _BeatStub()
+        )
+        assert not fencing.is_revoked()
+
+    def test_stale_pump_cannot_fence_next_lease(self):
+        """The race the lease-scoped revoke closes: a pump stuck past
+        the joiner's patience wakes AFTER the worker adopted its next
+        lease — its revoke must be a no-op against the new fence."""
+        fencing.adopt(9, "lease-new")
+        fencing.revoke("old pump deadline", lease_id="lease-old")
+        assert not fencing.is_revoked()
+        fencing.check("publish")  # the new lease is untouched
+
+
+# ---------------------------------------------------------------------------
+# ship-mode byte identity (in-process work_loop over real tcp)
+
+
+N_FAMILIES = 8
+
+
+@pytest.fixture(scope="module")
+def ship_env(tmp_path_factory):
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    tmp = tmp_path_factory.mktemp("ship")
+    rng = np.random.default_rng(1807)
+    name, genome = random_genome(rng, 5000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=N_FAMILIES, error_rate=0.01
+    )
+    bam = str(tmp / "ship.bam")
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    cfg = FrameworkConfig(
+        genome_dir=os.path.dirname(fasta),
+        genome_fasta_file_name=os.path.basename(fasta),
+        aligner="self",
+    )
+    sp_cfg = dataclasses.replace(cfg, tmp=str(tmp / "sp_tmp"))
+    target, _results, _stats = run_pipeline(
+        sp_cfg, bam, outdir=str(tmp / "single")
+    )
+    return {"bam": bam, "cfg": cfg, "sp_sha": _sha(target)}
+
+
+class TestShipByteIdentity:
+    @pytest.mark.parametrize("slices", [1, 3])
+    def test_ship_work_loop_matches_single_process(
+        self, ship_env, tmp_path, monkeypatch, slices
+    ):
+        """Shared-nothing: the worker fetches every slice input and
+        pushes every output over the wire as 512-byte CRC chunks (many
+        chunks per slice, so the resumable framing is really exercised)
+        — and the merge still equals the single-process SHA."""
+        monkeypatch.setenv(ENV_CHUNK_B, "512")
+        monkeypatch.setenv(ENV_WORKER_ID, "ws0")
+        monkeypatch.setenv(ENV_COORDINATOR_ADDR, "")
+        outdir = str(tmp_path / "out")
+        rundir = os.path.join(outdir, "elastic")
+        os.makedirs(rundir, exist_ok=True)
+        cfg = ship_env["cfg"]
+        specs = split_input(ship_env["bam"], rundir, slices)
+        assert all(
+            os.path.getsize(os.path.join(rundir, sl["path"])) > 512
+            for sl in specs
+        )  # every slice really crosses the wire in multiple chunks
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, config_doc(cfg), addresses=["tcp:127.0.0.1:0"],
+            ship=True,
+        )
+        server.start_monitor()
+        # graftlint: owned-thread -- test coordinator accept loop,
+        # drained below
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not server.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            processed = worker_mod.work_loop(
+                server.bound[0], worker_id="ws0"
+            )
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)
+        assert processed == slices
+        target, report = merge_mod.finalize(
+            cfg, ship_env["bam"], outdir, specs, ledger.manifests()
+        )
+        assert report["ok"], report["checks"]
+        assert _sha(target) == ship_env["sp_sha"]
+
+    def test_ship_fetch_resends_through_drops(
+        self, ship_env, tmp_path, monkeypatch
+    ):
+        """A dropped chunk request mid-fetch is retried from the same
+        offset (`slice_chunk_resent`) and the assembled input passes
+        the whole-file CRC — bytes survive a lossy wire."""
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.setenv(ENV_CHUNK_B, "512")
+        rundir = str(tmp_path / "run")
+        specs = split_input(ship_env["bam"], rundir, 1)
+        ledger = SliceLedger(rundir, specs, lease_s=30.0)
+        server = Coordinator(
+            ledger, config_doc(ship_env["cfg"]),
+            addresses=["tcp:127.0.0.1:0"], ship=True,
+        )
+        # graftlint: owned-thread -- test coordinator accept loop,
+        # drained below
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not server.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # drop the 2nd and 3rd fetch requests on the client edge
+            failpoints.arm(
+                "net_send=drop@hit=2@peer=tcp:;net_send=drop@hit=3@peer=tcp:"
+            )
+            dest = str(tmp_path / "fetched.bam")
+            worker_mod._fetch_slice(
+                server.bound[0], specs[0], dest, worker="wf"
+            )
+        finally:
+            failpoints.disarm()
+            server.request_drain()
+            thread.join(timeout=10.0)
+        src = os.path.join(rundir, specs[0]["path"])
+        assert open(dest, "rb").read() == open(src, "rb").read()
+        resends = [
+            e for e in _events(sink)
+            if e.get("event") == "slice_chunk_resent"
+        ]
+        assert len(resends) >= 2
+        assert all(e["attempt"] >= 1 for e in resends)
+
+    def test_push_with_stale_epoch_raises_fenced(
+        self, ship_env, tmp_path, monkeypatch
+    ):
+        """slice_push under a superseded epoch must raise FencedError
+        locally — a zombie may not even land BYTES, let alone a
+        manifest."""
+        monkeypatch.setenv(ENV_CHUNK_B, "512")
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        with open(os.path.join(rundir, "slices", "slice0000.bam"), "wb") as fh:
+            fh.write(b"x" * 64)
+        ledger = SliceLedger(rundir, specs, lease_s=0.05)
+        server = Coordinator(
+            ledger, {"doc": True}, addresses=["tcp:127.0.0.1:0"], ship=True,
+        )
+        # graftlint: owned-thread -- test coordinator accept loop,
+        # drained below
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not server.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            zombie = ledger.lease("wz")
+            time.sleep(0.1)
+            ledger.expire_scan()
+            ledger.lease("wr")  # supersedes: epoch moves past the zombie
+            payload = str(tmp_path / "pushed.bam")
+            with open(payload, "wb") as fh:
+                fh.write(b"z" * 2048)
+            with pytest.raises(fencing.FencedError):
+                worker_mod._push_output(
+                    server.bound[0], 0, zombie["lease_id"],
+                    zombie["fence_epoch"], payload, worker="wz",
+                )
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)

@@ -138,6 +138,9 @@ def _registry_check(schedule: str = "", events: tuple = (),
     reg = contracts.REGISTRY
     for term in filter(None, (t.strip() for t in schedule.split(";"))):
         site = term.split("=", 1)[0]
+        if ":" in site:
+            # worker-scoped term (--worker-failpoints wid:site=action)
+            site = site.split(":", 1)[1]
         if site not in reg.failpoint_sites:
             raise SystemExit(
                 f"chaos_drill: schedule {term!r} names failpoint site "
@@ -182,12 +185,21 @@ def _run_child(wd: str, bam: str, outdir: str, ledger: str,
 
 def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
                  workers: int, slices: int,
-                 worker_failpoints: str = "",
-                 coordinator_failpoints: str = ""):
+                 worker_failpoints: str | tuple = (),
+                 coordinator_failpoints: str = "",
+                 ship: bool = False,
+                 env_extra: dict | None = None):
     """One `cli elastic run` over the drill input with the drill's
     pipeline geometry (same cfg the _child runs use, so the merged
-    output must equal the fault-free reference bytes)."""
-    _registry_check(schedule=worker_failpoints)
+    output must equal the fault-free reference bytes).
+
+    worker_failpoints takes one `wid:schedule` term or a tuple of them
+    (one --worker-failpoints flag each); env_extra rides the coordinator
+    environment (lease duration, ship chunk size)."""
+    if isinstance(worker_failpoints, str):
+        worker_failpoints = (worker_failpoints,) if worker_failpoints else ()
+    for term in worker_failpoints:
+        _registry_check(schedule=term)
     _registry_check(schedule=coordinator_failpoints)
     cfgfile = os.path.join(wd, "elastic_cfg.yaml")
     if not os.path.exists(cfgfile):
@@ -212,6 +224,7 @@ def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
         env["BSSEQ_TPU_FAILPOINTS"] = coordinator_failpoints
     else:
         env.pop("BSSEQ_TPU_FAILPOINTS", None)
+    env.update(env_extra or {})
     cmd = [
         sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
         "elastic", "run",
@@ -221,8 +234,10 @@ def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
         "--outdir", outdir,
         "--workers", str(workers), "--slices", str(slices),
     ]
-    if worker_failpoints:
-        cmd += ["--worker-failpoints", worker_failpoints]
+    if ship:
+        cmd.append("--ship")
+    for term in worker_failpoints:
+        cmd += ["--worker-failpoints", term]
     return subprocess.run(
         cmd, env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT,
     )
@@ -1127,9 +1142,13 @@ def run_drill(quick: bool, out_path: str) -> dict:
             )
         entry["seconds"] = round(time.monotonic() - t0, 1)
 
-        # graftswarm: the COORDINATOR is hard-killed at its second
-        # manifest commit (one slice durably committed, the rest in
-        # flight). Durable truth is the filesystem: the re-run's ledger
+        # graftswarm: the COORDINATOR is hard-killed at its third
+        # manifest commit. Both workers publish their FIRST slices
+        # near-simultaneously (symmetric start), so a kill inside that
+        # wave could land between a concurrent twin's hit-count and its
+        # save, leaving nothing durable; the third commit sits a whole
+        # slice-compute later, so wave one is durably committed and the
+        # rest in flight. Durable truth is the filesystem: the re-run's ledger
         # rescan trusts the verified manifest (`elastic_ledger_resumed`
         # with done>=1), re-executes only the incomplete slices, and
         # still merges byte-identical.
@@ -1140,7 +1159,7 @@ def run_drill(quick: bool, out_path: str) -> dict:
         t0 = time.monotonic()
         cp = _run_elastic(
             wd, bam, outdir, ledger, workers=2, slices=4,
-            coordinator_failpoints="elastic_manifest_commit=exit:9@hit=2",
+            coordinator_failpoints="elastic_manifest_commit=exit:9@hit=3",
         )
         entry["kill_rc"] = cp.returncode
         if cp.returncode != 9:
@@ -1196,6 +1215,144 @@ def run_drill(quick: bool, out_path: str) -> dict:
                     and entry["ledger_resumed"] >= 1
                     and entry["slices_rerun"] < 4  # done slice not redone
                 )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftnet (ISSUE 18): worker w0 is PARTITIONED at the wire —
+        # every net_send from its third request on (join and first lease
+        # get through, so it holds a lease) raises ConnectionError. The
+        # renewal pump treats that as transient, the local deadline
+        # lapses, the fence self-revokes, and the coordinator requeues
+        # the lease it stopped hearing about. Meanwhile w1 is a ZOMBIE:
+        # its renewal pump exits cleanly after compute, then the publish
+        # stalls 10s — by the time the stale commit arrives the slice
+        # has been re-leased under a higher fence epoch, so the
+        # coordinator refuses it with `publish_fenced` instead of
+        # letting a dead lease overwrite live work.
+        entry = {"ok": False}
+        results["net_partition_worker_requeue"] = entry
+        ledger = os.path.join(wd, "np.jsonl")
+        _registry_check(events=("publish_fenced", "slice_requeued",
+                                "elastic_publish_refused",
+                                "failpoint_fired"))
+        partition = ";".join(
+            f"net_send=partition@hit={h}@peer=127.0.0.1"
+            for h in range(3, 41)
+        )
+        t0 = time.monotonic()
+        cp = _run_elastic(
+            wd, bam, os.path.join(wd, "out_net_partition"), ledger,
+            workers=3, slices=4,
+            worker_failpoints=(
+                f"w0:{partition}",
+                "w1:elastic_publish=stall:10s@hit=1",
+            ),
+            env_extra={"BSSEQ_TPU_ELASTIC_LEASE_S": "3.0"},
+        )
+        if cp.returncode != 0:
+            entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+        else:
+            out = json.loads(cp.stdout)
+            counts = _ledger_counts(ledger)
+            entry["byte_identical"] = (
+                open(out["target"], "rb").read() == ref_bytes
+            )
+            entry["slice_requeued"] = counts.get("slice_requeued", 0)
+            entry["publish_fenced"] = counts.get("publish_fenced", 0)
+            entry["publish_refused"] = counts.get(
+                "elastic_publish_refused", 0
+            )
+            entry["faults_fired"] = counts.get("failpoint_fired", 0)
+            entry["counters_reconciled"] = out["report"].get("ok", False)
+            entry["trace"] = _trace_check(ledger, expect_requeued=True)
+            entry["ok"] = (
+                entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["slice_requeued"] >= 2  # partitioned + zombie
+                and entry["publish_fenced"] >= 1
+                and entry["publish_refused"] >= 1
+                and entry["faults_fired"] >= 1
+                and entry["trace"]["ok"]
+            )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftnet: every request w0 sends is DUPLICATED on the wire (a
+        # second connection replays the identical frame, same rid). The
+        # server answers the replay from its rid cache (`frame_dup_
+        # ignored`) instead of re-dispatching — so the duplicated
+        # publishes and lease requests stay idempotent: no double
+        # commit, no double grant, bytes identical.
+        entry = {"ok": False}
+        results["net_dup_publish_idempotent"] = entry
+        ledger = os.path.join(wd, "nd.jsonl")
+        _registry_check(events=("frame_dup_ignored", "failpoint_fired"))
+        t0 = time.monotonic()
+        cp = _run_elastic(
+            wd, bam, os.path.join(wd, "out_net_dup"), ledger,
+            workers=2, slices=4,
+            worker_failpoints="w0:net_send=dup@peer=127.0.0.1",
+        )
+        if cp.returncode != 0:
+            entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+        else:
+            out = json.loads(cp.stdout)
+            counts = _ledger_counts(ledger)
+            entry["byte_identical"] = (
+                open(out["target"], "rb").read() == ref_bytes
+            )
+            entry["dups_ignored"] = counts.get("frame_dup_ignored", 0)
+            entry["slice_requeued"] = counts.get("slice_requeued", 0)
+            entry["faults_fired"] = counts.get("failpoint_fired", 0)
+            entry["counters_reconciled"] = out["report"].get("ok", False)
+            entry["trace"] = _trace_check(ledger)
+            entry["ok"] = (
+                entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["dups_ignored"] >= 1
+                and entry["faults_fired"] >= 1
+                and entry["trace"]["ok"]
+            )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftnet: shared-nothing shipping under packet loss. Workers
+        # fetch slice inputs and push outputs as CRC-verified 1 KiB
+        # chunks (BSSEQ_TPU_ELASTIC_CHUNK_B); w0's 4th and 5th requests
+        # — mid-fetch — are DROPPED after send. The chunk loop resends
+        # (`slice_chunk_resent`) from the acknowledged offset, and the
+        # merged output must stay byte-identical to the shared-FS
+        # reference: the wire adds failure modes, never bytes.
+        entry = {"ok": False}
+        results["ship_mode_drop_resume"] = entry
+        ledger = os.path.join(wd, "ns.jsonl")
+        _registry_check(events=("slice_chunk_resent", "failpoint_fired"))
+        t0 = time.monotonic()
+        cp = _run_elastic(
+            wd, bam, os.path.join(wd, "out_net_ship"), ledger,
+            workers=2, slices=4,
+            worker_failpoints=(
+                "w0:net_send=drop@hit=4;net_send=drop@hit=5",
+            ),
+            ship=True,
+            env_extra={"BSSEQ_TPU_ELASTIC_CHUNK_B": "1024"},
+        )
+        if cp.returncode != 0:
+            entry["error"] = f"rc={cp.returncode}: {cp.stderr[-500:]}"
+        else:
+            out = json.loads(cp.stdout)
+            counts = _ledger_counts(ledger)
+            entry["byte_identical"] = (
+                open(out["target"], "rb").read() == ref_bytes
+            )
+            entry["chunks_resent"] = counts.get("slice_chunk_resent", 0)
+            entry["faults_fired"] = counts.get("failpoint_fired", 0)
+            entry["counters_reconciled"] = out["report"].get("ok", False)
+            entry["trace"] = _trace_check(ledger)
+            entry["ok"] = (
+                entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["chunks_resent"] >= 1
+                and entry["faults_fired"] >= 1
+                and entry["trace"]["ok"]
+            )
         entry["seconds"] = round(time.monotonic() - t0, 1)
 
     ok = all(v.get("ok") for v in results.values())
