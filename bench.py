@@ -1459,6 +1459,46 @@ def _run_netchaos_quick() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def _run_preempt_quick() -> dict | None:
+    """tools/preempt_probe.py --quick -> PREEMPT_HEAD.json: the
+    graftpreempt artifact (voluntary drain-and-handoff vs lease-expiry
+    recovery — one requeue microbench over both paths plus a real
+    preempted-then-resumed run pinned to the single-process SHA, with
+    the measured handoff latency strictly below the lease). Best-effort
+    and cpu-pinned like the chaos drill. BSSEQ_BENCH_PREEMPT=0 skips."""
+    if os.environ.get("BSSEQ_BENCH_PREEMPT", "1") == "0":
+        return None
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "preempt_probe.py",
+    )
+    out_path = os.path.join(os.getcwd(), "PREEMPT_HEAD.json")
+    try:
+        cp = subprocess.run(
+            [sys.executable, tool, "--quick", "--out", out_path],
+            capture_output=True, text=True,
+            timeout=_env_timeout("BSSEQ_BENCH_PREEMPT_TIMEOUT", 900),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        data = {}
+        if os.path.exists(out_path):
+            with open(out_path) as fh:
+                data = json.load(fh)
+        table = data.get("table", {})
+        return {
+            "path": out_path,
+            "ok": bool(data.get("ok")) and cp.returncode == 0,
+            "handoff_latency_s": table.get("handoff_latency_s"),
+            "lease_expiry_recovery_s": table.get("lease_expiry_recovery_s"),
+            "preempt_requeue_s": table.get("preempt_requeue_s"),
+            "byte_identical": data.get("pipeline_handoff", {}).get(
+                "byte_identical"
+            ),
+        }
+    except Exception as exc:  # noqa: BLE001 — bench must never crash here
+        return {"path": out_path, "ok": False, "error": str(exc)[:200]}
+
+
 def _run_contracts_quick() -> dict | None:
     """graftcontract quick leg: `cli lint --contracts --json` over the
     package, embedding the drift/waiver verdict in the artifact so a
@@ -1726,6 +1766,18 @@ def main() -> None:
             "bench_netchaos",
             {"ok": netchaos.get("ok"), "passed": netchaos.get("passed"),
              "failed": netchaos.get("failed")},
+            sink=ledger_sink,
+        )
+    preempt = _run_preempt_quick()
+    if preempt is not None:
+        out["preempt"] = preempt
+        observe.emit(
+            "bench_preempt",
+            {
+                "ok": preempt.get("ok"),
+                "path": preempt.get("path"),
+                "handoff_latency_s": preempt.get("handoff_latency_s"),
+            },
             sink=ledger_sink,
         )
     trace = _run_trace_quick()

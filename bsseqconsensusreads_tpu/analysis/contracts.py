@@ -745,6 +745,14 @@ ENV_VARS: tuple[EnvVar, ...] = (
            "elastic.coordinator",
            "ship-mode transfer chunk size in bytes (clamped to 4 MiB "
            "so a chunk always fits one frame)"),
+    EnvVar("BSSEQ_TPU_PREEMPT_GRACE_S", "float", "30", "elastic.preempt",
+           "drain-and-handoff budget after SIGTERM: finish the in-flight "
+           "batch, flush, release the lease — then exit regardless"),
+    EnvVar("BSSEQ_TPU_ADMIT_WATERMARK", "int", "queue capacity",
+           "serve.jobs",
+           "admission queue depth at which submit sheds with a typed "
+           "`overloaded` refusal instead of blocking (0 disables on "
+           "the router; the engine queue defaults to its capacity)"),
 )
 
 FAILPOINT_SITES: frozenset[str] = frozenset({
@@ -897,6 +905,14 @@ EVENTS: tuple[LedgerEvent, ...] = (
     LedgerEvent("frame_dup_ignored", ("rid", "op"), "serve.server"),
     LedgerEvent("slice_chunk_resent", ("slice", "offset", "attempt"),
                 "elastic.worker"),
+    # graftpreempt (voluntary drain-and-handoff + overload shedding)
+    LedgerEvent("worker_preempted", ("worker", "reason"),
+                "elastic.coordinator"),
+    LedgerEvent("handoff_published",
+                ("slice", "worker", "batches_kept", "handoff_latency_s"),
+                "elastic.preempt"),
+    LedgerEvent("jobs_shed", ("depth", "watermark", "retry_after_s"),
+                "serve.jobs"),
 )
 
 #: counters read across a layer boundary (StageStats surface fields,
@@ -909,6 +925,7 @@ COUNTERS: frozenset[str] = frozenset({
     "families_quarantined", "family_records_quarantined",
     "stream_gap", "stream_truncated", "frame_resync", "frame_lost",
     "jobs_routed", "jobs_requeued", "affinity_hits", "replica_restarts",
+    "jobs_shed",
 })
 
 OPS: tuple[ProtocolOp, ...] = (
@@ -937,11 +954,16 @@ OPS: tuple[ProtocolOp, ...] = (
     ProtocolOp("slice_push", ("coordinator",),
                "ship mode: one CRC'd chunk of a slice output (fenced, "
                "sequential stream with resync replies)"),
+    ProtocolOp("preempt", ("coordinator", "router"),
+               "voluntary drain: a worker releases its lease early "
+               "(coordinator requeues immediately), or an operator "
+               "drains one router replica onto survivors"),
 )
 
 REFUSAL_REASONS: frozenset[str] = frozenset({
     "transport", "bad_address", "truncated_frame", "oversized_frame",
     "bad_json",
+    "overloaded", "drain_timeout",
 })
 
 CLI_COMMANDS: frozenset[str] = frozenset({
@@ -993,6 +1015,7 @@ RULES: frozenset[str] = frozenset({
     "blocking-scheduler-loop", "thread-unsafe-mutation",
     "swallowed-exception", "untraced-transport-send",
     "unframed-socket-read", "contract-drift", "unfenced-commit",
+    "unbounded-drain-wait",
 })
 
 WAIVERS: tuple[Waiver, ...] = (

@@ -15,6 +15,13 @@ Scope: files under a `serve` package directory, plus functions anywhere
 whose name says they are a scheduler/serve/retire loop. Loops outside
 that scope are other rules' business — a worker thread may legitimately
 block forever on its feed queue.
+
+A second rule covers the shutdown half of the same failure class:
+`unbounded-drain-wait` flags blocking primitives with no timeout bound
+inside drain-, preemption-, or signal-reachable functions anywhere in
+the tree — a graceful-exit path that can park forever converts a
+bounded-handoff guarantee into a hang the supervisor must SIGKILL out
+of, losing the checkpoint flush the drain existed to protect.
 """
 
 from __future__ import annotations
@@ -135,11 +142,76 @@ def check_blocking_scheduler_loop(
                 )
 
 
+#: Function-name fragments that mark a drain-/preemption-/signal-
+#: reachable path wherever it lives. Deliberately narrower than "stop":
+#: a `stop()` may block on work completion by design, but anything
+#: named for drain, preemption, or signal handling has promised a
+#: bounded exit.
+_DRAIN_NAME_FRAGMENTS = (
+    "drain",
+    "preempt",
+    "shutdown",
+    "sigterm",
+    "sigint",
+    "on_signal",
+    "reap",
+    "handoff",
+    "teardown",
+)
+
+
+def _drain_scoped(sf: SourceFile, node: ast.AST) -> bool:
+    return any(
+        any(frag in func.name.lower() for frag in _DRAIN_NAME_FRAGMENTS)
+        for func in sf.enclosing_functions(node)
+    )
+
+
+def check_unbounded_drain_wait(
+    sf: SourceFile, index: PackageIndex
+) -> Iterator[Finding]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _BLOCKING_ATTRS
+        ):
+            continue
+        if _is_bounded(node, node.func.attr):
+            continue
+        if node.func.attr == "get" and node.args:
+            # q.get() is the canonical unbounded form; a positional
+            # argument here is almost always a mapping key — dict.get
+            # lookups are not blocking waits
+            continue
+        if not _drain_scoped(sf, node):
+            continue
+        yield Finding(
+            rule="unbounded-drain-wait",
+            path=sf.display,
+            line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f".{node.func.attr}() with no timeout on a drain/"
+                "preempt/signal path — a graceful exit that can park "
+                "forever forfeits the bounded-handoff guarantee and "
+                "ends in SIGKILL; pass timeout= and escalate on lapse"
+            ),
+        )
+
+
 RULES = [
     Rule(
         name="blocking-scheduler-loop",
         summary="unbounded queue / blocking wait / sleep inside "
         "scheduler, retire, or serve loops",
         check=check_blocking_scheduler_loop,
+    ),
+    Rule(
+        name="unbounded-drain-wait",
+        summary="blocking wait with no timeout inside drain-, "
+        "preemption-, or signal-reachable functions",
+        check=check_unbounded_drain_wait,
     ),
 ]

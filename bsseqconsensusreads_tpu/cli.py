@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import time
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
 from bsseqconsensusreads_tpu.faults import guard as _guard
@@ -847,7 +848,19 @@ def cmd_submit(args) -> int:
         "chemistry": args.chemistry or None,
     }
     try:
-        resp = request(args.socket, {"op": "submit", "spec": spec})
+        # overload shedding is a *retry* signal, not a failure: honor
+        # the server's retry_after_s hint with bounded backoff until
+        # either admission succeeds or the submit budget lapses
+        deadline = time.monotonic() + args.timeout
+        while True:
+            resp = request(args.socket, {"op": "submit", "spec": spec})
+            if resp.get("ok") or resp.get("guard") != "overloaded":
+                break
+            delay = min(2.0, max(0.05, float(
+                resp.get("retry_after_s") or 0.1)))
+            if time.monotonic() + delay >= deadline:
+                break
+            time.sleep(delay)
         if not resp.get("ok"):
             observe.stderr_line(f"submit refused: {resp.get('error')}")
             return 3
@@ -870,7 +883,7 @@ def cmd_submit(args) -> int:
 
 def cmd_serve_ctl(args) -> int:
     """Operator half of the serve protocol: ping / stats / status /
-    drain against a running engine."""
+    drain / preempt against a running engine or router."""
     from bsseqconsensusreads_tpu.serve.server import request
 
     payload: dict = {"op": args.op}
@@ -881,6 +894,12 @@ def cmd_serve_ctl(args) -> int:
         payload["job"] = args.job
     if args.op == "drain":
         payload["timeout"] = args.timeout
+        payload["sent_s"] = time.time()
+    if args.op == "preempt":
+        if not args.replica:
+            observe.stderr_line("serve-ctl preempt needs --replica")
+            return 2
+        payload["replica"] = args.replica
     try:
         resp = request(args.socket, payload, timeout=args.timeout + 30.0)
     except OSError as exc:
@@ -1212,11 +1231,20 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_submit)
 
     p = sub.add_parser(
-        "serve-ctl", help="ping/stats/status/drain a running serve engine"
+        "serve-ctl",
+        help="ping/stats/status/drain/preempt a running serve engine "
+        "or router",
     )
-    p.add_argument("op", choices=("ping", "stats", "status", "drain"))
+    p.add_argument(
+        "op", choices=("ping", "stats", "status", "drain", "preempt")
+    )
     p.add_argument("--socket", required=True)
     p.add_argument("--job", default="")
+    p.add_argument(
+        "--replica", default="",
+        help="replica id for `preempt`: voluntarily drain one router "
+        "replica — migrate its jobs to survivors, then reap it",
+    )
     p.add_argument("--timeout", type=float, default=600.0)
     p.set_defaults(fn=cmd_serve_ctl)
 

@@ -42,6 +42,7 @@ import bisect
 import dataclasses
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -51,6 +52,7 @@ from collections import deque
 
 from bsseqconsensusreads_tpu.config import FrameworkConfig
 from bsseqconsensusreads_tpu.elastic import fencing as _fencing
+from bsseqconsensusreads_tpu.elastic import preempt as _preempt
 from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.faults import integrity as _integrity
 from bsseqconsensusreads_tpu.io.bam import BamReader, BamWriter
@@ -321,6 +323,7 @@ class SliceLedger:
         self._slice_epoch: dict[int, int] = {}
         self.requeues = 0
         self.workers_lost = 0
+        self.preempts = 0
         self.workers: set[str] = set()
         for sl in slices:
             m = self._verified_manifest(sl)
@@ -532,6 +535,51 @@ class SliceLedger:
                 self._requeue_locked(lease, "lease_expired")
         return len(expired)
 
+    def preempt(self, worker: str, lease_id: str, sid: int,
+                batches_kept: int = 0, epoch: int | None = None) -> dict:
+        """Voluntary drain-and-handoff: the holder finished its
+        in-flight batch, flushed the checkpoint prefix durable, and is
+        handing the lease back BEFORE exiting — so the slice requeues
+        immediately instead of waiting out `lease_s` expiry. The next
+        grant mints a higher fence epoch, which revokes the departed
+        holder exactly as a crash would; a handoff carrying a stale
+        epoch is itself a zombie and is refused `fenced` with the same
+        precedence the publish path enforces (fence before lease
+        bookkeeping)."""
+        fenced_current: int | None = None
+        with self._lock:
+            current = self._slice_epoch.get(sid)
+            if (epoch is not None and current is not None
+                    and int(epoch) < current):
+                fenced_current = current
+            else:
+                lease = self._leases.get(lease_id)
+                if (lease is None or lease["sid"] != sid
+                        or lease["worker"] != worker):
+                    # lapsed (or already requeued) before the handoff
+                    # landed: nothing to release — the expiry path
+                    # already did the work this op would have
+                    return {"ok": False, "reason": "lease_expired"}
+                self._leases.pop(lease_id)
+                self.preempts += 1
+                with observe.bind_trace(
+                    (self.slices.get(sid) or {}).get("trace")
+                ):
+                    observe.emit(
+                        "worker_preempted",
+                        {"worker": worker, "reason": "handoff",
+                         "slice": slice_name(sid),
+                         "batches_kept": int(batches_kept)},
+                    )
+                self._requeue_locked(lease, "preempted")
+        if fenced_current is not None:
+            _fencing.emit_publish_fenced(
+                slice_name(sid), worker, int(epoch), fenced_current,
+                trace=(self.slices.get(sid) or {}).get("trace"),
+            )
+            return {"ok": False, "reason": "fenced", "epoch": fenced_current}
+        return {"ok": True}
+
     def note_worker_dead(self, worker: str) -> None:
         """Supervisor fast path: a reaped worker process requeues its
         leases immediately instead of waiting out the lease clock."""
@@ -569,6 +617,7 @@ class SliceLedger:
                 "leased": len(self._leases),
                 "requeues": self.requeues,
                 "workers_lost": self.workers_lost,
+                "preempts": self.preempts,
                 "workers": len(self.workers),
             }
 
@@ -657,6 +706,15 @@ class Coordinator(ProtocolServer):
                 worker=str(req.get("worker") or ""),
                 epoch=int(epoch) if epoch is not None else None,
             )
+        if op == "preempt":
+            epoch = req.get("epoch")
+            return self.ledger.preempt(
+                str(req.get("worker") or ""),
+                str(req.get("lease_id") or ""),
+                int(req.get("slice", -1)),
+                batches_kept=int(req.get("batches_kept") or 0),
+                epoch=int(epoch) if epoch is not None else None,
+            )
         if op == "slice_fetch":
             return self._slice_fetch(req)
         if op == "slice_push":
@@ -675,6 +733,7 @@ class Coordinator(ProtocolServer):
                 "counters": {
                     "requeues": c["requeues"],
                     "workers_lost": c["workers_lost"],
+                    "preempts": c["preempts"],
                 },
             }}
         return {"ok": False, "error": f"unknown op {op!r}"}
@@ -839,7 +898,15 @@ def _run_fleet(
 ) -> None:
     """Coordinator in-process + N worker subprocesses (the fleet spawn
     idiom: identity env var, one-shot first-life failpoint override,
-    respawn budget)."""
+    respawn budget).
+
+    The supervisor is itself preemptible: SIGTERM/SIGINT latch an
+    interrupt; the loop then SIGTERMs every worker (each does its own
+    voluntary drain-and-handoff), reaps the children inside the grace
+    budget (kill on lapse — no orphans either way), stops respawning,
+    and raises with the ledger counts. The ledger is durable truth, so
+    the interrupted run is resumable: rerunning against the same outdir
+    rescans committed manifests and requeues only unfinished slices."""
     server = Coordinator(ledger, cfg_doc_, addresses=[address], ship=ship)
     server.start_monitor()
     # graftlint: owned-thread -- the accept loop owns the socket; this
@@ -849,6 +916,19 @@ def _run_fleet(
     )
     thread.start()
     deadline = time.monotonic() + timeout_s
+    interrupted = threading.Event()
+    prev_handlers: dict[int, object] = {}
+
+    def _on_signal(signum, frame):  # pragma: no cover - signal context
+        interrupted.set()
+
+    for _sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[_sig] = signal.signal(_sig, _on_signal)
+        except ValueError:
+            # not the main thread (library/test embedding): the caller
+            # owns signal routing; drain still works via the ledger API
+            break
     try:
         while not server.bound:
             if time.monotonic() > deadline:
@@ -892,7 +972,31 @@ def _run_fleet(
             restarts[wid] = 0
             spawn(wid)
 
+        def drain_children() -> None:
+            """SIGTERM every live worker (voluntary handoff), then reap
+            inside the grace budget — kill on lapse. No orphans."""
+            for proc in procs.values():
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+            reap_by = time.monotonic() + _preempt.grace_s()
+            for wid, proc in list(procs.items()):
+                if proc is None:
+                    continue
+                try:
+                    proc.wait(timeout=max(0.5, reap_by - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait(timeout=10.0)
+                procs[wid] = None
+
         while not ledger.all_done():
+            if interrupted.is_set():
+                drain_children()
+                raise ElasticError(
+                    "elastic run interrupted: workers drained and "
+                    f"reaped, ledger resumable at {ledger.rundir} — "
+                    f"{ledger.counts()}"
+                )
             if time.monotonic() > deadline:
                 raise ElasticError(
                     f"elastic run timed out ({timeout_s:.0f}s) with "
@@ -907,7 +1011,7 @@ def _run_fleet(
                     ledger.note_worker_dead(wid)
                 if ledger.all_done():
                     continue
-                if restarts[wid] < max_restarts:
+                if restarts[wid] < max_restarts and not interrupted.is_set():
                     restarts[wid] += 1
                     spawn(wid)
             if all(p is None for p in procs.values()) and not ledger.all_done():
@@ -928,6 +1032,11 @@ def _run_fleet(
                 proc.kill()
                 proc.wait(timeout=10.0)
     finally:
+        for _sig, prev in prev_handlers.items():
+            try:
+                signal.signal(_sig, prev)
+            except (ValueError, TypeError):
+                pass
         server.request_drain()
         thread.join(timeout=10.0)
 
@@ -985,6 +1094,7 @@ def run_elastic(
                                          ledger.manifests())
     report["requeues"] = ledger.requeues
     report["workers_lost"] = ledger.workers_lost
+    report["preempts"] = ledger.preempts
     report["wall_s"] = round(time.monotonic() - t0, 3)
     observe.emit(
         "elastic_run_complete",

@@ -40,6 +40,7 @@ from bsseqconsensusreads_tpu.faults import failpoints as _failpoints
 from bsseqconsensusreads_tpu.faults import integrity as _integrity
 from bsseqconsensusreads_tpu.io.bam import BamReader
 from bsseqconsensusreads_tpu.parallel.multihost import WorkerHeartbeat
+from bsseqconsensusreads_tpu.pipeline import checkpoint as _checkpoint
 from bsseqconsensusreads_tpu.pipeline.bucketemit import (
     BucketPlan,
     blob_bucket_key,
@@ -49,6 +50,7 @@ from bsseqconsensusreads_tpu.serve import transport
 from bsseqconsensusreads_tpu.utils import observe
 
 from bsseqconsensusreads_tpu.elastic import fencing as _fencing
+from bsseqconsensusreads_tpu.elastic import preempt as _preempt
 from bsseqconsensusreads_tpu.elastic.coordinator import (
     ENV_COORDINATOR_ADDR,
     ENV_SPAWNED_AT,
@@ -364,10 +366,64 @@ def _push_output(address: str, sid: int, lease_id: str, epoch,
             raise ElasticError(f"slice_push refused: {resp}")
 
 
+def _handoff(address: str, *, wid: str, sl: dict, lease_id: str, epoch,
+             batches_kept: int, rundir: str, ship: bool,
+             flag: "_preempt.PreemptFlag") -> None:
+    """Voluntary drain-and-handoff after a PreemptedError: persist the
+    handoff manifest (shared-rundir mode; ship successors refetch and
+    resume nothing local), then release the lease with a `preempt` op
+    so the coordinator requeues IMMEDIATELY instead of waiting out
+    `lease_s`. Every step is best-effort under the grace budget — a
+    lapse degrades to the crash path (lease expiry), never a hang."""
+    sname = slice_name(sl["sid"])
+    if not ship:
+        _preempt.write_handoff(
+            os.path.join(rundir, "slices", sname),
+            slice_name=sname, worker=wid, batches_kept=batches_kept,
+        )
+    budget = flag.deadline() - time.monotonic()
+    try:
+        # bind the slice's trace so the preempt frame ships `_trace`
+        # and the coordinator's requeue joins this attempt's causal tree
+        slice_trace = sl.get("trace")
+        with observe.bind_trace(slice_trace):
+            resp = transport.request(
+                address,
+                {"op": "preempt", "worker": wid, "lease_id": lease_id,
+                 "slice": sl["sid"], "epoch": epoch,
+                 "batches_kept": batches_kept},
+                timeout=max(1.0, min(30.0, budget)),
+            )
+    except (OSError, transport.TransportError):
+        # the wire is gone too: exit anyway — the durable prefix is on
+        # disk and lease expiry requeues the slice coordinator-side
+        resp = {"ok": False, "reason": "unreachable"}
+    latency = time.monotonic() - flag.requested_at()
+    if resp.get("ok"):
+        _preempt.emit_handoff_published(
+            slice_name=sname, worker=wid, batches_kept=batches_kept,
+            handoff_latency_s=latency,
+        )
+    else:
+        observe.emit(
+            "elastic_publish_refused",
+            {"slice": sname, "worker": wid, "reason": "preempt_" + str(
+                resp.get("reason") or "refused")},
+        )
+
+
 def work_loop(address: str, worker_id: str | None = None,
               poll_s: float = 0.2) -> int:
     """Join a coordinator and process leased slices until it says done.
-    Returns the number of slices this process published."""
+    Returns the number of slices this process published.
+
+    graftpreempt: SIGTERM latches a preemption instead of killing the
+    process. Mid-slice, the checkpoint batch gate aborts at the next
+    batch boundary (the interrupting batch flushed durable first) and
+    the worker hands the slice back via the `preempt` op; idle or
+    between slices, the worker simply stops leasing and exits 0."""
+    _preempt.install_signal_handler()
+    _checkpoint.install_batch_gate(_preempt.batch_gate())
     wid = worker_id or os.environ.get(ENV_WORKER_ID) or f"pid{os.getpid()}"
     os.environ[ENV_WORKER_ID] = wid
     os.environ[ENV_COORDINATOR_ADDR] = address
@@ -402,6 +458,10 @@ def work_loop(address: str, worker_id: str | None = None,
     wait_t0: float | None = None
     try:
         while True:
+            if _preempt.FLAG.pending():
+                # preempted while holding nothing: no handoff to
+                # publish, just stop leasing and exit clean
+                return processed
             hb.beat(phase="lease_poll")
             grant = transport.request(
                 address, {"op": "lease", "worker": wid}, timeout=60.0
@@ -500,6 +560,19 @@ def work_loop(address: str, worker_id: str | None = None,
                     )
                     _fencing.release()
                     continue
+                except _preempt.PreemptedError as exc:
+                    # voluntary eviction: the batch gate stopped the
+                    # slice at a durable batch boundary — hand the
+                    # lease back explicitly and exit 0. Fencing keeps
+                    # precedence: a revoked epoch raises FencedError
+                    # from the handoff flush itself (caught above)
+                    _handoff(
+                        address, wid=wid, sl=sl, lease_id=lease_id,
+                        epoch=epoch, batches_kept=exc.batches_kept,
+                        rundir=rundir, ship=ship, flag=_preempt.FLAG,
+                    )
+                    _fencing.release()
+                    return processed
                 if resp.get("ok"):
                     _fencing.release()
                     processed += 1

@@ -71,6 +71,25 @@ def _gate(what: str) -> None:
         _WRITE_GATE(what)
 
 
+#: Batch-boundary gate, installed by elastic.preempt in workers: called
+#: with the would-be durable batch count after every batch consumed by
+#: write_batches, and raises PreemptedError once a preemption latch is
+#: set. The pending buffer is flushed BEFORE the error propagates, so
+#: the interrupting batch is durable — handoff latency is bounded by one
+#: batch, never one lease. None everywhere else.
+_BATCH_GATE = None
+
+
+def install_batch_gate(gate) -> None:
+    global _BATCH_GATE
+    _BATCH_GATE = gate
+
+
+def _batch_gate(batches_done: int) -> None:
+    if _BATCH_GATE is not None:
+        _BATCH_GATE(batches_done)
+
+
 @dataclasses.dataclass
 class _Manifest:
     batches_done: int = 0
@@ -302,6 +321,15 @@ class BatchCheckpoint:
         for batch in batches:
             buf.extend(batch)
             pending += 1
+            try:
+                _batch_gate(self.manifest.batches_done + pending)
+            except BaseException:
+                # make the in-flight batch durable before unwinding:
+                # the gate fires at a batch boundary, so `buf` is a
+                # complete prefix — flushing it now is what bounds
+                # handoff latency to one batch instead of one lease
+                self._flush(buf, pending)
+                raise
             if pending == self.every:
                 self._flush(buf, pending)
                 buf, pending = [], 0

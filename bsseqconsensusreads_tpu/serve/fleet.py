@@ -234,7 +234,11 @@ class ReplicaSet:
             try:
                 _transport.request(
                     replica.address,
-                    {"op": "drain", "timeout": drain_timeout},
+                    # sent_s: the replica accounts its drain deadline
+                    # from frame-send time, not receipt — a slow accept
+                    # queue must not extend the budget
+                    {"op": "drain", "timeout": drain_timeout,
+                     "sent_s": time.time()},
                     timeout=drain_timeout + 10.0,
                 )
             except (OSError, ConnectionError):

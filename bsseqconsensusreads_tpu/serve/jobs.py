@@ -42,6 +42,35 @@ class QueueClosed(RuntimeError):
     """Submission refused because the engine is draining or stopped."""
 
 
+#: admission watermark: queue depth at or above which submit sheds
+#: instead of blocking (default: the queue's own maxsize — shedding
+#: engages exactly where the blocking put would have stalled)
+ENV_ADMIT_WATERMARK = "BSSEQ_TPU_ADMIT_WATERMARK"
+
+
+def admit_watermark(default: int) -> int:
+    """Queue-depth shed threshold; 0 disables shedding (legacy
+    blocking-put behavior)."""
+    raw = os.environ.get(ENV_ADMIT_WATERMARK)
+    if raw is None:
+        return default
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return default
+
+
+class OverloadedError(RuntimeError):
+    """Submission shed at the admission watermark. Carries the
+    `retry_after_s` hint the typed `overloaded` transport refusal
+    forwards to the client — backlog-proportional, so a storm's
+    retries spread out instead of re-synchronizing."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.25):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 #: Job lifecycle states (monotonic: queued → running → done|failed).
 QUEUED = "queued"
 RUNNING = "running"
@@ -180,6 +209,10 @@ class JobQueue:
         self._lock = threading.Lock()
         self._seq = 0
         self._closed = False
+        #: overload-shed accounting: every watermark refusal increments
+        #: jobs_shed, and the `jobs_shed` ledger event count must
+        #: reconcile against it (chaos drill overload_shed scenario)
+        self.counters = {"jobs_shed": 0}
 
     # -- submission ------------------------------------------------------
 
@@ -187,11 +220,34 @@ class JobQueue:
         """Admit one job (or raise AdmissionError/QueueClosed). Runs in
         the submitter's thread: validation and the header probe cost the
         tenant who submitted, never the scheduler loop."""
+        shed: tuple[int, int] | None = None
         with self._lock:
             if self._closed:
                 raise QueueClosed("serve engine is draining; job refused")
-            self._seq += 1
-            job_id = f"j{self._seq:04d}"
+            # overload watermark: shed ABOVE capacity instead of
+            # blocking the submitter's connection thread against a full
+            # queue — the typed refusal carries a backlog-proportional
+            # retry hint, so a storm spreads out instead of stacking up
+            depth = self._pending.qsize()
+            watermark = admit_watermark(self._pending.maxsize)
+            if watermark and depth >= watermark:
+                self.counters["jobs_shed"] += 1
+                shed = (depth, watermark)
+            else:
+                self._seq += 1
+                job_id = f"j{self._seq:04d}"
+        if shed is not None:
+            depth, watermark = shed
+            retry = round(min(5.0, max(0.05, 0.02 * depth)), 3)
+            observe.emit(
+                "jobs_shed",
+                {"depth": depth, "watermark": watermark,
+                 "retry_after_s": retry},
+            )
+            raise OverloadedError(
+                f"admission queue at depth {depth} >= watermark "
+                f"{watermark}; job shed", retry_after_s=retry,
+            )
         _failpoints.fire("serve_submit", stage="serve", job=job_id)
         self._admit(spec)
         fp = {

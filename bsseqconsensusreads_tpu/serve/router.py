@@ -36,6 +36,7 @@ survivor without the tenant ever reconnecting.
 from __future__ import annotations
 
 import hashlib
+import subprocess
 import threading
 import time
 
@@ -115,6 +116,7 @@ class Router:
             "jobs_requeued": 0,
             "affinity_hits": 0,
             "replica_restarts": 0,
+            "jobs_shed": 0,
         }
         self._stop = threading.Event()
         self._monitor_thread: threading.Thread | None = None
@@ -179,6 +181,7 @@ class Router:
         return replica, False
 
     def submit(self, spec: dict) -> dict:
+        self._shed_check()
         digest = self._digest(spec)
         with self._lock:
             self._seq += 1
@@ -198,10 +201,40 @@ class Router:
             return resp
         return {"ok": True, "job": job.snapshot()}
 
+    def _shed_check(self) -> None:
+        """Router-tier overload watermark (BSSEQ_TPU_ADMIT_WATERMARK,
+        disabled when unset): at or above `watermark` open routed jobs,
+        admission sheds with the typed `overloaded` refusal + a backlog-
+        proportional retry hint instead of piling more forwards onto a
+        fleet that is already behind."""
+        watermark = _jobs.admit_watermark(0)
+        if not watermark:
+            return
+        with self._lock:
+            depth = sum(
+                1 for j in self._jobs.values() if j.state not in _TERMINAL
+            )
+            if depth < watermark:
+                return
+            self.counters["jobs_shed"] += 1
+        retry = round(min(5.0, max(0.05, 0.02 * depth)), 3)
+        observe.emit(
+            "jobs_shed",
+            {"depth": depth, "watermark": watermark,
+             "retry_after_s": retry},
+        )
+        err = _transport.TransportError(
+            f"router at depth {depth} >= watermark {watermark}; job shed",
+            reason="overloaded",
+        )
+        err.retry_after_s = retry
+        raise err
+
     def _route(self, job: RoutedJob, exclude: str | None) -> dict:
         """Place + forward one job, retrying transient route errors and
         falling through to other replicas on hard ones."""
         last_error = "no live replicas"
+        last_shed: dict | None = None
         tried: set[str] = set([exclude] if exclude else [])
         for _ in range(max(1, len(self.fleet.replicas)) * 2):
             with self._lock:
@@ -214,6 +247,8 @@ class Router:
                     # last refusal (admission errors are the tenant's)
                     alive = {r.rid for r in self.fleet.alive()}
                     if alive <= tried:
+                        if last_shed is not None:
+                            return last_shed
                         return {"ok": False, "error": last_error}
                     # fall through the affinity pin to a fresh replica
                     fresh = [
@@ -249,7 +284,13 @@ class Router:
                     )
                 return resp
             last_error = str(resp.get("error"))
+            if resp.get("guard") == "overloaded":
+                # keep the TYPED refusal: the client's backoff loop
+                # keys on `guard`/`retry_after_s`, not the message
+                last_shed = resp
             tried.add(replica.rid)
+        if last_shed is not None:
+            return last_shed
         return {"ok": False, "error": last_error}
 
     def _forward(self, job: RoutedJob, replica: _fleet.Replica) -> dict:
@@ -258,6 +299,7 @@ class Router:
         transient I/O error exercises exactly the retry the grammar
         promises (chaos: fleet_router_transient_io)."""
         last: Exception | None = None
+        shed_resp: dict | None = None
         with observe.bind_trace(job.trace) as trace_ctx:
             for _ in range(self.forward_retries):
                 try:
@@ -265,7 +307,7 @@ class Router:
                         "fleet_route", stage="fleet", job=job.rid
                     )
                     # trace_ctx bound above rides the wire as `_trace`
-                    return _transport.request(
+                    resp = _transport.request(
                         replica.address,
                         {"op": "submit", "spec": job.spec},
                         timeout=self.forward_timeout,
@@ -277,6 +319,20 @@ class Router:
                     if not replica.alive():
                         break
                     time.sleep(0.05)
+                    continue
+                if (not resp.get("ok")
+                        and resp.get("guard") == "overloaded"):
+                    # typed shed: back off by the replica's own hint
+                    # (bounded by the retry budget), then try again —
+                    # exhaustion falls back to _route's re-placement
+                    shed_resp = resp
+                    time.sleep(
+                        min(2.0, float(resp.get("retry_after_s") or 0.1))
+                    )
+                    continue
+                return resp
+        if shed_resp is not None:
+            return shed_resp
         return {"ok": False, "error": f"forward to {replica.rid}: {last}"}
 
     # -- tenant-facing ops ----------------------------------------------
@@ -496,6 +552,89 @@ class Router:
                     {"replica_id": replica.rid, "error": str(exc)},
                 )
 
+    # -- voluntary replica preemption ------------------------------------
+
+    def preempt_replica(self, replica_id: str,
+                        grace_s: float = 30.0) -> dict:
+        """Voluntary drain of one replica: take it out of placement,
+        migrate its non-retired jobs to survivors (the SAME requeue
+        machinery a death uses — but loudly, `worker_preempted`, and
+        with no respawn), then terminate and reap the process. The
+        monitor never books this exit as a death because the replica is
+        detached from supervision before the process goes down."""
+        replica = self.fleet.lookup(replica_id)
+        if replica is None:
+            return {"ok": False, "error": f"unknown replica {replica_id!r}"}
+        if not replica.alive():
+            return {"ok": False,
+                    "error": f"replica {replica_id} is not alive"}
+        # detach FIRST: supervised -> False and alive() -> False, so the
+        # monitor skips it and placement stops choosing it — without
+        # this, the kill below would race _handle_death into a double
+        # requeue plus an unwanted respawn
+        proc = replica.proc
+        address = replica.address
+        replica.proc = None
+        replica.address = ""
+        with self._lock:
+            orphans = [
+                j for j in self._jobs.values()
+                if j.replica_id == replica_id and j.state not in _TERMINAL
+            ]
+            for job in orphans:
+                job.state = "requeued"
+                job.remote_id = None
+            # affinity pins to a leaving replica would re-place repeat
+            # inputs onto nothing
+            self._affinity = {
+                d: r for d, r in self._affinity.items() if r != replica_id
+            }
+        observe.emit(
+            "worker_preempted",
+            {"worker": replica_id, "reason": "drain",
+             "jobs_migrated": len(orphans)},
+        )
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10.0)
+        elif proc is None and address:
+            # attached (unsupervised) replica: ask it to drain itself;
+            # its own supervisor owns the process
+            try:
+                _transport.request(
+                    address,
+                    {"op": "drain", "timeout": grace_s,
+                     "sent_s": time.time()},
+                    timeout=grace_s + 10.0,
+                )
+            except (OSError, ConnectionError):
+                pass
+        for job in orphans:
+            with self._lock:
+                job.requeues += 1
+                self.counters["jobs_requeued"] += 1
+            resp = self._route(job, exclude=replica_id)
+            with observe.bind_trace(job.trace):
+                observe.emit(
+                    "fleet_requeue",
+                    {
+                        "rjob": job.rid,
+                        "from_replica": replica_id,
+                        "to_replica": job.replica_id,
+                        "ok": bool(resp.get("ok")),
+                    },
+                )
+            if not resp.get("ok"):
+                with self._lock:
+                    job.state = "failed"
+                    job.last = {"error": resp.get("error")}
+        return {"ok": True, "replica": replica_id,
+                "migrated": len(orphans)}
+
 
 class RouterServer(ProtocolServer):
     """The router's socket front: same ops as a single replica, plus
@@ -541,16 +680,10 @@ class RouterServer(ProtocolServer):
             return {"ok": True, "stats": self.router.fleet_stats()}
         if op == "metrics":
             return {"ok": True, "metrics": self.router.metrics_dict()}
-        if op == "drain":
-            self._drain_requested.set()
-            timeout = req.get("timeout")
-            deadline = (
-                None if timeout is None
-                else time.monotonic() + float(timeout)
+        if op == "preempt":
+            return self.router.preempt_replica(
+                str(req.get("replica") or "")
             )
-            while not self._drained.is_set():
-                self._drained.wait(timeout=0.25)
-                if deadline is not None and time.monotonic() >= deadline:
-                    break
-            return {"ok": True, "drained": self._drained.is_set()}
+        if op == "drain":
+            return self._drain_op(req)
         return {"ok": False, "error": f"unknown op {op!r}"}

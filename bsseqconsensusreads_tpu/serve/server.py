@@ -191,7 +191,7 @@ class ServeEngine:
         return {
             "jobs": [j.status() for j in jobs],
             "pending": self.queue.pending_count(),
-            "counters": self.scheduler.counters(),
+            "counters": {**self.scheduler.counters(), **self.queue.counters},
             "engine_alive": self.scheduler.alive,
             "engine_error": self.scheduler.engine_error,
         }
@@ -420,6 +420,22 @@ class ProtocolServer:
                 try:
                     with observe.bind_trace(trace_ctx):
                         resp = self._dispatch(req)
+                except _transport.TransportError as exc:
+                    # typed dispatch refusal (overload shed, drain
+                    # lapse): same answer shape as a refused frame, so
+                    # clients branch on `guard`, not on error strings
+                    resp = {
+                        "ok": False, "error": f"refused: {exc}",
+                        "guard": exc.reason,
+                    }
+                    retry_after = getattr(exc, "retry_after_s", None)
+                    if retry_after is not None:
+                        resp["retry_after_s"] = retry_after
+                    with observe.bind_trace(trace_ctx):
+                        observe.emit(
+                            "serve_frame_refused",
+                            {"reason": exc.reason, "error": str(exc)},
+                        )
                 except Exception as exc:  # protocol errors answer, not crash
                     resp = {
                         "ok": False,
@@ -466,6 +482,32 @@ class ProtocolServer:
 
     # -- subclass surface ------------------------------------------------
 
+    def _drain_op(self, req: dict) -> dict:
+        """The shared `drain` protocol op. The wait deadline accounts
+        from the instant the client SENT the frame (`sent_s`, same-host
+        wall clock) when the request carries it — wire and accept delay
+        spend the caller's budget rather than extending it, the same
+        send-time discipline the lease-renewal pump applies. A lapse is
+        a TYPED `drain_timeout` refusal, never an ambiguous ok."""
+        self._drain_requested.set()
+        timeout = req.get("timeout")
+        budget = None if timeout is None else float(timeout)
+        sent_s = req.get("sent_s")
+        if budget is not None and sent_s is not None:
+            try:
+                budget -= max(0.0, time.time() - float(sent_s))
+            except (TypeError, ValueError):
+                pass  # unparseable stamp: fall back to receipt-time
+        deadline = None if budget is None else time.monotonic() + budget
+        while not self._drained.is_set():
+            if deadline is not None and time.monotonic() >= deadline:
+                raise _transport.TransportError(
+                    f"drain incomplete after {timeout}s from frame send",
+                    reason="drain_timeout",
+                )
+            self._drained.wait(timeout=0.25)
+        return {"ok": True, "drained": True}
+
     def _dispatch(self, req: dict) -> dict:
         raise NotImplementedError
 
@@ -492,6 +534,12 @@ class ServeServer(ProtocolServer):
         if op == "submit":
             try:
                 job = self.engine.submit(req.get("spec") or {})
+            except _jobs.OverloadedError as exc:
+                err = _transport.TransportError(
+                    str(exc), reason="overloaded"
+                )
+                err.retry_after_s = exc.retry_after_s
+                raise err from None
             except (_jobs.AdmissionError, _jobs.QueueClosed) as exc:
                 return {"ok": False, "error": str(exc)}
             return {"ok": True, "job": job.status()}
@@ -514,17 +562,7 @@ class ServeServer(ProtocolServer):
         if op == "metrics":
             return {"ok": True, "metrics": self.engine.metrics_dict()}
         if op == "drain":
-            self._drain_requested.set()
-            timeout = req.get("timeout")
-            deadline = (
-                None if timeout is None
-                else time.monotonic() + float(timeout)
-            )
-            while not self._drained.is_set():
-                self._drained.wait(timeout=0.25)
-                if deadline is not None and time.monotonic() >= deadline:
-                    break
-            return {"ok": True, "drained": self._drained.is_set()}
+            return self._drain_op(req)
         return {"ok": False, "error": f"unknown op {op!r}"}
 
 

@@ -117,6 +117,11 @@ EVENT_SCHEMA: dict[str, tuple[str, ...]] = {
     "publish_fenced": ("slice", "worker", "epoch", "current"),
     "frame_dup_ignored": ("rid", "op"),
     "slice_chunk_resent": ("slice", "offset", "attempt"),
+    # graftpreempt: voluntary drain-and-handoff + overload shedding
+    "worker_preempted": ("worker", "reason"),
+    "handoff_published": ("slice", "worker", "batches_kept",
+                          "handoff_latency_s"),
+    "jobs_shed": ("depth", "watermark", "retry_after_s"),
     # grafttrace (observability): completed causal spans (root spans
     # carry no 'parent' key; trace/span ids also stamp ordinary events)
     # and the crash-path flight-recorder dump

@@ -47,6 +47,7 @@ FIXTURES = {
     "unleased-work-dispatch": "fx_unleased_work_dispatch.py",
     "untraced-transport-send": "fx_untraced_transport_send.py",
     "contract-drift": "fx_contract_drift.py",
+    "unbounded-drain-wait": "fx_unbounded_drain_wait.py",
 }
 
 

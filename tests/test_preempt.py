@@ -1,0 +1,1031 @@
+"""graftpreempt tests: voluntary drain-and-handoff, bounded handoff
+latency, and typed overload shedding.
+
+* latch — the SIGTERM latch is sticky (salvo dedup), carries the grace
+  budget deadline, and the checkpoint batch gate raises PreemptedError
+  with a *durable* batches_kept;
+* flush-first — the batch the latch interrupts is flushed durable
+  BEFORE the error unwinds (vs. the crash path, which loses the
+  partial shard);
+* ledger preempt — a valid preempt releases the lease and requeues the
+  slice IMMEDIATELY (no lease_s wait), with fencing keeping precedence
+  over the lease bookkeeping (a stale-epoch preempt is refused
+  `fenced` exactly like a stale publish);
+* handoff byte-identity — work_loop preempted mid-slice over real tcp
+  (shared-rundir and ship mode) hands off, a successor resumes the
+  durable prefix, and the merge equals the single-process SHA, with
+  `handoff_latency_s` bounded well below the lease;
+* overload — the admission watermark sheds with a typed `overloaded`
+  refusal + retry hint (counter and ledger event reconcile), the
+  router's forward path backs off and converges, and the wire carries
+  the refusal type end-to-end;
+* drain deadlines — `drain` budgets are accounted from frame-SEND time
+  (`sent_s`), refusing typed (`drain_timeout`) on lapse instead of
+  answering an ambiguous ok;
+* supervisor — `cli elastic run` SIGTERM drains and reaps every worker
+  child (no orphans) and leaves a resumable ledger (slow, subprocess).
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bsseqconsensusreads_tpu.config import FrameworkConfig
+from bsseqconsensusreads_tpu.elastic import (
+    Coordinator,
+    SliceLedger,
+    config_doc,
+    fencing,
+    merge as merge_mod,
+    slice_name,
+    split_input,
+    worker as worker_mod,
+)
+from bsseqconsensusreads_tpu.elastic import preempt as preempt_mod
+from bsseqconsensusreads_tpu.faults import failpoints, integrity
+from bsseqconsensusreads_tpu.io.bam import BamHeader, BamWriter
+from bsseqconsensusreads_tpu.pipeline import checkpoint as ckpt_mod
+from bsseqconsensusreads_tpu.pipeline.calling import (
+    call_molecular_batches,
+)
+from bsseqconsensusreads_tpu.pipeline.checkpoint import BatchCheckpoint
+from bsseqconsensusreads_tpu.serve import jobs as jobs_mod
+from bsseqconsensusreads_tpu.serve import router as router_mod
+from bsseqconsensusreads_tpu.serve import transport
+from bsseqconsensusreads_tpu.serve.jobs import JobQueue, JobSpec
+from bsseqconsensusreads_tpu.serve.router import Router
+from bsseqconsensusreads_tpu.serve.server import ProtocolServer
+from bsseqconsensusreads_tpu.utils.testing import (
+    make_grouped_bam_records,
+    random_genome,
+    write_fasta,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """The latch, the batch gate, the fence, and the SIGTERM handler
+    are process-global: every test leaves them as it found them."""
+    yield
+    preempt_mod.FLAG.clear()
+    ckpt_mod.install_batch_gate(None)
+    fencing.release()
+    failpoints.disarm()
+    # in-process work_loop sets the elastic identity env (worker.py);
+    # left behind, observe.emit would stamp THAT worker id over every
+    # later test's payloads
+    os.environ.pop("BSSEQ_TPU_WORKER_ID", None)
+    os.environ.pop("BSSEQ_TPU_COORDINATOR_ADDR", None)
+    try:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    except ValueError:
+        pass
+
+
+def _events(path):
+    out = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def _sha(path):
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# latch + grace budget + batch gate
+
+
+class TestLatch:
+    def test_latch_is_sticky_and_dedups_salvos(self):
+        flag = preempt_mod.PreemptFlag()
+        assert not flag.pending()
+        assert flag.requested_at() == 0.0
+        assert flag.request() is True
+        assert flag.pending()
+        t0 = flag.requested_at()
+        assert t0 > 0.0
+        # the grid sends SIGTERM in salvos: the second must not
+        # restart the latency clock
+        assert flag.request() is False
+        assert flag.requested_at() == t0
+        flag.clear()
+        assert not flag.pending()
+        assert flag.requested_at() == 0.0
+
+    def test_grace_env(self, monkeypatch):
+        monkeypatch.delenv(preempt_mod.ENV_GRACE_S, raising=False)
+        assert preempt_mod.grace_s() == preempt_mod.DEFAULT_GRACE_S
+        monkeypatch.setenv(preempt_mod.ENV_GRACE_S, "12.5")
+        assert preempt_mod.grace_s() == 12.5
+        monkeypatch.setenv(preempt_mod.ENV_GRACE_S, "not-a-float")
+        assert preempt_mod.grace_s() == preempt_mod.DEFAULT_GRACE_S
+
+    def test_deadline_tracks_grace_budget(self, monkeypatch):
+        monkeypatch.setenv(preempt_mod.ENV_GRACE_S, "5")
+        flag = preempt_mod.PreemptFlag()
+        flag.request()
+        assert abs(flag.deadline() - (flag.requested_at() + 5.0)) < 0.01
+
+    def test_sigterm_latches_instead_of_killing(self):
+        flag = preempt_mod.PreemptFlag()
+        assert preempt_mod.install_signal_handler(flag)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not flag.pending() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert flag.pending()
+
+    def test_install_off_main_thread_is_refused_not_fatal(self):
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(
+                ok=preempt_mod.install_signal_handler()
+            )
+        )
+        t.start()
+        t.join(timeout=10.0)
+        assert box["ok"] is False
+
+    def test_batch_gate_raises_with_durable_count(self):
+        flag = preempt_mod.PreemptFlag()
+        gate = preempt_mod.batch_gate(flag)
+        gate(3)  # unlatched: a no-op
+        flag.request()
+        with pytest.raises(preempt_mod.PreemptedError) as ei:
+            gate(3)
+        assert ei.value.batches_kept == 3
+
+
+class TestHandoffManifest:
+    def test_roundtrip_is_atomic(self, tmp_path):
+        sdir = str(tmp_path / "slice_0000")
+        path = preempt_mod.write_handoff(
+            sdir, slice_name="slice_0000", worker="w0", batches_kept=7
+        )
+        assert os.path.basename(path) == preempt_mod.HANDOFF_NAME
+        assert not os.path.exists(path + ".tmp")
+        manifest = preempt_mod.read_handoff(sdir)
+        assert manifest["batches_kept"] == 7
+        # the durable batch count IS the methyl watermark (tallies
+        # flush inside on_flush before the manifest advances)
+        assert manifest["methyl_watermark"] == manifest["batches_kept"]
+        assert manifest["worker"] == "w0"
+
+    def test_read_absent_is_none(self, tmp_path):
+        assert preempt_mod.read_handoff(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# flush-first: the interrupting batch is durable before control unwinds
+
+
+class TestBatchGateFlushFirst:
+    def test_pending_batch_flushed_before_preempt_unwinds(self, tmp_path):
+        rng = np.random.default_rng(77)
+        gname, genome = random_genome(rng, 3000)
+        header, records = make_grouped_bam_records(
+            rng, gname, genome, n_families=40
+        )
+        uh = BamHeader(
+            text="@HD\tVN:1.6\tSO:unsorted\n",
+            references=header.references,
+        )
+        target = str(tmp_path / "consensus.bam")
+        ck = BatchCheckpoint(target, uh, every=2)
+        flag = preempt_mod.PreemptFlag()
+        latched_at = {"batch": None}
+
+        real_gate = preempt_mod.batch_gate(flag)
+
+        def gate(batches_done):
+            if batches_done == 3:
+                flag.request()
+                latched_at["batch"] = batches_done
+            real_gate(batches_done)
+
+        ckpt_mod.install_batch_gate(gate)
+        with pytest.raises(preempt_mod.PreemptedError) as ei:
+            ck.write_batches(
+                call_molecular_batches(iter(records), batch_families=8)
+            )
+        # the crash path (test_checkpoint) keeps only FULL shards: an
+        # interrupt at batch 3 with every=2 would leave 2 durable. The
+        # preempt gate flushes the pending buffer first, so batch 3 —
+        # the batch the latch interrupted — is on disk too.
+        assert latched_at["batch"] == 3
+        assert ei.value.batches_kept == 3
+        assert ck.batches_done == 3
+        manifest = json.loads(
+            (tmp_path / "consensus.bam.ckpt.json").read_text()
+        )
+        assert manifest["batches_done"] == 3
+
+
+# ---------------------------------------------------------------------------
+# ledger: immediate requeue + fencing precedence
+
+
+def _fake_rundir(tmp_path, n=2):
+    rundir = str(tmp_path / "run")
+    specs = []
+    for sid in range(n):
+        os.makedirs(
+            os.path.join(rundir, "slices", slice_name(sid)), exist_ok=True
+        )
+        specs.append({
+            "sid": sid,
+            "path": os.path.join("slices", f"{slice_name(sid)}.bam"),
+            "records": 5 + sid,
+            "families": 2,
+            "family_crc": 1000 + sid,
+            "input_crc": 0,
+        })
+    return rundir, specs
+
+
+def _out(rundir, sid, payload=b"consensus-bytes"):
+    path = os.path.join(rundir, "slices", slice_name(sid), "out.bam")
+    with open(path, "wb") as fh:
+        fh.write(payload)
+    return {
+        "slice": slice_name(sid),
+        "output": "out.bam",
+        "crc": integrity.file_crc32(path),
+        "family_crc": 1000 + sid,
+        "records_out": 2,
+    }
+
+
+class TestLedgerPreempt:
+    def test_preempt_requeues_immediately_no_lease_wait(
+        self, tmp_path, monkeypatch
+    ):
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        # lease_s is an HOUR: only a voluntary release can requeue
+        # inside this test's lifetime
+        ledger = SliceLedger(rundir, specs, lease_s=3600.0)
+        grant = ledger.lease("w0")
+        t0 = time.monotonic()
+        resp = ledger.preempt(
+            "w0", grant["lease_id"], 0, batches_kept=2,
+            epoch=grant.get("fence_epoch"),
+        )
+        assert resp == {"ok": True}
+        regrant = ledger.lease("w1")
+        assert time.monotonic() - t0 < 5.0  # nothing waited for expiry
+        assert regrant["slice"]["sid"] == 0
+        assert regrant["lease_id"] != grant["lease_id"]
+        # the successor's fence epoch supersedes the departed holder's
+        assert regrant["fence_epoch"] > grant["fence_epoch"]
+        counts = ledger.counts()
+        assert counts["preempts"] == 1 and counts["requeues"] == 1
+        events = _events(sink)
+        pre = [e for e in events if e.get("event") == "worker_preempted"]
+        assert len(pre) == 1
+        assert pre[0]["reason"] == "handoff"
+        assert pre[0]["batches_kept"] == 2
+        req = [e for e in events if e.get("event") == "slice_requeued"]
+        assert len(req) == 1 and req[0]["reason"] == "preempted"
+        # the old holder's lease is gone: its heartbeat is refused
+        assert not ledger.heartbeat("w0", grant["lease_id"])
+
+    def test_preempt_unknown_lease_refused(self, tmp_path):
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=3600.0)
+        ledger.lease("w0")
+        resp = ledger.preempt("w0", "no-such-lease", 0)
+        assert resp == {"ok": False, "reason": "lease_expired"}
+        assert ledger.counts()["preempts"] == 0
+
+    def test_preempt_stale_epoch_fenced_with_precedence(
+        self, tmp_path, monkeypatch
+    ):
+        """PR 18 precedence holds for the preempt op too: a preempt
+        carrying an epoch below the slice's current grant is a zombie
+        and is refused `fenced` BEFORE any lease bookkeeping runs —
+        it must not release the successor's lease."""
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        rundir, specs = _fake_rundir(tmp_path, n=1)
+        ledger = SliceLedger(rundir, specs, lease_s=3600.0)
+        stale = ledger.lease("w0")
+        ledger.note_worker_dead("w0")  # requeue: w0 is now a zombie
+        fresh = ledger.lease("w1")
+        assert fresh["fence_epoch"] > stale["fence_epoch"]
+        resp = ledger.preempt(
+            "w0", stale["lease_id"], 0, batches_kept=1,
+            epoch=stale["fence_epoch"],
+        )
+        assert resp["ok"] is False
+        assert resp["reason"] == "fenced"
+        assert resp["epoch"] == fresh["fence_epoch"]
+        assert ledger.counts()["preempts"] == 0
+        # the successor's lease survived the zombie's preempt
+        assert ledger.heartbeat("w1", fresh["lease_id"])
+        fenced = [
+            e for e in _events(sink) if e.get("event") == "publish_fenced"
+        ]
+        assert len(fenced) == 1 and fenced[0]["worker"] == "w0"
+
+
+# ---------------------------------------------------------------------------
+# handoff byte-identity (in-process work_loop over real tcp)
+
+
+N_FAMILIES = 8
+
+
+@pytest.fixture(scope="module")
+def preempt_env(tmp_path_factory):
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+
+    tmp = tmp_path_factory.mktemp("preempt")
+    rng = np.random.default_rng(2008)
+    name, genome = random_genome(rng, 5000)
+    fasta = str(tmp / "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=N_FAMILIES, error_rate=0.01
+    )
+    bam = str(tmp / "preempt.bam")
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    cfg = FrameworkConfig(
+        genome_dir=os.path.dirname(fasta),
+        genome_fasta_file_name=os.path.basename(fasta),
+        aligner="self",
+        # small batches: a slice spans several batches, so the gate
+        # really interrupts MID-slice with a durable prefix behind it
+        batch_families=2,
+    )
+    sp_cfg = dataclasses.replace(cfg, tmp=str(tmp / "sp_tmp"))
+    target, _results, _stats = run_pipeline(
+        sp_cfg, bam, outdir=str(tmp / "single")
+    )
+    return {"bam": bam, "cfg": cfg, "sp_sha": _sha(target)}
+
+
+class TestHandoffByteIdentity:
+    @pytest.mark.parametrize("ship", [False, True])
+    def test_preempted_worker_hands_off_successor_matches_sha(
+        self, preempt_env, tmp_path, monkeypatch, ship
+    ):
+        """SIGTERM mid-slice (the latch set between batches): the
+        worker flushes, writes the handoff manifest (shared-rundir
+        mode), releases its lease via the preempt op, and exits 0; the
+        coordinator requeues immediately; a successor resumes the
+        durable prefix and the merge equals the single-process SHA."""
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        outdir = str(tmp_path / "out")
+        rundir = os.path.join(outdir, "elastic")
+        os.makedirs(rundir, exist_ok=True)
+        cfg = preempt_env["cfg"]
+        specs = split_input(preempt_env["bam"], rundir, 2)
+        lease_s = 300.0
+        ledger = SliceLedger(rundir, specs, lease_s=lease_s)
+        server = Coordinator(
+            ledger, config_doc(cfg), addresses=["tcp:127.0.0.1:0"],
+            ship=ship,
+        )
+        server.start_monitor()
+        # graftlint: owned-thread -- test coordinator accept loop,
+        # drained below
+        thread = threading.Thread(
+            target=server.serve_forever, daemon=True
+        )
+        thread.start()
+
+        # stand-in for SIGTERM: latch once the 2nd batch of the first
+        # leased slice is in flight (the handler does exactly this)
+        arm = {"on": True}
+        real_gate_factory = preempt_mod.batch_gate
+
+        def triggering_gate_factory(flag=None):
+            real = real_gate_factory(flag)
+
+            def gate(batches_done):
+                if arm["on"] and batches_done >= 2:
+                    preempt_mod.FLAG.request()
+                real(batches_done)
+
+            return gate
+
+        monkeypatch.setattr(
+            preempt_mod, "batch_gate", triggering_gate_factory
+        )
+        try:
+            deadline = time.monotonic() + 10.0
+            while not server.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.bound
+            done0 = worker_mod.work_loop(
+                server.bound[0], worker_id="pw0"
+            )
+            assert done0 == 0  # preempted before publishing anything
+            counts = ledger.counts()
+            assert counts["preempts"] == 1
+            assert counts["requeues"] == 1
+            handoff = preempt_mod.read_handoff(
+                os.path.join(rundir, "slices", slice_name(0))
+            )
+            if ship:
+                # shared-nothing: the private workdir is gone with the
+                # worker; successors refetch, nothing lands in rundir
+                assert handoff is None
+            else:
+                assert handoff["batches_kept"] >= 2
+                assert handoff["worker"] == "pw0"
+            # successor: same protocol, no latch
+            arm["on"] = False
+            preempt_mod.FLAG.clear()
+            done1 = worker_mod.work_loop(
+                server.bound[0], worker_id="pw1"
+            )
+            assert done1 == 2  # the requeued slice + the untouched one
+        finally:
+            server.request_drain()
+            thread.join(timeout=10.0)
+        target, report = merge_mod.finalize(
+            cfg, preempt_env["bam"], outdir, specs, ledger.manifests()
+        )
+        assert report["ok"], report["checks"]
+        assert _sha(target) == preempt_env["sp_sha"]
+        events = _events(sink)
+        published = [
+            e for e in events if e.get("event") == "handoff_published"
+        ]
+        assert len(published) == 1
+        assert published[0]["worker"] == "pw0"
+        assert published[0]["batches_kept"] >= 2
+        # THE bound: voluntary handoff must beat lease-expiry recovery
+        # by an order of magnitude — latency is one batch + one rpc
+        latency = published[0]["handoff_latency_s"]
+        assert 0.0 <= latency < 30.0 < lease_s
+        preempted = [
+            e for e in events if e.get("event") == "worker_preempted"
+        ]
+        assert len(preempted) == 1
+        assert preempted[0]["worker"] == "pw0"
+        assert preempted[0]["reason"] == "handoff"
+
+
+# ---------------------------------------------------------------------------
+# overload shedding: watermark, typed refusal, bounded backoff
+
+
+GENOME = "".join(
+    "ACGT"[i] for i in np.random.default_rng(7).integers(0, 4, size=2000)
+)
+
+
+def _grouped_bam(path, seed, n_families=4):
+    header, records = make_grouped_bam_records(
+        np.random.default_rng(seed), f"chr{seed % 97}", GENOME,
+        n_families=n_families, reads_per_strand=(2, 3), read_len=40,
+    )
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write(r)
+
+
+class TestAdmitWatermark:
+    def test_default_passthrough(self, monkeypatch):
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        assert jobs_mod.admit_watermark(64) == 64
+        assert jobs_mod.admit_watermark(0) == 0
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "3")
+        assert jobs_mod.admit_watermark(64) == 3
+
+    def test_bad_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "three")
+        assert jobs_mod.admit_watermark(64) == 64
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "-5")
+        assert jobs_mod.admit_watermark(64) == 0  # clamped: disabled
+
+
+class TestQueueShedding:
+    def _spec(self, tmp_path, k):
+        inp = str(tmp_path / f"in{k}.bam")
+        if not os.path.exists(inp):
+            _grouped_bam(inp, seed=k + 1)
+        return JobSpec.from_dict(
+            {"input": inp, "output": inp + ".out"}
+        )
+
+    def test_sheds_at_watermark_with_reconciled_counter(
+        self, tmp_path, monkeypatch
+    ):
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        q = JobQueue(max_pending=2)  # watermark defaults to capacity
+        q.submit(self._spec(tmp_path, 0))
+        q.submit(self._spec(tmp_path, 1))
+        with pytest.raises(jobs_mod.OverloadedError) as ei:
+            q.submit(self._spec(tmp_path, 2))
+        assert 0.05 <= ei.value.retry_after_s <= 5.0
+        assert q.counters["jobs_shed"] == 1
+        shed = [
+            e for e in _events(sink) if e.get("event") == "jobs_shed"
+        ]
+        # counter and ledger evidence must reconcile 1:1
+        assert len(shed) == q.counters["jobs_shed"] == 1
+        assert shed[0]["depth"] == 2 and shed[0]["watermark"] == 2
+        assert shed[0]["retry_after_s"] == ei.value.retry_after_s
+
+    def test_env_watermark_sheds_below_capacity(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "1")
+        q = JobQueue(max_pending=64)
+        q.submit(self._spec(tmp_path, 0))
+        with pytest.raises(jobs_mod.OverloadedError):
+            q.submit(self._spec(tmp_path, 1))
+        assert q.counters["jobs_shed"] == 1
+
+    def test_shed_is_not_terminal_backlog_drains_then_admits(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "1")
+        q = JobQueue(max_pending=64)
+        q.submit(self._spec(tmp_path, 0))
+        with pytest.raises(jobs_mod.OverloadedError):
+            q.submit(self._spec(tmp_path, 1))
+        # overload is a state, not a verdict: once the backlog drains
+        # the same submit is admitted
+        assert q.claim() is not None
+        job = q.submit(self._spec(tmp_path, 1))
+        assert job.id
+
+
+class _FakeReplica:
+    def __init__(self, rid):
+        self.rid = rid
+        self.address = f"tcp:127.0.0.1:1{rid[1:]}"
+        self.proc = None
+        self.generation = 0
+        self.up = True
+
+    @property
+    def supervised(self):
+        return True
+
+    def alive(self):
+        return self.up
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.replicas = [_FakeReplica(f"r{i}") for i in range(n)]
+
+    def alive(self):
+        return [r for r in self.replicas if r.alive()]
+
+    def lookup(self, rid):
+        for r in self.replicas:
+            if r.rid == rid:
+                return r
+        return None
+
+    def restart(self, replica):
+        replica.generation += 1
+        replica.up = True
+
+
+class TestRouterShedding:
+    def test_router_watermark_sheds_typed(self, monkeypatch, tmp_path):
+        calls = {"n": 0}
+
+        def fake_request(address, payload, timeout=0.0):
+            if payload.get("op") == "submit":
+                calls["n"] += 1
+                return {"ok": True,
+                        "job": {"id": f"j{calls['n']:04d}",
+                                "state": "queued"}}
+            return {"ok": True}
+
+        monkeypatch.setattr(
+            router_mod._transport, "request", fake_request
+        )
+        monkeypatch.setenv(jobs_mod.ENV_ADMIT_WATERMARK, "1")
+        router = Router(replicas=_FakeFleet(2))  # no launch(): no monitor
+        inp = str(tmp_path / "in.bin")
+        with open(inp, "wb") as fh:
+            fh.write(b"x" * 64)
+        assert router.submit({"input": inp, "output": inp + ".o"})["ok"]
+        with pytest.raises(transport.TransportError) as ei:
+            router.submit({"input": inp, "output": inp + ".o2"})
+        assert ei.value.reason == "overloaded"
+        assert 0.05 <= ei.value.retry_after_s <= 5.0
+        assert router.counters["jobs_shed"] == 1
+
+    def test_router_watermark_disabled_without_env(
+        self, monkeypatch, tmp_path
+    ):
+        def fake_request(address, payload, timeout=0.0):
+            if payload.get("op") == "submit":
+                return {"ok": True, "job": {"id": "j1", "state": "queued"}}
+            return {"ok": True}
+
+        monkeypatch.setattr(
+            router_mod._transport, "request", fake_request
+        )
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        router = Router(replicas=_FakeFleet(1))
+        inp = str(tmp_path / "in.bin")
+        with open(inp, "wb") as fh:
+            fh.write(b"x" * 64)
+        for k in range(8):
+            assert router.submit(
+                {"input": inp, "output": f"{inp}.{k}"}
+            )["ok"]
+        assert router.counters["jobs_shed"] == 0
+
+    def test_forward_backs_off_on_replica_shed_and_converges(
+        self, monkeypatch, tmp_path
+    ):
+        """A replica answering `overloaded` is not dead: the forward
+        path sleeps the replica's own retry hint and retries, so a
+        transient storm converges instead of failing the job."""
+        attempts = {"n": 0}
+
+        def fake_request(address, payload, timeout=0.0):
+            if payload.get("op") == "submit":
+                attempts["n"] += 1
+                if attempts["n"] <= 2:
+                    return {"ok": False, "guard": "overloaded",
+                            "error": "refused: shed",
+                            "retry_after_s": 0.01}
+                return {"ok": True,
+                        "job": {"id": "j0001", "state": "queued"}}
+            return {"ok": True}
+
+        monkeypatch.setattr(
+            router_mod._transport, "request", fake_request
+        )
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        router = Router(replicas=_FakeFleet(1))
+        inp = str(tmp_path / "in.bin")
+        with open(inp, "wb") as fh:
+            fh.write(b"x" * 64)
+        resp = router.submit({"input": inp, "output": inp + ".o"})
+        assert resp["ok"]
+        assert attempts["n"] == 3  # two sheds, then admitted
+
+    def test_forward_exhaustion_returns_the_typed_shed(
+        self, monkeypatch, tmp_path
+    ):
+        def fake_request(address, payload, timeout=0.0):
+            if payload.get("op") == "submit":
+                return {"ok": False, "guard": "overloaded",
+                        "error": "refused: shed", "retry_after_s": 0.01}
+            return {"ok": True}
+
+        monkeypatch.setattr(
+            router_mod._transport, "request", fake_request
+        )
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        router = Router(replicas=_FakeFleet(1), forward_retries=2)
+        inp = str(tmp_path / "in.bin")
+        with open(inp, "wb") as fh:
+            fh.write(b"x" * 64)
+        resp = router.submit({"input": inp, "output": inp + ".o"})
+        # the caller sees the typed refusal (retry-able), not a
+        # fabricated transport error
+        assert resp["ok"] is False
+        assert resp.get("guard") == "overloaded"
+
+
+class _Overloaded(ProtocolServer):
+    """Server whose dispatch sheds: the typed-refusal path end-to-end."""
+
+    def _dispatch(self, req):
+        if req.get("op") == "drain":
+            return self._drain_op(req)
+        err = transport.TransportError(
+            "admission queue at depth 9 >= watermark 8; job shed",
+            reason="overloaded",
+        )
+        err.retry_after_s = 0.25
+        raise err
+
+    def _on_drain(self):
+        pass
+
+
+class TestWireRefusal:
+    def test_overloaded_refusal_rides_the_wire_typed(
+        self, tmp_path, monkeypatch
+    ):
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        srv = _Overloaded(addresses=["tcp:127.0.0.1:0"])
+        # graftlint: owned-thread -- test accept loop, drained below
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while not srv.bound and time.monotonic() < deadline:
+                time.sleep(0.01)
+            resp = transport.request(
+                srv.bound[0], {"op": "submit", "spec": {}}, timeout=5.0
+            )
+        finally:
+            srv.request_drain()
+            t.join(timeout=10.0)
+        assert resp["ok"] is False
+        assert resp["guard"] == "overloaded"
+        assert resp["retry_after_s"] == 0.25
+        assert resp["error"].startswith("refused:")
+        refused = [
+            e for e in _events(sink)
+            if e.get("event") == "serve_frame_refused"
+        ]
+        assert any(e["reason"] == "overloaded" for e in refused)
+
+
+# ---------------------------------------------------------------------------
+# drain deadlines accounted from frame-send time (satellite: the same
+# bug class PR 18 fixed in the lease-renewal pump)
+
+
+class _SlowDrain(ProtocolServer):
+    def __init__(self, *a, drain_s=0.0, **k):
+        super().__init__(*a, **k)
+        self.drain_s = drain_s
+
+    def _dispatch(self, req):
+        if req.get("op") == "drain":
+            return self._drain_op(req)
+        return {"ok": True}
+
+    def _on_drain(self):
+        if self.drain_s:
+            time.sleep(self.drain_s)
+
+
+def _serve(srv):
+    # graftlint: owned-thread -- test accept loop, drained by the test
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while not srv.bound and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv.bound
+    return t
+
+
+class TestDrainDeadline:
+    def test_budget_counts_from_send_not_receipt(self):
+        """A drain frame that spent its whole budget in flight (or in
+        the accept queue) is ALREADY late: the server must refuse
+        typed, not grant itself a fresh budget at receipt."""
+        srv = _SlowDrain(addresses=["tcp:127.0.0.1:0"], drain_s=0.0)
+        t = _serve(srv)
+        try:
+            resp = transport.request(
+                srv.bound[0],
+                {"op": "drain", "timeout": 5.0,
+                 "sent_s": time.time() - 60.0},
+                timeout=10.0,
+            )
+        finally:
+            srv.request_drain()
+            t.join(timeout=10.0)
+        assert resp["ok"] is False
+        assert resp["guard"] == "drain_timeout"
+
+    def test_drain_within_budget_completes_ok(self):
+        srv = _SlowDrain(addresses=["tcp:127.0.0.1:0"], drain_s=0.2)
+        t = _serve(srv)
+        try:
+            resp = transport.request(
+                srv.bound[0],
+                {"op": "drain", "timeout": 30.0, "sent_s": time.time()},
+                timeout=60.0,
+            )
+        finally:
+            t.join(timeout=10.0)
+        assert resp == {"ok": True, "drained": True}
+
+    def test_drain_without_sent_s_keeps_receipt_accounting(self):
+        srv = _SlowDrain(addresses=["tcp:127.0.0.1:0"], drain_s=0.2)
+        t = _serve(srv)
+        try:
+            resp = transport.request(
+                srv.bound[0], {"op": "drain", "timeout": 30.0},
+                timeout=60.0,
+            )
+        finally:
+            t.join(timeout=10.0)
+        assert resp == {"ok": True, "drained": True}
+
+
+# ---------------------------------------------------------------------------
+# replica voluntary drain: jobs migrate to survivors, no respawn
+
+
+class TestReplicaDrainMigration:
+    def test_preempt_replica_migrates_jobs_no_respawn(
+        self, monkeypatch, tmp_path
+    ):
+        sink = str(tmp_path / "ledger.jsonl")
+        monkeypatch.setenv("BSSEQ_TPU_STATS", sink)
+        monkeypatch.delenv(jobs_mod.ENV_ADMIT_WATERMARK, raising=False)
+        placements = []
+
+        def fake_request(address, payload, timeout=0.0):
+            if payload.get("op") == "submit":
+                placements.append(address)
+                return {"ok": True,
+                        "job": {"id": f"j{len(placements):04d}",
+                                "state": "queued"}}
+            return {"ok": True}
+
+        monkeypatch.setattr(
+            router_mod._transport, "request", fake_request
+        )
+        fleet = _FakeFleet(2)
+        router = Router(replicas=fleet)
+        inputs = []
+        for k in range(4):
+            p = str(tmp_path / f"in{k}.bin")
+            with open(p, "wb") as fh:
+                fh.write(bytes([k]) * 64)
+            inputs.append(p)
+        for p in inputs:
+            assert router.submit({"input": p, "output": p + ".o"})["ok"]
+        victim = next(
+            j.replica_id for j in router._jobs.values()
+        )
+        orphaned = [
+            j.rid for j in router._jobs.values()
+            if j.replica_id == victim
+        ]
+        assert orphaned
+        resp = router.preempt_replica(victim)
+        assert resp["ok"]
+        assert resp["migrated"] == len(orphaned)
+        survivor = next(
+            r.rid for r in fleet.replicas if r.rid != victim
+        )
+        for rid in orphaned:
+            job = router._jobs[rid]
+            # migrated onto the survivor, never back onto the victim
+            assert job.replica_id == survivor
+            assert job.state not in ("failed",)
+            assert job.requeues == 1
+        # the drained replica is OUT: detached from supervision
+        # (alive() False via empty address) and never respawned
+        replica = fleet.lookup(victim)
+        assert replica.address == ""
+        assert router.counters["jobs_requeued"] == len(orphaned)
+        events = _events(sink)
+        pre = [
+            e for e in events if e.get("event") == "worker_preempted"
+        ]
+        assert len(pre) == 1
+        assert pre[0]["worker"] == victim
+        assert pre[0]["reason"] == "drain"
+        req = [e for e in events if e.get("event") == "fleet_requeue"]
+        assert len(req) == len(orphaned)
+        assert all(e["to_replica"] == survivor for e in req)
+
+    def test_preempt_unknown_replica_refused(self, monkeypatch):
+        monkeypatch.setattr(
+            router_mod._transport, "request",
+            lambda *a, **k: {"ok": True},
+        )
+        router = Router(replicas=_FakeFleet(1))
+        resp = router.preempt_replica("r9")
+        assert resp["ok"] is False and "unknown" in resp["error"]
+
+    def test_preempt_dead_replica_refused(self, monkeypatch):
+        monkeypatch.setattr(
+            router_mod._transport, "request",
+            lambda *a, **k: {"ok": True},
+        )
+        fleet = _FakeFleet(2)
+        fleet.replicas[0].up = False
+        router = Router(replicas=fleet)
+        resp = router.preempt_replica("r0")
+        assert resp["ok"] is False and "not alive" in resp["error"]
+
+
+# ---------------------------------------------------------------------------
+# supervisor SIGTERM: drain + reap, no orphans, resumable ledger (slow)
+
+
+def _children(pid):
+    kids = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as fh:
+                fields = fh.read().split()
+        except OSError:
+            continue
+        if len(fields) > 3 and int(fields[3]) == pid:
+            kids.append(int(entry))
+    return kids
+
+
+@pytest.mark.slow
+class TestSupervisorSignal:
+    def test_sigterm_drains_workers_no_orphans_ledger_resumable(
+        self, preempt_env, tmp_path
+    ):
+        outdir = str(tmp_path / "out")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+            BSSEQ_TPU_STATS=str(tmp_path / "ledger.jsonl"),
+            BSSEQ_TPU_PREEMPT_GRACE_S="10",
+        )
+        env.pop("BSSEQ_TPU_FAILPOINTS", None)
+        cfg = preempt_env["cfg"]
+        fasta = os.path.join(
+            cfg.genome_dir, cfg.genome_fasta_file_name
+        )
+        args = [
+            sys.executable, "-m", "bsseqconsensusreads_tpu.cli",
+            "elastic", "run",
+            "--bam", preempt_env["bam"],
+            "--reference", fasta,
+            "--outdir", outdir,
+            "--workers", "2", "--slices", "2",
+        ]
+        proc = subprocess.Popen(
+            args, cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            deadline = time.monotonic() + 120.0
+            kids = []
+            while time.monotonic() < deadline:
+                kids = _children(proc.pid)
+                if kids or proc.poll() is not None:
+                    break
+                time.sleep(0.1)
+            assert proc.poll() is None, (
+                "run finished before it could be interrupted — "
+                "grow the input"
+            )
+            assert kids, "no worker children appeared"
+            proc.send_signal(signal.SIGTERM)
+            out, err = proc.communicate(timeout=300)
+        except Exception:
+            proc.kill()
+            proc.communicate()
+            raise
+        # the supervisor exits loudly (non-zero) with the drain story
+        assert proc.returncode != 0
+        assert "interrupted" in err
+        assert "resumable" in err
+        # NO orphans: every worker child is reaped (pid gone, or at
+        # worst an exited process someone else owns — never a live
+        # python worker of ours)
+        for pid in kids:
+            assert not os.path.exists(f"/proc/{pid}/stat") or (
+                open(f"/proc/{pid}/stat").read().split()[2] in ("Z", "X")
+            ), f"worker {pid} survived the supervisor drain"
+        # the ledger is terminal + resumable: the SAME command finishes
+        # the run from the rundir the drain left behind
+        cp = subprocess.run(
+            args, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=900,
+        )
+        assert cp.returncode == 0, cp.stderr
+        produced = [
+            os.path.join(outdir, f) for f in os.listdir(outdir)
+            if f.endswith("_consensus_duplex_unfiltered.bam")
+        ]
+        assert len(produced) == 1, f"no merged output in {outdir}"
+        assert _sha(produced[0]) == preempt_env["sp_sha"]

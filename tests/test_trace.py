@@ -544,7 +544,9 @@ class TestMetricsPlane:
         assert m["component"] == "coordinator"
         assert m["slices"] == 1 and m["outstanding_leases"] == 1
         assert m["lease_backlog"] == 0 and m["workers"] == 1
-        assert m["counters"] == {"requeues": 0, "workers_lost": 0}
+        assert m["counters"] == {
+            "requeues": 0, "workers_lost": 0, "preempts": 0,
+        }
 
     def test_observe_top_polls_json_lines(self, served_engine, capsys):
         addr, _eng = served_engine

@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -188,7 +189,8 @@ def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
                  worker_failpoints: str | tuple = (),
                  coordinator_failpoints: str = "",
                  ship: bool = False,
-                 env_extra: dict | None = None):
+                 env_extra: dict | None = None,
+                 popen: bool = False):
     """One `cli elastic run` over the drill input with the drill's
     pipeline geometry (same cfg the _child runs use, so the merged
     output must equal the fault-free reference bytes).
@@ -238,9 +240,37 @@ def _run_elastic(wd: str, bam: str, outdir: str, ledger: str,
         cmd.append("--ship")
     for term in worker_failpoints:
         cmd += ["--worker-failpoints", term]
+    if popen:
+        # the preempt storm signals the run's worker children from the
+        # OUTSIDE mid-flight — the caller owns waiting and reaping
+        return subprocess.Popen(
+            cmd, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
     return subprocess.run(
         cmd, env=env, capture_output=True, text=True, timeout=CHILD_TIMEOUT,
     )
+
+
+def _worker_children(supervisor_pid: int) -> list[int]:
+    """PIDs of `elastic worker` children of one supervisor (via /proc:
+    the drill SIGTERMs workers the way a preempting scheduler would —
+    from outside the process tree, not through the supervisor)."""
+    kids = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as fh:
+                fields = fh.read().split()
+            with open(f"/proc/{entry}/cmdline", "rb") as fh:
+                cmdline = fh.read()
+        except OSError:
+            continue
+        if (len(fields) > 3 and int(fields[3]) == supervisor_pid
+                and b"elastic" in cmdline and b"worker" in cmdline):
+            kids.append(int(entry))
+    return kids
 
 
 def _ledger_counts(path: str) -> dict:
@@ -325,12 +355,13 @@ def _molecular_ref(bam: str, out: str, ledger: str,
     return open(out, "rb").read()
 
 
-def _spawn_serve(sock: str, ledger: str, env_extra: dict | None = None):
+def _spawn_serve(sock: str, ledger: str, env_extra: dict | None = None,
+                 extra: list | None = None):
     from bsseqconsensusreads_tpu.serve.server import request
 
     proc = subprocess.Popen(
         [sys.executable, "-m", "bsseqconsensusreads_tpu.cli", "serve",
-         "--socket", sock, "--batch-families", "16"],
+         "--socket", sock, "--batch-families", "16", *(extra or [])],
         env=_serve_env(ledger, env_extra),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
     )
@@ -1353,6 +1384,185 @@ def run_drill(quick: bool, out_path: str) -> dict:
                 and entry["faults_fired"] >= 1
                 and entry["trace"]["ok"]
             )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftpreempt: the STORM. Every elastic worker child catches
+        # SIGTERM mid-slice (sent from outside the tree, the way a
+        # preempting scheduler does). Each finishes its in-flight
+        # batch, flushes the checkpoint shard + handoff manifest,
+        # releases its lease via the `preempt` op (the coordinator
+        # requeues IMMEDIATELY — no lease_s wait), and exits 0; the
+        # supervisor respawns, successors resume the durable prefix,
+        # and the merge is byte-identical. Three ledgers reconcile:
+        # run-ledger events (worker_preempted == handoff_published),
+        # the slice ledger (report.preempts), and the trace tree
+        # (preempted slices still reach elastic_slice_done).
+        entry = {"ok": False}
+        results["preempt_storm"] = entry
+        _registry_check(events=("worker_preempted", "handoff_published",
+                                "slice_requeued"))
+        ledger = os.path.join(wd, "ps.jsonl")
+        outdir = os.path.join(wd, "out_preempt_storm")
+        t0 = time.monotonic()
+        proc = _run_elastic(
+            wd, bam, outdir, ledger,
+            workers=2, slices=4,
+            env_extra={"BSSEQ_TPU_PREEMPT_GRACE_S": "120"},
+            popen=True,
+        )
+        storm_sigterms = 0
+        try:
+            # arm the storm once both workers hold leases (and give
+            # them a beat to get INSIDE their slices) — the handoff
+            # then has an in-flight batch to finish and flush
+            arm_by = time.monotonic() + CHILD_TIMEOUT
+            while time.monotonic() < arm_by and proc.poll() is None:
+                if _ledger_counts(ledger).get("elastic_lease", 0) >= 2:
+                    time.sleep(0.75)
+                    for pid in _worker_children(proc.pid):
+                        try:
+                            os.kill(pid, signal.SIGTERM)
+                            storm_sigterms += 1
+                        except ProcessLookupError:
+                            continue
+                    if storm_sigterms:
+                        break
+                time.sleep(0.05)
+            out_txt, err_txt = proc.communicate(timeout=CHILD_TIMEOUT)
+        except Exception:
+            proc.kill()
+            proc.communicate()
+            raise
+        entry["storm_sigterms"] = storm_sigterms
+        if proc.returncode != 0:
+            entry["error"] = f"rc={proc.returncode}: {err_txt[-500:]}"
+        elif storm_sigterms == 0:
+            entry["error"] = "run finished before the storm could land"
+        else:
+            out = json.loads(out_txt)
+            counts = _ledger_counts(ledger)
+            latencies = []
+            with open(ledger) as fh:
+                for line in fh:
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if ev.get("event") == "handoff_published":
+                        latencies.append(float(ev["handoff_latency_s"]))
+            lease_s = 30.0  # elastic default (coordinator.DEFAULT_LEASE_S)
+            entry["byte_identical"] = (
+                open(out["target"], "rb").read() == ref_bytes
+            )
+            entry["worker_preempted"] = counts.get("worker_preempted", 0)
+            entry["handoffs_published"] = counts.get("handoff_published", 0)
+            entry["slice_requeued"] = counts.get("slice_requeued", 0)
+            entry["report_preempts"] = out["report"].get("preempts", 0)
+            entry["counters_reconciled"] = out["report"].get("ok", False)
+            entry["max_handoff_latency_s"] = (
+                round(max(latencies), 3) if latencies else None
+            )
+            entry["lease_s"] = lease_s
+            entry["trace"] = _trace_check(ledger, expect_requeued=True)
+            entry["ok"] = (
+                entry["byte_identical"]
+                and entry["counters_reconciled"]
+                and entry["worker_preempted"] >= 1
+                and entry["handoffs_published"] >= 1
+                # every ledger tells the same story: each preempt the
+                # slice ledger counted published exactly one handoff
+                # and requeued exactly one slice in the run ledger
+                and entry["worker_preempted"] == entry["report_preempts"]
+                and entry["handoffs_published"] == entry["report_preempts"]
+                and entry["slice_requeued"] >= entry["report_preempts"]
+                # the bound the tier exists for: voluntary handoff
+                # strictly inside the lease the crash path waits out
+                and entry["max_handoff_latency_s"] is not None
+                and entry["max_handoff_latency_s"] < lease_s
+                and entry["trace"]["ok"]
+            )
+        entry["seconds"] = round(time.monotonic() - t0, 1)
+
+        # graftpreempt: admission storm at ~3x the watermark. The serve
+        # daemon runs with BSSEQ_TPU_ADMIT_WATERMARK=2; six tenants
+        # submit back-to-back, so the overflow is refused with the
+        # typed `overloaded` guard + retry_after_s hint (never a hang,
+        # never a lost job); each refused tenant backs off by the hint
+        # and resubmits, every job retires byte-identical, and the shed
+        # evidence reconciles: refusals seen on the wire == jobs_shed
+        # ledger events == the jobs_shed counter in the drained stats.
+        entry = {"ok": False}
+        results["overload_shed"] = entry
+        _registry_check(events=("jobs_shed",))
+        sock = os.path.join(wd, "serve_ol.sock")
+        ledger = os.path.join(wd, "serve_ol.jsonl")
+        t0 = time.monotonic()
+        # one resident job at a time (--max-active 1) so the queue
+        # really backs up: 1 running + 2 queued == the watermark, and
+        # the fourth submit in the salvo is the first typed refusal
+        proc = _spawn_serve(sock, ledger,
+                            {"BSSEQ_TPU_ADMIT_WATERMARK": "2"},
+                            extra=["--max-active", "1"])
+        try:
+            outs = [os.path.join(wd, f"ol_{k}.out.bam") for k in range(6)]
+            job_ids = []
+            refused_on_wire = 0
+            error = None
+            for opath in outs:
+                spec = {"input": bam, "output": opath}
+                sub_by = time.monotonic() + 300
+                while True:
+                    r = request(sock, {"op": "submit", "spec": spec})
+                    if r.get("ok"):
+                        job_ids.append(r["job"]["id"])
+                        break
+                    if r.get("guard") != "overloaded":
+                        error = f"hard refusal: {r}"
+                        break
+                    if time.monotonic() > sub_by:
+                        error = f"backoff never converged: {r}"
+                        break
+                    refused_on_wire += 1
+                    time.sleep(min(2.0, max(
+                        0.05, float(r.get("retry_after_s") or 0.1)
+                    )))
+                if error:
+                    break
+            if error is not None:
+                entry["error"] = error
+            else:
+                states = []
+                for jid in job_ids:
+                    s = request(sock, {"op": "wait", "job": jid,
+                                       "timeout": 300}, timeout=360)
+                    states.append(s.get("job", {}).get("state"))
+                stats = request(sock, {"op": "stats"})
+                rc = _stop_serve(proc, sock)
+                counts = _ledger_counts(ledger)
+                shed_counter = (
+                    stats.get("stats", {}).get("counters", {})
+                    .get("jobs_shed", 0)
+                )
+                entry["refused_on_wire"] = refused_on_wire
+                entry["jobs_shed_counter"] = shed_counter
+                entry["jobs_shed_events"] = counts.get("jobs_shed", 0)
+                entry["states"] = states
+                entry["identical"] = [
+                    open(o, "rb").read() == clean_ref for o in outs
+                ]
+                entry["ok"] = (
+                    refused_on_wire >= 1
+                    and shed_counter == refused_on_wire
+                    and entry["jobs_shed_events"] == refused_on_wire
+                    and all(s == "done" for s in states)
+                    and len(states) == len(outs)
+                    and all(entry["identical"])
+                    and rc == 0
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
         entry["seconds"] = round(time.monotonic() - t0, 1)
 
     ok = all(v.get("ok") for v in results.values())

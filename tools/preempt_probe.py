@@ -1,0 +1,289 @@
+#!/usr/bin/env python
+"""Preemption probe: measure voluntary drain-and-handoff against the
+lease-expiry recovery it replaces, and prove the bound the tier is
+built around — handoff latency is ONE batch + one rpc, strictly below
+the lease a crash has to wait out.
+
+Two legs, one artifact (PREEMPT_HEAD.json):
+
+* **requeue microbench** (synthetic slice ledger): a worker that
+  vanishes silently costs a full `lease_s` before the monitor's expire
+  scan requeues its slice; a worker that announces itself via the
+  `preempt` op costs one rpc. Both paths are measured wall-clock
+  against the SAME ledger.
+* **pipeline handoff** (real run): an in-process elastic run over a
+  self-aligned input; the first worker latches mid-slice (exactly what
+  the SIGTERM handler does), finishes the in-flight batch, flushes the
+  checkpoint shard + handoff manifest, and releases its lease; a
+  successor resumes the durable prefix. The probe records the
+  `handoff_published.handoff_latency_s` the worker measured and
+  asserts the merged output is byte-identical to a single-process run
+  — preemption must cost latency, never bytes.
+
+Usage:
+    python tools/preempt_probe.py [--quick] [--out PREEMPT_HEAD.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("BSSEQ_TPU_BACKEND", "cpu")
+
+
+def _sha(path: str) -> str:
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+def _events(path: str) -> list[dict]:
+    out = []
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def requeue_microbench(wd: str, lease_s: float = 3.0) -> dict:
+    """Same ledger, both recovery paths: silent loss waits out the
+    lease; a voluntary preempt requeues in one call."""
+    from bsseqconsensusreads_tpu.elastic import SliceLedger, slice_name
+
+    rundir = os.path.join(wd, "micro")
+    specs = []
+    for sid in range(1):
+        os.makedirs(os.path.join(rundir, "slices", slice_name(sid)),
+                    exist_ok=True)
+        specs.append({
+            "sid": sid, "path": os.path.join(
+                "slices", f"{slice_name(sid)}.bam"),
+            "records": 5, "families": 2,
+            "family_crc": 1000, "input_crc": 0,
+        })
+    ledger = SliceLedger(rundir, specs, lease_s=lease_s)
+
+    # leg 1: the worker vanishes — nothing moves until the expire scan
+    # crosses lease_s (scanned at the monitor's cadence)
+    ledger.lease("ghost")
+    t0 = time.monotonic()
+    while ledger.counts()["requeues"] < 1:
+        ledger.expire_scan()
+        if time.monotonic() - t0 > lease_s * 10 + 30:
+            raise RuntimeError("lease never expired")
+        time.sleep(0.02)
+    expiry_recovery_s = time.monotonic() - t0
+
+    # leg 2: the worker says goodbye — the requeue is the rpc itself
+    grant = ledger.lease("polite")
+    t0 = time.monotonic()
+    resp = ledger.preempt(
+        "polite", grant["lease_id"], grant["slice"]["sid"],
+        batches_kept=0, epoch=grant.get("fence_epoch"),
+    )
+    preempt_requeue_s = time.monotonic() - t0
+    if not resp.get("ok"):
+        raise RuntimeError(f"preempt refused: {resp}")
+    if ledger.counts()["requeues"] != 2:
+        raise RuntimeError(f"requeue missing: {ledger.counts()}")
+    return {
+        "lease_s": lease_s,
+        "lease_expiry_recovery_s": round(expiry_recovery_s, 3),
+        "preempt_requeue_s": round(preempt_requeue_s, 6),
+        "speedup": round(expiry_recovery_s / max(preempt_requeue_s, 1e-9)),
+    }
+
+
+def pipeline_handoff(wd: str, quick: bool) -> dict:
+    """One in-process elastic run: worker 0 is preempted mid-slice, a
+    successor resumes the durable prefix, and the merge must equal the
+    single-process SHA. Reports the worker-measured handoff latency."""
+    import numpy as np
+
+    from bsseqconsensusreads_tpu.config import FrameworkConfig
+    from bsseqconsensusreads_tpu.elastic import (
+        Coordinator,
+        SliceLedger,
+        config_doc,
+        merge as merge_mod,
+        slice_name,
+        split_input,
+        worker as worker_mod,
+    )
+    from bsseqconsensusreads_tpu.elastic import preempt as preempt_mod
+    from bsseqconsensusreads_tpu.io.bam import BamWriter
+    from bsseqconsensusreads_tpu.pipeline.stages import run_pipeline
+    from bsseqconsensusreads_tpu.utils.testing import (
+        make_grouped_bam_records,
+        random_genome,
+        write_fasta,
+    )
+
+    n_families, genome_len = (8, 5_000) if quick else (24, 20_000)
+    rng = np.random.default_rng(2020)
+    name, genome = random_genome(rng, genome_len)
+    fasta = os.path.join(wd, "genome.fa")
+    write_fasta(fasta, name, genome)
+    header, records = make_grouped_bam_records(
+        rng, name, genome, n_families=n_families, error_rate=0.01
+    )
+    bam = os.path.join(wd, "probe.bam")
+    with BamWriter(bam, header) as w:
+        w.write_all(records)
+    cfg = FrameworkConfig(
+        genome_dir=wd,
+        genome_fasta_file_name="genome.fa",
+        aligner="self",
+        batch_families=2,
+    )
+    sp_cfg = dataclasses.replace(cfg, tmp=os.path.join(wd, "sp_tmp"))
+    sp_target, _r, _s = run_pipeline(
+        sp_cfg, bam, outdir=os.path.join(wd, "single")
+    )
+    sp_sha = _sha(sp_target)
+
+    sink = os.path.join(wd, "probe_ledger.jsonl")
+    os.environ["BSSEQ_TPU_STATS"] = sink
+    outdir = os.path.join(wd, "out")
+    rundir = os.path.join(outdir, "elastic")
+    os.makedirs(rundir, exist_ok=True)
+    specs = split_input(bam, rundir, 2)
+    lease_s = 30.0
+    ledger = SliceLedger(rundir, specs, lease_s=lease_s)
+    server = Coordinator(
+        ledger, config_doc(cfg), addresses=["tcp:127.0.0.1:0"]
+    )
+    server.start_monitor()
+    # graftlint: owned-thread -- probe coordinator accept loop, drained
+    # before the merge below
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    # stand in for SIGTERM: latch once the second batch of the first
+    # slice is in flight (the signal handler does exactly this)
+    arm = {"on": True}
+    real_gate_factory = preempt_mod.batch_gate
+
+    def triggering_gate_factory(flag=None):
+        real = real_gate_factory(flag)
+
+        def gate(batches_done):
+            if arm["on"] and batches_done >= 2:
+                preempt_mod.FLAG.request()
+            real(batches_done)
+
+        return gate
+
+    preempt_mod.batch_gate = triggering_gate_factory
+    try:
+        deadline = time.monotonic() + 60.0
+        while not server.bound and time.monotonic() < deadline:
+            time.sleep(0.01)
+        done0 = worker_mod.work_loop(server.bound[0], worker_id="probe-w0")
+        arm["on"] = False
+        preempt_mod.FLAG.clear()
+        done1 = worker_mod.work_loop(server.bound[0], worker_id="probe-w1")
+    finally:
+        preempt_mod.batch_gate = real_gate_factory
+        preempt_mod.FLAG.clear()
+        os.environ.pop("BSSEQ_TPU_WORKER_ID", None)
+        os.environ.pop("BSSEQ_TPU_COORDINATOR_ADDR", None)
+        server.request_drain()
+        thread.join(timeout=10.0)
+    target, report = merge_mod.finalize(
+        cfg, bam, outdir, specs, ledger.manifests()
+    )
+    published = [
+        e for e in _events(sink) if e.get("event") == "handoff_published"
+    ]
+    if len(published) != 1:
+        raise RuntimeError(
+            f"expected exactly one handoff, ledger has {len(published)}"
+        )
+    handoff = preempt_mod.read_handoff(
+        os.path.join(rundir, "slices", slice_name(0))
+    )
+    return {
+        "families": n_families,
+        "slices_preempted_then_resumed": done0,
+        "slices_by_successor": done1,
+        "lease_s": lease_s,
+        "handoff_latency_s": float(published[0]["handoff_latency_s"]),
+        "batches_kept": int(published[0]["batches_kept"]),
+        "handoff_manifest": handoff,
+        "byte_identical": _sha(target) == sp_sha,
+        "counters_reconciled": bool(report.get("ok")),
+        "preempts": ledger.counts().get("preempts", 0),
+    }
+
+
+def run_probe(quick: bool, out_path: str) -> dict:
+    with tempfile.TemporaryDirectory(prefix="bsseq_preempt_") as wd:
+        micro = requeue_microbench(wd)
+        pipe = pipeline_handoff(wd, quick)
+    table = {
+        # the crash path: silent loss costs the whole lease before the
+        # expire scan moves the slice
+        "lease_expiry_recovery_s": micro["lease_expiry_recovery_s"],
+        "microbench_lease_s": micro["lease_s"],
+        # the voluntary path: the requeue is one rpc...
+        "preempt_requeue_s": micro["preempt_requeue_s"],
+        # ...and the end-to-end handoff (finish the in-flight batch,
+        # flush the shard, publish) is bounded by one batch
+        "handoff_latency_s": round(pipe["handoff_latency_s"], 3),
+        "run_lease_s": pipe["lease_s"],
+        "handoff_vs_lease_ratio": round(
+            pipe["handoff_latency_s"] / pipe["lease_s"], 4
+        ),
+    }
+    ok = (
+        pipe["byte_identical"]
+        and pipe["counters_reconciled"]
+        and pipe["preempts"] == 1
+        and pipe["batches_kept"] >= 2
+        # THE bound: voluntary handoff strictly below the lease the
+        # crash path waits out — on both the microbench and the run
+        and pipe["handoff_latency_s"] < pipe["lease_s"]
+        and micro["preempt_requeue_s"] < micro["lease_expiry_recovery_s"]
+    )
+    out = {
+        "metric": "preemption: voluntary handoff vs lease-expiry recovery",
+        "ok": ok,
+        "quick": quick,
+        "table": table,
+        "requeue_microbench": micro,
+        "pipeline_handoff": pipe,
+    }
+    with open(out_path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller input (the bench.py ride-along)")
+    ap.add_argument("--out", default=os.path.join(REPO, "PREEMPT_HEAD.json"))
+    args = ap.parse_args()
+    out = run_probe(args.quick, args.out)
+    print(json.dumps(out, indent=1))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
